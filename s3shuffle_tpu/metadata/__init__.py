@@ -1,0 +1,3 @@
+from s3shuffle_tpu.metadata.helper import ShuffleHelper
+
+__all__ = ["ShuffleHelper"]

@@ -1,0 +1,4 @@
+from s3shuffle_tpu.storage.backend import FileStatus, RangedReader, StorageBackend, get_backend
+from s3shuffle_tpu.storage.dispatcher import Dispatcher
+
+__all__ = ["FileStatus", "RangedReader", "StorageBackend", "get_backend", "Dispatcher"]

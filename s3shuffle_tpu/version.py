@@ -1,0 +1,14 @@
+"""Build info, logged at manager startup.
+
+Parity: the reference generates ``SparkS3ShuffleBuild`` via sbt-buildinfo
+(build.sbt:18-27) and logs name/version/spark-version/build-time at manager
+startup (sort/S3ShuffleManager.scala:39-41).
+"""
+
+__version__ = "0.1.0"
+
+BUILD_INFO = {
+    "name": "s3shuffle_tpu",
+    "version": __version__,
+    "target": "tpu (jax/xla/pallas) + cpu fallback",
+}

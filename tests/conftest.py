@@ -1,0 +1,24 @@
+"""Test configuration.
+
+Force JAX onto a virtual 8-device CPU mesh so sharding/collective tests run
+without TPU hardware (the driver separately dry-run-compiles the multi-chip
+path via __graft_entry__.dryrun_multichip).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+from s3shuffle_tpu.storage.dispatcher import Dispatcher  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_dispatcher_singleton():
+    Dispatcher.reset()
+    yield
+    Dispatcher.reset()

@@ -1,0 +1,54 @@
+import pytest
+
+from s3shuffle_tpu.config import MiB, ShuffleConfig
+
+
+def test_defaults_match_reference():
+    # SURVEY.md §5.6 flag table defaults
+    c = ShuffleConfig()
+    assert c.buffer_size == 8 * MiB
+    assert c.max_buffer_size_task == 128 * MiB
+    assert c.max_concurrency_task == 10
+    assert c.cache_partition_lengths and c.cache_checksums and c.cleanup
+    assert c.folder_prefixes == 10
+    assert not c.always_create_index
+    assert c.use_block_manager
+    assert not c.force_batch_fetch
+    assert not c.use_fallback_fetch
+    assert c.checksum_enabled and c.checksum_algorithm == "ADLER32"
+
+
+def test_from_dict_reference_keys():
+    c = ShuffleConfig.from_dict(
+        {
+            "spark.shuffle.s3.rootDir": "memory://bucket/root",
+            "spark.shuffle.s3.bufferSize": "1m",
+            "spark.shuffle.s3.folderPrefixes": "3",
+            "spark.shuffle.s3.cleanup": "false",
+            "spark.shuffle.checksum.algorithm": "CRC32",
+        }
+    )
+    assert c.root_dir == "memory://bucket/root/"
+    assert c.buffer_size == MiB
+    assert c.folder_prefixes == 3
+    assert not c.cleanup
+    assert c.checksum_algorithm == "CRC32"
+
+
+def test_from_env(monkeypatch):
+    monkeypatch.setenv("S3SHUFFLE_MAX_CONCURRENCY_TASK", "4")
+    monkeypatch.setenv("S3SHUFFLE_CHECKSUM_ENABLED", "false")
+    c = ShuffleConfig.from_env()
+    assert c.max_concurrency_task == 4
+    assert not c.checksum_enabled
+
+
+def test_bad_algorithm_raises():
+    # Parity: unsupported algorithms raise (S3ShuffleHelper.scala:94-103)
+    with pytest.raises(ValueError):
+        ShuffleConfig(checksum_algorithm="MD5")
+
+
+def test_unknown_key_raises():
+    with pytest.raises(KeyError):
+        ShuffleConfig.from_dict({"spark.shuffle.s3.nope": "1"})

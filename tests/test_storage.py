@@ -1,0 +1,175 @@
+import threading
+
+import pytest
+
+from s3shuffle_tpu.block_ids import (
+    ShuffleDataBlockId,
+    ShuffleIndexBlockId,
+    parse_index_name,
+)
+from s3shuffle_tpu.config import ShuffleConfig
+from s3shuffle_tpu.storage.backend import MemoryBackend, get_backend
+from s3shuffle_tpu.storage.dispatcher import Dispatcher
+from s3shuffle_tpu.utils.concurrent_map import ConcurrentObjectMap
+
+
+@pytest.fixture(params=["file", "memory"])
+def backend_root(request, tmp_path):
+    if request.param == "file":
+        return f"file://{tmp_path}/shuffle"
+    return f"memory://test-{request.node.name}"
+
+
+def test_backend_roundtrip(backend_root):
+    backend = get_backend(backend_root)
+    path = f"{backend_root}/a/b/obj.data"
+    with backend.create(path) as s:
+        s.write(b"hello ")
+        s.write(b"world")
+    assert backend.status(path).size == 11
+    r = backend.open_ranged(path)
+    assert r.read_fully(0, 5) == b"hello"
+    assert r.read_fully(6, 5) == b"world"
+    assert r.read_fully(6, 100) == b"world"  # short read at EOF
+    r.close()
+    listed = backend.list_prefix(f"{backend_root}/a")
+    assert len(listed) == 1 and listed[0].size == 11
+    backend.delete_prefix(f"{backend_root}/a")
+    assert backend.list_prefix(f"{backend_root}/a") == []
+    assert not backend.exists(path)
+
+
+def test_missing_object_raises(backend_root):
+    backend = get_backend(backend_root)
+    with pytest.raises(FileNotFoundError):
+        backend.status(f"{backend_root}/nope")
+    with pytest.raises(FileNotFoundError):
+        backend.open_ranged(f"{backend_root}/nope")
+
+
+def test_rename(tmp_path):
+    backend = get_backend(f"file://{tmp_path}")
+    src, dst = f"file://{tmp_path}/src.bin", f"file://{tmp_path}/sub/dst.bin"
+    with backend.create(src) as s:
+        s.write(b"x" * 100)
+    assert backend.rename(src, dst)
+    assert backend.status(dst).size == 100
+    assert not backend.exists(src)
+
+
+def test_dispatcher_path_layout(tmp_path):
+    # {root}{mapId % folderPrefixes}/{appId}/{shuffleId}/{name}
+    # (S3ShuffleDispatcher.scala:142-143)
+    cfg = ShuffleConfig(root_dir=f"file://{tmp_path}/root", folder_prefixes=3, app_id="app1")
+    d = Dispatcher(cfg)
+    block = ShuffleDataBlockId(shuffle_id=7, map_id=10)
+    assert d.get_path(block) == f"file://{tmp_path}/root/1/app1/7/shuffle_7_10_0.data"
+
+
+def test_dispatcher_fallback_layout(tmp_path):
+    # {root}{appId}/{shuffleId}/{hash(name) % prefixes}/{name}
+    # (S3ShuffleDispatcher.scala:132-141)
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/root",
+        folder_prefixes=5,
+        app_id="app1",
+        use_fallback_fetch=True,
+    )
+    d = Dispatcher(cfg)
+    block = ShuffleDataBlockId(shuffle_id=7, map_id=10)
+    path = d.get_path(block)
+    assert path.startswith(f"file://{tmp_path}/root/app1/7/")
+    assert path.endswith("/shuffle_7_10_0.data")
+    assert d.get_path(block) == path  # deterministic
+
+
+def test_dispatcher_list_and_remove(tmp_path):
+    cfg = ShuffleConfig(root_dir=f"file://{tmp_path}/root", folder_prefixes=4, app_id="a")
+    d = Dispatcher(cfg)
+    for map_id in range(8):
+        for block in (
+            ShuffleDataBlockId(3, map_id),
+            ShuffleIndexBlockId(3, map_id),
+        ):
+            with d.create_block(block) as s:
+                s.write(b"\x00" * 16)
+    with d.create_block(ShuffleIndexBlockId(4, 0)) as s:
+        s.write(b"\x00" * 8)
+
+    indices = d.list_shuffle_indices(3)
+    assert [b.map_id for b in indices] == list(range(8))
+    assert all(b.shuffle_id == 3 for b in indices)
+
+    d.remove_shuffle(3)
+    assert d.list_shuffle_indices(3) == []
+    assert d.list_shuffle_indices(4) != []  # other shuffle untouched
+    d.remove_root()
+    assert d.list_shuffle_indices(4) == []
+
+
+def test_status_cache_and_invalidation(tmp_path):
+    cfg = ShuffleConfig(root_dir=f"file://{tmp_path}/root", app_id="a")
+    d = Dispatcher(cfg)
+    block = ShuffleDataBlockId(1, 0)
+    with d.create_block(block) as s:
+        s.write(b"abc")
+    path = d.get_path(block)
+    st1 = d.get_file_status_cached(path)
+    # Rewrite the object bigger; cached status must still be returned...
+    with d.create_block(block) as s:
+        s.write(b"abcdef")
+    assert d.get_file_status_cached(path).size == st1.size == 3
+    # ...until invalidated by shuffle id (S3ShuffleDispatcher.scala:211-228).
+    d.close_cached_blocks(1)
+    assert d.get_file_status_cached(path).size == 6
+
+
+def test_parse_index_name():
+    assert parse_index_name("shuffle_1_22_0.index") == ShuffleIndexBlockId(1, 22, 0)
+    assert parse_index_name("some/prefix/shuffle_1_22_0.index") == ShuffleIndexBlockId(1, 22)
+    assert parse_index_name("shuffle_1_22_0.data") is None
+    assert parse_index_name("junk") is None
+
+
+def test_concurrent_object_map_computes_once():
+    m = ConcurrentObjectMap()
+    calls = []
+    barrier = threading.Barrier(8)
+
+    def compute(key):
+        calls.append(key)
+        return key * 2
+
+    def worker():
+        barrier.wait()
+        assert m.get_or_else_put("k", compute) == "kk"
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert calls == ["k"]
+
+
+def test_concurrent_object_map_remove_action():
+    m = ConcurrentObjectMap()
+    m.put("shuffle_1_a", 1)
+    m.put("shuffle_2_b", 2)
+    closed = []
+    removed = m.remove(lambda k: k.startswith("shuffle_1"), closed.append)
+    assert removed == 1 and closed == [1]
+    assert m.get("shuffle_2_b") == 2
+
+
+def test_memory_backend_fault_injection():
+    backend = MemoryBackend()
+    with backend.create("memory://x/obj") as s:
+        s.write(b"data")
+
+    def boom(path):
+        raise OSError("injected")
+
+    backend.open_interceptor = boom
+    with pytest.raises(OSError):
+        backend.open_ranged("memory://x/obj")
