@@ -1,0 +1,54 @@
+"""Reduce-side (and map-side) aggregation.
+
+Parity: the reference hands records to Spark's ``Aggregator``
+(combineValuesByKey / combineCombinersByKey — S3ShuffleReader.scala:124-138);
+this is the framework-native equivalent.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, Iterator, Tuple
+
+
+class Aggregator:
+    def __init__(
+        self,
+        create_combiner: Callable[[Any], Any],
+        merge_value: Callable[[Any, Any], Any],
+        merge_combiners: Callable[[Any, Any], Any],
+    ):
+        self.create_combiner = create_combiner
+        self.merge_value = merge_value
+        self.merge_combiners = merge_combiners
+
+    def combine_values_by_key(
+        self, records: Iterable[Tuple[Any, Any]]
+    ) -> Iterator[Tuple[Any, Any]]:
+        """Used when the map side did NOT pre-combine."""
+        combiners: Dict[Any, Any] = {}
+        for k, v in records:
+            if k in combiners:
+                combiners[k] = self.merge_value(combiners[k], v)
+            else:
+                combiners[k] = self.create_combiner(v)
+        return iter(combiners.items())
+
+    def combine_combiners_by_key(
+        self, records: Iterable[Tuple[Any, Any]]
+    ) -> Iterator[Tuple[Any, Any]]:
+        """Used when map-side combine already produced combiners."""
+        combiners: Dict[Any, Any] = {}
+        for k, c in records:
+            if k in combiners:
+                combiners[k] = self.merge_combiners(combiners[k], c)
+            else:
+                combiners[k] = c
+        return iter(combiners.items())
+
+
+def fold_by_key_aggregator(zero: Any, fn: Callable[[Any, Any], Any]) -> Aggregator:
+    return Aggregator(
+        create_combiner=lambda v: fn(zero, v),
+        merge_value=fn,
+        merge_combiners=fn,
+    )

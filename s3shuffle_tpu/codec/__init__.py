@@ -1,0 +1,61 @@
+"""Block codec registry.
+
+The reference delegates compression to Spark's codec streams
+(``spark.io.compression.*`` — SURVEY.md §0, §7.1); this framework owns the
+codec seam so it can be offloaded: ``none``/``zlib``/``zstd`` (CPU, stdlib),
+``native`` (C++ LZ-class, :mod:`s3shuffle_tpu.codec.native`), and ``tpu``
+(batched Pallas kernels, :mod:`s3shuffle_tpu.codec.tpu`). All codecs share the
+concatenatable block framing in :mod:`s3shuffle_tpu.codec.framing`, which is
+what makes batch fetch legal (the reference requires a concatenatable codec
+for batch reads — S3ShuffleReader.scala:55-75).
+"""
+
+from __future__ import annotations
+
+from s3shuffle_tpu.codec.framing import (
+    CODEC_IDS,
+    CodecInputStream,
+    CodecOutputStream,
+    FrameCodec,
+)
+
+
+def get_codec(name: str, block_size: int = 64 * 1024, level: int = 1) -> "FrameCodec | None":
+    """Resolve a codec by config name. ``none`` → None (raw bytes, no framing,
+    still concatenatable). ``auto`` → native if built, else zlib."""
+    name = (name or "none").lower()
+    if name in ("none", "raw", "off"):
+        return None
+    if name == "auto":
+        try:
+            from s3shuffle_tpu.codec.native import NativeLZCodec
+
+            return NativeLZCodec(block_size=block_size)
+        except Exception:
+            name = "zlib"
+    if name == "zlib":
+        from s3shuffle_tpu.codec.cpu import ZlibCodec
+
+        return ZlibCodec(block_size=block_size, level=level)
+    if name == "zstd":
+        from s3shuffle_tpu.codec.cpu import ZstdCodec
+
+        return ZstdCodec(block_size=block_size, level=level)
+    if name == "native":
+        from s3shuffle_tpu.codec.native import NativeLZCodec
+
+        return NativeLZCodec(block_size=block_size)
+    if name == "tpu":
+        from s3shuffle_tpu.codec.tpu import TpuCodec
+
+        return TpuCodec(block_size=block_size)
+    raise ValueError(f"Unknown codec: {name}")
+
+
+__all__ = [
+    "get_codec",
+    "FrameCodec",
+    "CodecInputStream",
+    "CodecOutputStream",
+    "CODEC_IDS",
+]

@@ -1,0 +1,47 @@
+"""CPU codecs (stdlib zlib, zstandard) behind the shared framing.
+
+These are the default/fallback path, mirroring how the reference leaves
+compression on the JVM CPU via Spark's codec streams; the TPU codec
+(:mod:`s3shuffle_tpu.codec.tpu`) replaces them on the hot path.
+"""
+
+from __future__ import annotations
+
+import zlib
+
+from s3shuffle_tpu.codec.framing import CODEC_IDS, FrameCodec
+
+
+class ZlibCodec(FrameCodec):
+    name = "zlib"
+    codec_id = CODEC_IDS["zlib"]
+
+    def __init__(self, block_size: int = 64 * 1024, level: int = 1):
+        super().__init__(block_size)
+        self.level = level
+
+    def compress_block(self, data: bytes) -> bytes:
+        # raw deflate (wbits=-15): no per-block zlib header/trailer overhead
+        c = zlib.compressobj(self.level, zlib.DEFLATED, -15)
+        return c.compress(data) + c.flush()
+
+    def decompress_block(self, data: bytes, uncompressed_len: int) -> bytes:
+        return zlib.decompress(data, -15, uncompressed_len)
+
+
+class ZstdCodec(FrameCodec):
+    name = "zstd"
+    codec_id = CODEC_IDS["zstd"]
+
+    def __init__(self, block_size: int = 64 * 1024, level: int = 1):
+        super().__init__(block_size)
+        import zstandard
+
+        self._c = zstandard.ZstdCompressor(level=level)
+        self._d = zstandard.ZstdDecompressor()
+
+    def compress_block(self, data: bytes) -> bytes:
+        return self._c.compress(data)
+
+    def decompress_block(self, data: bytes, uncompressed_len: int) -> bytes:
+        return self._d.decompress(data, max_output_size=uncompressed_len)

@@ -1,0 +1,218 @@
+"""Concatenatable block framing shared by every codec.
+
+Wire format per block::
+
+    [u8 codec_id][u32le uncompressed_len][u32le compressed_len][payload]
+
+Properties the read plane relies on:
+
+- **Self-delimiting** — a partition's compressed stream is a sequence of
+  frames; the decoder never needs out-of-band lengths beyond the partition's
+  byte range (which the index provides).
+- **Concatenatable** — concatenating two partitions' streams yields a valid
+  stream, which is what legalizes batch fetch (the reference requires a
+  "concatenation of serialized streams" codec property —
+  S3ShuffleReader.scala:55-75).
+- **Incompressible-block escape** — if compression doesn't shrink a block, it
+  is stored raw (codec_id=0) so worst-case expansion is 9 bytes per block.
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+from typing import BinaryIO, Callable, List, Tuple
+
+from s3shuffle_tpu.utils.io import read_fully as _read_fully
+
+HEADER = struct.Struct("<BII")
+HEADER_SIZE = HEADER.size  # 9 bytes
+
+CODEC_IDS = {
+    "raw": 0,
+    "zlib": 1,
+    "zstd": 2,
+    "native-lz": 3,
+    "tpu-lz": 4,
+}
+_NAMES = {v: k for k, v in CODEC_IDS.items()}
+
+
+class FrameCodec:
+    """One compression algorithm behind the shared framing.
+
+    Subclasses implement block-granular ``compress_block``/``decompress_block``;
+    streaming, framing, and the raw-block escape live here. Batch codecs (TPU)
+    additionally override :meth:`compress_blocks` to process many blocks per
+    device round-trip.
+    """
+
+    name = "abstract"
+    codec_id = 0
+
+    def __init__(self, block_size: int = 64 * 1024):
+        if block_size <= 0:
+            raise ValueError("block_size must be positive")
+        self.block_size = block_size
+
+    # --- block granular (override) ---
+    def compress_block(self, data: bytes) -> bytes:
+        raise NotImplementedError
+
+    def decompress_block(self, data: bytes, uncompressed_len: int) -> bytes:
+        raise NotImplementedError
+
+    # --- batch granular (TPU codecs override for device efficiency) ---
+    def compress_blocks(self, blocks: List[bytes]) -> List[bytes]:
+        return [self.compress_block(b) for b in blocks]
+
+    def decompress_blocks(self, blocks: List[Tuple[bytes, int]]) -> List[bytes]:
+        return [self.decompress_block(b, n) for b, n in blocks]
+
+    # --- framing ---
+    def frame_block(self, raw: bytes) -> bytes:
+        compressed = self.compress_block(raw)
+        if len(compressed) >= len(raw):
+            return HEADER.pack(0, len(raw), len(raw)) + raw
+        return HEADER.pack(self.codec_id, len(raw), len(compressed)) + compressed
+
+    def compress_stream(self, sink: BinaryIO) -> "CodecOutputStream":
+        return CodecOutputStream(self, sink)
+
+    def decompress_stream(self, source: BinaryIO) -> "CodecInputStream":
+        return CodecInputStream(self, source)
+
+    def compress_bytes(self, data: bytes) -> bytes:
+        out = io.BytesIO()
+        s = CodecOutputStream(self, out, close_sink=False)
+        s.write(data)
+        s.close()
+        return out.getvalue()
+
+    def decompress_bytes(self, data: bytes) -> bytes:
+        return self.decompress_stream(io.BytesIO(data)).read()
+
+
+class CodecOutputStream(io.RawIOBase):
+    """Buffers up to ``block_size`` bytes, then emits one frame. ``close``
+    flushes the final short block and closes the sink."""
+
+    def __init__(self, codec: FrameCodec, sink: BinaryIO, close_sink: bool = True):
+        self._codec = codec
+        self._sink = sink
+        self._buf = bytearray()
+        self._close_sink = close_sink
+
+    def writable(self) -> bool:
+        return True
+
+    def write(self, b) -> int:
+        data = bytes(b)
+        self._buf.extend(data)
+        bs = self._codec.block_size
+        while len(self._buf) >= bs:
+            self._emit(bytes(self._buf[:bs]))
+            del self._buf[:bs]
+        return len(data)
+
+    def _emit(self, raw: bytes) -> None:
+        self._sink.write(self._codec.frame_block(raw))
+
+    def flush_block(self) -> None:
+        """Force the current partial block out (used at partition boundaries so
+        partitions never share a frame)."""
+        if self._buf:
+            self._emit(bytes(self._buf))
+            self._buf.clear()
+
+    def close(self) -> None:
+        if not self.closed:
+            self.flush_block()
+            if self._close_sink:
+                self._sink.close()
+            else:
+                try:
+                    self._sink.flush()
+                except (AttributeError, ValueError):
+                    pass
+        super().close()
+
+
+class CodecInputStream(io.RawIOBase):
+    """Reads frames from ``source`` and serves decompressed bytes. Any codec's
+    frames are accepted (the decoder dispatches on codec_id), so readers can
+    decode data written by a different configured codec."""
+
+    def __init__(self, codec: FrameCodec | None, source: BinaryIO):
+        self._codec = codec
+        self._source = source
+        self._current = b""
+        self._pos = 0
+        self._eof = False
+
+    def readable(self) -> bool:
+        return True
+
+    def _fill(self) -> bool:
+        header = _read_fully(self._source, HEADER_SIZE)
+        if not header:
+            self._eof = True
+            return False
+        if len(header) < HEADER_SIZE:
+            raise IOError(f"Truncated frame header ({len(header)} bytes)")
+        codec_id, ulen, clen = HEADER.unpack(header)
+        payload = _read_fully(self._source, clen)
+        if len(payload) < clen:
+            raise IOError(f"Truncated frame payload ({len(payload)}/{clen} bytes)")
+        if codec_id == 0:
+            if ulen != clen:
+                raise IOError("Raw frame with mismatched lengths")
+            self._current = payload
+        else:
+            self._current = decompress_frame_payload(codec_id, payload, ulen, self._codec)
+            if len(self._current) != ulen:
+                raise IOError(
+                    f"Decompressed length {len(self._current)} != header {ulen}"
+                )
+        self._pos = 0
+        return True
+
+    def read(self, size: int = -1) -> bytes:
+        if size is None or size < 0:
+            chunks = []
+            while True:
+                chunk = self.read(1 << 20)
+                if not chunk:
+                    return b"".join(chunks)
+                chunks.append(chunk)
+        while self._pos >= len(self._current):
+            if self._eof or not self._fill():
+                return b""
+        end = min(self._pos + size, len(self._current))
+        out = self._current[self._pos : end]
+        self._pos = end
+        return out
+
+    def close(self) -> None:
+        if not self.closed:
+            self._source.close()
+        super().close()
+
+
+def decompress_frame_payload(
+    codec_id: int, payload: bytes, ulen: int, hint: FrameCodec | None
+) -> bytes:
+    """Dispatch on the frame's codec id; ``hint`` avoids a registry lookup when
+    the configured codec matches (the common case)."""
+    if hint is not None and codec_id == hint.codec_id:
+        return hint.decompress_block(payload, ulen)
+    name = _NAMES.get(codec_id)
+    if name is None:
+        raise IOError(f"Unknown codec id in frame: {codec_id}")
+    from s3shuffle_tpu.codec import get_codec
+
+    codec = get_codec({"native-lz": "native", "tpu-lz": "native", "zlib": "zlib", "zstd": "zstd"}[name])
+    assert codec is not None
+    return codec.decompress_block(payload, ulen)
+
+

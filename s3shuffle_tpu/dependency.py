@@ -1,0 +1,91 @@
+"""Shuffle dependency + partitioners.
+
+Parity: the analog of Spark's ``ShuffleDependency`` (partitioner, serializer,
+aggregator, keyOrdering, mapSideCombine) that the reference's manager receives
+in ``registerShuffle`` (sort/S3ShuffleManager.scala:52-71) and consults in the
+reader (storage/S3ShuffleReader.scala:124-149).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+from s3shuffle_tpu.aggregator import Aggregator
+from s3shuffle_tpu.serializer import PickleBatchSerializer, Serializer
+
+
+class Partitioner:
+    num_partitions: int
+
+    def __call__(self, key: Any) -> int:
+        raise NotImplementedError
+
+
+class HashPartitioner(Partitioner):
+    def __init__(self, num_partitions: int):
+        if num_partitions < 1:
+            raise ValueError("num_partitions must be >= 1")
+        self.num_partitions = num_partitions
+
+    def __call__(self, key: Any) -> int:
+        return _stable_key_hash(key) % self.num_partitions
+
+
+class RangePartitioner(Partitioner):
+    """Key-range partitioner (what sortByKey uses): bounds[i] is the inclusive
+    upper key of partition i; computed from a sample by :func:`range_bounds`."""
+
+    def __init__(self, bounds, key_func: Optional[Callable[[Any], Any]] = None):
+        self.bounds = list(bounds)
+        self.num_partitions = len(self.bounds) + 1
+        self._key = key_func or (lambda k: k)
+
+    def __call__(self, key: Any) -> int:
+        import bisect
+
+        return bisect.bisect_left(self.bounds, self._key(key))
+
+
+def range_bounds(sample_keys, num_partitions: int):
+    keys = sorted(sample_keys)
+    if not keys or num_partitions <= 1:
+        return []
+    step = len(keys) / num_partitions
+    return [keys[min(len(keys) - 1, int(step * (i + 1)))] for i in range(num_partitions - 1)]
+
+
+def _stable_key_hash(key: Any) -> int:
+    """Deterministic across processes (PYTHONHASHSEED-independent) so map and
+    reduce tasks in different processes agree on partition assignment."""
+    import hashlib
+
+    if isinstance(key, int):
+        return key & 0x7FFFFFFF
+    if isinstance(key, bytes):
+        data = key
+    elif isinstance(key, str):
+        data = key.encode("utf-8")
+    else:
+        import pickle
+
+        data = pickle.dumps(key, protocol=4)
+    return int.from_bytes(hashlib.blake2b(data, digest_size=4).digest(), "big") & 0x7FFFFFFF
+
+
+@dataclasses.dataclass
+class ShuffleDependency:
+    shuffle_id: int
+    partitioner: Partitioner
+    serializer: Serializer = dataclasses.field(default_factory=PickleBatchSerializer)
+    aggregator: Optional[Aggregator] = None
+    key_ordering: Optional[Callable[[Any], Any]] = None  # key func; None = no ordering
+    map_side_combine: bool = False
+
+    def __post_init__(self) -> None:
+        if self.map_side_combine and self.aggregator is None:
+            raise ValueError("map_side_combine requires an aggregator")
+
+    @property
+    def num_partitions(self) -> int:
+        return self.partitioner.num_partitions

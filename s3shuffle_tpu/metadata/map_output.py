@@ -1,0 +1,96 @@
+"""Map-output tracking — the control plane.
+
+Parity: the reference's control plane is Spark RPC: map tasks return a
+``MapStatus`` whose location ``S3ShuffleWriter`` rewrites to
+``FALLBACK_BLOCK_MANAGER_ID`` (S3ShuffleWriter.scala:7-21) — the key trick
+that makes shuffle output executor-independent — and reducers enumerate blocks
+via ``MapOutputTracker.getMapSizesByExecutorId`` (S3ShuffleReader.scala:169-176).
+
+Here the tracker is a process-local registry (single-host mode); multi-host
+deployments can instead enumerate via store listing (``use_block_manager=False``
+— the reference's alternative path, S3ShuffleReader.scala:181-196), for which
+the store itself is the metadata service. ``STORE_LOCATION`` is the analog of
+FALLBACK_BLOCK_MANAGER_ID: every committed map output lives in the object
+store, never on a worker.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+# Analog of FallbackStorage.FALLBACK_BLOCK_MANAGER_ID ("fallback", "remote", 7337):
+# shuffle output is addressed to the store, not to any worker.
+STORE_LOCATION = "object-store"
+
+
+@dataclasses.dataclass
+class MapStatus:
+    map_id: int
+    location: str
+    sizes: np.ndarray  # per reduce partition, stored (compressed) bytes
+
+
+class MapOutputTracker:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._shuffles: Dict[int, Dict[int, MapStatus]] = {}
+        self._num_partitions: Dict[int, int] = {}
+
+    def register_shuffle(self, shuffle_id: int, num_partitions: int) -> None:
+        with self._lock:
+            self._shuffles.setdefault(shuffle_id, {})
+            self._num_partitions[shuffle_id] = num_partitions
+
+    def register_map_output(self, shuffle_id: int, status: MapStatus) -> None:
+        with self._lock:
+            if shuffle_id not in self._shuffles:
+                raise KeyError(f"Shuffle {shuffle_id} not registered")
+            self._shuffles[shuffle_id][status.map_id] = status
+
+    def contains(self, shuffle_id: int) -> bool:
+        return shuffle_id in self._shuffles
+
+    def num_partitions(self, shuffle_id: int) -> int:
+        return self._num_partitions[shuffle_id]
+
+    def get_map_sizes_by_range(
+        self,
+        shuffle_id: int,
+        start_map_index: int,
+        end_map_index: Optional[int],
+        start_partition: int,
+        end_partition: int,
+    ) -> List[Tuple[int, List[Tuple[int, int]]]]:
+        """[(map_id, [(reduce_id, size), ...]), ...] for the requested map and
+        partition ranges — the shape MapOutputTracker.getMapSizesByExecutorId
+        returns, minus executor locations (everything is STORE_LOCATION)."""
+        with self._lock:
+            if shuffle_id not in self._shuffles:
+                raise KeyError(f"Shuffle {shuffle_id} not registered")
+            statuses = self._shuffles[shuffle_id]
+            out = []
+            for map_id in sorted(statuses):
+                if map_id < start_map_index:
+                    continue
+                if end_map_index is not None and map_id >= end_map_index:
+                    continue
+                status = statuses[map_id]
+                sizes = [
+                    (rid, int(status.sizes[rid]))
+                    for rid in range(start_partition, end_partition)
+                ]
+                out.append((map_id, sizes))
+            return out
+
+    def unregister_shuffle(self, shuffle_id: int) -> None:
+        with self._lock:
+            self._shuffles.pop(shuffle_id, None)
+            self._num_partitions.pop(shuffle_id, None)
+
+    def shuffle_ids(self) -> List[int]:
+        with self._lock:
+            return list(self._shuffles.keys())

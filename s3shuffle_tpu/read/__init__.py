@@ -1,0 +1,16 @@
+from s3shuffle_tpu.read.block_stream import BlockStream
+from s3shuffle_tpu.read.block_iterator import BlockIterator
+from s3shuffle_tpu.read.prefetch import BufferedPrefetchIterator, ThreadPredictor
+from s3shuffle_tpu.read.checksum_stream import ChecksumError, ChecksumValidationStream
+from s3shuffle_tpu.read.reader import ShuffleReadMetrics, ShuffleReader
+
+__all__ = [
+    "BlockStream",
+    "BlockIterator",
+    "BufferedPrefetchIterator",
+    "ThreadPredictor",
+    "ChecksumError",
+    "ChecksumValidationStream",
+    "ShuffleReader",
+    "ShuffleReadMetrics",
+]

@@ -1,0 +1,69 @@
+"""Maps reduce-side block ids to ranged block streams.
+
+Parity: ``S3ShuffleBlockIterator`` (S3ShuffleBlockIterator.scala:10-57) — for
+each ``ShuffleBlockId`` / ``ShuffleBlockBatchId``, look up the map output's
+cumulative-offset index and build a :class:`BlockStream` over the right byte
+range (:36-43). A missing index means an uncommitted/partial map output: in
+pure-listing mode it is silently skipped, but when ``use_block_manager`` or
+``always_create_index`` is set it is rethrown as a consistency-bug canary
+(:46-53).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Iterable, Iterator, Tuple, Union
+
+from s3shuffle_tpu.block_ids import (
+    ShuffleBlockBatchId,
+    ShuffleBlockId,
+    ShuffleDataBlockId,
+)
+from s3shuffle_tpu.metadata.helper import ShuffleHelper
+from s3shuffle_tpu.read.block_stream import BlockStream
+from s3shuffle_tpu.storage.dispatcher import Dispatcher
+
+logger = logging.getLogger("s3shuffle_tpu.read")
+
+ReadableBlockId = Union[ShuffleBlockId, ShuffleBlockBatchId]
+
+
+class BlockIterator:
+    def __init__(
+        self,
+        dispatcher: Dispatcher,
+        helper: ShuffleHelper,
+        blocks: Iterable[ReadableBlockId],
+    ):
+        self.dispatcher = dispatcher
+        self.helper = helper
+        self._blocks = iter(blocks)
+
+    def __iter__(self) -> Iterator[Tuple[ReadableBlockId, BlockStream]]:
+        must_raise = (
+            self.dispatcher.config.use_block_manager
+            or self.dispatcher.config.always_create_index
+        )
+        for block in self._blocks:
+            if isinstance(block, ShuffleBlockBatchId):
+                start, end = block.start_reduce_id, block.end_reduce_id
+            else:
+                start, end = block.reduce_id, block.reduce_id + 1
+            try:
+                offsets = self.helper.get_partition_lengths(block.shuffle_id, block.map_id)
+            except FileNotFoundError:
+                if must_raise:
+                    # Consistency canary (S3ShuffleBlockIterator.scala:46-53):
+                    # driver metadata said this block exists but no index found.
+                    raise
+                logger.warning("Skipping block %s: missing index (listing mode)", block.name)
+                continue
+            if end >= len(offsets):
+                raise IndexError(
+                    f"Block {block.name} reduce range [{start},{end}) out of bounds "
+                    f"for index with {len(offsets) - 1} partitions"
+                )
+            data_block = ShuffleDataBlockId(block.shuffle_id, block.map_id)
+            yield block, BlockStream(
+                self.dispatcher, block, data_block, int(offsets[start]), int(offsets[end])
+            )

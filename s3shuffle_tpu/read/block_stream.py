@@ -1,0 +1,108 @@
+"""Ranged input stream over a sub-range of a map task's data object.
+
+Parity: ``S3ShuffleBlockStream`` (S3ShuffleBlockStream.scala:16-111):
+
+- serves the byte range ``[offsets[start_reduce], offsets[end_reduce])``;
+- lazily opens the underlying store object on first read (:26-34) — so merely
+  constructing streams for many blocks costs nothing;
+- uses positioned ``read_fully`` (:59, 81) — no shared cursor, prefetch
+  threads can read concurrently;
+- auto-closes the underlying reader when the range is exhausted (:61-63);
+- zero-length ranges never open the object (:38);
+- IO errors are logged and surfaced as EOF (:66-70, 87-92) — the read-side
+  resilience behavior (SURVEY.md §5.3).
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import threading
+from typing import Optional
+
+from s3shuffle_tpu.block_ids import BlockId, ShuffleDataBlockId
+from s3shuffle_tpu.storage.backend import RangedReader
+from s3shuffle_tpu.storage.dispatcher import Dispatcher
+
+logger = logging.getLogger("s3shuffle_tpu.read")
+
+
+class BlockStream(io.RawIOBase):
+    def __init__(
+        self,
+        dispatcher: Dispatcher,
+        block: BlockId,
+        data_block: ShuffleDataBlockId,
+        start_offset: int,
+        end_offset: int,
+    ):
+        if end_offset < start_offset:
+            raise ValueError(f"Invalid range [{start_offset}, {end_offset})")
+        self.dispatcher = dispatcher
+        self.block = block
+        self.data_block = data_block
+        self.start_offset = start_offset
+        self.end_offset = end_offset
+        self.max_bytes = end_offset - start_offset
+        self._pos = start_offset
+        self._reader: Optional[RangedReader] = None
+        self._reader_closed = False
+        self._lock = threading.Lock()
+
+    def readable(self) -> bool:
+        return True
+
+    def _ensure_open(self) -> Optional[RangedReader]:
+        if self._reader is None and not self._reader_closed:
+            self._reader = self.dispatcher.open_block(self.data_block)
+        return self._reader
+
+    def read(self, size: int = -1) -> bytes:
+        with self._lock:
+            remaining = self.end_offset - self._pos
+            if remaining <= 0:
+                self._close_reader()
+                return b""
+            if size is None or size < 0:
+                size = remaining
+            n = min(size, remaining)
+            try:
+                reader = self._ensure_open()
+                if reader is None:
+                    return b""
+                data = reader.read_fully(self._pos, n)
+            except OSError as e:
+                # Log + EOF, matching S3ShuffleBlockStream.scala:66-70.
+                logger.error("Error reading %s range [%d,%d): %s", self.block.name, self._pos, self.end_offset, e)
+                self._close_reader()
+                return b""
+            self._pos += len(data)
+            if self._pos >= self.end_offset or not data:
+                self._close_reader()
+            return data
+
+    def skip(self, n: int) -> int:
+        with self._lock:
+            n = max(0, min(n, self.end_offset - self._pos))
+            self._pos += n
+            if self._pos >= self.end_offset:
+                self._close_reader()
+            return n
+
+    def available(self) -> int:
+        return self.end_offset - self._pos
+
+    def _close_reader(self) -> None:
+        if self._reader is not None:
+            try:
+                self._reader.close()
+            except OSError:
+                pass
+            self._reader = None
+        self._reader_closed = True
+
+    def close(self) -> None:
+        if not self.closed:
+            with self._lock:
+                self._close_reader()
+        super().close()

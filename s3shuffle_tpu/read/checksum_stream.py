@@ -1,0 +1,100 @@
+"""Streaming checksum validation.
+
+Parity: ``S3ChecksumValidationStream`` (S3ChecksumValidationStream.scala:17-92)
+— wraps the raw (stored-byte) stream of a single- or batch-block read and
+walks reduce ids from start to end, updating a running checksum over each
+partition's bytes; at every partition boundary the computed value is compared
+against the map task's stored checksum array and a mismatch raises (:68-86).
+A single ``read`` never crosses a partition boundary (:54-55); zero-length
+partitions are validated and skipped immediately (:79-82).
+"""
+
+from __future__ import annotations
+
+import io
+from typing import BinaryIO
+
+import numpy as np
+
+from s3shuffle_tpu.block_ids import BlockId
+from s3shuffle_tpu.utils.checksums import create_checksum
+
+
+class ChecksumError(IOError):
+    """Parity: SparkException("Invalid checksum detected...")."""
+
+
+class ChecksumValidationStream(io.RawIOBase):
+    def __init__(
+        self,
+        block: BlockId,
+        source: BinaryIO,
+        offsets: np.ndarray,
+        checksums: np.ndarray,
+        start_reduce_id: int,
+        end_reduce_id: int,
+        algorithm: str,
+    ):
+        self._block = block
+        self._source = source
+        self._offsets = offsets
+        self._checksums = checksums
+        self._reduce_id = start_reduce_id
+        self._end_reduce_id = end_reduce_id
+        self._algorithm = algorithm
+        self._checksum = create_checksum(algorithm)
+        self._pos_in_partition = 0
+        self._skip_empty_and_validate()
+
+    def readable(self) -> bool:
+        return True
+
+    def _partition_len(self) -> int:
+        return int(self._offsets[self._reduce_id + 1] - self._offsets[self._reduce_id])
+
+    def _skip_empty_and_validate(self) -> None:
+        # Zero-length partitions validate trivially and advance (scala :79-82).
+        while self._reduce_id < self._end_reduce_id and self._partition_len() == 0:
+            self._validate_current()
+            self._reduce_id += 1
+            self._pos_in_partition = 0
+
+    def _validate_current(self) -> None:
+        expected = int(self._checksums[self._reduce_id]) & 0xFFFFFFFF
+        actual = self._checksum.value
+        if actual != expected:
+            raise ChecksumError(
+                f"Invalid checksum detected for {self._block.name} reduce partition "
+                f"{self._reduce_id} ({self._algorithm}): "
+                f"expected {expected:#010x}, computed {actual:#010x}"
+            )
+        self._checksum.reset()
+
+    def read(self, size: int = -1) -> bytes:
+        if self._reduce_id >= self._end_reduce_id:
+            return b""
+        remaining = self._partition_len() - self._pos_in_partition
+        if size is None or size < 0:
+            size = remaining
+        # Never read past the current partition boundary in one call (:54-55).
+        n = min(size, remaining)
+        data = self._source.read(n) if n > 0 else b""
+        if data:
+            self._checksum.update(data)
+            self._pos_in_partition += len(data)
+        if self._pos_in_partition >= self._partition_len():
+            self._validate_current()
+            self._reduce_id += 1
+            self._pos_in_partition = 0
+            self._skip_empty_and_validate()
+        elif not data:
+            raise ChecksumError(
+                f"Premature EOF in {self._block.name} reduce partition "
+                f"{self._reduce_id}: got {self._pos_in_partition} of {self._partition_len()} bytes"
+            )
+        return data
+
+    def close(self) -> None:
+        if not self.closed:
+            self._source.close()
+        super().close()

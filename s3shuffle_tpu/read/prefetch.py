@@ -1,0 +1,310 @@
+"""Bounded-memory adaptive prefetcher — the read-side concurrency engine.
+
+Parity: ``S3BufferedPrefetchIterator`` (S3BufferedPrefetchIterator.scala:16-213)
+and ``S3BufferedInputStreamAdaptor`` (S3BufferedInputStreamAdaptor.scala:7-59):
+
+- background threads pull (block, stream) pairs and *prefill* each stream's
+  buffer — the actual store GET happens on the prefetch thread (adaptor
+  :13-21), never on the consumer;
+- memory budget: per-stream buffer = ``min(max_buffer_size, stream.max_bytes)``
+  and the sum of in-flight buffers ≤ ``max_buffer_size``; producers wait when
+  over budget (:122-135), consumers notify on stream close (:96-100);
+- completed streams go on a LIFO stack (:30, 146, 209 — LIFO keeps the freshest
+  buffer hot);
+- **ThreadPredictor** (:32-69): a hill-climbing controller over thread count
+  1..max_threads driven by *consumer wait latency* (not throughput — that
+  choice is what keeps it stable on both NFS and S3, SURVEY.md §7.3): wait
+  latencies go into a 20-sample ring; each full ring records the total for the
+  current thread count and moves toward the neighboring count with the lower
+  recorded total, exploring unmeasured neighbors first;
+- thread management: new threads spawn when the target grows (:78-94); threads
+  with id ≥ target retire themselves (:112-115);
+- on exhaustion, per-task stats are logged: bytes, wait/prefetch ms, achieved
+  MiB/s, avg block size, thread count (:155-186).
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import threading
+import time
+from typing import Iterator, List, Optional, Tuple
+
+from s3shuffle_tpu.read.block_stream import BlockStream
+from s3shuffle_tpu.utils.io import read_up_to as _read_up_to
+
+logger = logging.getLogger("s3shuffle_tpu.read")
+
+RING_SIZE = 20
+
+
+class ThreadPredictor:
+    """Latency-driven hill climb over the prefetch thread count."""
+
+    def __init__(self, max_threads: int, initial: int = 1):
+        self.max_threads = max(1, max_threads)
+        self.current = min(max(1, initial), self.max_threads)
+        self._ring: List[int] = []
+        self._totals: dict[int, int] = {}
+
+    def add_measurement_and_predict(self, wait_latency_ns: int) -> int:
+        self._ring.append(wait_latency_ns)
+        if len(self._ring) < RING_SIZE:
+            return self.current
+        total = sum(self._ring)
+        self._ring.clear()
+        self._totals[self.current] = total
+        down = max(1, self.current - 1)
+        up = min(self.max_threads, self.current + 1)
+        # Explore unmeasured neighbors first (optimistically), then move to
+        # whichever measured count had the lowest total wait.
+        for candidate in (up, down):
+            if candidate != self.current and candidate not in self._totals:
+                self.current = candidate
+                return self.current
+        best = min(
+            {c: self._totals[c] for c in {down, self.current, up}}.items(),
+            key=lambda kv: kv[1],
+        )[0]
+        # Re-measure neighbors eventually: forget the losing direction's stale
+        # total so a drifting backend (S3 vs NFS vs page cache) is re-probed.
+        if best != self.current:
+            self._totals.pop(best, None)
+        self.current = best
+        return self.current
+
+
+class PrefetchedBlockStream(io.RawIOBase):
+    """A block stream whose first ``len(buffer)`` bytes were prefetched on a
+    background thread; the remainder (blocks larger than the per-stream buffer)
+    streams through synchronously. ``close`` is idempotent — a double close
+    logs a warning (adaptor :49-58) — and releases budget via ``on_close``."""
+
+    def __init__(self, block, stream: BlockStream, buffer: bytes, on_close):
+        self.block = block
+        self._stream = stream
+        self._buffer = buffer
+        self._pos = 0
+        self._on_close = on_close
+        self._closed_once = False
+        self.buffer_size = len(buffer)
+
+    def readable(self) -> bool:
+        return True
+
+    def read(self, size: int = -1) -> bytes:
+        if size is None or size < 0:
+            return self.readall()
+        if self._pos < len(self._buffer):
+            end = min(self._pos + size, len(self._buffer))
+            out = self._buffer[self._pos : end]
+            self._pos = end
+            return out
+        return self._stream.read(size)
+
+    def readall(self) -> bytes:
+        chunks = []
+        while True:
+            chunk = self.read(1 << 20)
+            if not chunk:
+                return b"".join(chunks)
+            chunks.append(chunk)
+
+    def close(self) -> None:
+        if self._closed_once:
+            if not self.closed:
+                logger.warning("Double close of prefetched stream for %s", self.block)
+            return
+        self._closed_once = True
+        self._stream.close()
+        self._buffer = b""
+        if self._on_close is not None:
+            self._on_close(self.buffer_size)
+        super().close()
+
+
+class BufferedPrefetchIterator:
+    def __init__(
+        self,
+        source: Iterator[Tuple[object, BlockStream]],
+        max_buffer_size: int,
+        max_threads: int = 10,
+    ):
+        self._source = source
+        self._max_buffer_size = max(1, max_buffer_size)
+        self._predictor = ThreadPredictor(max_threads)
+        self._lock = threading.Condition()
+        # Separate lock for pulling source items: next(source) can do store
+        # I/O (index GETs in BlockIterator) and must not serialize completions
+        # or block the consumer on the main condition lock.
+        self._source_lock = threading.Lock()
+        self._completed: List[PrefetchedBlockStream] = []  # LIFO stack
+        self._buffers_in_flight = 0
+        self._active_fetches = 0
+        self._source_exhausted = False
+        self._error: Optional[BaseException] = None
+        self._desired_threads = self._predictor.current
+        self._thread_seq = 0
+        self._threads: List[threading.Thread] = []
+        # stats (printStatistics parity, :155-186)
+        self._stat_bytes = 0
+        self._stat_blocks = 0
+        self._stat_prefetch_ns = 0
+        self._stat_wait_ns = 0
+        self._max_observed_threads = 1
+        self._stats_printed = False
+        self._configure_threads()
+
+    # ------------------------------------------------------------------
+    # Producer side
+    # ------------------------------------------------------------------
+    def _configure_threads(self) -> None:
+        with self._lock:
+            while len(self._threads) < self._desired_threads:
+                tid = self._thread_seq
+                self._thread_seq += 1
+                t = threading.Thread(
+                    target=self._prefetch_loop, args=(tid,), daemon=True, name=f"prefetch-{tid}"
+                )
+                self._threads.append(t)
+                self._max_observed_threads = max(self._max_observed_threads, len(self._threads))
+                t.start()
+            # Threads with id ≥ desired retire themselves in _prefetch_loop.
+
+    def _prefetch_loop(self, thread_id: int) -> None:
+        me = threading.current_thread()
+        while True:
+            with self._lock:
+                # Retire by *position*, not id (ids grow monotonically, so an
+                # id comparison would instantly kill every respawned thread
+                # after a scale-down): the newest len-desired threads retire
+                # (S3BufferedPrefetchIterator.scala:112-115).
+                try:
+                    position = self._threads.index(me)
+                except ValueError:
+                    position = 0
+                if position >= self._desired_threads:
+                    self._threads.remove(me)
+                    self._lock.notify_all()
+                    return
+                if self._source_exhausted or self._error is not None:
+                    self._threads.remove(me)
+                    self._lock.notify_all()
+                    return
+            # Pull the next item outside the main lock — may perform index
+            # GETs inside the source generator.
+            with self._source_lock:
+                if self._source_exhausted:
+                    continue
+                try:
+                    item = next(self._source)
+                except StopIteration:
+                    with self._lock:
+                        self._source_exhausted = True
+                        self._threads.remove(me)
+                        self._lock.notify_all()
+                    return
+                except BaseException as e:  # surface to consumer
+                    with self._lock:
+                        self._error = e
+                        self._source_exhausted = True
+                        self._threads.remove(me)
+                        self._lock.notify_all()
+                    return
+            block, stream = item
+            bsize = min(self._max_buffer_size, max(1, stream.max_bytes))
+            with self._lock:
+                self._active_fetches += 1
+                # Budget wait (:122-135): sum of in-flight buffers ≤ budget.
+                while (
+                    self._buffers_in_flight + bsize > self._max_buffer_size
+                    and self._error is None
+                ):
+                    self._lock.wait(timeout=0.5)
+                self._buffers_in_flight += bsize
+            try:
+                t0 = time.perf_counter_ns()
+                buffer = _read_up_to(stream, bsize)  # ← the actual store GET
+                dt = time.perf_counter_ns() - t0
+                prefetched = PrefetchedBlockStream(block, stream, buffer, self._release_budget(len(buffer), bsize))
+                with self._lock:
+                    self._stat_prefetch_ns += dt
+                    self._stat_bytes += len(buffer)
+                    self._stat_blocks += 1
+                    self._completed.append(prefetched)  # LIFO push
+                    self._active_fetches -= 1
+                    self._lock.notify_all()
+            except BaseException as e:
+                with self._lock:
+                    self._error = e
+                    self._active_fetches -= 1
+                    self._lock.notify_all()
+                return
+
+    def _release_budget(self, actual: int, reserved: int):
+        def on_close(_buffer_size: int) -> None:
+            with self._lock:
+                self._buffers_in_flight -= reserved
+                self._lock.notify_all()
+
+        return on_close
+
+    # ------------------------------------------------------------------
+    # Consumer side
+    # ------------------------------------------------------------------
+    def __iter__(self) -> "BufferedPrefetchIterator":
+        return self
+
+    def __next__(self) -> PrefetchedBlockStream:
+        t0 = time.perf_counter_ns()
+        with self._lock:
+            while not self._completed:
+                if self._error is not None:
+                    raise self._error
+                if self._source_exhausted and self._active_fetches == 0 and not self._threads_alive():
+                    self._print_statistics()
+                    raise StopIteration
+                self._lock.wait(timeout=0.1)
+            item = self._completed.pop()  # LIFO pop (:146, 209)
+            wait_ns = time.perf_counter_ns() - t0
+            self._stat_wait_ns += wait_ns
+            self._desired_threads = self._predictor.add_measurement_and_predict(wait_ns)
+        self._configure_threads()
+        return item
+
+    def _threads_alive(self) -> bool:
+        self._threads = [t for t in self._threads if t.is_alive()]
+        return bool(self._threads)
+
+    def _print_statistics(self) -> None:
+        if self._stats_printed or self._stat_blocks == 0:
+            self._stats_printed = True
+            return
+        self._stats_printed = True
+        total_ns = max(1, self._stat_prefetch_ns)
+        mib = self._stat_bytes / (1024 * 1024)
+        logger.info(
+            "Statistics: %d bytes read in %d blocks (avg %.0f B), waiting %.1f ms, "
+            "prefetching %.1f ms (%.1f MiB/s, %.0f%% waiting), threads=%d",
+            self._stat_bytes,
+            self._stat_blocks,
+            self._stat_bytes / self._stat_blocks,
+            self._stat_wait_ns / 1e6,
+            self._stat_prefetch_ns / 1e6,
+            mib / (total_ns / 1e9),
+            100.0 * self._stat_wait_ns / max(1, self._stat_wait_ns + self._stat_prefetch_ns),
+            self._max_observed_threads,
+        )
+
+    @property
+    def stats(self) -> dict:
+        return {
+            "bytes": self._stat_bytes,
+            "blocks": self._stat_blocks,
+            "wait_ns": self._stat_wait_ns,
+            "prefetch_ns": self._stat_prefetch_ns,
+            "threads": self._max_observed_threads,
+        }
+
+

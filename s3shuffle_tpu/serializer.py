@@ -1,0 +1,167 @@
+"""Record serialization.
+
+Parity: the reference reuses Spark's serializer machinery (Java/Kryo via
+``SerializerManager`` — storage/S3ShuffleReader.scala:98-110); this framework
+owns the seam. A serializer turns (key, value) records into a byte stream and
+back; ``relocatable`` serializers produce streams whose concatenation equals
+the serialization of the concatenated records — the property Spark calls
+``supportsRelocationOfSerializedObjects`` and the reference requires for batch
+fetch (S3ShuffleReader.scala:55-75).
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, BinaryIO, Iterable, Iterator, Tuple
+
+from s3shuffle_tpu.utils.io import read_fully as _read_fully
+
+_U32 = struct.Struct("<I")
+
+
+class Serializer:
+    name = "abstract"
+    relocatable = False
+
+    def new_write_stream(self, sink: BinaryIO) -> "RecordWriter":
+        raise NotImplementedError
+
+    def new_read_stream(self, source: BinaryIO) -> Iterator[Tuple[Any, Any]]:
+        raise NotImplementedError
+
+    def dumps(self, records: Iterable[Tuple[Any, Any]]) -> bytes:
+        import io
+
+        buf = io.BytesIO()
+        w = self.new_write_stream(buf)
+        for k, v in records:
+            w.write(k, v)
+        w.close()
+        return buf.getvalue()
+
+    def loads(self, data: bytes) -> Iterator[Tuple[Any, Any]]:
+        import io
+
+        return self.new_read_stream(io.BytesIO(data))
+
+
+class RecordWriter:
+    def write(self, key: Any, value: Any) -> None:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        """Push any buffered records downstream so the bytes emitted so far
+        form a valid stream prefix (needed at spill boundaries)."""
+
+    def close(self) -> None:
+        raise NotImplementedError
+
+
+# ----------------------------------------------------------------------------
+# Pickle batch serializer (default — arbitrary Python KV)
+# ----------------------------------------------------------------------------
+
+
+class _PickleBatchWriter(RecordWriter):
+    def __init__(self, sink: BinaryIO, batch_size: int):
+        self._sink = sink
+        self._batch: list = []
+        self._batch_size = batch_size
+
+    def write(self, key: Any, value: Any) -> None:
+        self._batch.append((key, value))
+        if len(self._batch) >= self._batch_size:
+            self.flush()
+
+    def flush(self) -> None:
+        if self._batch:
+            payload = pickle.dumps(self._batch, protocol=pickle.HIGHEST_PROTOCOL)
+            self._sink.write(_U32.pack(len(payload)))
+            self._sink.write(payload)
+            self._batch = []
+
+    def close(self) -> None:
+        self.flush()
+
+
+class PickleBatchSerializer(Serializer):
+    """Frames of ``[u32le len][pickle([(k, v), ...])]``. Self-delimiting ⇒
+    relocatable/concatenatable."""
+
+    name = "pickle"
+    relocatable = True
+
+    def __init__(self, batch_size: int = 512):
+        self.batch_size = batch_size
+
+    def new_write_stream(self, sink: BinaryIO) -> RecordWriter:
+        return _PickleBatchWriter(sink, self.batch_size)
+
+    def new_read_stream(self, source: BinaryIO) -> Iterator[Tuple[Any, Any]]:
+        while True:
+            header = source.read(_U32.size)
+            if not header:
+                return
+            if len(header) < _U32.size:
+                raise IOError("Truncated record-batch header")
+            (n,) = _U32.unpack(header)
+            payload = _read_fully(source, n)
+            if len(payload) < n:
+                raise IOError(f"Truncated record batch ({len(payload)}/{n})")
+            yield from pickle.loads(payload)
+
+
+# ----------------------------------------------------------------------------
+# Bytes KV serializer (fast path — terasort-style byte keys/values)
+# ----------------------------------------------------------------------------
+
+
+class _BytesKVWriter(RecordWriter):
+    def __init__(self, sink: BinaryIO):
+        self._sink = sink
+
+    def write(self, key: Any, value: Any) -> None:
+        k = bytes(key)
+        v = bytes(value)
+        self._sink.write(_U32.pack(len(k)) + k + _U32.pack(len(v)) + v)
+
+    def close(self) -> None:
+        pass
+
+
+class BytesKVSerializer(Serializer):
+    """``[u32 klen][key][u32 vlen][value]`` — zero-copy-ish path for byte
+    records (the terasort workload shape)."""
+
+    name = "bytes-kv"
+    relocatable = True
+
+    def new_write_stream(self, sink: BinaryIO) -> RecordWriter:
+        return _BytesKVWriter(sink)
+
+    def new_read_stream(self, source: BinaryIO) -> Iterator[Tuple[bytes, bytes]]:
+        while True:
+            header = source.read(_U32.size)
+            if not header:
+                return
+            if len(header) < _U32.size:
+                raise IOError("Truncated key length")
+            (klen,) = _U32.unpack(header)
+            key = _read_fully(source, klen)
+            vheader = _read_fully(source, _U32.size)
+            if len(key) < klen or len(vheader) < _U32.size:
+                raise IOError("Truncated record")
+            (vlen,) = _U32.unpack(vheader)
+            value = _read_fully(source, vlen)
+            if len(value) < vlen:
+                raise IOError("Truncated value")
+            yield key, value
+
+
+def get_serializer(name: str) -> Serializer:
+    if name in ("pickle", "default"):
+        return PickleBatchSerializer()
+    if name == "bytes-kv":
+        return BytesKVSerializer()
+    raise ValueError(f"Unknown serializer: {name}")

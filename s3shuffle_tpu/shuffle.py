@@ -1,0 +1,189 @@
+"""High-level shuffle API — the end-to-end slice.
+
+The reference is driven by Spark jobs (``foldByKey``/``sortByKey``/... over a
+SparkContext — S3ShuffleManagerTest.scala:176-205); :class:`ShuffleContext` is
+the framework-native equivalent: it owns a manager, runs map tasks and reduce
+tasks on a worker pool (the analog of ``local[N]``), and exposes the classic
+shuffle operations the reference's tests exercise.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Iterable, List, Optional, Sequence, Tuple
+
+from s3shuffle_tpu.aggregator import Aggregator, fold_by_key_aggregator
+from s3shuffle_tpu.config import ShuffleConfig
+from s3shuffle_tpu.dependency import (
+    HashPartitioner,
+    Partitioner,
+    RangePartitioner,
+    ShuffleDependency,
+    range_bounds,
+)
+from s3shuffle_tpu.manager import ShuffleManager
+from s3shuffle_tpu.serializer import Serializer
+
+logger = logging.getLogger("s3shuffle_tpu.context")
+
+
+class ShuffleContext:
+    def __init__(
+        self,
+        config: Optional[ShuffleConfig] = None,
+        manager: Optional[ShuffleManager] = None,
+        num_workers: int = 2,
+    ):
+        self.manager = manager or ShuffleManager(config)
+        self.num_workers = max(1, num_workers)
+        self._next_shuffle_id = itertools.count()
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    def run_shuffle(
+        self,
+        input_partitions: Sequence[Iterable[Tuple[Any, Any]]],
+        num_output_partitions: Optional[int] = None,
+        partitioner: Optional[Partitioner] = None,
+        aggregator: Optional[Aggregator] = None,
+        key_ordering: Optional[Callable[[Any], Any]] = None,
+        map_side_combine: bool = False,
+        serializer: Optional[Serializer] = None,
+        cleanup: bool = True,
+    ) -> List[List[Tuple[Any, Any]]]:
+        """Full shuffle: map tasks write, reduce tasks read. Returns the
+        materialized output partitions."""
+        if partitioner is None:
+            if num_output_partitions is None:
+                raise ValueError("need num_output_partitions or partitioner")
+            partitioner = HashPartitioner(num_output_partitions)
+        shuffle_id = next(self._next_shuffle_id)
+        dep_kwargs = dict(
+            shuffle_id=shuffle_id,
+            partitioner=partitioner,
+            aggregator=aggregator,
+            key_ordering=key_ordering,
+            map_side_combine=map_side_combine,
+        )
+        if serializer is not None:
+            dep_kwargs["serializer"] = serializer
+        dep = ShuffleDependency(**dep_kwargs)
+        handle = self.manager.register_shuffle(shuffle_id, dep)
+
+        def map_task(task: Tuple[int, Iterable[Tuple[Any, Any]]]) -> None:
+            map_id, records = task
+            writer = self.manager.get_writer(handle, map_id)
+            try:
+                writer.write(records)
+                writer.stop(success=True)
+            except BaseException:
+                writer.stop(success=False)
+                raise
+
+        def reduce_task(reduce_id: int) -> List[Tuple[Any, Any]]:
+            reader = self.manager.get_reader(handle, reduce_id, reduce_id + 1)
+            return list(reader.read())
+
+        with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
+            list(pool.map(map_task, enumerate(input_partitions)))
+            outputs = list(pool.map(reduce_task, range(partitioner.num_partitions)))
+        if cleanup:
+            self.manager.unregister_shuffle(shuffle_id)
+        return outputs
+
+    # ------------------------------------------------------------------
+    # The operations the reference's test suite exercises
+    # (S3ShuffleManagerTest.scala:44-174).
+    # ------------------------------------------------------------------
+    def fold_by_key(
+        self,
+        input_partitions: Sequence[Iterable[Tuple[Any, Any]]],
+        zero: Any,
+        fn: Callable[[Any, Any], Any],
+        num_partitions: int,
+        map_side_combine: bool = True,
+    ) -> List[Tuple[Any, Any]]:
+        agg = fold_by_key_aggregator(zero, fn)
+        out = self.run_shuffle(
+            input_partitions,
+            num_partitions,
+            aggregator=agg,
+            map_side_combine=map_side_combine,
+        )
+        return [kv for part in out for kv in part]
+
+    def combine_by_key(
+        self,
+        input_partitions: Sequence[Iterable[Tuple[Any, Any]]],
+        create_combiner: Callable[[Any], Any],
+        merge_value: Callable[[Any, Any], Any],
+        merge_combiners: Callable[[Any, Any], Any],
+        num_partitions: int,
+        map_side_combine: bool = True,
+    ) -> List[Tuple[Any, Any]]:
+        agg = Aggregator(create_combiner, merge_value, merge_combiners)
+        out = self.run_shuffle(
+            input_partitions,
+            num_partitions,
+            aggregator=agg,
+            map_side_combine=map_side_combine,
+        )
+        return [kv for part in out for kv in part]
+
+    def group_by_key(
+        self,
+        input_partitions: Sequence[Iterable[Tuple[Any, Any]]],
+        num_partitions: int,
+    ) -> List[Tuple[Any, List[Any]]]:
+        """No map-side combine — the dependency shape of the reference's
+        runWithSparkConf_noMapSideCombine test (:56-73)."""
+        agg = Aggregator(
+            create_combiner=lambda v: [v],
+            merge_value=lambda acc, v: acc + [v],
+            merge_combiners=lambda a, b: a + b,
+        )
+        out = self.run_shuffle(
+            input_partitions, num_partitions, aggregator=agg, map_side_combine=False
+        )
+        return [kv for part in out for kv in part]
+
+    def sort_by_key(
+        self,
+        input_partitions: Sequence[Iterable[Tuple[Any, Any]]],
+        num_partitions: int,
+        key_func: Optional[Callable[[Any], Any]] = None,
+        serializer: Optional[Serializer] = None,
+    ) -> List[List[Tuple[Any, Any]]]:
+        """Range-partitioned, key-ordered shuffle — the terasort shape
+        (S3ShuffleManagerTest.scala:146-174). Output partition i holds keys
+        ≤ partition i+1's keys; each partition is internally sorted."""
+        key = key_func or (lambda k: k)
+        sample: List[Any] = []
+        materialized: List[List[Tuple[Any, Any]]] = []
+        for part in input_partitions:
+            p = list(part)
+            materialized.append(p)
+            sample.extend(key(k) for k, _v in p[:: max(1, len(p) // 64)])
+        # bounds hold mapped keys; the partitioner maps raw keys with the same
+        # key_func before bisecting.
+        bounds = range_bounds(sample, num_partitions)
+        part_fn = RangePartitioner(bounds, key_func=key)
+        return self.run_shuffle(
+            materialized,
+            partitioner=part_fn,
+            key_ordering=key,
+            serializer=serializer,
+        )
+
+    # ------------------------------------------------------------------
+    def stop(self) -> None:
+        self.manager.stop()
+
+    def __enter__(self) -> "ShuffleContext":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

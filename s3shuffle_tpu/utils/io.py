@@ -1,0 +1,31 @@
+"""Shared byte-stream helpers."""
+
+from __future__ import annotations
+
+from typing import BinaryIO
+
+
+def read_fully(source: BinaryIO, n: int) -> bytes:
+    """Read up to ``n`` bytes, looping over short reads; short only at EOF."""
+    chunks = []
+    remaining = n
+    while remaining > 0:
+        chunk = source.read(remaining)
+        if not chunk:
+            break
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    return b"".join(chunks)
+
+
+def read_up_to(source: BinaryIO, n: int, chunk_limit: int = 1 << 22) -> bytes:
+    """Like :func:`read_fully` but bounds each underlying read call."""
+    chunks = []
+    remaining = n
+    while remaining > 0:
+        chunk = source.read(min(remaining, chunk_limit))
+        if not chunk:
+            break
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    return b"".join(chunks)

@@ -1,0 +1,173 @@
+"""Map-side writer: all reduce partitions of one map task → one data object.
+
+Parity: ``S3ShuffleMapOutputWriter`` (S3ShuffleMapOutputWriter.scala:27-244):
+
+- a single data object ``ShuffleDataBlockId(shuffle, map, NOOP_REDUCE_ID)``
+  streamed through one buffered, measured write stream (:43-49), opened lazily
+  on the first partition byte;
+- partition writers must be requested in monotonically increasing reduce-id
+  order (:67-73);
+- per-partition byte counts tracked as bytes flow (:168-202);
+- ``commit_all_partitions`` sanity-checks stream position == total bytes
+  (:96-100), closes the data stream (final flush), then writes the index
+  (+ checksum object if enabled) via the helper (:111-116) — the index write
+  is the COMMIT POINT; empty map outputs produce NO index unless
+  ``always_create_index`` (:111);
+- ``abort`` drops the partial object.
+
+Deviation from the reference (by design): the reference receives per-partition
+checksums computed by Spark's writers; here the partition writer computes them
+itself over the stored bytes, which is the same quantity the read-side
+validation stream checks (S3ChecksumValidationStream.scala:41-66).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import logging
+from typing import List, Optional
+
+import numpy as np
+
+from s3shuffle_tpu.block_ids import ShuffleDataBlockId
+from s3shuffle_tpu.metadata.helper import ShuffleHelper
+from s3shuffle_tpu.storage.dispatcher import Dispatcher
+from s3shuffle_tpu.utils.checksums import Checksum, create_checksum
+from s3shuffle_tpu.write.measure import MeasuredOutputStream
+
+logger = logging.getLogger("s3shuffle_tpu.write")
+
+
+@dataclasses.dataclass
+class MapOutputCommitMessage:
+    partition_lengths: np.ndarray
+    checksums: Optional[np.ndarray] = None
+
+
+class MapOutputWriter:
+    def __init__(
+        self,
+        dispatcher: Dispatcher,
+        helper: ShuffleHelper,
+        shuffle_id: int,
+        map_id: int,
+        num_partitions: int,
+    ):
+        self.dispatcher = dispatcher
+        self.helper = helper
+        self.shuffle_id = shuffle_id
+        self.map_id = map_id
+        self.num_partitions = num_partitions
+        cfg = dispatcher.config
+        self._checksums_enabled = cfg.checksum_enabled
+        self._lengths = np.zeros(num_partitions, dtype=np.int64)
+        self._checksum_values = np.zeros(num_partitions, dtype=np.int64)
+        self._stream: Optional[MeasuredOutputStream] = None
+        self._total_bytes = 0
+        self._last_partition_id = -1
+        self._committed = False
+        self._block = ShuffleDataBlockId(shuffle_id, map_id)
+
+    # ------------------------------------------------------------------
+    def _init_stream(self) -> MeasuredOutputStream:
+        if self._stream is None:
+            raw = self.dispatcher.create_block(self._block)
+            buffered = io.BufferedWriter(raw, buffer_size=self.dispatcher.config.buffer_size)  # type: ignore[arg-type]
+            self._stream = MeasuredOutputStream(buffered, self._block.name)
+        return self._stream
+
+    def get_partition_writer(self, reduce_partition_id: int) -> "PartitionWriter":
+        if reduce_partition_id <= self._last_partition_id:
+            # S3ShuffleMapOutputWriter.scala:67-73
+            raise ValueError(
+                f"Partition writers must be requested in increasing order: "
+                f"{reduce_partition_id} after {self._last_partition_id}"
+            )
+        if reduce_partition_id >= self.num_partitions:
+            raise IndexError(reduce_partition_id)
+        self._last_partition_id = reduce_partition_id
+        checksum = (
+            create_checksum(self.dispatcher.config.checksum_algorithm)
+            if self._checksums_enabled
+            else None
+        )
+        return PartitionWriter(self, reduce_partition_id, checksum)
+
+    def _record_partition(self, reduce_id: int, nbytes: int, checksum_value: int) -> None:
+        self._lengths[reduce_id] = nbytes
+        self._checksum_values[reduce_id] = checksum_value
+        self._total_bytes += nbytes
+
+    # ------------------------------------------------------------------
+    def commit_all_partitions(self) -> MapOutputCommitMessage:
+        if self._committed:
+            raise RuntimeError("commit_all_partitions called twice")
+        self._committed = True
+        if self._stream is not None:
+            if self._stream.bytes_written != self._total_bytes:
+                # S3ShuffleMapOutputWriter.scala:96-100
+                raise IOError(
+                    f"Stream position {self._stream.bytes_written} does not match "
+                    f"sum of partition lengths {self._total_bytes}"
+                )
+            self._stream.close()  # final flush to the store, logs bandwidth
+        if self._total_bytes > 0 or self.dispatcher.config.always_create_index:
+            if self._checksums_enabled:
+                self.helper.write_checksums(
+                    self.shuffle_id, self.map_id, self._checksum_values
+                )
+            # Index written LAST: it is the commit point — a data object with
+            # no index is invisible to readers (S3ShuffleBlockIterator.scala:46-53).
+            self.helper.write_partition_lengths(self.shuffle_id, self.map_id, self._lengths)
+        checksums = self._checksum_values if self._checksums_enabled else None
+        return MapOutputCommitMessage(self._lengths, checksums)
+
+    def abort(self, error: Exception | None = None) -> None:
+        if self._stream is not None:
+            try:
+                self._stream.close()
+            except OSError:
+                pass
+        self.dispatcher.backend.delete(self.dispatcher.get_path(self._block))
+        logger.warning(
+            "Aborted map output %s: %s", self._block.name, error if error else "unknown"
+        )
+
+
+class PartitionWriter(io.RawIOBase):
+    """Counts and checksums the stored bytes of one reduce partition while
+    passing them through to the shared data-object stream."""
+
+    def __init__(self, parent: MapOutputWriter, reduce_id: int, checksum: Optional[Checksum]):
+        self._parent = parent
+        self.reduce_id = reduce_id
+        self._checksum = checksum
+        self._count = 0
+        self._finalized = False
+
+    def writable(self) -> bool:
+        return True
+
+    def write(self, b) -> int:
+        data = bytes(b)
+        if data:
+            stream = self._parent._init_stream()
+            stream.write(data)
+            if self._checksum is not None:
+                self._checksum.update(data)
+            self._count += len(data)
+        return len(data)
+
+    @property
+    def bytes_written(self) -> int:
+        return self._count
+
+    def close(self) -> None:
+        # Finalize this partition's length/checksum; the shared data stream
+        # stays open for the next partition.
+        if not self._finalized:
+            self._finalized = True
+            value = self._checksum.value if self._checksum is not None else 0
+            self._parent._record_partition(self.reduce_id, self._count, value)
+        super().close()

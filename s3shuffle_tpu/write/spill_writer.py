@@ -1,0 +1,183 @@
+"""Map-task shuffle writer: partition records, spill when over budget, commit.
+
+Parity: the role of Spark's three map-side writers (SortShuffleWriter /
+UnsafeShuffleWriter / BypassMergeSortShuffleWriter) feeding the reference's
+``S3ShuffleMapOutputWriter`` (SURVEY.md §3.2), collapsed into one strategy
+that keeps their shared contract:
+
+- records are routed to per-partition serializer→codec pipelines (map-side
+  combine applied first when the dependency asks for it);
+- memory is bounded: when buffered bytes exceed the budget, every partition's
+  pipeline is flushed at a frame boundary and appended to a local spill file
+  (the codec framing is concatenatable, so spill segments concatenate into a
+  valid partition stream — the same relocatable-serializer property Spark's
+  UnsafeShuffleWriter exploits);
+- on ``stop(success=True)``, partitions are streamed in monotone order into
+  the single data object via :class:`MapOutputWriter` and the commit registers
+  a MapStatus addressed to the object store (S3ShuffleWriter.scala:10-18).
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import os
+import tempfile
+from typing import Any, Callable, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from s3shuffle_tpu.codec.framing import FrameCodec
+from s3shuffle_tpu.write.map_output_writer import MapOutputCommitMessage, MapOutputWriter
+
+logger = logging.getLogger("s3shuffle_tpu.write")
+
+
+class _PartitionPipeline:
+    """serializer → (codec) → in-memory sink for one reduce partition."""
+
+    def __init__(self, serializer, codec: Optional[FrameCodec]):
+        self.sink = io.BytesIO()
+        if codec is not None:
+            from s3shuffle_tpu.codec.framing import CodecOutputStream
+
+            self.codec_stream: Optional[CodecOutputStream] = CodecOutputStream(
+                codec, self.sink, close_sink=False
+            )
+            target = self.codec_stream
+        else:
+            self.codec_stream = None
+            target = self.sink
+        self.record_writer = serializer.new_write_stream(target)
+        self.spill_segments: List[Tuple[int, int]] = []  # (offset, length) in spill file
+
+    def buffered_bytes(self) -> int:
+        return self.sink.tell()
+
+    def flush_to_frame_boundary(self) -> bytes:
+        self.record_writer.flush()
+        if self.codec_stream is not None:
+            self.codec_stream.flush_block()
+        data = self.sink.getvalue()
+        self.sink.seek(0)
+        self.sink.truncate(0)
+        return data
+
+    def finalize(self) -> bytes:
+        self.record_writer.close()
+        if self.codec_stream is not None:
+            self.codec_stream.close()
+        return self.sink.getvalue()
+
+
+class ShuffleMapWriter:
+    def __init__(
+        self,
+        handle,
+        map_id: int,
+        output_writer: MapOutputWriter,
+        codec: Optional[FrameCodec],
+        on_commit: Callable[[int, int, np.ndarray], None],
+        spill_memory_budget: Optional[int] = None,
+    ):
+        self.handle = handle
+        self.dep = handle.dependency
+        self.map_id = map_id
+        self.output_writer = output_writer
+        self.codec = codec
+        self.on_commit = on_commit
+        cfg = output_writer.dispatcher.config
+        self.spill_memory_budget = spill_memory_budget or cfg.max_buffer_size_task
+        self._pipelines = [
+            _PartitionPipeline(self.dep.serializer, codec)
+            for _ in range(self.dep.num_partitions)
+        ]
+        self._spill_file: Optional[str] = None
+        self._spill_fd = None
+        self._records_written = 0
+        self._stopped = False
+        self.spill_count = 0
+
+    # ------------------------------------------------------------------
+    def write(self, records: Iterable[Tuple[Any, Any]]) -> None:
+        dep = self.dep
+        if dep.map_side_combine:
+            assert dep.aggregator is not None
+            records = dep.aggregator.combine_values_by_key(records)
+        partitioner = dep.partitioner
+        pipelines = self._pipelines
+        check_every = 4096
+        # Running total across write() calls — incremental callers writing
+        # small batches must still hit the budget check.
+        n = self._records_written
+        for k, v in records:
+            pipelines[partitioner(k)].record_writer.write(k, v)
+            n += 1
+            if n % check_every == 0 and self._buffered_total() > self.spill_memory_budget:
+                self._spill()
+        self._records_written = n
+
+    def _buffered_total(self) -> int:
+        return sum(p.buffered_bytes() for p in self._pipelines)
+
+    def _spill(self) -> None:
+        if self._spill_fd is None:
+            fd, self._spill_file = tempfile.mkstemp(prefix="s3shuffle-map-spill-")
+            self._spill_fd = os.fdopen(fd, "wb+")
+        f = self._spill_fd
+        for pipeline in self._pipelines:
+            data = pipeline.flush_to_frame_boundary()
+            if data:
+                offset = f.tell()
+                f.write(data)
+                pipeline.spill_segments.append((offset, len(data)))
+        self.spill_count += 1
+        logger.info(
+            "Map %d spilled to %s (spill #%d)", self.map_id, self._spill_file, self.spill_count
+        )
+
+    # ------------------------------------------------------------------
+    def stop(self, success: bool) -> Optional[MapOutputCommitMessage]:
+        if self._stopped:
+            return None
+        self._stopped = True
+        if not success:
+            self.output_writer.abort()
+            self._cleanup_spill()
+            return None
+        try:
+            for pid, pipeline in enumerate(self._pipelines):
+                final = pipeline.finalize()
+                writer = self.output_writer.get_partition_writer(pid)
+                for offset, length in pipeline.spill_segments:
+                    assert self._spill_fd is not None
+                    self._spill_fd.seek(offset)
+                    remaining = length
+                    while remaining > 0:
+                        chunk = self._spill_fd.read(min(remaining, 1 << 20))
+                        if not chunk:
+                            raise IOError("Truncated spill file")
+                        writer.write(chunk)
+                        remaining -= len(chunk)
+                if final:
+                    writer.write(final)
+                writer.close()
+            message = self.output_writer.commit_all_partitions()
+            self.on_commit(self.handle.shuffle_id, self.map_id, message.partition_lengths)
+            return message
+        except BaseException as e:
+            self.output_writer.abort(e if isinstance(e, Exception) else None)
+            raise
+        finally:
+            self._cleanup_spill()
+
+    def _cleanup_spill(self) -> None:
+        if self._spill_fd is not None:
+            self._spill_fd.close()
+            self._spill_fd = None
+        if self._spill_file is not None:
+            try:
+                os.remove(self._spill_file)
+            except OSError:
+                pass
+            self._spill_file = None

@@ -1,0 +1,133 @@
+import io
+
+import pytest
+
+from s3shuffle_tpu.codec import get_codec
+from s3shuffle_tpu.codec.framing import HEADER_SIZE
+from s3shuffle_tpu.serializer import BytesKVSerializer, PickleBatchSerializer, get_serializer
+
+
+@pytest.fixture(params=["zlib", "zstd"])
+def codec(request):
+    return get_codec(request.param, block_size=1024)
+
+
+def test_codec_roundtrip(codec):
+    data = b"hello world " * 1000
+    compressed = codec.compress_bytes(data)
+    assert len(compressed) < len(data)
+    assert codec.decompress_bytes(compressed) == data
+
+
+def test_codec_empty_and_tiny(codec):
+    assert codec.decompress_bytes(codec.compress_bytes(b"")) == b""
+    assert codec.decompress_bytes(codec.compress_bytes(b"x")) == b"x"
+
+
+def test_incompressible_stored_raw(codec):
+    import os
+
+    data = os.urandom(4096)
+    compressed = codec.compress_bytes(data)
+    # 4 blocks of 1024, each stored raw with 9-byte header
+    assert len(compressed) == len(data) + 4 * HEADER_SIZE
+    assert codec.decompress_bytes(compressed) == data
+
+
+def test_concatenation_property(codec):
+    # Concatenated compressed streams == compression of concatenated data
+    # (the property that legalizes batch fetch, S3ShuffleReader.scala:55-75).
+    a, b = b"A" * 3000, b"B" * 500
+    cat = codec.compress_bytes(a) + codec.compress_bytes(b)
+    assert codec.decompress_bytes(cat) == a + b
+
+
+def test_cross_codec_frames_decode():
+    # A reader configured with zstd can still decode zlib frames (dispatch on
+    # the frame's codec id).
+    zlib_codec = get_codec("zlib", block_size=512)
+    zstd_codec = get_codec("zstd", block_size=512)
+    data = b"mixed codec data " * 200
+    stream = zlib_codec.compress_bytes(data)
+    from s3shuffle_tpu.codec.framing import CodecInputStream
+
+    out = CodecInputStream(zstd_codec, io.BytesIO(stream)).read()
+    assert out == data
+
+
+def test_truncated_frame_raises(codec):
+    compressed = codec.compress_bytes(b"some data worth framing" * 100)
+    from s3shuffle_tpu.codec.framing import CodecInputStream
+
+    with pytest.raises(IOError):
+        CodecInputStream(codec, io.BytesIO(compressed[: len(compressed) - 3])).read()
+    with pytest.raises(IOError):
+        CodecInputStream(codec, io.BytesIO(compressed[:5])).read()
+
+
+def test_codec_none():
+    assert get_codec("none") is None
+    assert get_codec("off") is None
+
+
+@pytest.fixture(params=["pickle", "bytes-kv"])
+def serializer(request):
+    return get_serializer(request.param)
+
+
+def _records(serializer):
+    if isinstance(serializer, BytesKVSerializer):
+        return [(f"k{i}".encode(), f"value-{i}".encode() * 3) for i in range(100)]
+    return [(f"k{i}", {"payload": i}) for i in range(100)]
+
+
+def test_serializer_roundtrip(serializer):
+    records = _records(serializer)
+    data = serializer.dumps(records)
+    assert list(serializer.loads(data)) == records
+
+
+def test_serializer_concatenation_relocatable(serializer):
+    # relocatable ⇒ concat of streams == stream of concat
+    r1, r2 = _records(serializer)[:30], _records(serializer)[30:]
+    assert serializer.relocatable
+    cat = serializer.dumps(r1) + serializer.dumps(r2)
+    assert list(serializer.loads(cat)) == r1 + r2
+
+
+def test_serializer_through_codec(serializer, codec):
+    from s3shuffle_tpu.codec.framing import CodecOutputStream
+
+    records = _records(serializer)
+    sink = io.BytesIO()
+    cs = CodecOutputStream(codec, sink, close_sink=False)
+    w = serializer.new_write_stream(cs)
+    for k, v in records:
+        w.write(k, v)
+    w.close()
+    cs.close()
+    out = list(
+        serializer.new_read_stream(codec.decompress_stream(io.BytesIO(sink.getvalue())))
+    )
+    assert out == records
+
+
+def test_pickle_flush_mid_stream_valid_prefix():
+    s = PickleBatchSerializer(batch_size=1000)
+    sink = io.BytesIO()
+    w = s.new_write_stream(sink)
+    w.write("a", 1)
+    w.flush()  # spill boundary: bytes so far must be a valid stream
+    assert list(s.loads(sink.getvalue())) == [("a", 1)]
+    w.write("b", 2)
+    w.close()
+    assert list(s.loads(sink.getvalue())) == [("a", 1), ("b", 2)]
+
+
+def test_pickle_batch_overflow_regression():
+    # Regression: writing more than batch_size records through new_write_stream
+    # must auto-flush (previously crashed with AttributeError).
+    s = PickleBatchSerializer(batch_size=4)
+    records = [(i, i * 2) for i in range(50)]
+    data = s.dumps(records)
+    assert list(s.loads(data)) == records

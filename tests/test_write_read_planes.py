@@ -1,0 +1,423 @@
+import io
+import os
+
+import numpy as np
+import pytest
+
+from s3shuffle_tpu.block_ids import ShuffleBlockBatchId, ShuffleBlockId, ShuffleDataBlockId
+from s3shuffle_tpu.config import ShuffleConfig
+from s3shuffle_tpu.metadata.helper import ShuffleHelper
+from s3shuffle_tpu.read.block_iterator import BlockIterator
+from s3shuffle_tpu.read.block_stream import BlockStream
+from s3shuffle_tpu.read.checksum_stream import ChecksumError, ChecksumValidationStream
+from s3shuffle_tpu.read.prefetch import (
+    RING_SIZE,
+    BufferedPrefetchIterator,
+    ThreadPredictor,
+)
+from s3shuffle_tpu.storage.dispatcher import Dispatcher
+from s3shuffle_tpu.utils.checksums import create_checksum
+from s3shuffle_tpu.write.map_output_writer import MapOutputWriter
+from s3shuffle_tpu.write.single_spill import SingleSpillMapOutputWriter
+
+
+@pytest.fixture
+def env(tmp_path):
+    cfg = ShuffleConfig(root_dir=f"file://{tmp_path}/root", app_id="t", folder_prefixes=3)
+    d = Dispatcher(cfg)
+    return d, ShuffleHelper(d)
+
+
+def write_map_output(d, helper, shuffle_id, map_id, parts):
+    w = MapOutputWriter(d, helper, shuffle_id, map_id, len(parts))
+    for pid, data in enumerate(parts):
+        pw = w.get_partition_writer(pid)
+        pw.write(data)
+        pw.close()
+    return w.commit_all_partitions()
+
+
+def test_map_output_writer_end_to_end(env):
+    d, helper = env
+    parts = [b"alpha" * 10, b"", b"gamma" * 20]
+    msg = write_map_output(d, helper, 1, 0, parts)
+    assert msg.partition_lengths.tolist() == [50, 0, 100]
+    # data object holds partitions back to back
+    raw = d.backend.read_all(d.get_path(ShuffleDataBlockId(1, 0)))
+    assert raw == b"".join(parts)
+    # index is cumulative; checksums match stored bytes
+    offsets = helper.get_partition_lengths(1, 0)
+    assert offsets.tolist() == [0, 50, 50, 150]
+    checks = helper.get_checksums(1, 0)
+    for pid, data in enumerate(parts):
+        c = create_checksum("ADLER32")
+        c.update(data)
+        assert checks[pid] == c.value
+
+
+def test_monotone_partition_order_enforced(env):
+    d, helper = env
+    w = MapOutputWriter(d, helper, 2, 0, 4)
+    w.get_partition_writer(1).close()
+    with pytest.raises(ValueError):
+        w.get_partition_writer(1)
+    with pytest.raises(ValueError):
+        w.get_partition_writer(0)
+    w.get_partition_writer(3).close()
+
+
+def test_empty_output_no_index(env):
+    d, helper = env
+    w = MapOutputWriter(d, helper, 3, 0, 2)
+    for pid in range(2):
+        w.get_partition_writer(pid).close()
+    w.commit_all_partitions()
+    # S3ShuffleMapOutputWriter.scala:111 — no bytes ⇒ no index object
+    with pytest.raises(FileNotFoundError):
+        helper.read_block_as_array(
+            __import__("s3shuffle_tpu.block_ids", fromlist=["ShuffleIndexBlockId"]).ShuffleIndexBlockId(3, 0)
+        )
+
+
+def test_empty_output_with_always_create_index(tmp_path):
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/r", app_id="t", always_create_index=True
+    )
+    d = Dispatcher(cfg)
+    helper = ShuffleHelper(d)
+    w = MapOutputWriter(d, helper, 3, 1, 2)
+    w.commit_all_partitions()
+    assert helper.get_partition_lengths(3, 1).tolist() == [0, 0, 0]
+
+
+def test_abort_deletes_partial_object(env):
+    d, helper = env
+    w = MapOutputWriter(d, helper, 4, 0, 1)
+    pw = w.get_partition_writer(0)
+    pw.write(b"partial")
+    pw.close()
+    w.abort(RuntimeError("boom"))
+    assert not d.backend.exists(d.get_path(ShuffleDataBlockId(4, 0)))
+
+
+def test_single_spill_rename(env, tmp_path):
+    d, helper = env
+    spill = tmp_path / "spill.bin"
+    spill.write_bytes(b"X" * 30 + b"Y" * 70)
+    w = SingleSpillMapOutputWriter(d, helper, 5, 2)
+    w.transfer_map_spill_file(str(spill), np.array([30, 70]))
+    assert not spill.exists()  # renamed away
+    assert d.backend.read_all(d.get_path(ShuffleDataBlockId(5, 2))) == b"X" * 30 + b"Y" * 70
+    assert helper.get_partition_lengths(5, 2).tolist() == [0, 30, 100]
+
+
+def test_single_spill_copy_when_no_rename(env, tmp_path):
+    d, helper = env
+    d.supports_rename = False
+    spill = tmp_path / "spill2.bin"
+    spill.write_bytes(b"Z" * 64)
+    w = SingleSpillMapOutputWriter(d, helper, 5, 3)
+    w.transfer_map_spill_file(str(spill), np.array([64]))
+    assert d.backend.read_all(d.get_path(ShuffleDataBlockId(5, 3))) == b"Z" * 64
+    assert not spill.exists()
+
+
+# ---------------------------------------------------------------------------
+# Read plane
+# ---------------------------------------------------------------------------
+
+
+def test_block_stream_ranged_reads(env):
+    d, helper = env
+    write_map_output(d, helper, 10, 0, [b"A" * 100, b"B" * 50, b"C" * 25])
+    offsets = helper.get_partition_lengths(10, 0)
+    data_block = ShuffleDataBlockId(10, 0)
+    s = BlockStream(d, ShuffleBlockId(10, 0, 1), data_block, int(offsets[1]), int(offsets[2]))
+    assert s.max_bytes == 50
+    assert s.read(20) == b"B" * 20
+    assert s.read() == b"B" * 30
+    assert s.read(10) == b""  # exhausted + auto-closed
+
+
+def test_block_stream_zero_length_never_opens(env):
+    d, _ = env
+    calls = []
+    orig = d.open_block
+    d.open_block = lambda b: (calls.append(b), orig(b))[1]
+    s = BlockStream(d, ShuffleBlockId(11, 0, 0), ShuffleDataBlockId(11, 0), 5, 5)
+    assert s.read() == b""
+    assert calls == []  # S3ShuffleBlockStream.scala:38
+
+
+def test_block_stream_io_error_returns_eof(env):
+    d, helper = env
+    write_map_output(d, helper, 12, 0, [b"data" * 10])
+    # delete the object behind the stream's back
+    d.backend.delete(d.get_path(ShuffleDataBlockId(12, 0)))
+    d.clear_status_cache()
+    s = BlockStream(d, ShuffleBlockId(12, 0, 0), ShuffleDataBlockId(12, 0), 0, 40)
+    assert s.read() == b""  # log + EOF (scala :66-70)
+
+
+def test_block_iterator_ranges(env):
+    d, helper = env
+    write_map_output(d, helper, 13, 0, [b"a" * 10, b"b" * 20])
+    write_map_output(d, helper, 13, 1, [b"c" * 5, b"d" * 15])
+    blocks = [
+        ShuffleBlockId(13, 0, 1),
+        ShuffleBlockBatchId(13, 1, 0, 2),
+    ]
+    out = list(BlockIterator(d, helper, blocks))
+    assert out[0][1].max_bytes == 20
+    assert out[1][1].max_bytes == 20
+    assert out[1][1].read() == b"c" * 5 + b"d" * 15
+
+
+def test_block_iterator_missing_index_metadata_mode_raises(env):
+    d, helper = env
+    with pytest.raises(FileNotFoundError):
+        list(BlockIterator(d, helper, [ShuffleBlockId(14, 0, 0)]))
+
+
+def test_block_iterator_missing_index_listing_mode_skips(tmp_path):
+    cfg = ShuffleConfig(root_dir=f"file://{tmp_path}/r", app_id="t", use_block_manager=False)
+    d = Dispatcher(cfg)
+    helper = ShuffleHelper(d)
+    out = list(BlockIterator(d, helper, [ShuffleBlockId(14, 0, 0)]))
+    assert out == []  # silently skipped (S3ShuffleBlockIterator.scala:46-53)
+
+
+# ---------------------------------------------------------------------------
+# Prefetcher
+# ---------------------------------------------------------------------------
+
+
+def _make_streams(env, shuffle_id, n_blocks, block_size=1000):
+    d, helper = env
+    streams = []
+    for m in range(n_blocks):
+        payload = bytes([m % 256]) * block_size
+        write_map_output(d, helper, shuffle_id, m, [payload])
+        offsets = helper.get_partition_lengths(shuffle_id, m)
+        streams.append(
+            (
+                ShuffleBlockId(shuffle_id, m, 0),
+                BlockStream(d, ShuffleBlockId(shuffle_id, m, 0), ShuffleDataBlockId(shuffle_id, m), 0, int(offsets[1])),
+            )
+        )
+    return streams
+
+
+def test_prefetch_iterator_delivers_all(env):
+    streams = _make_streams(env, 20, 25)
+    it = BufferedPrefetchIterator(iter(streams), max_buffer_size=4000, max_threads=4)
+    seen = set()
+    for prefetched in it:
+        data = prefetched.read()
+        assert len(data) == 1000
+        seen.add(data[0])
+        prefetched.close()
+    assert len(seen) == 25
+    stats = it.stats
+    assert stats["blocks"] == 25 and stats["bytes"] == 25_000
+
+
+def test_prefetch_budget_respected(env):
+    # budget smaller than one block: per-stream buffer caps at budget and
+    # streams larger than the buffer stream the remainder synchronously
+    streams = _make_streams(env, 21, 5, block_size=10_000)
+    it = BufferedPrefetchIterator(iter(streams), max_buffer_size=4096, max_threads=2)
+    count = 0
+    for prefetched in it:
+        assert prefetched.buffer_size <= 4096
+        assert len(prefetched.read()) == 10_000
+        prefetched.close()
+        count += 1
+    assert count == 5
+
+
+def test_prefetch_propagates_source_error(env):
+    def bad_source():
+        yield from _make_streams(env, 22, 2)
+        raise RuntimeError("enumeration failed")
+
+    it = BufferedPrefetchIterator(bad_source(), max_buffer_size=100_000, max_threads=2)
+    with pytest.raises(RuntimeError, match="enumeration failed"):
+        for prefetched in it:
+            prefetched.read()
+            prefetched.close()
+
+
+def test_thread_predictor_hill_climb():
+    p = ThreadPredictor(max_threads=4, initial=1)
+    # High latency at 1 thread → after a full ring, explores up
+    for _ in range(RING_SIZE):
+        t = p.add_measurement_and_predict(1_000_000)
+    assert t == 2
+    # Lower latency at 2 threads → stays or explores; feed rings and check
+    # it never exceeds bounds and eventually settles on a low-latency count
+    for _ in range(RING_SIZE * 6):
+        t = p.add_measurement_and_predict(10_000)
+    assert 1 <= t <= 4
+
+
+def test_thread_predictor_bounds():
+    p = ThreadPredictor(max_threads=1)
+    for _ in range(RING_SIZE * 3):
+        assert p.add_measurement_and_predict(100) == 1
+
+
+# ---------------------------------------------------------------------------
+# Checksum validation stream
+# ---------------------------------------------------------------------------
+
+
+def _checksums_for(parts, algo="ADLER32"):
+    out = []
+    for data in parts:
+        c = create_checksum(algo)
+        c.update(data)
+        out.append(c.value)
+    return np.array(out, dtype=np.int64)
+
+
+def test_checksum_stream_valid(env):
+    parts = [b"aaa" * 5, b"", b"bbbb" * 3]
+    offsets = np.array([0, 15, 15, 27], dtype=np.int64)
+    stream = ChecksumValidationStream(
+        ShuffleBlockBatchId(1, 0, 0, 3),
+        io.BytesIO(b"".join(parts)),
+        offsets,
+        _checksums_for(parts),
+        0,
+        3,
+        "ADLER32",
+    )
+    assert stream.read() + stream.read() + stream.read() == b"".join(parts)
+
+
+def test_checksum_stream_detects_corruption():
+    parts = [b"hello world checksum" * 10]
+    offsets = np.array([0, 200], dtype=np.int64)
+    corrupted = bytearray(b"".join(parts))
+    corrupted[50] ^= 0xFF
+    stream = ChecksumValidationStream(
+        ShuffleBlockId(1, 0, 0),
+        io.BytesIO(bytes(corrupted)),
+        offsets,
+        _checksums_for(parts),
+        0,
+        1,
+        "ADLER32",
+    )
+    with pytest.raises(ChecksumError, match="Invalid checksum"):
+        while stream.read(64):
+            pass
+
+
+def test_checksum_stream_never_crosses_boundary():
+    parts = [b"A" * 10, b"B" * 10]
+    offsets = np.array([0, 10, 20], dtype=np.int64)
+    stream = ChecksumValidationStream(
+        ShuffleBlockBatchId(1, 0, 0, 2),
+        io.BytesIO(b"".join(parts)),
+        offsets,
+        _checksums_for(parts),
+        0,
+        2,
+        "ADLER32",
+    )
+    chunk = stream.read(15)  # asks past the boundary
+    assert chunk == b"A" * 10  # but gets only partition 0's remainder
+
+
+def test_checksum_stream_premature_eof():
+    parts = [b"C" * 30]
+    offsets = np.array([0, 30], dtype=np.int64)
+    stream = ChecksumValidationStream(
+        ShuffleBlockId(1, 0, 0),
+        io.BytesIO(b"C" * 12),  # truncated
+        offsets,
+        _checksums_for(parts),
+        0,
+        1,
+        "ADLER32",
+    )
+    with pytest.raises(ChecksumError, match="Premature EOF"):
+        while stream.read(8):
+            pass
+
+
+def test_single_spill_nonlocal_backend_copies(tmp_path):
+    # Regression: rename fast path must only trigger when the store IS the
+    # local fs; memory:// (rename-capable) must fall back to stream copy.
+    cfg = ShuffleConfig(root_dir="memory://single-spill-test", app_id="t")
+    d = Dispatcher(cfg)
+    helper = ShuffleHelper(d)
+    spill = tmp_path / "s.bin"
+    spill.write_bytes(b"Q" * 48)
+    w = SingleSpillMapOutputWriter(d, helper, 6, 0)
+    w.transfer_map_spill_file(str(spill), np.array([48]))
+    assert d.backend.read_all(d.get_path(ShuffleDataBlockId(6, 0))) == b"Q" * 48
+
+
+def test_spill_triggers_across_multiple_write_calls(env):
+    # Regression: the budget check must use a running record count.
+    from s3shuffle_tpu.dependency import HashPartitioner, ShuffleDependency
+    from s3shuffle_tpu.write.spill_writer import ShuffleMapWriter
+
+    d, helper = env
+    dep = ShuffleDependency(30, HashPartitioner(2))
+    handle = type("H", (), {"shuffle_id": 30, "dependency": dep})()
+    committed = []
+    w = ShuffleMapWriter(
+        handle,
+        0,
+        MapOutputWriter(d, helper, 30, 0, 2),
+        codec=None,
+        on_commit=lambda s, m, l: committed.append((s, m)),
+        spill_memory_budget=1000,
+    )
+    payload = b"x" * 100
+    for i in range(5000):  # 5000 calls of 1 record each
+        w.write([(i, payload)])
+    assert w.spill_count > 0
+    msg = w.stop(success=True)
+    assert msg is not None and committed == [(30, 0)]
+    # round-trip the spilled output
+    from s3shuffle_tpu.read.block_iterator import BlockIterator
+
+    total = 0
+    for _b, stream in BlockIterator(d, helper, [ShuffleBlockId(30, 0, 0), ShuffleBlockId(30, 0, 1)]):
+        records = list(dep.serializer.new_read_stream(stream))
+        total += len(records)
+    assert total == 5000
+
+
+def test_prefetch_scales_up_after_scale_down(env):
+    # Regression: after a scale-down, newly spawned threads must not
+    # instantly retire (old id-based retirement bug). A tiny budget keeps
+    # producers alive (waiting) so pool liveness is observable mid-stream.
+    streams = _make_streams(env, 23, 60, block_size=200)
+    it = BufferedPrefetchIterator(iter(streams), max_buffer_size=250, max_threads=4)
+    with it._lock:
+        it._desired_threads = 2
+    for _ in range(10):
+        p = next(it)
+        p.read()
+        p.close()
+    with it._lock:
+        it._desired_threads = 4
+    it._configure_threads()
+    import time as _t
+
+    _t.sleep(0.3)
+    with it._lock:
+        alive = [t for t in it._threads if t.is_alive()]
+    assert len(alive) >= 1  # pool survived the oscillation (not all retired)
+    consumed = 10
+    for p in it:
+        p.read()
+        p.close()
+        consumed += 1
+    assert consumed == 60  # nothing dropped across the resize
