@@ -70,11 +70,15 @@ class FrameCodec:
         return [self.decompress_block(b, n) for b, n in blocks]
 
     # --- framing ---
-    def frame_block(self, raw: bytes) -> bytes:
-        compressed = self.compress_block(raw)
+    def frame_from(self, raw: bytes, compressed: bytes) -> bytes:
+        """Frame a pre-compressed block, applying the raw escape — the single
+        place the escape rule and header layout live."""
         if len(compressed) >= len(raw):
             return HEADER.pack(0, len(raw), len(raw)) + raw
         return HEADER.pack(self.codec_id, len(raw), len(compressed)) + compressed
+
+    def frame_block(self, raw: bytes) -> bytes:
+        return self.frame_from(raw, self.compress_block(raw))
 
     def compress_stream(self, sink: BinaryIO) -> "CodecOutputStream":
         return CodecOutputStream(self, sink)
@@ -95,13 +99,19 @@ class FrameCodec:
 
 class CodecOutputStream(io.RawIOBase):
     """Buffers up to ``block_size`` bytes, then emits one frame. ``close``
-    flushes the final short block and closes the sink."""
+    flushes the final short block and closes the sink.
+
+    Batch codecs (``codec.batch_blocks > 1``, e.g. the TPU codec) have full
+    blocks accumulated and compressed ``batch_blocks`` at a time — one device
+    round-trip per batch — while emitting byte-identical framing."""
 
     def __init__(self, codec: FrameCodec, sink: BinaryIO, close_sink: bool = True):
         self._codec = codec
         self._sink = sink
         self._buf = bytearray()
         self._close_sink = close_sink
+        self._pending: List[bytes] = []  # full blocks awaiting a batch flush
+        self._batch_blocks = max(1, getattr(codec, "batch_blocks", 1))
 
     def writable(self) -> bool:
         return True
@@ -111,19 +121,36 @@ class CodecOutputStream(io.RawIOBase):
         self._buf.extend(data)
         bs = self._codec.block_size
         while len(self._buf) >= bs:
-            self._emit(bytes(self._buf[:bs]))
+            self._pending.append(bytes(self._buf[:bs]))
             del self._buf[:bs]
+            if len(self._pending) >= self._batch_blocks:
+                self._emit_pending()
         return len(data)
 
-    def _emit(self, raw: bytes) -> None:
-        self._sink.write(self._codec.frame_block(raw))
+    def _emit_pending(self) -> None:
+        if not self._pending:
+            return
+        if len(self._pending) == 1:
+            self._sink.write(self._codec.frame_block(self._pending[0]))
+        else:
+            compressed = self._codec.compress_blocks(self._pending)
+            for raw, comp in zip(self._pending, compressed):
+                self._sink.write(self._codec.frame_from(raw, comp))
+        self._pending.clear()
+
+    @property
+    def pending_bytes(self) -> int:
+        """Raw bytes buffered but not yet framed (partial block + batch queue)
+        — memory-budget accounting must count these."""
+        return len(self._buf) + sum(len(p) for p in self._pending)
 
     def flush_block(self) -> None:
-        """Force the current partial block out (used at partition boundaries so
+        """Force everything buffered out (used at partition boundaries so
         partitions never share a frame)."""
         if self._buf:
-            self._emit(bytes(self._buf))
+            self._pending.append(bytes(self._buf))
             self._buf.clear()
+        self._emit_pending()
 
     def close(self) -> None:
         if not self.closed:
@@ -211,7 +238,7 @@ def decompress_frame_payload(
         raise IOError(f"Unknown codec id in frame: {codec_id}")
     from s3shuffle_tpu.codec import get_codec
 
-    codec = get_codec({"native-lz": "native", "tpu-lz": "native", "zlib": "zlib", "zstd": "zstd"}[name])
+    codec = get_codec({"native-lz": "native", "tpu-lz": "tpu", "zlib": "zlib", "zstd": "zstd"}[name])
     assert codec is not None
     return codec.decompress_block(payload, ulen)
 

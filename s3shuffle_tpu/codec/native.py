@@ -1,0 +1,142 @@
+"""ctypes bindings for the native C++ data-plane library.
+
+Loads ``libs3shuffle_native.so`` (built by ``make -C s3shuffle_tpu/native``);
+if absent, attempts one build at import. The codec registry's ``auto`` mode
+falls back to zlib when neither works, so the framework stays pure-Python
+functional everywhere.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+from s3shuffle_tpu.codec.framing import CODEC_IDS, FrameCodec
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
+_SO_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libs3shuffle_native.so"))
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO_PATH):
+            subprocess.run(
+                ["make", "-C", os.path.abspath(_NATIVE_DIR)],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        lib = ctypes.CDLL(_SO_PATH)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        lib.slz_crc32c.restype = ctypes.c_uint32
+        lib.slz_crc32c.argtypes = [u8p, ctypes.c_size_t, ctypes.c_uint32]
+        lib.slz_adler32.restype = ctypes.c_uint32
+        lib.slz_adler32.argtypes = [u8p, ctypes.c_size_t, ctypes.c_uint32]
+        lib.slz_compress.restype = ctypes.c_size_t
+        lib.slz_compress.argtypes = [u8p, ctypes.c_size_t, u8p, ctypes.c_size_t]
+        lib.slz_decompress.restype = ctypes.c_size_t
+        lib.slz_decompress.argtypes = [u8p, ctypes.c_size_t, u8p, ctypes.c_size_t]
+        lib.slz_crc32c_batch.restype = None
+        lib.slz_crc32c_batch.argtypes = [u8p, i64p, ctypes.c_int64, u32p]
+        lib.slz_compress_batch.restype = None
+        lib.slz_compress_batch.argtypes = [u8p, i64p, ctypes.c_int64, u8p, i64p, i64p]
+        lib.slz_decompress_batch.restype = None
+        lib.slz_decompress_batch.argtypes = [u8p, i64p, ctypes.c_int64, u8p, i64p, i64p]
+        _lib = lib
+        return lib
+
+
+def _u8(buf) -> ctypes.POINTER(ctypes.c_uint8):  # type: ignore[valid-type]
+    return ctypes.cast(ctypes.c_char_p(bytes(buf)) if isinstance(buf, (bytes, bytearray)) else buf, ctypes.POINTER(ctypes.c_uint8))
+
+
+def native_available() -> bool:
+    try:
+        _load()
+        return True
+    except Exception:
+        return False
+
+
+def native_crc32c(data: bytes, value: int = 0) -> int:
+    lib = _load()
+    if not data:
+        return value
+    buf = ctypes.cast(ctypes.c_char_p(data), ctypes.POINTER(ctypes.c_uint8))
+    return lib.slz_crc32c(buf, len(data), value)
+
+
+def native_adler32(data: bytes, value: int = 1) -> int:
+    lib = _load()
+    if not data:
+        return value
+    buf = ctypes.cast(ctypes.c_char_p(data), ctypes.POINTER(ctypes.c_uint8))
+    return lib.slz_adler32(buf, len(data), value)
+
+
+class NativeLZCodec(FrameCodec):
+    """SLZ — the C++ greedy-LZ77 block codec (LZ4-class speed/ratio target)."""
+
+    name = "native-lz"
+    codec_id = CODEC_IDS["native-lz"]
+
+    def __init__(self, block_size: int = 64 * 1024):
+        super().__init__(block_size)
+        self._lib = _load()
+
+    def compress_block(self, data: bytes) -> bytes:
+        n = len(data)
+        if n == 0:
+            return b"\x00"  # varint 0 literals (valid empty block)
+        src = ctypes.cast(ctypes.c_char_p(data), ctypes.POINTER(ctypes.c_uint8))
+        cap = n  # if it doesn't shrink, framing stores raw
+        dst = ctypes.create_string_buffer(max(1, cap))
+        clen = self._lib.slz_compress(
+            src, n, ctypes.cast(dst, ctypes.POINTER(ctypes.c_uint8)), cap
+        )
+        if clen == 0:
+            return data  # incompressible: framing's raw escape triggers
+        return ctypes.string_at(dst, clen)
+
+    def decompress_block(self, data: bytes, uncompressed_len: int) -> bytes:
+        src = ctypes.cast(ctypes.c_char_p(data), ctypes.POINTER(ctypes.c_uint8))
+        dst = ctypes.create_string_buffer(max(1, uncompressed_len))
+        n = self._lib.slz_decompress(
+            src, len(data), ctypes.cast(dst, ctypes.POINTER(ctypes.c_uint8)), uncompressed_len
+        )
+        if n != uncompressed_len:
+            raise IOError(
+                f"SLZ decompression produced {n} bytes, expected {uncompressed_len}"
+            )
+        return ctypes.string_at(dst, uncompressed_len)
+
+    # ------------------------------------------------------------------
+    # numpy batch paths (used by the TPU host pipeline and benchmarks)
+    # ------------------------------------------------------------------
+    def crc32c_batch(self, concat: np.ndarray, offsets: np.ndarray) -> np.ndarray:
+        lib = self._lib
+        concat = np.ascontiguousarray(concat, dtype=np.uint8)
+        offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+        count = len(offsets) - 1
+        out = np.zeros(count, dtype=np.uint32)
+        lib.slz_crc32c_batch(
+            concat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            count,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        )
+        return out

@@ -1,0 +1,257 @@
+// s3shuffle_tpu native data-plane kernels (CPU).
+//
+// The reference has zero native code (SURVEY.md §2: 100% Scala on the JVM,
+// compression delegated to Spark's codec streams and java.util.zip). This
+// library is the TPU build's native equivalent of that JVM byte plane: a fast
+// LZ77-class block codec ("SLZ" — our own format, designed around the shared
+// 9-byte frame header in codec/framing.py) and hardware-friendly checksums
+// (CRC32C slicing-by-8, Adler32), exposed with a C ABI for ctypes.
+//
+// Build: make -C s3shuffle_tpu/native   →  libs3shuffle_native.so
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// CRC32C (Castagnoli, reflected 0x82F63B78) — slicing-by-8
+// ---------------------------------------------------------------------------
+
+static uint32_t crc32c_table[8][256];
+static bool crc32c_init_done = false;
+
+static void crc32c_init() {
+    for (int i = 0; i < 256; i++) {
+        uint32_t crc = (uint32_t)i;
+        for (int j = 0; j < 8; j++)
+            crc = (crc & 1) ? (crc >> 1) ^ 0x82F63B78u : crc >> 1;
+        crc32c_table[0][i] = crc;
+    }
+    for (int i = 0; i < 256; i++) {
+        uint32_t crc = crc32c_table[0][i];
+        for (int t = 1; t < 8; t++) {
+            crc = crc32c_table[0][crc & 0xFF] ^ (crc >> 8);
+            crc32c_table[t][i] = crc;
+        }
+    }
+    crc32c_init_done = true;
+}
+
+uint32_t slz_crc32c(const uint8_t* data, size_t n, uint32_t prev) {
+    if (!crc32c_init_done) crc32c_init();
+    uint32_t crc = prev ^ 0xFFFFFFFFu;
+    while (n >= 8) {
+        uint32_t lo, hi;
+        memcpy(&lo, data, 4);
+        memcpy(&hi, data + 4, 4);
+        lo ^= crc;
+        crc = crc32c_table[7][lo & 0xFF] ^ crc32c_table[6][(lo >> 8) & 0xFF] ^
+              crc32c_table[5][(lo >> 16) & 0xFF] ^ crc32c_table[4][lo >> 24] ^
+              crc32c_table[3][hi & 0xFF] ^ crc32c_table[2][(hi >> 8) & 0xFF] ^
+              crc32c_table[1][(hi >> 16) & 0xFF] ^ crc32c_table[0][hi >> 24];
+        data += 8;
+        n -= 8;
+    }
+    while (n--) crc = crc32c_table[0][(crc ^ *data++) & 0xFF] ^ (crc >> 8);
+    return crc ^ 0xFFFFFFFFu;
+}
+
+// ---------------------------------------------------------------------------
+// Adler32 (mod 65521, deferred modulo)
+// ---------------------------------------------------------------------------
+
+uint32_t slz_adler32(const uint8_t* data, size_t n, uint32_t prev) {
+    const uint32_t MOD = 65521;
+    uint32_t a = prev & 0xFFFF, b = (prev >> 16) & 0xFFFF;
+    while (n > 0) {
+        size_t chunk = n > 5552 ? 5552 : n;  // max bytes before a,b overflow
+        n -= chunk;
+        for (size_t i = 0; i < chunk; i++) {
+            a += *data++;
+            b += a;
+        }
+        a %= MOD;
+        b %= MOD;
+    }
+    return (b << 16) | a;
+}
+
+// ---------------------------------------------------------------------------
+// SLZ: greedy LZ77 block codec (own wire format)
+//
+// Block payload = repeated groups:
+//   varint L            literal run length
+//   L literal bytes
+//   u16le offset        (absent after the final literal run)
+//   varint M            match length - MIN_MATCH
+// A group's offset/match is absent exactly when the literals reach the end of
+// the block (decoder knows the uncompressed length from the frame header).
+// Max offset 65535; matches may overlap (RLE via offset < length).
+// ---------------------------------------------------------------------------
+
+static const size_t MIN_MATCH = 4;
+static const uint32_t HASH_BITS = 14;
+
+static inline uint32_t load32(const uint8_t* p) {
+    uint32_t v;
+    memcpy(&v, p, 4);
+    return v;
+}
+
+static inline uint32_t hash4(uint32_t v) {
+    return (v * 2654435761u) >> (32 - HASH_BITS);
+}
+
+static inline uint8_t* put_varint(uint8_t* p, size_t v) {
+    while (v >= 0x80) {
+        *p++ = (uint8_t)(v | 0x80);
+        v >>= 7;
+    }
+    *p++ = (uint8_t)v;
+    return p;
+}
+
+static inline const uint8_t* get_varint(const uint8_t* p, const uint8_t* end, size_t* out) {
+    size_t v = 0;
+    int shift = 0;
+    while (p < end) {
+        uint8_t b = *p++;
+        v |= (size_t)(b & 0x7F) << shift;
+        if (!(b & 0x80)) {
+            *out = v;
+            return p;
+        }
+        shift += 7;
+        if (shift > 35) break;
+    }
+    return nullptr;  // malformed
+}
+
+// Compress one block. Returns compressed size, or 0 if output would not fit
+// in `cap` (caller stores the block raw via the framing escape).
+size_t slz_compress(const uint8_t* src, size_t n, uint8_t* dst, size_t cap) {
+    if (n == 0) return 0;
+    uint32_t table[1u << HASH_BITS];
+    memset(table, 0xFF, sizeof(table));  // 0xFFFFFFFF = empty
+
+    const uint8_t* ip = src;
+    const uint8_t* anchor = src;
+    const uint8_t* iend = src + n;
+    const uint8_t* mflimit = (n > MIN_MATCH + 8) ? iend - (MIN_MATCH + 8) : src;
+    uint8_t* op = dst;
+    uint8_t* oend = dst + cap;
+
+    while (ip < mflimit) {
+        uint32_t h = hash4(load32(ip));
+        uint32_t cand = table[h];
+        table[h] = (uint32_t)(ip - src);
+        if (cand != 0xFFFFFFFFu) {
+            const uint8_t* cp = src + cand;
+            if ((size_t)(ip - cp) <= 0xFFFF && load32(cp) == load32(ip)) {
+                // extend match forward
+                const uint8_t* m = ip + MIN_MATCH;
+                const uint8_t* c = cp + MIN_MATCH;
+                while (m < iend && *m == *c) {
+                    m++;
+                    c++;
+                }
+                size_t mlen = (size_t)(m - ip);
+                size_t llen = (size_t)(ip - anchor);
+                // emit: varint L, literals, u16 offset, varint (M - MIN_MATCH)
+                if (op + llen + 12 > oend) return 0;
+                op = put_varint(op, llen);
+                memcpy(op, anchor, llen);
+                op += llen;
+                uint16_t off = (uint16_t)(ip - cp);
+                *op++ = (uint8_t)(off & 0xFF);
+                *op++ = (uint8_t)(off >> 8);
+                op = put_varint(op, mlen - MIN_MATCH);
+                // seed hash table inside the match (sparse, every 2nd byte)
+                const uint8_t* seed_end = (ip + mlen < mflimit) ? ip + mlen : mflimit;
+                for (const uint8_t* s = ip + 1; s < seed_end; s += 2)
+                    table[hash4(load32(s))] = (uint32_t)(s - src);
+                ip += mlen;
+                anchor = ip;
+                continue;
+            }
+        }
+        ip++;
+    }
+    // final literal run
+    size_t llen = (size_t)(iend - anchor);
+    if (op + llen + 8 > oend) return 0;
+    op = put_varint(op, llen);
+    memcpy(op, anchor, llen);
+    op += llen;
+    return (size_t)(op - dst);
+}
+
+// Decompress one block of known uncompressed size. Returns bytes produced,
+// or 0 on malformed input.
+size_t slz_decompress(const uint8_t* src, size_t n, uint8_t* dst, size_t ulen) {
+    const uint8_t* ip = src;
+    const uint8_t* iend = src + n;
+    uint8_t* op = dst;
+    uint8_t* oend = dst + ulen;
+
+    while (ip < iend) {
+        size_t llen;
+        ip = get_varint(ip, iend, &llen);
+        if (!ip || llen > (size_t)(oend - op) || llen > (size_t)(iend - ip)) return 0;
+        memcpy(op, ip, llen);
+        op += llen;
+        ip += llen;
+        if (op == oend) break;  // final run, no match follows
+        if (ip + 2 > iend) return 0;
+        uint16_t off = (uint16_t)(ip[0] | (ip[1] << 8));
+        ip += 2;
+        size_t mlen;
+        ip = get_varint(ip, iend, &mlen);
+        if (!ip) return 0;
+        mlen += MIN_MATCH;
+        if (off == 0 || (size_t)(op - dst) < off || mlen > (size_t)(oend - op)) return 0;
+        const uint8_t* match = op - off;
+        if (off >= mlen) {
+            memcpy(op, match, mlen);
+            op += mlen;
+        } else {
+            // overlapping copy (RLE-style) — byte-wise
+            for (size_t i = 0; i < mlen; i++) *op++ = *match++;
+        }
+    }
+    return (size_t)(op - dst);
+}
+
+// ---------------------------------------------------------------------------
+// Batch entry points (one call per frame batch → fewer ctypes crossings)
+// ---------------------------------------------------------------------------
+
+// srcs/dsts are concatenated buffers with offset arrays (int64).
+void slz_crc32c_batch(const uint8_t* data, const int64_t* offsets, int64_t count,
+                      uint32_t* out) {
+    for (int64_t i = 0; i < count; i++) {
+        out[i] = slz_crc32c(data + offsets[i], (size_t)(offsets[i + 1] - offsets[i]), 0);
+    }
+}
+
+void slz_compress_batch(const uint8_t* src, const int64_t* src_offsets, int64_t count,
+                        uint8_t* dst, const int64_t* dst_offsets, int64_t* out_sizes) {
+    for (int64_t i = 0; i < count; i++) {
+        size_t n = (size_t)(src_offsets[i + 1] - src_offsets[i]);
+        size_t cap = (size_t)(dst_offsets[i + 1] - dst_offsets[i]);
+        out_sizes[i] = (int64_t)slz_compress(src + src_offsets[i], n, dst + dst_offsets[i], cap);
+    }
+}
+
+void slz_decompress_batch(const uint8_t* src, const int64_t* src_offsets, int64_t count,
+                          uint8_t* dst, const int64_t* dst_offsets, int64_t* out_sizes) {
+    for (int64_t i = 0; i < count; i++) {
+        size_t n = (size_t)(src_offsets[i + 1] - src_offsets[i]);
+        size_t ulen = (size_t)(dst_offsets[i + 1] - dst_offsets[i]);
+        out_sizes[i] = (int64_t)slz_decompress(src + src_offsets[i], n, dst + dst_offsets[i], ulen);
+    }
+}
+
+}  // extern "C"

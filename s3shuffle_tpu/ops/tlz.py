@@ -1,0 +1,294 @@
+"""TLZ — a TPU-native block-parallel compression format.
+
+The reference compresses shuffle bytes with JVM LZ4/Snappy streams (Spark's
+``spark.io.compression.*``; SURVEY.md §0). Byte-serial LZ parsing is hostile
+to TPUs (data-dependent control flow, scalar loops), so TLZ is designed from
+the hardware up instead of translating LZ4:
+
+- a block is split into fixed **16-byte groups** (the VPU lane shape likes
+  contiguous 16B chunks; group count per 64 KiB block = 4096 fits a u16);
+- encoding finds, for every group, the nearest previous *identical* group —
+  computed with sort-based hash matching (``argsort`` of group hashes; equal
+  hashes become sorted neighbors, so "nearest previous occurrence" is one
+  shifted compare — no hash-table scatter, no sequential scan);
+- match chains are collapsed by **pointer jumping** (log₂ G vectorized hops)
+  so every match's source is a *literal* group;
+- therefore decoding is literal placement + one parallel gather — no
+  sequential back-reference chasing like LZ77 — equally fast on TPU or in
+  vectorized numpy on the host;
+- runs (RLE) fall out naturally: a run ≥ 2 groups matches at distance 1.
+
+Wire format of one TLZ frame payload (fits the shared 9-byte frame header,
+codec_id = ``tpu-lz``):
+
+    [u16le n_groups]
+    [bitmap ceil(n_groups/8) bytes  — bit i set ⇒ group i is a match]
+    [u16le src_group_index × n_matches  — always a literal group]
+    [literal groups × 16 bytes (last one zero-padded to 16)]
+
+Ratio characteristics: catches aligned 16-byte redundancy (runs, repeated
+records, zero padding, columnar patterns); misses unaligned text redundancy —
+the CPU SLZ codec or zstd remain better for that, and the framing's raw
+escape bounds the worst case. Encoding cost is O(G log G) sort + O(G) VPU
+work per block, fully batched over B blocks.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Tuple
+
+import numpy as np
+
+GROUP = 16
+
+
+def _jax():
+    import jax
+    import jax.numpy as jnp
+
+    return jax, jnp
+
+
+# ---------------------------------------------------------------------------
+# Device encoder (batched)
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=8)
+def _encode_kernel(n_groups: int):
+    jax, jnp = _jax()
+
+    # Odd multipliers give an invertible-ish mix; collisions are fine (they
+    # are verified by exact compare) — they only cost missed matches never
+    # wrong matches.
+    mults = (np.arange(GROUP, dtype=np.int64) * 2 + 1) * 0x9E3779B1
+    mults = jnp.asarray((mults % (1 << 31)).astype(np.int32))
+
+    @jax.jit
+    def kernel(blocks_u8):
+        # blocks_u8: (B, n_groups * GROUP) uint8
+        b = blocks_u8.shape[0]
+        groups = blocks_u8.reshape(b, n_groups, GROUP).astype(jnp.int32)
+        h = jnp.sum(groups * mults[None, None, :], axis=2, dtype=jnp.int32)
+
+        # nearest previous identical group via sort: stable-sort (h, idx);
+        # an equal-hash neighbor to the left has the largest smaller index.
+        order = jnp.argsort(h, axis=1, stable=True)  # (B, G)
+        h_sorted = jnp.take_along_axis(h, order, axis=1)
+        prev_same = jnp.concatenate(
+            [jnp.full((b, 1), False), h_sorted[:, 1:] == h_sorted[:, :-1]], axis=1
+        )
+        prev_idx_sorted = jnp.concatenate(
+            [jnp.zeros((b, 1), dtype=order.dtype), order[:, :-1]], axis=1
+        )
+        cand_sorted = jnp.where(prev_same, prev_idx_sorted, -1)
+        # scatter candidates back to original positions
+        cand = jnp.zeros_like(cand_sorted).at[jnp.arange(b)[:, None], order].set(cand_sorted)
+
+        # verify exact equality (hash collisions ⇒ missed match, never wrong)
+        safe_cand = jnp.maximum(cand, 0)
+        cand_groups = jnp.take_along_axis(groups, safe_cand[:, :, None], axis=1)
+        equal = jnp.all(cand_groups == groups, axis=2) & (cand >= 0)
+
+        # pointer jumping: collapse chains so sources are literal groups
+        src = jnp.where(equal, safe_cand, jnp.arange(n_groups)[None, :])
+        for _ in range(int(np.ceil(np.log2(max(2, n_groups))))):
+            src = jnp.take_along_axis(src, src, axis=1)
+
+        is_match = equal
+        n_matches = jnp.sum(is_match, axis=1, dtype=jnp.int32)
+
+        # compact match sources and literal groups via rank + scatter
+        match_rank = jnp.cumsum(is_match, axis=1) - 1
+        lit_rank = jnp.cumsum(~is_match, axis=1) - 1
+        rows = jnp.arange(b)[:, None]
+        srcs_compact = jnp.zeros((b, n_groups), dtype=jnp.int32)
+        srcs_compact = srcs_compact.at[
+            rows, jnp.where(is_match, match_rank, n_groups - 1)
+        ].set(jnp.where(is_match, src, 0), mode="drop")
+        lits_compact = jnp.zeros((b, n_groups, GROUP), dtype=jnp.uint8)
+        lits_compact = lits_compact.at[
+            rows, jnp.where(is_match, n_groups - 1, lit_rank)
+        ].set(jnp.where(is_match[:, :, None], 0, groups).astype(jnp.uint8), mode="drop")
+
+        # bitmap packed to uint8 (little-endian bit order within the byte)
+        bit_weights = jnp.asarray([1, 2, 4, 8, 16, 32, 64, 128], dtype=jnp.int32)
+        bitmap = jnp.sum(
+            is_match.reshape(b, n_groups // 8, 8).astype(jnp.int32) * bit_weights[None, None, :],
+            axis=2,
+            dtype=jnp.int32,
+        ).astype(jnp.uint8)
+
+        return bitmap, srcs_compact.astype(jnp.uint16), lits_compact, n_matches
+
+    return kernel
+
+
+def encode_blocks_device(blocks: List[bytes], block_size: int) -> List[bytes]:
+    """Encode a batch of ≤block_size byte blocks on the device. Returns the
+    TLZ payload per block (caller applies the framing raw-escape when a
+    payload fails to shrink)."""
+    if block_size % (8 * GROUP) != 0:
+        raise ValueError("block_size must be a multiple of 128")
+    n_groups = block_size // GROUP
+    b = len(blocks)
+    staged = np.zeros((b, block_size), dtype=np.uint8)
+    for i, blk in enumerate(blocks):
+        arr = np.frombuffer(blk, dtype=np.uint8)
+        staged[i, : len(arr)] = arr
+    bitmap, srcs, lits, n_matches = (
+        np.asarray(x) for x in _encode_kernel(n_groups)(staged)
+    )
+    out: List[bytes] = []
+    header = np.array([n_groups], dtype="<u2").tobytes()
+    for i, blk in enumerate(blocks):
+        used_groups = (len(blk) + GROUP - 1) // GROUP
+        if used_groups < n_groups:
+            # Short (final) block: re-encode host-side view of the bitmap for
+            # just the used groups. Matches among pad groups are discarded.
+            payload = _assemble_payload_numpy(blk)
+        else:
+            m = int(n_matches[i])
+            payload = (
+                header
+                + bitmap[i].tobytes()
+                + srcs[i, :m].astype("<u2").tobytes()
+                + lits[i, : n_groups - m].tobytes()
+            )
+        out.append(payload)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Host (numpy) encoder/decoder — used for short tail blocks, for CPU-side
+# reads of tpu-lz frames, and as the differential-testing oracle.
+# ---------------------------------------------------------------------------
+
+
+def _group_view(data: bytes) -> Tuple[np.ndarray, int]:
+    n_groups = (len(data) + GROUP - 1) // GROUP
+    padded = np.zeros(n_groups * GROUP, dtype=np.uint8)
+    padded[: len(data)] = np.frombuffer(data, dtype=np.uint8)
+    return padded.reshape(n_groups, GROUP), n_groups
+
+
+def _assemble_payload_numpy(data: bytes) -> bytes:
+    groups, n_groups = _group_view(data)
+    h = groups.astype(np.int64) @ (np.arange(GROUP, dtype=np.int64) * 2 + 1)
+    order = np.argsort(h, kind="stable")
+    h_sorted = h[order]
+    prev_same = np.concatenate([[False], h_sorted[1:] == h_sorted[:-1]])
+    prev_idx = np.concatenate([[0], order[:-1]])
+    cand_sorted = np.where(prev_same, prev_idx, -1)
+    cand = np.zeros(n_groups, dtype=np.int64)
+    cand[order] = cand_sorted
+    safe = np.maximum(cand, 0)
+    equal = (groups[safe] == groups).all(axis=1) & (cand >= 0)
+    src = np.where(equal, safe, np.arange(n_groups))
+    for _ in range(int(np.ceil(np.log2(max(2, n_groups))))):
+        src = src[src]
+    is_match = equal
+    bitmap = np.packbits(is_match.astype(np.uint8), bitorder="little")
+    srcs = src[is_match].astype("<u2")
+    lits = groups[~is_match]
+    return (
+        np.array([n_groups], dtype="<u2").tobytes()
+        + bitmap.tobytes()
+        + srcs.tobytes()
+        + lits.tobytes()
+    )
+
+
+def decode_payload_numpy(payload: bytes, uncompressed_len: int) -> bytes:
+    if len(payload) < 2:
+        raise IOError("TLZ payload too short")
+    n_groups = int(np.frombuffer(payload[:2], dtype="<u2")[0])
+    bm_len = (n_groups + 7) // 8
+    off = 2
+    bitmap = np.frombuffer(payload[off : off + bm_len], dtype=np.uint8)
+    off += bm_len
+    if len(bitmap) < bm_len:
+        raise IOError("TLZ bitmap truncated")
+    is_match = np.unpackbits(bitmap, count=n_groups, bitorder="little").astype(bool)
+    n_matches = int(is_match.sum())
+    srcs = np.frombuffer(payload[off : off + 2 * n_matches], dtype="<u2")
+    off += 2 * n_matches
+    if len(srcs) < n_matches:
+        raise IOError("TLZ sources truncated")
+    n_lits = n_groups - n_matches
+    lits = np.frombuffer(payload[off : off + n_lits * GROUP], dtype=np.uint8)
+    if len(lits) < n_lits * GROUP:
+        raise IOError("TLZ literals truncated")
+    out = np.zeros((n_groups, GROUP), dtype=np.uint8)
+    out[~is_match] = lits.reshape(n_lits, GROUP)
+    src_idx = srcs.astype(np.int64)
+    if n_matches:
+        if (src_idx >= n_groups).any() or is_match[src_idx].any():
+            raise IOError("TLZ match source is not a literal group")
+        out[is_match] = out[src_idx]
+    flat = out.reshape(-1)[:uncompressed_len]
+    return flat.tobytes()
+
+
+@functools.lru_cache(maxsize=8)
+def _decode_kernel(n_groups: int):
+    """Batched device decoder: fixed-shape inputs (padded), parallel gather."""
+    jax, jnp = _jax()
+
+    @jax.jit
+    def kernel(is_match, srcs_padded, lits_padded):
+        # is_match: (B, G) bool; srcs_padded: (B, G) int32 (match slots filled
+        # in match order); lits_padded: (B, G, GROUP) uint8 (literal slots in
+        # literal order).
+        b = is_match.shape[0]
+        rows = jnp.arange(b)[:, None]
+        match_rank = jnp.cumsum(is_match, axis=1) - 1
+        lit_rank = jnp.cumsum(~is_match, axis=1) - 1
+        out = jnp.zeros((b, n_groups, GROUP), dtype=jnp.uint8)
+        lit_vals = jnp.take_along_axis(
+            lits_padded, jnp.maximum(lit_rank, 0)[:, :, None], axis=1
+        )
+        out = jnp.where(is_match[:, :, None], 0, lit_vals)
+        src_of = jnp.take_along_axis(srcs_padded, jnp.maximum(match_rank, 0), axis=1)
+        gathered = jnp.take_along_axis(out, src_of[:, :, None], axis=1)
+        out = jnp.where(is_match[:, :, None], gathered, out)
+        return out.reshape(b, n_groups * GROUP)
+
+    return kernel
+
+
+def decode_blocks_device(payloads: List[bytes], ulens: List[int], block_size: int) -> List[bytes]:
+    """Batched device decode of full-size TLZ payloads; short blocks fall back
+    to the numpy decoder."""
+    n_groups = block_size // GROUP
+    b = len(payloads)
+    is_match = np.zeros((b, n_groups), dtype=bool)
+    srcs = np.zeros((b, n_groups), dtype=np.int32)
+    lits = np.zeros((b, n_groups, GROUP), dtype=np.uint8)
+    fallback: dict[int, bytes] = {}
+    for i, payload in enumerate(payloads):
+        ng = int(np.frombuffer(payload[:2], dtype="<u2")[0])
+        if ng != n_groups:
+            fallback[i] = decode_payload_numpy(payload, ulens[i])
+            continue
+        bm_len = (ng + 7) // 8
+        bm = np.frombuffer(payload[2 : 2 + bm_len], dtype=np.uint8)
+        m = np.unpackbits(bm, count=ng, bitorder="little").astype(bool)
+        nm = int(m.sum())
+        off = 2 + bm_len
+        s = np.frombuffer(payload[off : off + 2 * nm], dtype="<u2")
+        off += 2 * nm
+        nl = ng - nm
+        l = np.frombuffer(payload[off : off + nl * GROUP], dtype=np.uint8)
+        is_match[i] = m
+        srcs[i, :nm] = s
+        lits[i, :nl] = l.reshape(nl, GROUP)
+    decoded = np.asarray(_decode_kernel(n_groups)(is_match, srcs, lits))
+    out = []
+    for i in range(b):
+        if i in fallback:
+            out.append(fallback[i])
+        else:
+            out.append(decoded[i, : ulens[i]].tobytes())
+    return out

@@ -52,7 +52,11 @@ class _PartitionPipeline:
         self.spill_segments: List[Tuple[int, int]] = []  # (offset, length) in spill file
 
     def buffered_bytes(self) -> int:
-        return self.sink.tell()
+        # Count bytes queued inside the codec stream too (batch codecs hold
+        # full raw blocks until a batch flush) — the spill budget must see
+        # them or a wide shuffle with the TPU codec blows past the budget.
+        pending = self.codec_stream.pending_bytes if self.codec_stream is not None else 0
+        return self.sink.tell() + pending
 
     def flush_to_frame_boundary(self) -> bytes:
         self.record_writer.flush()

@@ -1,0 +1,156 @@
+"""TLZ device codec: roundtrip, format, fused checksum, end-to-end shuffle."""
+
+import io
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+from s3shuffle_tpu.codec.framing import CodecInputStream, CodecOutputStream
+from s3shuffle_tpu.codec.tpu import (
+    FusedChecksumAccumulator,
+    TpuCodec,
+    fused_compress_and_checksum,
+)
+from s3shuffle_tpu.ops import tlz
+from s3shuffle_tpu.ops.checksum import POLY_CRC32
+from s3shuffle_tpu.utils.checksums import crc32c_py
+
+BS = 2048  # small block for tests (multiple of 128)
+
+
+def _payload_cases():
+    rng = np.random.default_rng(0)
+    compressible = (b"HEADER_ROW_0123" + b"\x00" * 49) * 200  # aligned repeats
+    runs = b"A" * 3000 + b"B" * 3000 + bytes(rng.integers(0, 256, 1000, dtype=np.uint8))
+    return [
+        b"",
+        b"x",
+        b"0123456789abcdef" * 4,  # exact groups, all identical → matches
+        compressible,
+        runs,
+        os.urandom(BS * 3 + 17),  # incompressible with odd tail
+        os.urandom(BS),  # exactly one block
+    ]
+
+
+@pytest.mark.parametrize("idx", range(7))
+def test_tlz_numpy_roundtrip(idx):
+    data = _payload_cases()[idx]
+    payload = tlz._assemble_payload_numpy(data)
+    assert tlz.decode_payload_numpy(payload, len(data)) == data
+
+
+def test_tlz_device_encode_matches_numpy_decode():
+    rng = np.random.default_rng(1)
+    blocks = [
+        (b"record-%04d-----" % (i % 7)) * (BS // 16) for i in range(5)
+    ] + [bytes(rng.integers(0, 256, BS, dtype=np.uint8)) for _ in range(3)]
+    encoded = tlz.encode_blocks_device(blocks, BS)
+    for raw, payload in zip(blocks, encoded):
+        assert tlz.decode_payload_numpy(payload, len(raw)) == raw
+
+
+def test_tlz_device_decode_matches():
+    blocks = [(b"0123456789abcdef" * (BS // 16)), os.urandom(BS), b"Z" * BS]
+    encoded = tlz.encode_blocks_device(blocks, BS)
+    decoded = tlz.decode_blocks_device(encoded, [len(b) for b in blocks], BS)
+    assert decoded == blocks
+
+
+def test_tlz_compresses_aligned_redundancy():
+    data = b"0123456789abcdef" * (BS // 16)  # one repeated group
+    payload = tlz._assemble_payload_numpy(data)
+    # 1 literal group + (G-1) matches: ~2 + G/8 + 2(G-1) + 16 bytes
+    assert len(payload) < len(data) // 4
+
+
+def test_tlz_corrupt_payload_raises():
+    data = b"0123456789abcdef" * 8
+    payload = bytearray(tlz._assemble_payload_numpy(data))
+    with pytest.raises(IOError):
+        tlz.decode_payload_numpy(bytes(payload[:3]), len(data))
+    # corrupt a source index to point at a match group
+    with pytest.raises(IOError):
+        tlz.decode_payload_numpy(payload[:2] + b"\xff" * (len(payload) - 2), len(data))
+
+
+def test_tpu_codec_stream_roundtrip():
+    codec = TpuCodec(block_size=BS, batch_blocks=4)
+    for data in _payload_cases():
+        sink = io.BytesIO()
+        out = CodecOutputStream(codec, sink, close_sink=False)
+        # write in awkward chunk sizes to exercise buffering
+        for ofs in range(0, len(data), 700):
+            out.write(data[ofs : ofs + 700])
+        out.close()
+        got = CodecInputStream(codec, io.BytesIO(sink.getvalue())).read()
+        assert got == data
+
+
+def test_tpu_codec_batched_framing_identical_to_single():
+    # batch_blocks must not change the emitted bytes' decodability or
+    # the concatenation property
+    codec_b = TpuCodec(block_size=BS, batch_blocks=8)
+    data = (b"batchable-frame-" * 512) + os.urandom(777)
+    framed = codec_b.compress_bytes(data)
+    assert codec_b.decompress_bytes(framed) == data
+    # concatenation property survives batching
+    other = b"tail" * 100
+    cat = framed + codec_b.compress_bytes(other)
+    assert codec_b.decompress_bytes(cat) == data + other
+
+
+def test_fused_checksum_equals_streaming_crc():
+    codec = TpuCodec(block_size=BS, batch_blocks=8)
+    rng = np.random.default_rng(2)
+    blocks = [
+        (b"fuse-test-group-" * (BS // 16)),
+        bytes(rng.integers(0, 256, BS, dtype=np.uint8)),
+        (b"\x00" * BS),
+    ]
+    frames, frame_crcs = fused_compress_and_checksum(codec, blocks)
+    # per-frame device CRC == byte-serial CRC of each stored frame
+    for frame, crc in zip(frames, frame_crcs):
+        assert crc == crc32c_py(frame)
+    # stitched partition checksum == byte-serial CRC over all stored bytes
+    acc = FusedChecksumAccumulator()
+    for frame, crc in zip(frames, frame_crcs):
+        acc._crc = __import__(
+            "s3shuffle_tpu.ops.checksum", fromlist=["crc_combine"]
+        ).crc_combine(acc._crc, crc, len(frame), acc.poly)
+    assert acc.value == crc32c_py(b"".join(frames))
+
+
+def test_fused_accumulator_header_payload_split():
+    acc = FusedChecksumAccumulator(poly=POLY_CRC32)
+    header, payload = b"HDRHDRHDR", os.urandom(500)
+    acc.add_frame(header, zlib.crc32(payload) & 0xFFFFFFFF, len(payload))
+    assert acc.value == (zlib.crc32(header + payload) & 0xFFFFFFFF)
+
+
+def test_end_to_end_shuffle_with_tpu_codec(tmp_path):
+    import collections
+    import random
+
+    from s3shuffle_tpu.config import ShuffleConfig
+    from s3shuffle_tpu.shuffle import ShuffleContext
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/tpu-shuffle",
+        app_id="tpu-e2e",
+        codec="tpu",
+        codec_block_size=BS,
+    )
+    rng = random.Random(3)
+    parts = [[(rng.randrange(20), 1) for _ in range(2000)] for _ in range(3)]
+    expected = collections.Counter()
+    for p in parts:
+        for k, v in p:
+            expected[k] += v
+    with ShuffleContext(config=cfg, num_workers=2) as ctx:
+        result = dict(ctx.fold_by_key(parts, 0, lambda a, b: a + b, num_partitions=3))
+    assert result == dict(expected)
