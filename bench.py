@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Benchmark: shuffle bytes/sec/chip (write+read), terasort-style workload.
+
+Mirrors BASELINE.json config #1: terasort-shaped KV shuffle against a
+``file://`` root. The measured configuration uses the framework's native C++
+SLZ codec (the CPU data plane); the baseline is the same shuffle through
+zlib-1 — the stand-in for the reference's JVM LZ4-class codec stream
+("examples/terasort 1GB, local[4] ... JVM LZ4 (CPU baseline)").
+
+Also reports (extra JSON keys) the TPU device-kernel rates measured on the
+attached chip: batched CRC32C and TLZ encode, plus host-link bandwidth —
+the offload path's building blocks.
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ..., ...extras}
+"""
+
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+RECORDS_PER_MAP = 120_000
+N_MAPS = 6
+N_REDUCERS = 8
+KEY_BYTES, VALUE_BYTES = 10, 90  # terasort record shape
+
+
+def gen_partitions(seed=42):
+    rng = random.Random(seed)
+    filler = [rng.randbytes(VALUE_BYTES) for _ in range(64)]  # semi-compressible values
+    parts = []
+    for _m in range(N_MAPS):
+        part = [
+            (rng.randbytes(KEY_BYTES), filler[rng.randrange(64)])
+            for _ in range(RECORDS_PER_MAP)
+        ]
+        parts.append(part)
+    return parts
+
+
+def run_shuffle(parts, codec: str, workers: int = 4):
+    from s3shuffle_tpu.config import ShuffleConfig
+    from s3shuffle_tpu.serializer import BytesKVSerializer
+    from s3shuffle_tpu.shuffle import ShuffleContext
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+
+    root = tempfile.mkdtemp(prefix=f"s3shuffle-bench-{codec}-")
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{root}",
+        app_id=f"bench-{codec}",
+        codec=codec,
+        checksum_algorithm="CRC32C" if codec in ("native", "tpu") else "ADLER32",
+    )
+    try:
+        ctx = ShuffleContext(config=cfg, num_workers=workers)
+        t0 = time.perf_counter()
+        out = ctx.sort_by_key(parts, num_partitions=N_REDUCERS, serializer=BytesKVSerializer())
+        dt = time.perf_counter() - t0
+        n_records = sum(len(p) for p in out)
+        assert n_records == N_MAPS * RECORDS_PER_MAP, f"lost records: {n_records}"
+        flat_keys = [k for p in out for k, _v in p]
+        assert flat_keys == sorted(flat_keys), "ordering broken"
+        ctx.stop()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    raw_bytes = N_MAPS * RECORDS_PER_MAP * (KEY_BYTES + VALUE_BYTES + 8)
+    return raw_bytes / dt, dt
+
+
+def device_kernel_rates():
+    """On-chip rates for the offload building blocks (independent of the
+    host link, which on this rig is a slow tunnel)."""
+    out = {}
+    try:
+        import numpy as np
+
+        from s3shuffle_tpu.ops import tlz
+        from s3shuffle_tpu.ops.checksum import POLY_CRC32C, crc32_batch
+
+        L, B = 16 * 1024, 128  # 2 MiB per batch keeps tunnel staging sane
+        rng = np.random.default_rng(0)
+        batch = rng.integers(0, 256, size=(B, L), dtype=np.uint8)
+        lengths = np.full(B, L, dtype=np.int64)
+        crc32_batch(batch, lengths, POLY_CRC32C)  # compile
+        t0 = time.perf_counter()
+        iters = 3
+        for _ in range(iters):
+            crc32_batch(batch, lengths, POLY_CRC32C)
+        out["tpu_crc32c_mb_s"] = round(iters * B * L / 1e6 / (time.perf_counter() - t0), 1)
+
+        blocks = [batch[i].tobytes() for i in range(B)]
+        tlz.encode_blocks_device(blocks, L)  # compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            tlz.encode_blocks_device(blocks, L)
+        out["tpu_tlz_encode_mb_s"] = round(iters * B * L / 1e6 / (time.perf_counter() - t0), 1)
+    except Exception as e:  # never fail the bench over the TPU probe
+        out["tpu_probe_error"] = str(e)[:120]
+    return out
+
+
+def main():
+    parts = gen_partitions()
+    native_bps, native_s = run_shuffle(parts, "native")
+    zlib_bps, zlib_s = run_shuffle(parts, "zlib")
+    extras = device_kernel_rates()
+    result = {
+        "metric": "shuffle bytes/sec/chip (write+read), terasort-style, native codec",
+        "value": round(native_bps / 1e6, 2),
+        "unit": "MB/s",
+        "vs_baseline": round(native_bps / zlib_bps, 3),
+        "baseline": "same shuffle through zlib-1 (JVM LZ4-class CPU codec stand-in)",
+        "native_wall_s": round(native_s, 2),
+        "zlib_wall_s": round(zlib_s, 2),
+        "shuffle_mb": round(N_MAPS * RECORDS_PER_MAP * (KEY_BYTES + VALUE_BYTES + 8) / 1e6, 1),
+        **extras,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -184,28 +184,29 @@ def _jax():
     return jax, jnp
 
 
+def _crc_math(data_u8, w_bits, length: int):
+    """Raw (unjitted) CRC computation — shared by the standalone kernel and
+    larger fused traces (see __graft_entry__)."""
+    jax, jnp = _jax()
+    # data_u8: (B, L) uint8, right-aligned. w_bits: (L*8, 32) int8.
+    b = data_u8.shape[0]
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (data_u8[:, :, None] >> shifts[None, None, :]) & jnp.uint8(1)
+    bits = bits.reshape(b, length * 8).astype(jnp.int8)
+    counts = jax.lax.dot_general(
+        bits,
+        w_bits,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )  # (B, 32) — MXU int8 matmul, exact int32 accumulation
+    parity = (counts & 1).astype(jnp.uint32)
+    return jnp.sum(parity << jnp.arange(32, dtype=jnp.uint32)[None, :], axis=1, dtype=jnp.uint32)
+
+
 @functools.lru_cache(maxsize=8)
 def _crc_kernel(length: int):
-    jax, jnp = _jax()
-
-    @jax.jit
-    def kernel(data_u8, w_bits):
-        # data_u8: (B, L) uint8, right-aligned. w_bits: (L*8, 32) int8.
-        b = data_u8.shape[0]
-        shifts = jnp.arange(8, dtype=jnp.uint8)
-        bits = (data_u8[:, :, None] >> shifts[None, None, :]) & jnp.uint8(1)
-        bits = bits.reshape(b, length * 8).astype(jnp.int8)
-        counts = jax.lax.dot_general(
-            bits,
-            w_bits,
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.int32,
-        )  # (B, 32) — MXU int8 matmul, exact int32 accumulation
-        parity = (counts & 1).astype(jnp.uint32)
-        packed = jnp.sum(parity << jnp.arange(32, dtype=jnp.uint32)[None, :], axis=1, dtype=jnp.uint32)
-        return packed
-
-    return kernel
+    jax, _jnp = _jax()
+    return jax.jit(functools.partial(_crc_math, length=length))
 
 
 def crc32_batch(blocks, lengths, poly: int = POLY_CRC32C, block_len: int | None = None) -> np.ndarray:

@@ -55,8 +55,9 @@ def _jax():
 # ---------------------------------------------------------------------------
 
 
-@functools.lru_cache(maxsize=8)
-def _encode_kernel(n_groups: int):
+def _encode_math(blocks_u8, n_groups: int):
+    """The raw (unjitted) encode computation — shared by the standalone
+    jitted kernel and larger fused traces (see __graft_entry__)."""
     jax, jnp = _jax()
 
     # Odd multipliers give an invertible-ish mix; collisions are fine (they
@@ -65,64 +66,65 @@ def _encode_kernel(n_groups: int):
     mults = (np.arange(GROUP, dtype=np.int64) * 2 + 1) * 0x9E3779B1
     mults = jnp.asarray((mults % (1 << 31)).astype(np.int32))
 
-    @jax.jit
-    def kernel(blocks_u8):
-        # blocks_u8: (B, n_groups * GROUP) uint8
-        b = blocks_u8.shape[0]
-        groups = blocks_u8.reshape(b, n_groups, GROUP).astype(jnp.int32)
-        h = jnp.sum(groups * mults[None, None, :], axis=2, dtype=jnp.int32)
+    b = blocks_u8.shape[0]
+    groups = blocks_u8.reshape(b, n_groups, GROUP).astype(jnp.int32)
+    h = jnp.sum(groups * mults[None, None, :], axis=2, dtype=jnp.int32)
 
-        # nearest previous identical group via sort: stable-sort (h, idx);
-        # an equal-hash neighbor to the left has the largest smaller index.
-        order = jnp.argsort(h, axis=1, stable=True)  # (B, G)
-        h_sorted = jnp.take_along_axis(h, order, axis=1)
-        prev_same = jnp.concatenate(
-            [jnp.full((b, 1), False), h_sorted[:, 1:] == h_sorted[:, :-1]], axis=1
-        )
-        prev_idx_sorted = jnp.concatenate(
-            [jnp.zeros((b, 1), dtype=order.dtype), order[:, :-1]], axis=1
-        )
-        cand_sorted = jnp.where(prev_same, prev_idx_sorted, -1)
-        # scatter candidates back to original positions
-        cand = jnp.zeros_like(cand_sorted).at[jnp.arange(b)[:, None], order].set(cand_sorted)
+    # nearest previous identical group via sort: stable-sort (h, idx);
+    # an equal-hash neighbor to the left has the largest smaller index.
+    order = jnp.argsort(h, axis=1, stable=True)  # (B, G)
+    h_sorted = jnp.take_along_axis(h, order, axis=1)
+    prev_same = jnp.concatenate(
+        [jnp.full((b, 1), False), h_sorted[:, 1:] == h_sorted[:, :-1]], axis=1
+    )
+    prev_idx_sorted = jnp.concatenate(
+        [jnp.zeros((b, 1), dtype=order.dtype), order[:, :-1]], axis=1
+    )
+    cand_sorted = jnp.where(prev_same, prev_idx_sorted, -1)
+    # scatter candidates back to original positions
+    cand = jnp.zeros_like(cand_sorted).at[jnp.arange(b)[:, None], order].set(cand_sorted)
 
-        # verify exact equality (hash collisions ⇒ missed match, never wrong)
-        safe_cand = jnp.maximum(cand, 0)
-        cand_groups = jnp.take_along_axis(groups, safe_cand[:, :, None], axis=1)
-        equal = jnp.all(cand_groups == groups, axis=2) & (cand >= 0)
+    # verify exact equality (hash collisions ⇒ missed match, never wrong)
+    safe_cand = jnp.maximum(cand, 0)
+    cand_groups = jnp.take_along_axis(groups, safe_cand[:, :, None], axis=1)
+    equal = jnp.all(cand_groups == groups, axis=2) & (cand >= 0)
 
-        # pointer jumping: collapse chains so sources are literal groups
-        src = jnp.where(equal, safe_cand, jnp.arange(n_groups)[None, :])
-        for _ in range(int(np.ceil(np.log2(max(2, n_groups))))):
-            src = jnp.take_along_axis(src, src, axis=1)
+    # pointer jumping: collapse chains so sources are literal groups
+    src = jnp.where(equal, safe_cand, jnp.arange(n_groups)[None, :])
+    for _ in range(int(np.ceil(np.log2(max(2, n_groups))))):
+        src = jnp.take_along_axis(src, src, axis=1)
 
-        is_match = equal
-        n_matches = jnp.sum(is_match, axis=1, dtype=jnp.int32)
+    is_match = equal
+    n_matches = jnp.sum(is_match, axis=1, dtype=jnp.int32)
 
-        # compact match sources and literal groups via rank + scatter
-        match_rank = jnp.cumsum(is_match, axis=1) - 1
-        lit_rank = jnp.cumsum(~is_match, axis=1) - 1
-        rows = jnp.arange(b)[:, None]
-        srcs_compact = jnp.zeros((b, n_groups), dtype=jnp.int32)
-        srcs_compact = srcs_compact.at[
-            rows, jnp.where(is_match, match_rank, n_groups - 1)
-        ].set(jnp.where(is_match, src, 0), mode="drop")
-        lits_compact = jnp.zeros((b, n_groups, GROUP), dtype=jnp.uint8)
-        lits_compact = lits_compact.at[
-            rows, jnp.where(is_match, n_groups - 1, lit_rank)
-        ].set(jnp.where(is_match[:, :, None], 0, groups).astype(jnp.uint8), mode="drop")
+    # compact match sources and literal groups via rank + scatter
+    match_rank = jnp.cumsum(is_match, axis=1) - 1
+    lit_rank = jnp.cumsum(~is_match, axis=1) - 1
+    rows = jnp.arange(b)[:, None]
+    srcs_compact = jnp.zeros((b, n_groups), dtype=jnp.int32)
+    srcs_compact = srcs_compact.at[
+        rows, jnp.where(is_match, match_rank, n_groups - 1)
+    ].set(jnp.where(is_match, src, 0), mode="drop")
+    lits_compact = jnp.zeros((b, n_groups, GROUP), dtype=jnp.uint8)
+    lits_compact = lits_compact.at[
+        rows, jnp.where(is_match, n_groups - 1, lit_rank)
+    ].set(jnp.where(is_match[:, :, None], 0, groups).astype(jnp.uint8), mode="drop")
 
-        # bitmap packed to uint8 (little-endian bit order within the byte)
-        bit_weights = jnp.asarray([1, 2, 4, 8, 16, 32, 64, 128], dtype=jnp.int32)
-        bitmap = jnp.sum(
-            is_match.reshape(b, n_groups // 8, 8).astype(jnp.int32) * bit_weights[None, None, :],
-            axis=2,
-            dtype=jnp.int32,
-        ).astype(jnp.uint8)
+    # bitmap packed to uint8 (little-endian bit order within the byte)
+    bit_weights = jnp.asarray([1, 2, 4, 8, 16, 32, 64, 128], dtype=jnp.int32)
+    bitmap = jnp.sum(
+        is_match.reshape(b, n_groups // 8, 8).astype(jnp.int32) * bit_weights[None, None, :],
+        axis=2,
+        dtype=jnp.int32,
+    ).astype(jnp.uint8)
 
-        return bitmap, srcs_compact.astype(jnp.uint16), lits_compact, n_matches
+    return bitmap, srcs_compact.astype(jnp.uint16), lits_compact, n_matches
 
-    return kernel
+
+@functools.lru_cache(maxsize=8)
+def _encode_kernel(n_groups: int):
+    jax, _jnp = _jax()
+    return jax.jit(functools.partial(_encode_math, n_groups=n_groups))
 
 
 def encode_blocks_device(blocks: List[bytes], block_size: int) -> List[bytes]:

@@ -1,0 +1,15 @@
+"""ICI fast path: on-pod repartition via XLA collectives.
+
+The reference's only data plane is the object store (SURVEY.md §5.8 — no
+NCCL/MPI; the "network" is S3). For data that originates on-device, a TPU pod
+has a far better interconnect: this package repartitions sharded record
+batches with ``shard_map`` + ``all_to_all`` over a ``jax.sharding.Mesh``, so
+intra-pod shuffles ride ICI and only spill to the object store across
+pods/DCN or for durability (the store path remains the elastic/decommission-
+safe layer, exactly like the reference).
+"""
+
+from s3shuffle_tpu.parallel.mesh import make_mesh
+from s3shuffle_tpu.parallel.repartition import device_repartition, plan_capacity
+
+__all__ = ["make_mesh", "device_repartition", "plan_capacity"]

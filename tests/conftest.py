@@ -7,10 +7,18 @@ path via __graft_entry__.dryrun_multichip).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard override: the machine environment pins JAX_PLATFORMS=axon (the real
+# TPU tunnel); the test suite always runs on a virtual 8-device CPU mesh.
+# The axon PJRT plugin ignores the env var once set to "axon", so the config
+# update after import is what actually wins.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
