@@ -56,6 +56,9 @@ def _load() -> ctypes.CDLL:
         lib.slz_compress_batch.argtypes = [u8p, i64p, ctypes.c_int64, u8p, i64p, i64p]
         lib.slz_decompress_batch.restype = None
         lib.slz_decompress_batch.argtypes = [u8p, i64p, ctypes.c_int64, u8p, i64p, i64p]
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.slz_ragged_gather.restype = None
+        lib.slz_ragged_gather.argtypes = [u8p, i64p, i32p, i64p, ctypes.c_int64, u8p]
         _lib = lib
         return lib
 
@@ -78,6 +81,28 @@ def native_crc32c(data: bytes, value: int = 0) -> int:
         return value
     buf = ctypes.cast(ctypes.c_char_p(data), ctypes.POINTER(ctypes.c_uint8))
     return lib.slz_crc32c(buf, len(data), value)
+
+
+def native_ragged_gather(
+    buf: np.ndarray, offsets: np.ndarray, lens: np.ndarray, idx: np.ndarray, total: int
+) -> np.ndarray:
+    """Gather ragged rows ``idx`` of (buf, offsets, lens) into one contiguous
+    uint8 array of ``total`` bytes (one memcpy per row, no index arrays)."""
+    lib = _load()
+    buf = np.ascontiguousarray(buf, dtype=np.uint8)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    lens = np.ascontiguousarray(lens, dtype=np.int32)
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    out = np.empty(total, dtype=np.uint8)
+    lib.slz_ragged_gather(
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(idx),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    return out
 
 
 def native_adler32(data: bytes, value: int = 1) -> int:

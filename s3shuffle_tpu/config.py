@@ -55,6 +55,9 @@ class ShuffleConfig:
     # --- read plane ---
     max_buffer_size_task: int = 128 * MiB
     max_concurrency_task: int = 10
+    # in-memory budget for key-ordered reduce output before the batch sorter
+    # spills sorted columnar runs (analog of Spark's ExternalSorter memory)
+    sorter_spill_bytes: int = 256 * MiB
     use_block_manager: bool = True
     force_batch_fetch: bool = False
     # --- caches ---

@@ -15,11 +15,26 @@ from s3shuffle_tpu.aggregator import Aggregator
 from s3shuffle_tpu.serializer import PickleBatchSerializer, Serializer
 
 
+def natural_key(k):
+    """Identity key function. Used as a *marker*: when a dependency's
+    ``key_ordering`` or a RangePartitioner's key func IS this function, the
+    batch data plane knows keys order by raw bytes and takes the vectorized
+    sort/searchsorted path."""
+    return k
+
+
 class Partitioner:
     num_partitions: int
 
     def __call__(self, key: Any) -> int:
         raise NotImplementedError
+
+    def partition_batch(self, batch) -> "Any":
+        """Partition ids (np.int64 array) for a RecordBatch. Base: scalar
+        loop; subclasses vectorize where the key domain allows."""
+        import numpy as np
+
+        return np.fromiter((self(k) for k in batch.iter_keys()), np.int64, batch.n)
 
 
 class HashPartitioner(Partitioner):
@@ -39,12 +54,42 @@ class RangePartitioner(Partitioner):
     def __init__(self, bounds, key_func: Optional[Callable[[Any], Any]] = None):
         self.bounds = list(bounds)
         self.num_partitions = len(self.bounds) + 1
-        self._key = key_func or (lambda k: k)
+        self._key = key_func or natural_key
 
     def __call__(self, key: Any) -> int:
         import bisect
 
         return bisect.bisect_left(self.bounds, self._key(key))
+
+    def partition_batch(self, batch):
+        import bisect
+
+        import numpy as np
+
+        if (
+            self._key is not natural_key
+            or not self.bounds
+            or not isinstance(self.bounds[0], bytes)
+        ):
+            if not self.bounds:
+                return np.zeros(batch.n, dtype=np.int64)
+            return super().partition_batch(batch)
+        width = max(int(batch.klens.max()) if batch.n else 0, max(len(b) for b in self.bounds), 1)
+        skeys = batch.key_strings(width=width)
+        sbounds = np.array(self.bounds, dtype=f"S{width}")
+        pos = np.searchsorted(sbounds, skeys, side="left").astype(np.int64)
+        # Zero-pad ties: numpy S-compare is memcmp over the padded width, so a
+        # key that zero-pad-equals bounds[pos] may truly be > bounds[pos]
+        # (key = bound + b"\x00"*k). Re-resolve those rows with true bytes
+        # bisect (matches __call__ exactly).
+        cand = np.nonzero((pos < len(sbounds)) & (sbounds[np.minimum(pos, len(sbounds) - 1)] == skeys))[0]
+        if len(cand):
+            kb = batch.keys.tobytes()
+            ko = batch.koffsets
+            for i in cand.tolist():
+                key = kb[ko[i] : ko[i + 1]]
+                pos[i] = bisect.bisect_left(self.bounds, key)
+        return pos
 
 
 def range_bounds(sample_keys, num_partitions: int):

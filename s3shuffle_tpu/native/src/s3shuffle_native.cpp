@@ -254,4 +254,19 @@ void slz_decompress_batch(const uint8_t* src, const int64_t* src_offsets, int64_
     }
 }
 
+// Ragged row gather for the columnar record plane: dst receives rows
+// idx[0..n) of a ragged byte buffer (row i at src+offsets[i], length
+// lens[i]), concatenated. One memcpy per row — numpy fancy indexing costs
+// 8 bytes of int64 index per gathered byte; this costs nothing.
+void slz_ragged_gather(const uint8_t* src, const int64_t* offsets, const int32_t* lens,
+                       const int64_t* idx, int64_t n, uint8_t* dst) {
+    uint8_t* op = dst;
+    for (int64_t i = 0; i < n; i++) {
+        int64_t row = idx[i];
+        size_t len = (size_t)lens[row];
+        memcpy(op, src + offsets[row], len);
+        op += len;
+    }
+}
+
 }  // extern "C"

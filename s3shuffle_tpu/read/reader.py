@@ -131,7 +131,7 @@ class ShuffleReader:
         return blocks
 
     # ------------------------------------------------------------------
-    def read(self) -> Iterator[Tuple[Any, Any]]:
+    def _make_prefetcher(self) -> BufferedPrefetchIterator:
         blocks = self.compute_shuffle_blocks()
         cfg = self.dispatcher.config
 
@@ -143,12 +143,17 @@ class ShuffleReader:
                 self.metrics.remote_bytes_read += stream.max_bytes
                 yield block, stream
 
-        prefetcher = BufferedPrefetchIterator(
+        return BufferedPrefetchIterator(
             nonempty_streams(),
             max_buffer_size=cfg.max_buffer_size_task,
             max_threads=cfg.max_concurrency_task,
         )
 
+    def read(self) -> Iterator[Tuple[Any, Any]]:
+        if self.dep.serializer.supports_batches and self.dep.aggregator is None:
+            return self._read_batched()
+
+        prefetcher = self._make_prefetcher()
         records = self._record_iterator(prefetcher)
         records = self._counted(records)
 
@@ -163,24 +168,30 @@ class ShuffleReader:
             records = sorter.sorted_iterator()
         return records
 
-    def _record_iterator(self, prefetcher: BufferedPrefetchIterator):
+    def _wrapped_stream(self, prefetched):
+        """checksum validation + codec decompression over one block stream —
+        the analog of ``serializerManager.wrapStream`` (:98-110)."""
         cfg = self.dispatcher.config
+        block = prefetched.block
+        stream = prefetched
+        if cfg.checksum_enabled:
+            offsets = self.helper.get_partition_lengths(block.shuffle_id, block.map_id)
+            checksums = self.helper.get_checksums(block.shuffle_id, block.map_id)
+            if isinstance(block, ShuffleBlockBatchId):
+                start, end = block.start_reduce_id, block.end_reduce_id
+            else:
+                start, end = block.reduce_id, block.reduce_id + 1
+            stream = ChecksumValidationStream(
+                block, stream, offsets, checksums, start, end, cfg.checksum_algorithm
+            )
+        if self.codec is not None:
+            stream = CodecInputStream(self.codec, stream)
+        return stream
+
+    def _record_iterator(self, prefetcher: BufferedPrefetchIterator):
         for prefetched in prefetcher:
-            block = prefetched.block
-            stream = prefetched
+            stream = self._wrapped_stream(prefetched)
             try:
-                if cfg.checksum_enabled:
-                    offsets = self.helper.get_partition_lengths(block.shuffle_id, block.map_id)
-                    checksums = self.helper.get_checksums(block.shuffle_id, block.map_id)
-                    if isinstance(block, ShuffleBlockBatchId):
-                        start, end = block.start_reduce_id, block.end_reduce_id
-                    else:
-                        start, end = block.reduce_id, block.reduce_id + 1
-                    stream = ChecksumValidationStream(
-                        block, stream, offsets, checksums, start, end, cfg.checksum_algorithm
-                    )
-                if self.codec is not None:
-                    stream = CodecInputStream(self.codec, stream)
                 yield from self.dep.serializer.new_read_stream(stream)  # type: ignore[arg-type]
             finally:
                 stream.close()
@@ -189,6 +200,84 @@ class ShuffleReader:
         stats = prefetcher.stats
         self.metrics.wait_ns += stats["wait_ns"]
         self.metrics.prefetch_ns += stats["prefetch_ns"]
+
+    # ------------------------------------------------------------------
+    # Vectorized plane: columnar serializers stream RecordBatches; ordering
+    # runs as np.lexsort over fixed-width key views (s3shuffle_tpu.batch)
+    # instead of a per-record Python sort.
+    # ------------------------------------------------------------------
+    def read_batches(self):
+        """Yield RecordBatches (no aggregation/ordering applied)."""
+        prefetcher = self._make_prefetcher()
+        for prefetched in prefetcher:
+            stream = self._wrapped_stream(prefetched)
+            try:
+                for batch in self.dep.serializer.new_batch_read_stream(stream):
+                    self.metrics.records_read += batch.n
+                    yield batch
+            finally:
+                stream.close()
+                prefetched.close()
+        stats = prefetcher.stats
+        self.metrics.wait_ns += stats["wait_ns"]
+        self.metrics.prefetch_ns += stats["prefetch_ns"]
+
+    def _read_batched(self) -> Iterator[Tuple[Any, Any]]:
+        from s3shuffle_tpu.batch import BatchSorter
+        from s3shuffle_tpu.dependency import natural_key
+
+        key_ordering = self.dep.key_ordering
+        if key_ordering is None:
+            for batch in self.read_batches():
+                yield from batch.iter_records()
+            return
+        if key_ordering is natural_key:
+            yield from self._fed_batch_sorter().sorted_records()
+            return
+        # custom key function: per-record external sort over batch records
+        sorter = ExternalSorter(key_func=key_ordering)
+        for batch in self.read_batches():
+            sorter.insert_all(batch.iter_records())
+        yield from sorter.sorted_iterator()
+
+    def _fed_batch_sorter(self):
+        """Build the natural-byte-order BatchSorter and feed it every read
+        batch — shared by the records and batches terminal paths."""
+        from s3shuffle_tpu.batch import BatchSorter
+
+        sorter = BatchSorter(spill_bytes=self.dispatcher.config.sorter_spill_bytes)
+        for batch in self.read_batches():
+            sorter.add(batch)
+        return sorter
+
+    def read_result_batches(self):
+        """Fully-columnar terminal read: the reduce output as a list of
+        RecordBatches (ordering applied when the dependency asks for natural
+        byte ordering). The columnar sibling of :meth:`read` for callers that
+        stay in batch land (bench, device repartition)."""
+        from s3shuffle_tpu.batch import RecordBatch
+        from s3shuffle_tpu.dependency import natural_key
+
+        def fallback():
+            records = list(self.read())
+            for k, v in records[:1]:
+                if not isinstance(k, (bytes, bytearray, memoryview)) or not isinstance(
+                    v, (bytes, bytearray, memoryview)
+                ):
+                    raise ValueError(
+                        "materialize='batches' requires byte keys/values "
+                        f"(got {type(k).__name__}/{type(v).__name__}); use a "
+                        "bytes serializer or materialize='records'"
+                    )
+            return [RecordBatch.from_records(records)]
+
+        if not (self.dep.serializer.supports_batches and self.dep.aggregator is None):
+            return fallback()
+        if self.dep.key_ordering is None:
+            return list(self.read_batches())
+        if self.dep.key_ordering is natural_key:
+            return list(self._fed_batch_sorter().sorted_batches())
+        return fallback()
 
     def _counted(self, records):
         for kv in records:

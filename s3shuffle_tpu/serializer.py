@@ -23,12 +23,20 @@ _U32 = struct.Struct("<I")
 class Serializer:
     name = "abstract"
     relocatable = False
+    #: True when the serializer's wire format is columnar frames and the
+    #: batch read/write APIs are available — enables the vectorized data
+    #: plane end to end (see s3shuffle_tpu.batch).
+    supports_batches = False
 
     def new_write_stream(self, sink: BinaryIO) -> "RecordWriter":
         raise NotImplementedError
 
     def new_read_stream(self, source: BinaryIO) -> Iterator[Tuple[Any, Any]]:
         raise NotImplementedError
+
+    def new_batch_read_stream(self, source: BinaryIO):
+        """Yield RecordBatches (only when ``supports_batches``)."""
+        raise NotImplementedError(f"{self.name} does not support batch reads")
 
     def dumps(self, records: Iterable[Tuple[Any, Any]]) -> bytes:
         import io
@@ -49,6 +57,11 @@ class Serializer:
 class RecordWriter:
     def write(self, key: Any, value: Any) -> None:
         raise NotImplementedError
+
+    def write_batch(self, batch) -> None:
+        """Write a RecordBatch. Default: per-record fallback."""
+        for k, v in batch.iter_records():
+            self.write(k, v)
 
     def flush(self) -> None:
         """Push any buffered records downstream so the bytes emitted so far
@@ -100,7 +113,8 @@ class PickleBatchSerializer(Serializer):
 
     def new_read_stream(self, source: BinaryIO) -> Iterator[Tuple[Any, Any]]:
         while True:
-            header = source.read(_U32.size)
+            # read_fully: codec streams return short reads at frame boundaries
+            header = _read_fully(source, _U32.size)
             if not header:
                 return
             if len(header) < _U32.size:
@@ -142,7 +156,7 @@ class BytesKVSerializer(Serializer):
 
     def new_read_stream(self, source: BinaryIO) -> Iterator[Tuple[bytes, bytes]]:
         while True:
-            header = source.read(_U32.size)
+            header = _read_fully(source, _U32.size)
             if not header:
                 return
             if len(header) < _U32.size:
@@ -159,9 +173,72 @@ class BytesKVSerializer(Serializer):
             yield key, value
 
 
+# ----------------------------------------------------------------------------
+# Columnar KV serializer (the vectorized data plane — s3shuffle_tpu.batch)
+# ----------------------------------------------------------------------------
+
+
+class _ColumnarKVWriter(RecordWriter):
+    def __init__(self, sink: BinaryIO, batch_records: int):
+        self._sink = sink
+        self._pending: list = []
+        self._batch_records = batch_records
+
+    def write(self, key: Any, value: Any) -> None:
+        self._pending.append((bytes(key), bytes(value)))
+        if len(self._pending) >= self._batch_records:
+            self.flush()
+
+    def write_batch(self, batch) -> None:
+        from s3shuffle_tpu.batch import write_frame
+
+        self.flush()
+        write_frame(self._sink, batch)
+
+    def flush(self) -> None:
+        if self._pending:
+            from s3shuffle_tpu.batch import RecordBatch, write_frame
+
+            write_frame(self._sink, RecordBatch.from_records(self._pending))
+            self._pending = []
+
+    def close(self) -> None:
+        self.flush()
+
+
+class ColumnarKVSerializer(Serializer):
+    """Byte-KV records in columnar frames
+    (``[u32 len][u32 n][klens][vlens][keys][values]`` —
+    :mod:`s3shuffle_tpu.batch`). Self-delimiting ⇒ relocatable; columnar ⇒ the
+    whole write/read/partition/sort path is vectorized numpy instead of
+    per-record Python (the reference's per-record JVM iterators would be the
+    wrong design here — SURVEY.md §3.2/3.3 hot loops)."""
+
+    name = "bytes-kv-columnar"
+    relocatable = True
+    supports_batches = True
+
+    def __init__(self, batch_records: int = 8192):
+        self.batch_records = batch_records
+
+    def new_write_stream(self, sink: BinaryIO) -> RecordWriter:
+        return _ColumnarKVWriter(sink, self.batch_records)
+
+    def new_read_stream(self, source: BinaryIO) -> Iterator[Tuple[bytes, bytes]]:
+        for batch in self.new_batch_read_stream(source):
+            yield from batch.iter_records()
+
+    def new_batch_read_stream(self, source: BinaryIO):
+        from s3shuffle_tpu.batch import read_frames
+
+        return read_frames(source)
+
+
 def get_serializer(name: str) -> Serializer:
     if name in ("pickle", "default"):
         return PickleBatchSerializer()
     if name == "bytes-kv":
         return BytesKVSerializer()
+    if name in ("bytes-kv-columnar", "columnar"):
+        return ColumnarKVSerializer()
     raise ValueError(f"Unknown serializer: {name}")

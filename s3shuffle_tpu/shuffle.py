@@ -53,9 +53,11 @@ class ShuffleContext:
         map_side_combine: bool = False,
         serializer: Optional[Serializer] = None,
         cleanup: bool = True,
-    ) -> List[List[Tuple[Any, Any]]]:
+        materialize: str = "records",
+    ) -> List[Any]:
         """Full shuffle: map tasks write, reduce tasks read. Returns the
-        materialized output partitions."""
+        materialized output partitions — lists of (k, v) tuples, or lists of
+        RecordBatches when ``materialize="batches"`` (fully-columnar path)."""
         if partitioner is None:
             if num_output_partitions is None:
                 raise ValueError("need num_output_partitions or partitioner")
@@ -83,8 +85,10 @@ class ShuffleContext:
                 writer.stop(success=False)
                 raise
 
-        def reduce_task(reduce_id: int) -> List[Tuple[Any, Any]]:
+        def reduce_task(reduce_id: int):
             reader = self.manager.get_reader(handle, reduce_id, reduce_id + 1)
+            if materialize == "batches":
+                return reader.read_result_batches()
             return list(reader.read())
 
         with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
@@ -156,11 +160,14 @@ class ShuffleContext:
         num_partitions: int,
         key_func: Optional[Callable[[Any], Any]] = None,
         serializer: Optional[Serializer] = None,
-    ) -> List[List[Tuple[Any, Any]]]:
+        materialize: str = "records",
+    ) -> List[Any]:
         """Range-partitioned, key-ordered shuffle — the terasort shape
         (S3ShuffleManagerTest.scala:146-174). Output partition i holds keys
         ≤ partition i+1's keys; each partition is internally sorted."""
-        key = key_func or (lambda k: k)
+        from s3shuffle_tpu.dependency import natural_key
+
+        key = key_func or natural_key
         sample: List[Any] = []
         materialized: List[List[Tuple[Any, Any]]] = []
         for part in input_partitions:
@@ -176,6 +183,7 @@ class ShuffleContext:
             partitioner=part_fn,
             key_ordering=key,
             serializer=serializer,
+            materialize=materialize,
         )
 
     # ------------------------------------------------------------------

@@ -108,6 +108,9 @@ class ShuffleMapWriter:
         if dep.map_side_combine:
             assert dep.aggregator is not None
             records = dep.aggregator.combine_values_by_key(records)
+        elif dep.serializer.supports_batches:
+            self._write_batched(records)
+            return
         partitioner = dep.partitioner
         pipelines = self._pipelines
         check_every = 4096
@@ -120,6 +123,28 @@ class ShuffleMapWriter:
             if n % check_every == 0 and self._buffered_total() > self.spill_memory_budget:
                 self._spill()
         self._records_written = n
+
+    def _write_batched(self, records: Iterable[Tuple[Any, Any]]) -> None:
+        """Vectorized route: chunk records into columnar RecordBatches,
+        vectorized partition assignment + stable grouping, one columnar frame
+        per (chunk × partition) through each pipeline."""
+        from s3shuffle_tpu.batch import iter_record_batches, split_by_partition
+
+        dep = self.dep
+        for batch in iter_record_batches(records):
+            if batch.n == 0:
+                continue
+            pids = dep.partitioner.partition_batch(batch)
+            grouped, bounds = split_by_partition(batch, pids, dep.num_partitions)
+            for pid in range(dep.num_partitions):
+                lo, hi = int(bounds[pid]), int(bounds[pid + 1])
+                if hi > lo:
+                    self._pipelines[pid].record_writer.write_batch(
+                        grouped.slice_rows(lo, hi)
+                    )
+            self._records_written += batch.n
+            if self._buffered_total() > self.spill_memory_budget:
+                self._spill()
 
     def _buffered_total(self) -> int:
         return sum(p.buffered_bytes() for p in self._pipelines)

@@ -1,0 +1,193 @@
+"""Tests for the columnar batch data plane (s3shuffle_tpu.batch).
+
+The reference has no analog (its data plane is per-record JVM iterators —
+SURVEY.md §3.2/§3.3); these are property tests for the vectorized layer the
+TPU build adds: ragged gather, true-bytes ordering incl. zero-pad prefix ties,
+frame roundtrip, partition split, and the spill/merge sorter.
+"""
+
+import io
+import random
+
+import numpy as np
+import pytest
+
+from s3shuffle_tpu.batch import (
+    BatchSorter,
+    RecordBatch,
+    read_frames,
+    split_by_partition,
+    write_frame,
+)
+from s3shuffle_tpu.dependency import HashPartitioner, RangePartitioner, range_bounds
+from s3shuffle_tpu.serializer import ColumnarKVSerializer
+
+
+def _random_records(n, seed=0, max_len=24):
+    rng = random.Random(seed)
+    return [
+        (rng.randbytes(rng.randrange(0, max_len)), rng.randbytes(rng.randrange(0, max_len)))
+        for _ in range(n)
+    ]
+
+
+def test_roundtrip_records():
+    records = _random_records(1000)
+    batch = RecordBatch.from_records(records)
+    assert batch.n == 1000
+    assert batch.to_records() == records
+
+
+def test_empty_batch():
+    batch = RecordBatch.from_records([])
+    assert batch.n == 0
+    assert batch.to_records() == []
+    assert batch.argsort_by_key().tolist() == []
+
+
+def test_take_matches_python():
+    records = _random_records(500, seed=1)
+    batch = RecordBatch.from_records(records)
+    idx = np.array([3, 3, 0, 499, 250, 7], dtype=np.int64)
+    taken = batch.take(idx)
+    assert taken.to_records() == [records[i] for i in idx]
+
+
+def test_slice_rows_zero_copy_view():
+    records = _random_records(100, seed=2)
+    batch = RecordBatch.from_records(records)
+    sub = batch.slice_rows(10, 20)
+    assert sub.to_records() == records[10:20]
+
+
+def test_concat():
+    a = _random_records(50, seed=3)
+    b = _random_records(50, seed=4)
+    merged = RecordBatch.concat([RecordBatch.from_records(a), RecordBatch.from_records(b)])
+    assert merged.to_records() == a + b
+
+
+def test_argsort_matches_python_sorted():
+    records = _random_records(2000, seed=5)
+    batch = RecordBatch.from_records(records)
+    order = batch.argsort_by_key()
+    got = [k for k, _ in batch.take(order).iter_records()]
+    assert got == sorted(k for k, _ in records)
+
+
+def test_argsort_zero_pad_prefix_tie():
+    # b"ab" must sort before b"ab\x00" and b"ab\x00\x00" (padded views equal)
+    records = [(b"ab\x00\x00", b"3"), (b"ab", b"1"), (b"ab\x00", b"2"), (b"a", b"0")]
+    batch = RecordBatch.from_records(records)
+    out = batch.take(batch.argsort_by_key()).to_records()
+    assert [k for k, _ in out] == [b"a", b"ab", b"ab\x00", b"ab\x00\x00"]
+
+
+def test_frame_roundtrip():
+    records = _random_records(777, seed=6)
+    buf = io.BytesIO()
+    write_frame(buf, RecordBatch.from_records(records[:400]))
+    write_frame(buf, RecordBatch.from_records(records[400:]))
+    buf.seek(0)
+    out = [kv for b in read_frames(buf) for kv in b.iter_records()]
+    assert out == records
+
+
+def test_frame_truncation_detected():
+    buf = io.BytesIO()
+    write_frame(buf, RecordBatch.from_records(_random_records(10, seed=7)))
+    data = buf.getvalue()
+    with pytest.raises(IOError):
+        list(read_frames(io.BytesIO(data[:-3])))
+
+
+def test_split_by_partition():
+    records = _random_records(300, seed=8)
+    batch = RecordBatch.from_records(records)
+    part = HashPartitioner(7)
+    pids = part.partition_batch(batch)
+    # batch assignment must agree with the scalar partitioner
+    assert pids.tolist() == [part(k) for k, _ in records]
+    grouped, bounds = split_by_partition(batch, pids, 7)
+    seen = []
+    for p in range(7):
+        sub = grouped.slice_rows(int(bounds[p]), int(bounds[p + 1]))
+        for k, v in sub.iter_records():
+            assert part(k) == p
+            seen.append((k, v))
+    assert sorted(seen) == sorted(records)
+
+
+def test_range_partition_batch_matches_scalar():
+    records = _random_records(1000, seed=9, max_len=8)
+    # include zero-pad tie keys around a bound
+    records += [(b"zz", b"x"), (b"zz\x00", b"y"), (b"zz\x00\x00", b"z")]
+    keys = sorted(k for k, _ in records)
+    bounds = range_bounds(keys[:: max(1, len(keys) // 50)], 9)
+    part = RangePartitioner(bounds)
+    batch = RecordBatch.from_records(records)
+    assert part.partition_batch(batch).tolist() == [part(k) for k, _ in records]
+
+
+def test_batch_sorter_in_memory():
+    records = _random_records(5000, seed=10)
+    sorter = BatchSorter()
+    for start in range(0, 5000, 1000):
+        sorter.add(RecordBatch.from_records(records[start : start + 1000]))
+    out = list(sorter.sorted_records())
+    assert [k for k, _ in out] == sorted(k for k, _ in records)
+    assert sorted(out) == sorted(records)
+
+
+def test_batch_sorter_spills_and_merges():
+    records = _random_records(5000, seed=11)
+    sorter = BatchSorter(spill_bytes=10_000)  # force several spills
+    for start in range(0, 5000, 500):
+        sorter.add(RecordBatch.from_records(records[start : start + 500]))
+    assert sorter.spill_count > 0
+    out = list(sorter.sorted_records())
+    assert [k for k, _ in out] == sorted(k for k, _ in records)
+    assert sorted(out) == sorted(records)
+    assert sorter._spills == []  # cleaned up
+
+
+def test_columnar_serializer_stream_roundtrip():
+    records = _random_records(3000, seed=12)
+    ser = ColumnarKVSerializer(batch_records=256)
+    buf = io.BytesIO()
+    w = ser.new_write_stream(buf)
+    for k, v in records[:100]:
+        w.write(k, v)  # per-record API
+    w.write_batch(RecordBatch.from_records(records[100:]))  # batch API
+    w.close()
+    buf.seek(0)
+    assert list(ser.new_read_stream(buf)) == records
+
+
+def test_columnar_serializer_concatenatable():
+    a, b = _random_records(100, seed=13), _random_records(100, seed=14)
+    ser = ColumnarKVSerializer()
+    assert list(ser.loads(ser.dumps(a) + ser.dumps(b))) == a + b
+
+
+def test_columnar_frames_through_codec_any_block_size():
+    """Regression: a columnar frame header straddling a codec-frame boundary
+    must not be mistaken for EOF/corruption (short reads from
+    CodecInputStream at frame boundaries)."""
+    from s3shuffle_tpu.codec import get_codec
+    from s3shuffle_tpu.codec.framing import CodecInputStream, CodecOutputStream
+
+    records = _random_records(500, seed=20)
+    ser = ColumnarKVSerializer(batch_records=64)
+    for block_size in (97, 128, 1000, 4096):
+        codec = get_codec("zlib", block_size=block_size)
+        buf = io.BytesIO()
+        out = CodecOutputStream(codec, buf, close_sink=False)
+        w = ser.new_write_stream(out)
+        for k, v in records:
+            w.write(k, v)
+        w.close()
+        out.close()
+        buf.seek(0)
+        got = list(ser.new_read_stream(CodecInputStream(codec, buf)))
+        assert got == records, f"roundtrip failed at block_size={block_size}"
