@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# Sweep harness — the analog of the reference's examples/run_benchmarks.sh
+# (baseline vs NFS vs S3 × block sizes {32,128 MiB} × REPEAT —
+# SURVEY.md §2.2). Sweeps codec × codec-block-size × checksum over the
+# terasort and query-shaped workloads and appends one JSON line per
+# configuration to $OUT.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+SIZE="${SIZE:-100m}"
+REPEAT="${REPEAT:-2}"
+WORKERS="${WORKERS:-4}"
+CODECS="${CODECS:-none zlib native}"
+BLOCK_SIZES="${BLOCK_SIZES:-65536 262144}"
+CHECKSUMS="${CHECKSUMS:-CRC32C off}"
+ROOT="${ROOT:-}"          # empty → local temp dir; set s3://… to hit a store
+OUT="${OUT:-bench_results.jsonl}"
+
+ROOT_ARG=()
+[ -n "$ROOT" ] && ROOT_ARG=(--root "$ROOT")
+
+echo "# sweep $(date -u +%FT%TZ) size=$SIZE repeat=$REPEAT" >> "$OUT"
+for codec in $CODECS; do
+  for bs in $BLOCK_SIZES; do
+    for cs in $CHECKSUMS; do
+      echo ">>> terasort codec=$codec block=$bs checksum=$cs" >&2
+      python examples/terasort.py --size "$SIZE" --workers "$WORKERS" \
+        --codec "$codec" --block-size "$bs" --checksum "$cs" \
+        --repeat "$REPEAT" "${ROOT_ARG[@]}" >> "$OUT"
+    done
+  done
+done
+
+echo ">>> query profiles (scale 1000 == SF1)" >&2
+for codec in $CODECS; do
+  python examples/query_shuffles.py --query all --scale 1000 \
+    --codec "$codec" --workers "$WORKERS" "${ROOT_ARG[@]}" >> "$OUT"
+done
+
+echo "results in $OUT" >&2

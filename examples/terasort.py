@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""TeraSort benchmark — the framework analog of examples/terasort/run.sh in
+the reference (spark-submit of ehiggs/spark-terasort + TeraValidate against an
+S3A root, sizes 1g/10g/100g — SURVEY.md §2.2).
+
+Generates terasort-shaped records (10-byte keys, 90-byte values), runs a
+range-partitioned key-ordered shuffle through the full write/read data plane
+against any storage root (file://, memory://, s3:// via fsspec), then
+validates global ordering and record counts (the TeraValidate step).
+
+Usage:
+    python examples/terasort.py --size 1g --workers 8 --codec native
+    python examples/terasort.py --size 100m --root s3://bucket/prefix
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+KEY_BYTES, VALUE_BYTES = 10, 90  # the terasort record shape
+
+SIZES = {
+    "100m": 100 * 1024 * 1024,
+    "1g": 1024**3,
+    "10g": 10 * 1024**3,
+    "100g": 100 * 1024**3,
+}
+
+
+def generate(total_bytes: int, n_maps: int, seed: int = 42):
+    """Terasort input: random 10-byte keys, semi-compressible 90-byte values
+    (drawn from a small pool, matching text-like real data compressibility)."""
+    per_map = total_bytes // (KEY_BYTES + VALUE_BYTES) // n_maps
+    rng = random.Random(seed)
+    filler = [rng.randbytes(VALUE_BYTES) for _ in range(64)]
+    return [
+        [
+            (rng.randbytes(KEY_BYTES), filler[rng.randrange(64)])
+            for _ in range(per_map)
+        ]
+        for _ in range(n_maps)
+    ]
+
+
+def teravalidate(out_batches, expected_records: int) -> None:
+    """Global-order + count validation (the reference's TeraValidate step)."""
+    import numpy as np  # noqa: F401
+
+    from s3shuffle_tpu.batch import RecordBatch
+
+    merged = [RecordBatch.concat(p) for p in out_batches]
+    n = sum(b.n for b in merged)
+    assert n == expected_records, f"record count {n} != {expected_records}"
+    prev_last = None
+    for b in merged:
+        if b.n == 0:
+            continue
+        sk = b.key_strings(width=KEY_BYTES)
+        assert (sk[:-1] <= sk[1:]).all(), "order violated within partition"
+        if prev_last is not None:
+            assert prev_last <= sk[0], "order violated across partitions"
+        prev_last = sk[-1]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--size", default="100m", help=f"one of {list(SIZES)} or bytes")
+    ap.add_argument("--maps", type=int, default=8)
+    ap.add_argument("--reducers", type=int, default=8)
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--codec", default="native",
+                    help="none | zlib | zstd | native | tpu | auto")
+    ap.add_argument("--checksum", default="CRC32C", help="ADLER32|CRC32|CRC32C|off")
+    ap.add_argument("--root", default=None, help="storage root URI (default: temp dir)")
+    ap.add_argument("--block-size", type=int, default=64 * 1024, help="codec block size")
+    ap.add_argument("--repeat", type=int, default=1)
+    args = ap.parse_args()
+
+    from s3shuffle_tpu.config import ShuffleConfig
+    from s3shuffle_tpu.serializer import ColumnarKVSerializer
+    from s3shuffle_tpu.shuffle import ShuffleContext
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+
+    total_bytes = SIZES.get(args.size, None) or int(args.size)
+    tmp = None
+    root = args.root
+    if root is None:
+        tmp = tempfile.mkdtemp(prefix="terasort-")
+        root = f"file://{tmp}"
+
+    print(f"generating {total_bytes / 1e6:.0f} MB over {args.maps} map partitions...",
+          file=sys.stderr)
+    parts = generate(total_bytes, args.maps)
+    n_records = sum(len(p) for p in parts)
+
+    results = []
+    try:
+        for rep in range(args.repeat):
+            Dispatcher.reset()
+            cfg = ShuffleConfig(
+                root_dir=root,
+                app_id=f"terasort-{rep}",
+                codec=args.codec,
+                codec_block_size=args.block_size,
+                checksum_enabled=args.checksum.lower() != "off",
+                checksum_algorithm=args.checksum if args.checksum.lower() != "off" else "ADLER32",
+            )
+            ctx = ShuffleContext(config=cfg, num_workers=args.workers)
+            t0 = time.perf_counter()
+            out = ctx.sort_by_key(
+                parts,
+                num_partitions=args.reducers,
+                serializer=ColumnarKVSerializer(),
+                materialize="batches",
+            )
+            dt = time.perf_counter() - t0
+            teravalidate(out, n_records)
+            ctx.stop()
+            raw = n_records * (KEY_BYTES + VALUE_BYTES)
+            results.append({
+                "rep": rep,
+                "wall_s": round(dt, 3),
+                "records": n_records,
+                "mb": round(raw / 1e6, 1),
+                "mb_per_s": round(raw / 1e6 / dt, 1),
+            })
+            print(json.dumps(results[-1]), file=sys.stderr)
+    finally:
+        if tmp:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    best = max(r["mb_per_s"] for r in results)
+    print(json.dumps({
+        "bench": "terasort",
+        "size": args.size,
+        "codec": args.codec,
+        "checksum": args.checksum,
+        "workers": args.workers,
+        "best_mb_per_s": best,
+        "runs": results,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

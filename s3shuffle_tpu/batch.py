@@ -196,9 +196,12 @@ def _load_native_gather():
     global _native_gather
     if _native_gather is None:
         try:
-            from s3shuffle_tpu.codec.native import native_ragged_gather
+            from s3shuffle_tpu.codec.native import (
+                native_available,
+                native_ragged_gather,
+            )
 
-            _native_gather = native_ragged_gather
+            _native_gather = native_ragged_gather if native_available() else False
         except Exception:
             _native_gather = False
     return _native_gather

@@ -26,6 +26,8 @@ _lib_lock = threading.Lock()
 
 def _load() -> ctypes.CDLL:
     global _lib
+    if os.environ.get("S3SHUFFLE_DISABLE_NATIVE"):
+        raise RuntimeError("native library disabled via S3SHUFFLE_DISABLE_NATIVE")
     if _lib is not None:
         return _lib
     with _lib_lock:

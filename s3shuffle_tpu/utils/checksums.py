@@ -90,11 +90,13 @@ def crc32c_py(data: bytes, value: int = 0) -> int:
 def _crc32c_fn():
     """Prefer the native C++ implementation when available."""
     try:
-        from s3shuffle_tpu.codec.native import native_crc32c
+        from s3shuffle_tpu.codec.native import native_available, native_crc32c
 
-        return native_crc32c
+        if native_available():
+            return native_crc32c
     except Exception:
-        return crc32c_py
+        pass
+    return crc32c_py
 
 
 class Crc32C(Checksum):

@@ -24,6 +24,30 @@ import pytest  # noqa: E402
 
 from s3shuffle_tpu.storage.dispatcher import Dispatcher  # noqa: E402
 
+# Mode matrix (the analog of the reference CI's second run with
+# USE_SPARK_SHUFFLE_FETCH=true, ci.yml:58-65): S3SHUFFLE_TEST_MODE overrides
+# default config fields for the whole suite.
+_TEST_MODE = os.environ.get("S3SHUFFLE_TEST_MODE", "default")
+_MODE_OVERRIDES = {
+    "default": {},
+    "fallback-fetch": {"use_fallback_fetch": True},
+    "listing": {"use_block_manager": False},
+}.get(_TEST_MODE, {})
+
+if _MODE_OVERRIDES:
+    import dataclasses as _dc
+
+    from s3shuffle_tpu import config as _config_mod
+
+    _orig_init = _config_mod.ShuffleConfig.__init__
+
+    def _mode_init(self, *args, **kwargs):
+        for field, value in _MODE_OVERRIDES.items():
+            kwargs.setdefault(field, value)
+        _orig_init(self, *args, **kwargs)
+
+    _config_mod.ShuffleConfig.__init__ = _mode_init  # type: ignore[method-assign]
+
 
 @pytest.fixture(autouse=True)
 def _reset_dispatcher_singleton():

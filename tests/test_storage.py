@@ -60,7 +60,7 @@ def test_rename(tmp_path):
 def test_dispatcher_path_layout(tmp_path):
     # {root}{mapId % folderPrefixes}/{appId}/{shuffleId}/{name}
     # (S3ShuffleDispatcher.scala:142-143)
-    cfg = ShuffleConfig(root_dir=f"file://{tmp_path}/root", folder_prefixes=3, app_id="app1")
+    cfg = ShuffleConfig(root_dir=f"file://{tmp_path}/root", folder_prefixes=3, app_id="app1", use_fallback_fetch=False)
     d = Dispatcher(cfg)
     block = ShuffleDataBlockId(shuffle_id=7, map_id=10)
     assert d.get_path(block) == f"file://{tmp_path}/root/1/app1/7/shuffle_7_10_0.data"

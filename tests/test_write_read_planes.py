@@ -173,8 +173,11 @@ def test_block_iterator_ranges(env):
     assert out[1][1].read() == b"c" * 5 + b"d" * 15
 
 
-def test_block_iterator_missing_index_metadata_mode_raises(env):
-    d, helper = env
+def test_block_iterator_missing_index_metadata_mode_raises(tmp_path):
+    # pinned to metadata mode regardless of the CI mode matrix
+    cfg = ShuffleConfig(root_dir=f"file://{tmp_path}/r", app_id="t", use_block_manager=True)
+    d = Dispatcher(cfg)
+    helper = ShuffleHelper(d)
     with pytest.raises(FileNotFoundError):
         list(BlockIterator(d, helper, [ShuffleBlockId(14, 0, 0)]))
 
