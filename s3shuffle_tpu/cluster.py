@@ -1,0 +1,145 @@
+"""Multi-process shuffle execution — the scale-out slice.
+
+Parity: the reference runs inside Spark executors — separate JVMs that share
+nothing but the object store and the driver's RPC endpoint (SURVEY.md §3.2,
+§3.3). :class:`LocalCluster` reproduces that topology on one host: a
+coordinator process hosts the :class:`~s3shuffle_tpu.metadata.service.
+MetadataServer`; map and reduce tasks run in **worker processes** (fresh
+interpreters) that reach the coordinator over TCP and the data through the
+store. Because a stage's worker pool is torn down before the next stage runs,
+every run proves the executor-independence property the reference gets from
+its FALLBACK_BLOCK_MANAGER_ID rebranding (S3ShuffleWriter.scala:7-21): map
+workers are *dead* by the time reducers read — the shuffle survives because
+data lives in the store and metadata on the coordinator.
+
+On a multi-host TPU pod the same wiring applies: one MetadataServer on the
+coordinator host (DCN-reachable), one worker process per host/chip, store =
+GCS/S3. The task functions here are module-level so they pickle under the
+``spawn`` start method.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import multiprocessing as mp
+import pickle
+from typing import Any, Iterable, List, Optional, Sequence, Tuple
+
+from s3shuffle_tpu.config import ShuffleConfig
+from s3shuffle_tpu.dependency import ShuffleDependency
+from s3shuffle_tpu.metadata.service import MetadataServer, RemoteMapOutputTracker
+
+logger = logging.getLogger("s3shuffle_tpu.cluster")
+
+
+# Built once per worker process by the Pool initializer (one manager, one
+# coordinator connection per worker — not per task).
+_WORKER_MANAGER = None
+
+
+def _init_worker(cfg_dict: dict, tracker_addr: Tuple[str, int]) -> None:
+    global _WORKER_MANAGER
+    from s3shuffle_tpu.manager import ShuffleManager
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+
+    Dispatcher.reset()  # fresh process; never inherit another config
+    cfg = ShuffleConfig(**cfg_dict)
+    tracker = RemoteMapOutputTracker(tracker_addr)
+    _WORKER_MANAGER = ShuffleManager(config=cfg, tracker=tracker)
+
+
+def _run_map_task(args: Tuple[int, bytes, int, bytes]) -> int:
+    shuffle_id, dep_bytes, map_id, records_bytes = args
+    manager = _WORKER_MANAGER
+    assert manager is not None, "worker pool missing _init_worker initializer"
+    dep: ShuffleDependency = pickle.loads(dep_bytes)
+    handle = manager.register_shuffle(shuffle_id, dep)  # idempotent on tracker
+    records = pickle.loads(records_bytes)
+    writer = manager.get_writer(handle, map_id)
+    try:
+        writer.write(records)
+        writer.stop(success=True)
+    except BaseException:
+        writer.stop(success=False)
+        raise
+    return map_id
+
+
+def _run_reduce_task(args: Tuple[int, bytes, int]) -> bytes:
+    shuffle_id, dep_bytes, reduce_id = args
+    manager = _WORKER_MANAGER
+    assert manager is not None, "worker pool missing _init_worker initializer"
+    dep: ShuffleDependency = pickle.loads(dep_bytes)
+    handle = manager.register_shuffle(shuffle_id, dep)
+    reader = manager.get_reader(handle, reduce_id, reduce_id + 1)
+    return pickle.dumps(list(reader.read()), protocol=pickle.HIGHEST_PROTOCOL)
+
+
+class LocalCluster:
+    """Coordinator + per-stage worker process pools.
+
+    The coordinator owns the metadata service and the store lifecycle
+    (cleanup); workers are stage-scoped and disposable — the decommission
+    story is structural, not a recovery protocol (SURVEY.md §5.3).
+    """
+
+    def __init__(self, config: ShuffleConfig, num_workers: int = 2):
+        self.config = config
+        self.num_workers = max(1, num_workers)
+        self.server = MetadataServer().start()
+        self._cfg_dict = dataclasses.asdict(config)
+        self._ctx = mp.get_context("spawn")
+        self._next_shuffle_id = 0
+
+    # ------------------------------------------------------------------
+    def run_shuffle(
+        self,
+        input_partitions: Sequence[Iterable[Tuple[Any, Any]]],
+        dependency_factory,
+    ) -> List[List[Tuple[Any, Any]]]:
+        """Run one full shuffle with stage-scoped worker pools.
+
+        ``dependency_factory(shuffle_id)`` must return a picklable
+        ShuffleDependency (module-level key functions, no lambdas).
+        """
+        shuffle_id = self._next_shuffle_id
+        self._next_shuffle_id += 1
+        dep = dependency_factory(shuffle_id)
+        dep_bytes = pickle.dumps(dep, protocol=pickle.HIGHEST_PROTOCOL)
+        addr = self.server.address
+        # coordinator registers first so reducers never race an empty tracker
+        self.server.tracker.register_shuffle(shuffle_id, dep.num_partitions)
+
+        map_args = [
+            (shuffle_id, dep_bytes, map_id,
+             pickle.dumps(list(records), protocol=pickle.HIGHEST_PROTOCOL))
+            for map_id, records in enumerate(input_partitions)
+        ]
+        init = (_init_worker, (self._cfg_dict, addr))
+        with self._ctx.Pool(self.num_workers, *init) as pool:
+            done = pool.map(_run_map_task, map_args)
+        logger.info("map stage done: %d tasks (workers now dead)", len(done))
+
+        # map-stage workers are gone; a fresh pool serves the reduce stage —
+        # the read path may only depend on the store + metadata service.
+        reduce_args = [
+            (shuffle_id, dep_bytes, rid) for rid in range(dep.num_partitions)
+        ]
+        with self._ctx.Pool(self.num_workers, *init) as pool:
+            blobs = pool.map(_run_reduce_task, reduce_args)
+        return [pickle.loads(b) for b in blobs]
+
+    # ------------------------------------------------------------------
+    def cleanup_shuffle(self, shuffle_id: int) -> None:
+        from s3shuffle_tpu.storage.dispatcher import Dispatcher
+
+        self.server.tracker.unregister_shuffle(shuffle_id)
+        Dispatcher.get(self.config).remove_shuffle(shuffle_id)
+
+    def shutdown(self, remove_root: bool = True) -> None:
+        from s3shuffle_tpu.storage.dispatcher import Dispatcher
+
+        self.server.stop()
+        if remove_root and self.config.cleanup:
+            Dispatcher.get(self.config).remove_root()

@@ -32,7 +32,12 @@ from s3shuffle_tpu.codec import get_codec
 from s3shuffle_tpu.config import ShuffleConfig
 from s3shuffle_tpu.dependency import ShuffleDependency
 from s3shuffle_tpu.metadata.helper import ShuffleHelper
-from s3shuffle_tpu.metadata.map_output import STORE_LOCATION, MapOutputTracker, MapStatus
+from s3shuffle_tpu.metadata.map_output import (
+    STORE_LOCATION,
+    MapOutputTracker,
+    MapOutputTrackerLike,
+    MapStatus,
+)
 from s3shuffle_tpu.read.reader import ShuffleReader
 from s3shuffle_tpu.storage.dispatcher import Dispatcher
 from s3shuffle_tpu.version import BUILD_INFO
@@ -74,11 +79,14 @@ class ShuffleManager:
         config: Optional[ShuffleConfig] = None,
         dispatcher: Optional[Dispatcher] = None,
         bypass_merge_threshold: int = DEFAULT_BYPASS_MERGE_THRESHOLD,
+        tracker: Optional[MapOutputTrackerLike] = None,
     ):
         logger.info("%s", BUILD_INFO)
         self.dispatcher = dispatcher or Dispatcher.get(config)
         self.helper = ShuffleHelper(self.dispatcher)
-        self.tracker = MapOutputTracker()
+        # tracker may be a RemoteMapOutputTracker (metadata.service) — same
+        # interface, backed by the coordinator's TCP metadata service.
+        self.tracker = tracker or MapOutputTracker()
         self.bypass_merge_threshold = bypass_merge_threshold
         self._registered: Dict[int, ShuffleHandle] = {}
         self._lock = threading.Lock()

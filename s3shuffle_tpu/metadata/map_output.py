@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Protocol, Tuple
 
 import numpy as np
 
@@ -32,6 +32,33 @@ class MapStatus:
     map_id: int
     location: str
     sizes: np.ndarray  # per reduce partition, stored (compressed) bytes
+
+
+class MapOutputTrackerLike(Protocol):
+    """The tracker contract the manager/reader depend on — satisfied by the
+    in-process :class:`MapOutputTracker` and the TCP
+    :class:`~s3shuffle_tpu.metadata.service.RemoteMapOutputTracker`."""
+
+    def register_shuffle(self, shuffle_id: int, num_partitions: int) -> None: ...
+
+    def register_map_output(self, shuffle_id: int, status: MapStatus) -> None: ...
+
+    def get_map_sizes_by_range(
+        self,
+        shuffle_id: int,
+        start_map_index: int,
+        end_map_index: Optional[int],
+        start_partition: int,
+        end_partition: int,
+    ) -> List[Tuple[int, List[Tuple[int, int]]]]: ...
+
+    def contains(self, shuffle_id: int) -> bool: ...
+
+    def num_partitions(self, shuffle_id: int) -> int: ...
+
+    def unregister_shuffle(self, shuffle_id: int) -> None: ...
+
+    def shuffle_ids(self) -> List[int]: ...
 
 
 class MapOutputTracker:

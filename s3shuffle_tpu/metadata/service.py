@@ -1,0 +1,259 @@
+"""Networked map-output metadata service — the distributed control plane.
+
+Parity: the reference's control plane is the Spark driver's RPC endpoint —
+``MapOutputTracker.getMapSizesByExecutorId`` answers block-enumeration RPCs
+from reduce tasks (S3ShuffleReader.scala:169-176) and map tasks push
+``MapStatus`` back through task results (S3ShuffleWriter.scala:7-21). This
+module is the framework-native replacement (SURVEY.md §5.8: "control plane →
+a lightweight host-side metadata service"): a threaded TCP server wrapping
+:class:`~s3shuffle_tpu.metadata.map_output.MapOutputTracker`, and a client
+with the same interface so readers/managers are agnostic to local vs remote
+tracking. Multi-host TPU deployments run one server on the coordinator host;
+workers on other hosts connect over DCN.
+
+Wire protocol: length-prefixed JSON (``[u32le len][utf-8 json]``) over a
+persistent connection. JSON, not pickle — the control plane must not be a
+code-execution channel.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from s3shuffle_tpu.metadata.map_output import MapOutputTracker, MapStatus
+
+logger = logging.getLogger("s3shuffle_tpu.metadata.service")
+
+_LEN = struct.Struct("<I")
+_MAX_FRAME = 64 << 20
+
+
+def _send_frame(sock: socket.socket, obj: Any) -> None:
+    payload = json.dumps(obj).encode("utf-8")
+    if len(payload) > _MAX_FRAME:
+        # enforced on send too: a deterministic oversize must fail loudly,
+        # not surface as a bogus connection error on the peer
+        raise ValueError(f"Frame of {len(payload)} bytes exceeds {_MAX_FRAME} limit")
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_frame(sock: socket.socket) -> Optional[Any]:
+    header = _recv_exact(sock, _LEN.size)
+    if header is None:
+        return None
+    (n,) = _LEN.unpack(header)
+    if n > _MAX_FRAME:
+        raise IOError(f"Frame of {n} bytes exceeds limit")
+    payload = _recv_exact(sock, n)
+    if payload is None:
+        raise IOError("Connection closed mid-frame")
+    return json.loads(payload.decode("utf-8"))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            if not buf:
+                return None  # clean close between frames
+            raise IOError("Connection closed mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        tracker: MapOutputTracker = self.server.tracker  # type: ignore[attr-defined]
+        while True:
+            try:
+                req = _recv_frame(self.request)
+            except (IOError, json.JSONDecodeError) as e:
+                logger.warning("metadata connection error: %s", e)
+                return
+            if req is None:
+                return
+            try:
+                result = self._dispatch(tracker, req)
+                resp = {"ok": True, "result": result}
+            except KeyError as e:
+                resp = {"ok": False, "error": str(e), "error_type": "KeyError"}
+            except Exception as e:  # keep the server alive on bad requests
+                resp = {"ok": False, "error": f"{type(e).__name__}: {e}", "error_type": "RuntimeError"}
+            try:
+                _send_frame(self.request, resp)
+            except ValueError as e:  # response over the frame cap: report, don't die
+                _send_frame(
+                    self.request,
+                    {"ok": False, "error": f"{e} (narrow the requested range)",
+                     "error_type": "RuntimeError"},
+                )
+
+    @staticmethod
+    def _dispatch(tracker: MapOutputTracker, req: Any):
+        method = req.get("method")
+        a = req.get("args", [])
+        if method == "ping":
+            return "pong"
+        if method == "register_shuffle":
+            return tracker.register_shuffle(int(a[0]), int(a[1]))
+        if method == "register_map_output":
+            shuffle_id, map_id, location, sizes = a
+            status = MapStatus(
+                map_id=int(map_id),
+                location=str(location),
+                sizes=np.asarray(sizes, dtype=np.int64),
+            )
+            return tracker.register_map_output(int(shuffle_id), status)
+        if method == "get_map_sizes_by_range":
+            shuffle_id, smi, emi, sp, ep = a
+            return tracker.get_map_sizes_by_range(
+                int(shuffle_id), int(smi), None if emi is None else int(emi), int(sp), int(ep)
+            )
+        if method == "contains":
+            return tracker.contains(int(a[0]))
+        if method == "num_partitions":
+            return tracker.num_partitions(int(a[0]))
+        if method == "unregister_shuffle":
+            return tracker.unregister_shuffle(int(a[0]))
+        if method == "shuffle_ids":
+            return tracker.shuffle_ids()
+        raise RuntimeError(f"Unknown method: {method}")
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class MetadataServer:
+    """Hosts a MapOutputTracker over TCP. Start on the coordinator process;
+    workers connect with :class:`RemoteMapOutputTracker`."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 tracker: Optional[MapOutputTracker] = None):
+        self.tracker = tracker or MapOutputTracker()
+        self._server = _Server((host, port), _Handler)
+        self._server.tracker = self.tracker  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._server.server_address[:2]
+
+    def start(self) -> "MetadataServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="s3shuffle-metadata", daemon=True
+        )
+        self._thread.start()
+        logger.info("Metadata service listening on %s:%d", *self.address)
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+class RemoteMapOutputTracker:
+    """Client with MapOutputTracker's interface; safe for concurrent use
+    (one socket, per-call lock, transparent reconnect)."""
+
+    def __init__(self, address: Tuple[str, int], timeout: float = 30.0):
+        self.address = (address[0], int(address[1]))
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+
+    # -- wire ----------------------------------------------------------
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection(self.address, timeout=self.timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    def _call(self, method: str, *args):
+        with self._lock:
+            for attempt in (0, 1):  # one transparent reconnect
+                try:
+                    if self._sock is None:
+                        self._sock = self._connect()
+                    _send_frame(self._sock, {"method": method, "args": list(args)})
+                    resp = _recv_frame(self._sock)
+                    if resp is None:
+                        raise IOError("Server closed connection")
+                    break
+                except (OSError, IOError):
+                    if self._sock is not None:
+                        try:
+                            self._sock.close()
+                        except OSError:
+                            pass
+                        self._sock = None
+                    if attempt:
+                        raise
+        if not resp["ok"]:
+            if resp.get("error_type") == "KeyError":
+                raise KeyError(resp["error"])
+            raise RuntimeError(resp["error"])
+        return resp["result"]
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+
+    # -- MapOutputTracker interface ------------------------------------
+    def ping(self) -> bool:
+        return self._call("ping") == "pong"
+
+    def register_shuffle(self, shuffle_id: int, num_partitions: int) -> None:
+        self._call("register_shuffle", shuffle_id, num_partitions)
+
+    def register_map_output(self, shuffle_id: int, status: MapStatus) -> None:
+        self._call(
+            "register_map_output",
+            shuffle_id,
+            status.map_id,
+            status.location,
+            np.asarray(status.sizes).tolist(),
+        )
+
+    def get_map_sizes_by_range(
+        self,
+        shuffle_id: int,
+        start_map_index: int,
+        end_map_index: Optional[int],
+        start_partition: int,
+        end_partition: int,
+    ) -> List[Tuple[int, List[Tuple[int, int]]]]:
+        raw = self._call(
+            "get_map_sizes_by_range",
+            shuffle_id, start_map_index, end_map_index, start_partition, end_partition,
+        )
+        # JSON turns tuples into lists; restore the documented shape
+        return [(int(m), [(int(r), int(n)) for r, n in sizes]) for m, sizes in raw]
+
+    def contains(self, shuffle_id: int) -> bool:
+        return bool(self._call("contains", shuffle_id))
+
+    def num_partitions(self, shuffle_id: int) -> int:
+        return int(self._call("num_partitions", shuffle_id))
+
+    def unregister_shuffle(self, shuffle_id: int) -> None:
+        self._call("unregister_shuffle", shuffle_id)
+
+    def shuffle_ids(self) -> List[int]:
+        return [int(x) for x in self._call("shuffle_ids")]

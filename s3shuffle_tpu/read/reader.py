@@ -31,7 +31,7 @@ from s3shuffle_tpu.codec import CodecInputStream
 from s3shuffle_tpu.codec.framing import FrameCodec
 from s3shuffle_tpu.dependency import ShuffleDependency
 from s3shuffle_tpu.metadata.helper import ShuffleHelper
-from s3shuffle_tpu.metadata.map_output import MapOutputTracker
+from s3shuffle_tpu.metadata.map_output import MapOutputTrackerLike
 from s3shuffle_tpu.read.block_iterator import BlockIterator, ReadableBlockId
 from s3shuffle_tpu.read.checksum_stream import ChecksumValidationStream
 from s3shuffle_tpu.read.prefetch import BufferedPrefetchIterator
@@ -57,7 +57,7 @@ class ShuffleReader:
         self,
         dispatcher: Dispatcher,
         helper: ShuffleHelper,
-        tracker: Optional[MapOutputTracker],
+        tracker: Optional[MapOutputTrackerLike],
         dependency: ShuffleDependency,
         start_partition: int,
         end_partition: int,

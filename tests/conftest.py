@@ -49,6 +49,10 @@ if _MODE_OVERRIDES:
     _config_mod.ShuffleConfig.__init__ = _mode_init  # type: ignore[method-assign]
 
 
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: spawns worker processes / long-running")
+
+
 @pytest.fixture(autouse=True)
 def _reset_dispatcher_singleton():
     Dispatcher.reset()

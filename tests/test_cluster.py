@@ -1,0 +1,142 @@
+"""Tests for the distributed control plane (metadata service + multi-process
+execution) — the analog of the reference's driver-RPC block enumeration
+(S3ShuffleReader.scala:169-176) and its executor-independence property
+(S3ShuffleWriter.scala:7-21; tests run with dynamic allocation on,
+S3ShuffleManagerTest.scala:217)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from s3shuffle_tpu.config import ShuffleConfig
+from s3shuffle_tpu.metadata.map_output import MapOutputTracker, MapStatus, STORE_LOCATION
+from s3shuffle_tpu.metadata.service import MetadataServer, RemoteMapOutputTracker
+
+
+@pytest.fixture
+def service():
+    server = MetadataServer().start()
+    client = RemoteMapOutputTracker(server.address)
+    yield server, client
+    client.close()
+    server.stop()
+
+
+def test_service_roundtrip(service):
+    server, client = service
+    assert client.ping()
+    client.register_shuffle(3, 4)
+    assert client.contains(3)
+    assert not client.contains(99)
+    assert client.num_partitions(3) == 4
+    client.register_map_output(
+        3, MapStatus(map_id=0, location=STORE_LOCATION, sizes=np.array([10, 0, 5, 7]))
+    )
+    client.register_map_output(
+        3, MapStatus(map_id=2, location=STORE_LOCATION, sizes=np.array([1, 2, 3, 4]))
+    )
+    out = client.get_map_sizes_by_range(3, 0, None, 1, 3)
+    assert out == [(0, [(1, 0), (2, 5)]), (2, [(1, 2), (2, 3)])]
+    assert client.shuffle_ids() == [3]
+    client.unregister_shuffle(3)
+    assert not client.contains(3)
+
+
+def test_service_errors_propagate(service):
+    _server, client = service
+    with pytest.raises(KeyError):
+        client.get_map_sizes_by_range(42, 0, None, 0, 1)
+    with pytest.raises(KeyError):
+        client.register_map_output(
+            42, MapStatus(map_id=0, location=STORE_LOCATION, sizes=np.zeros(1))
+        )
+    # the connection must survive errors
+    assert client.ping()
+
+
+def test_service_concurrent_clients(service):
+    import threading
+
+    server, _ = service
+    server.tracker.register_shuffle(1, 8)
+    errors = []
+
+    def hammer(worker: int):
+        try:
+            c = RemoteMapOutputTracker(server.address)
+            for i in range(20):
+                c.register_map_output(
+                    1,
+                    MapStatus(
+                        map_id=worker * 100 + i,
+                        location=STORE_LOCATION,
+                        sizes=np.arange(8),
+                    ),
+                )
+            c.close()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(w,)) for w in range(4)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert not errors
+    assert len(server.tracker.get_map_sizes_by_range(1, 0, None, 0, 8)) == 80
+
+
+def test_remote_tracker_reconnects(service):
+    server, client = service
+    client.register_shuffle(7, 2)
+    # kill the client's socket behind its back; next call must reconnect
+    client._sock.close()
+    assert client.contains(7)
+
+
+def test_local_tracker_remote_tracker_same_interface():
+    local = MapOutputTracker()
+    for name in (
+        "register_shuffle", "register_map_output", "get_map_sizes_by_range",
+        "contains", "num_partitions", "unregister_shuffle", "shuffle_ids",
+    ):
+        assert hasattr(local, name) and hasattr(RemoteMapOutputTracker, name)
+
+
+# ---------------------------------------------------------------------------
+# Multi-process end-to-end: map workers die before reducers start
+# ---------------------------------------------------------------------------
+
+
+def _make_sort_dep(shuffle_id: int):
+    from s3shuffle_tpu.dependency import RangePartitioner, ShuffleDependency, natural_key
+    from s3shuffle_tpu.serializer import ColumnarKVSerializer
+
+    bounds = [bytes([b]) for b in (64, 128, 192)]
+    return ShuffleDependency(
+        shuffle_id=shuffle_id,
+        partitioner=RangePartitioner(bounds),
+        serializer=ColumnarKVSerializer(),
+        key_ordering=natural_key,
+    )
+
+
+@pytest.mark.slow
+def test_multiprocess_shuffle_survives_worker_death(tmp_path):
+    from s3shuffle_tpu.cluster import LocalCluster
+
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/store", app_id="cluster-test", codec="zlib"
+    )
+    rng = random.Random(0)
+    parts = [
+        [(rng.randbytes(6), rng.randbytes(20)) for _ in range(500)] for _ in range(3)
+    ]
+    cluster = LocalCluster(cfg, num_workers=2)
+    try:
+        out = cluster.run_shuffle(parts, _make_sort_dep)
+        got = [kv for p in out for kv in p]
+        assert len(got) == 1500
+        flat = [k for p in out for k, _v in p]
+        assert flat == sorted(k for p in parts for k, _v in p)
+    finally:
+        cluster.shutdown()
