@@ -88,10 +88,14 @@ class RecordBatch:
         n = len(records)
         if n == 0:
             return RecordBatch.empty()
-        klens = np.fromiter((len(k) for k, _v in records), dtype=np.int32, count=n)
-        vlens = np.fromiter((len(v) for _k, v in records), dtype=np.int32, count=n)
-        keys = np.frombuffer(b"".join([k for k, _v in records]), dtype=np.uint8)
-        values = np.frombuffer(b"".join([v for _k, v in records]), dtype=np.uint8)
+        key_list = [k for k, _v in records]
+        val_list = [v for _k, v in records]
+        # map(len, …) iterates in C — measurably faster than a genexpr with a
+        # Python-level len call per record on multi-100k batches
+        klens = np.fromiter(map(len, key_list), dtype=np.int32, count=n)
+        vlens = np.fromiter(map(len, val_list), dtype=np.int32, count=n)
+        keys = np.frombuffer(b"".join(key_list), dtype=np.uint8)
+        values = np.frombuffer(b"".join(val_list), dtype=np.uint8)
         return RecordBatch(klens, vlens, keys, values)
 
     @staticmethod
