@@ -165,12 +165,24 @@ class ShuffleContext:
         """Range-partitioned, key-ordered shuffle — the terasort shape
         (S3ShuffleManagerTest.scala:146-174). Output partition i holds keys
         ≤ partition i+1's keys; each partition is internally sorted."""
+        from s3shuffle_tpu.batch import RecordBatch
         from s3shuffle_tpu.dependency import natural_key
 
         key = key_func or natural_key
         sample: List[Any] = []
-        materialized: List[List[Tuple[Any, Any]]] = []
+        materialized: List[Any] = []
         for part in input_partitions:
+            if isinstance(part, RecordBatch):
+                # Columnar input: sample every step-th key without expanding
+                # the batch into per-record tuples.
+                materialized.append(part)
+                ko = part.koffsets
+                step = max(1, part.n // 64)
+                sample.extend(
+                    key(part.keys[ko[i] : ko[i + 1]].tobytes())
+                    for i in range(0, part.n, step)
+                )
+                continue
             p = list(part)
             materialized.append(p)
             sample.extend(key(k) for k, _v in p[:: max(1, len(p) // 64)])

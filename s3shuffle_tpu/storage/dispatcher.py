@@ -33,6 +33,11 @@ class Dispatcher:
 
     _instance: Optional["Dispatcher"] = None
     _instance_lock = threading.Lock()
+    # Private dispatchers for explicit configs that differ from the singleton
+    # (multi-tenant processes: tests, benches, side-by-side codecs). Keyed by
+    # config equality so repeated get(cfg) calls share one backend handle and
+    # FileStatus cache.
+    _private: List[tuple[ShuffleConfig, "Dispatcher"]] = []
 
     def __init__(self, config: ShuffleConfig):
         self.config = config
@@ -63,12 +68,31 @@ class Dispatcher:
             with cls._instance_lock:
                 if cls._instance is None:
                     cls._instance = Dispatcher(config or ShuffleConfig.from_env())
+        if config is not None and cls._instance.config != config:
+            # An explicit, different config must not silently inherit the
+            # singleton's settings (codec, root, checksum …): hand the caller
+            # a private dispatcher instead (memoized per config, so repeated
+            # calls share one backend handle + FileStatus cache). The
+            # singleton stays first-wins, like the reference's per-JVM
+            # S3ShuffleDispatcher.
+            with cls._instance_lock:
+                for i, (cfg, disp) in enumerate(cls._private):
+                    if cfg == config:
+                        # Move to the back: the eviction below is LRU.
+                        cls._private.append(cls._private.pop(i))
+                        return disp
+                disp = Dispatcher(config)
+                cls._private.append((config, disp))
+                if len(cls._private) > 16:
+                    cls._private.pop(0)
+                return disp
         return cls._instance
 
     @classmethod
     def reset(cls) -> None:
         with cls._instance_lock:
             cls._instance = None
+            cls._private = []
 
     def reinitialize(self, app_id: str) -> None:
         """Executor components re-init with the real application id once known

@@ -104,13 +104,19 @@ class ShuffleMapWriter:
 
     # ------------------------------------------------------------------
     def write(self, records: Iterable[Tuple[Any, Any]]) -> None:
+        from s3shuffle_tpu.batch import RecordBatch
+
         dep = self.dep
+        if not dep.map_side_combine and dep.serializer.supports_batches:
+            self._write_batched(records)
+            return
+        if isinstance(records, RecordBatch):
+            # Per-record routes (combine, or a non-batch serializer) consume
+            # (k, v) tuples — expand columnar input at the boundary.
+            records = records.iter_records()
         if dep.map_side_combine:
             assert dep.aggregator is not None
             records = dep.aggregator.combine_values_by_key(records)
-        elif dep.serializer.supports_batches:
-            self._write_batched(records)
-            return
         partitioner = dep.partitioner
         pipelines = self._pipelines
         check_every = 4096

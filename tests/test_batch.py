@@ -191,3 +191,19 @@ def test_columnar_frames_through_codec_any_block_size():
         buf.seek(0)
         got = list(ser.new_read_stream(CodecInputStream(codec, buf)))
         assert got == records, f"roundtrip failed at block_size={block_size}"
+
+
+def test_iter_record_batches_byte_bound_all_input_shapes():
+    # chunk_bytes must bound every input shape: list, iterator, RecordBatch.
+    from s3shuffle_tpu.batch import RecordBatch, iter_record_batches
+
+    recs = [(b"k", bytes(1000)) for _ in range(100)]
+    for source in (recs, iter(list(recs)), RecordBatch.from_records(recs)):
+        chunks = list(iter_record_batches(source, chunk_records=64, chunk_bytes=5000))
+        assert sum(c.n for c in chunks) == 100
+        assert all(c.nbytes <= 5100 for c in chunks), [c.nbytes for c in chunks]
+        assert len(chunks) > 10
+    # a single oversized record still comes through (one per chunk)
+    big = [(b"k", bytes(10_000))] * 3
+    chunks = list(iter_record_batches(big, chunk_records=64, chunk_bytes=5000))
+    assert [c.n for c in chunks] == [1, 1, 1]

@@ -275,3 +275,46 @@ def test_dynamic_map_range_read(tmp_path):
             )
     values = sorted(v for _k, v in out)
     assert values == sorted([1] * 10 + [2] * 10)
+
+
+def test_record_batch_input_with_default_serializer(tmp_path):
+    # Columnar input partitions must work on the per-record serializer route
+    # too (expanded at the writer boundary), not only with a batch serializer.
+    from s3shuffle_tpu.batch import RecordBatch
+
+    rng = random.Random(3)
+    recs = [(rng.randbytes(8), rng.randbytes(16)) for _ in range(2000)]
+    batches = [RecordBatch.from_records(recs[i::2]) for i in range(2)]
+    with make_ctx(tmp_path) as ctx:
+        out = ctx.sort_by_key(batches, num_partitions=3)
+    flat = [kv for part in out for kv in part]
+    assert sorted(flat) == sorted(recs)
+    keys = [k for k, _v in flat]
+    assert keys == sorted(keys)
+
+
+def test_record_batch_input_with_map_side_combine(tmp_path):
+    from s3shuffle_tpu.batch import RecordBatch
+
+    recs = [(b"k%d" % (i % 7), b"\x01") for i in range(500)]
+    batch = RecordBatch.from_records(recs)
+    with make_ctx(tmp_path) as ctx:
+        out = ctx.fold_by_key([batch], b"", lambda a, b: a + b, num_partitions=2)
+    assert {k: len(v) for k, v in out} == {b"k%d" % i: (72 if i < 3 else 71) for i in range(7)}
+
+
+def test_private_dispatcher_per_config(tmp_path):
+    # Two live configs in one process: each gets its own dispatcher (the
+    # singleton stays first-wins) and repeated gets memoize.
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+
+    Dispatcher.reset()
+    c1 = ShuffleConfig(root_dir=f"file://{tmp_path}/a/", app_id="a", codec="native")
+    c2 = ShuffleConfig(root_dir=f"file://{tmp_path}/b/", app_id="b", codec="zlib")
+    d1 = Dispatcher.get(c1)
+    assert Dispatcher.get(c1) is d1
+    d2 = Dispatcher.get(c2)
+    assert d2 is not d1
+    assert d2.config.codec == "zlib" and d1.config.codec == "native"
+    assert Dispatcher.get(c2) is d2
+    assert Dispatcher.get() is d1
