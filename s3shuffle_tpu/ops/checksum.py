@@ -221,11 +221,36 @@ def crc32_batch(blocks, lengths, poly: int = POLY_CRC32C, block_len: int | None 
     b, length = blocks.shape
     if block_len is not None and block_len != length:
         raise ValueError(f"block_len {block_len} != staged width {length}")
-    w_bits = _device_weights(poly, length)  # cached on-device, shipped once
     _, zero_crc = _weights.get(poly, length)
-    kernel = _crc_kernel(length)
-    x = np.asarray(kernel(blocks, w_bits))  # raw remainders, zero-init
+    if _use_pallas(b, length):
+        from s3shuffle_tpu.ops import crc_pallas
+
+        x = np.asarray(crc_pallas.crc_raw_batch(blocks, poly))
+    else:
+        w_bits = _device_weights(poly, length)  # cached on-device, shipped once
+        kernel = _crc_kernel(length)
+        x = np.asarray(kernel(blocks, w_bits))  # raw remainders, zero-init
     return (x ^ zero_crc[lengths]).astype(np.uint32)
+
+
+def _use_pallas(b: int, length: int) -> bool:
+    """Opt-in (S3SHUFFLE_PALLAS_CRC=1): the fused Pallas kernel keeps the 8x
+    bit expansion in VMEM. XLA's fusion is competitive (and on some rigs
+    faster at large batches), so the XLA lowering stays the default."""
+    import os
+
+    if os.environ.get("S3SHUFFLE_PALLAS_CRC") != "1":
+        return False
+    from s3shuffle_tpu.ops import crc_pallas
+
+    try:
+        import jax
+
+        if jax.default_backend() not in ("tpu",):
+            return False
+    except Exception:
+        return False
+    return crc_pallas.supported(b, length)
 
 
 @functools.lru_cache(maxsize=8)

@@ -1,0 +1,131 @@
+"""Pallas TPU kernel for batched CRC32/CRC32C.
+
+The XLA kernel in :mod:`s3shuffle_tpu.ops.checksum` computes the CRC as an
+int8 MXU matmul over the *bit expansion* of the payload — which is 8 int8 per
+byte, so the expansion materializes an 8x-payload intermediate through HBM
+before the dot consumes it. This kernel fuses the expansion into the matmul
+tile loop: each grid step loads a (TB, TL) uint8 data tile into VMEM, peels
+the 8 bit-planes on the VPU, and feeds each plane straight to the MXU against
+its (32, TL) weight plane — bits never exist outside VMEM, so HBM traffic is
+~1x payload plus the (reused) weight tiles.
+
+Layout notes:
+- weights are pre-shaped ``(8, 32, L)`` (bit-plane k, crc bit c, byte pos j),
+  so a plane slice ``w_ref[k]`` is a (32, TL) tile whose minor dim is the
+  128-aligned byte axis — clean VMEM tiling, and the dot contracts over TL
+  with ``dot_general`` (no transpose in-kernel);
+- grid is (B/TB, L/TL) with the L axis minor, accumulating into the same
+  (TB, 32) int32 output block (zeroed at j == 0);
+- the (counts & 1) parity pack stays outside the kernel (it is O(B*32)).
+
+Same math as checksum._crc_math: raw remainder with zero init over
+right-aligned rows; callers apply the zero-run fixup table for true
+init/final-xor semantics (checksum.crc32_batch).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+# Tile sizes: TB rows of the batch, TL bytes of the block per grid step.
+# (TB, TL) uint8 data tile = 16 KiB VMEM; 8 bit-planes are peeled in
+# registers; weight tile (8, 32, TL) int8 = 32 KiB.
+_TB = 128
+_TL = 128
+
+
+def _jax():
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    return jax, jnp, pl
+
+
+def _crc_counts_kernel(data_ref, w_ref, out_ref):
+    """One grid step: out[TB, 32] += Σ_k bits_k(data[TB, TL]) @ w[k, 32, TL]^T."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    data = data_ref[:].astype(jnp.int32)  # (TB, TL)
+    acc = jnp.zeros_like(out_ref)
+    for k in range(8):
+        bits_k = ((data >> k) & 1).astype(jnp.int8)  # (TB, TL)
+        # contract over TL: (TB, TL) x (32, TL) -> (TB, 32)
+        acc = acc + jax.lax.dot_general(
+            bits_k,
+            w_ref[k],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+    out_ref[:] = out_ref[:] + acc
+
+
+@functools.lru_cache(maxsize=8)
+def _counts_call(b: int, length: int, interpret: bool):
+    jax, jnp, pl = _jax()
+    from jax.experimental.pallas import tpu as pltpu
+
+    grid = (b // _TB, length // _TL)
+    call = pl.pallas_call(
+        _crc_counts_kernel,
+        out_shape=jax.ShapeDtypeStruct((b, 32), jnp.int32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_TB, _TL), lambda i, j: (i, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec((8, 32, _TL), lambda i, j: (0, 0, j), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((_TB, 32), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )
+
+    @jax.jit
+    def kernel(data_u8, w_planes):
+        counts = call(data_u8, w_planes)
+        parity = (counts & 1).astype(jnp.uint32)
+        return jnp.sum(
+            parity << jnp.arange(32, dtype=jnp.uint32)[None, :], axis=1, dtype=jnp.uint32
+        )
+
+    return kernel
+
+
+def supported(b: int, length: int) -> bool:
+    """Shapes the kernel tiles cleanly (callers fall back to the XLA path
+    otherwise)."""
+    return b % _TB == 0 and length % _TL == 0 and length > 0
+
+
+def plane_weights(poly: int, length: int) -> np.ndarray:
+    """Re-shape checksum's (L*8, 32) int8 bit-weight table to the kernel's
+    (8, 32, L) plane layout."""
+    from s3shuffle_tpu.ops.checksum import _weights
+
+    w_bits, _zero = _weights.get(poly, length)
+    # (L*8, 32) with row j*8+k  ->  (L, 8, 32) -> (8, 32, L)
+    return np.ascontiguousarray(w_bits.reshape(length, 8, 32).transpose(1, 2, 0))
+
+
+@functools.lru_cache(maxsize=8)
+def _device_plane_weights(poly: int, length: int):
+    jax, _jnp, _pl = _jax()
+    return jax.device_put(plane_weights(poly, length))
+
+
+def crc_raw_batch(blocks_u8, poly: int, interpret: bool = False):
+    """Raw zero-init CRC remainders of right-aligned (B, L) uint8 rows, via
+    the fused Pallas kernel. B and L must satisfy :func:`supported`."""
+    b, length = blocks_u8.shape
+    if not supported(b, length):
+        raise ValueError(f"unsupported shape ({b}, {length}) for pallas crc")
+    w = _device_plane_weights(poly, length) if not interpret else plane_weights(poly, length)
+    return _counts_call(b, length, interpret)(blocks_u8, w)
