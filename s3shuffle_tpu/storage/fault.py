@@ -1,0 +1,154 @@
+"""Fault-injection storage wrapper.
+
+The reference has no fault injection anywhere (SURVEY.md §5.3); its
+fault-tolerance story is architectural (shuffle data lives in the store, read
+errors surface as logged EOF, per-prefix delete errors are swallowed). This
+module makes those claims testable: :class:`FlakyBackend` wraps any
+:class:`StorageBackend` and injects failures per operation kind, selected by
+path substring and/or call count, optionally transient (fail the first N
+matching calls, then heal — models S3 503s / connection resets).
+
+Used by tests/test_fault_injection.py; safe to use in soak tooling too.
+"""
+
+from __future__ import annotations
+
+import io
+import threading
+from typing import BinaryIO, Callable, Dict, List, Optional
+
+from s3shuffle_tpu.storage.backend import FileStatus, RangedReader, StorageBackend
+
+#: Operation kinds that can be made to fail.
+OPS = ("create", "open", "read", "write", "status", "list", "delete", "rename")
+
+
+class FaultRule:
+    """Fail operations of ``op`` whose path contains ``match``.
+
+    ``skip`` matching calls pass through before failures start; after
+    ``times`` failures the rule is exhausted (None = fail forever).
+    ``exc`` is the exception factory.
+    """
+
+    def __init__(
+        self,
+        op: str,
+        match: str = "",
+        times: Optional[int] = 1,
+        skip: int = 0,
+        exc: Callable[[str], Exception] = lambda path: OSError(f"injected fault: {path}"),
+    ):
+        if op not in OPS:
+            raise ValueError(f"unknown op {op!r}; one of {OPS}")
+        self.op = op
+        self.match = match
+        self.times = times
+        self.skip = skip
+        self.exc = exc
+        self.hits = 0  # calls that matched (after skip) and raised
+        self._seen = 0
+        self._lock = threading.Lock()
+
+    def maybe_raise(self, op: str, path: str) -> None:
+        if op != self.op or self.match not in path:
+            return
+        with self._lock:
+            self._seen += 1
+            if self._seen <= self.skip:
+                return
+            if self.times is not None and self.hits >= self.times:
+                return
+            self.hits += 1
+            raise self.exc(path)
+
+
+class _FlakyReader(RangedReader):
+    def __init__(self, inner: RangedReader, path: str, check: Callable[[str, str], None]):
+        self._inner = inner
+        self._path = path
+        self._check = check
+
+    @property
+    def size(self) -> int:
+        return self._inner.size
+
+    def read_fully(self, position: int, length: int) -> bytes:
+        self._check("read", self._path)
+        return self._inner.read_fully(position, length)
+
+    def close(self) -> None:
+        self._inner.close()
+
+
+class _FlakyWriteStream(io.RawIOBase):
+    def __init__(self, inner: BinaryIO, path: str, check: Callable[[str, str], None]):
+        super().__init__()
+        self._inner = inner
+        self._path = path
+        self._check = check
+
+    def writable(self) -> bool:
+        return True
+
+    def write(self, b) -> int:
+        self._check("write", self._path)
+        return self._inner.write(b)
+
+    def flush(self) -> None:
+        if not self._inner.closed:
+            self._inner.flush()
+
+    def close(self) -> None:
+        if not self.closed:
+            self._inner.close()
+        super().close()
+
+
+class FlakyBackend(StorageBackend):
+    """Wraps ``inner``, raising per :class:`FaultRule` before delegating."""
+
+    def __init__(self, inner: StorageBackend, rules: Optional[List[FaultRule]] = None):
+        self.inner = inner
+        self.rules: List[FaultRule] = list(rules or [])
+        self.calls: Dict[str, int] = {op: 0 for op in OPS}
+        self.scheme = inner.scheme
+        self.supports_rename = inner.supports_rename
+
+    def add_rule(self, rule: FaultRule) -> FaultRule:
+        self.rules.append(rule)
+        return rule
+
+    def _check(self, op: str, path: str) -> None:
+        self.calls[op] = self.calls.get(op, 0) + 1
+        for rule in self.rules:
+            rule.maybe_raise(op, path)
+
+    # ------------------------------------------------------------------
+    def create(self, path: str) -> BinaryIO:
+        self._check("create", path)
+        return _FlakyWriteStream(self.inner.create(path), path, self._check)  # type: ignore[return-value]
+
+    def open_ranged(self, path: str, size_hint: int | None = None) -> RangedReader:
+        self._check("open", path)
+        return _FlakyReader(self.inner.open_ranged(path, size_hint), path, self._check)
+
+    def status(self, path: str) -> FileStatus:
+        self._check("status", path)
+        return self.inner.status(path)
+
+    def list_prefix(self, prefix: str) -> List[FileStatus]:
+        self._check("list", prefix)
+        return self.inner.list_prefix(prefix)
+
+    def delete(self, path: str) -> None:
+        self._check("delete", path)
+        self.inner.delete(path)
+
+    def delete_prefix(self, prefix: str) -> None:
+        self._check("delete", prefix)
+        self.inner.delete_prefix(prefix)
+
+    def rename(self, src: str, dst: str) -> bool:
+        self._check("rename", src)
+        return self.inner.rename(src, dst)

@@ -1,0 +1,155 @@
+"""Fault-injection tests — making the reference's fault-tolerance claims
+testable (SURVEY.md §5.3: read IOErrors surface as logged EOF, per-prefix
+delete errors are swallowed, block enumeration faults fail the task, checksum
+validation catches what EOF-swallowing would otherwise hide)."""
+
+import random
+
+import pytest
+
+from s3shuffle_tpu.config import ShuffleConfig
+from s3shuffle_tpu.shuffle import ShuffleContext
+from s3shuffle_tpu.storage.dispatcher import Dispatcher
+from s3shuffle_tpu.storage.fault import FaultRule, FlakyBackend
+
+
+def make_flaky_ctx(tmp_path, **overrides):
+    defaults = dict(
+        root_dir=f"file://{tmp_path}/shuffle", app_id="fault-app", cleanup=True
+    )
+    defaults.update(overrides)
+    Dispatcher.reset()
+    ctx = ShuffleContext(config=ShuffleConfig(**defaults), num_workers=2)
+    disp = ctx.manager.dispatcher
+    flaky = FlakyBackend(disp.backend)
+    disp.backend = flaky
+    return ctx, flaky
+
+
+def write_one_shuffle(ctx, n_records=2000, n_parts=3):
+    from s3shuffle_tpu.dependency import HashPartitioner, ShuffleDependency
+
+    rng = random.Random(0)
+    records = [(rng.randbytes(8), rng.randbytes(16)) for _ in range(n_records)]
+    sid = next(ctx._next_shuffle_id)
+    dep = ShuffleDependency(sid, HashPartitioner(n_parts))
+    handle = ctx.manager.register_shuffle(sid, dep)
+    w = ctx.manager.get_writer(handle, 0)
+    w.write(records)
+    w.stop(success=True)
+    return handle, records, n_parts
+
+
+def read_all(ctx, handle, n_parts):
+    out = []
+    for rid in range(n_parts):
+        out.extend(ctx.manager.get_reader(handle, rid, rid + 1).read())
+    return out
+
+
+def test_persistent_read_fault_surfaces_as_eof(tmp_path, caplog):
+    # Parity: mid-stream IOErrors are logged and surfaced as EOF, not raised
+    # (S3ShuffleBlockStream.scala:66-70, 87-92). With checksums off this
+    # truncates silently — the reference's documented behavior.
+    ctx, flaky = make_flaky_ctx(tmp_path, checksum_enabled=False)
+    handle, records, n_parts = write_one_shuffle(ctx)
+    flaky.add_rule(FaultRule("read", match=".data", times=None))
+    with caplog.at_level("ERROR", logger="s3shuffle_tpu.read"):
+        out = read_all(ctx, handle, n_parts)
+    assert out == []  # every data read EOFs immediately
+    assert any("injected fault" in r.message for r in caplog.records)
+    ctx.stop()
+
+
+def test_read_fault_with_checksum_is_detected(tmp_path):
+    # The EOF-swallowing above silently truncates; checksum validation turns
+    # the truncation into a hard error (our extension over the reference,
+    # which validates streaming checksums the same way).
+    from s3shuffle_tpu.read.checksum_stream import ChecksumError
+
+    ctx, flaky = make_flaky_ctx(tmp_path, checksum_enabled=True)
+    handle, records, n_parts = write_one_shuffle(ctx)
+    # fail from the second read on: the stream EOFs mid-partition
+    flaky.add_rule(FaultRule("read", match=".data", times=None, skip=1))
+    with pytest.raises(ChecksumError):
+        read_all(ctx, handle, n_parts)
+    ctx.stop()
+
+
+def test_transient_read_fault_only_loses_nothing_when_retried_by_caller(tmp_path):
+    # A fresh reader (the task-retry analog: Spark re-runs the reduce task)
+    # sees intact data after a transient fault window closes.
+    ctx, flaky = make_flaky_ctx(tmp_path, checksum_enabled=True)
+    handle, records, n_parts = write_one_shuffle(ctx)
+    rule = flaky.add_rule(FaultRule("open", match=".data", times=2))
+    with pytest.raises(OSError):
+        read_all(ctx, handle, n_parts)
+    with pytest.raises(OSError):
+        read_all(ctx, handle, n_parts)
+    # fault exhausted -> retry succeeds with exact data
+    out = read_all(ctx, handle, n_parts)
+    assert sorted(out) == sorted(records)
+    assert rule.hits == 2
+    ctx.stop()
+
+
+def test_delete_faults_are_swallowed_per_prefix(tmp_path, caplog):
+    # Parity: removeShuffle swallows per-prefix IO errors but logs them
+    # (S3ShuffleDispatcher.scala:109-114).
+    ctx, flaky = make_flaky_ctx(tmp_path)
+    handle, records, n_parts = write_one_shuffle(ctx)
+    flaky.add_rule(FaultRule("delete", times=None))
+    with caplog.at_level("WARNING", logger="s3shuffle_tpu.dispatcher"):
+        ctx.manager.unregister_shuffle(handle.shuffle_id)  # must not raise
+    assert any("delete of" in r.message for r in caplog.records)
+    ctx.stop()
+
+
+def test_index_fault_fails_enumeration_in_metadata_mode(tmp_path):
+    # Index reads are the commit point: a fault there must fail the read task
+    # (S3ShuffleBlockIterator.scala:46-53 rethrow when useBlockManager).
+    ctx, flaky = make_flaky_ctx(tmp_path, use_block_manager=True)
+    handle, records, n_parts = write_one_shuffle(ctx)
+    ctx.manager.helper.purge_cached_data_for_shuffle(handle.shuffle_id)  # drop index cache
+    flaky.add_rule(FaultRule("open", match=".index", times=None))
+    with pytest.raises(OSError):
+        read_all(ctx, handle, n_parts)
+    ctx.stop()
+
+
+def test_write_fault_aborts_commit_and_leaves_no_index(tmp_path):
+    # The index object is the commit point: a failed write must not publish
+    # one (write-data-then-index ordering, SURVEY.md §7.3).
+    ctx, flaky = make_flaky_ctx(tmp_path)
+    from s3shuffle_tpu.dependency import HashPartitioner, ShuffleDependency
+
+    sid = next(ctx._next_shuffle_id)
+    dep = ShuffleDependency(sid, HashPartitioner(2))
+    handle = ctx.manager.register_shuffle(sid, dep)
+    flaky.add_rule(FaultRule("write", times=None))
+    w = ctx.manager.get_writer(handle, 0)
+    with pytest.raises(OSError):
+        w.write([(b"k", b"v")] * 10)
+        w.stop(success=True)
+    w.stop(success=False)
+    assert not [
+        st for st in flaky.list_prefix(f"file://{tmp_path}/shuffle") if ".index" in st.path
+    ]
+    ctx.stop()
+
+
+def test_rule_matching_and_counters():
+    from s3shuffle_tpu.storage.backend import MemoryBackend
+
+    flaky = FlakyBackend(MemoryBackend())
+    rule = flaky.add_rule(FaultRule("open", match="a/b", times=1, skip=1))
+    with flaky.create("memory:///a/b/x") as s:
+        s.write(b"data")
+    flaky.open_ranged("memory:///a/b/x")  # skip=1: passes
+    with pytest.raises(OSError):
+        flaky.open_ranged("memory:///a/b/x")  # fails once
+    flaky.open_ranged("memory:///a/b/x")  # exhausted: passes
+    assert rule.hits == 1
+    assert flaky.calls["open"] == 3
+    with pytest.raises(ValueError):
+        FaultRule("frobnicate")
