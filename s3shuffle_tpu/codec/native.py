@@ -161,6 +161,15 @@ class NativeLZCodec(FrameCodec):
         n = len(blocks)
         if n <= 1:
             return [self.compress_block(b) for b in blocks]
+        from s3shuffle_tpu.utils import trace
+
+        if trace.enabled():
+            with trace.span("codec.compress_batch", blocks=n):
+                return self._compress_blocks_impl(blocks)
+        return self._compress_blocks_impl(blocks)
+
+    def _compress_blocks_impl(self, blocks):
+        n = len(blocks)
         src = np.frombuffer(b"".join(blocks), dtype=np.uint8)
         src_off = np.zeros(n + 1, dtype=np.int64)
         np.cumsum(np.fromiter(map(len, blocks), dtype=np.int64, count=n), out=src_off[1:])

@@ -224,8 +224,11 @@ class BufferedPrefetchIterator:
                     self._lock.wait(timeout=0.5)
                 self._buffers_in_flight += bsize
             try:
+                from s3shuffle_tpu.utils import trace
+
                 t0 = time.perf_counter_ns()
-                buffer = _read_up_to(stream, bsize)  # ← the actual store GET
+                with trace.span("read.prefetch", block=block.name, budget=bsize):
+                    buffer = _read_up_to(stream, bsize)  # ← the actual store GET
                 dt = time.perf_counter_ns() - t0
                 prefetched = PrefetchedBlockStream(block, stream, buffer, self._release_budget(len(buffer), bsize))
                 with self._lock:

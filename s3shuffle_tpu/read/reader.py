@@ -150,6 +150,9 @@ class ShuffleReader:
         )
 
     def read(self) -> Iterator[Tuple[Any, Any]]:
+        from s3shuffle_tpu.utils import trace
+
+        trace.count("read.tasks")
         if self.dep.serializer.supports_batches and self.dep.aggregator is None:
             return self._read_batched()
 

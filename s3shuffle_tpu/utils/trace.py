@@ -1,0 +1,168 @@
+"""Tracing / profiling subsystem.
+
+The reference's observability is (a) per-block write timing logs
+(S3MeasureOutputStream.scala:55-63), (b) per-task read statistics
+(S3BufferedPrefetchIterator.scala:155-186), and (c) an external JVM sampling
+profiler stack (uber jvm-profiler → InfluxDB → Grafana; examples/README.md:
+54-101). (a) and (b) are kept in the write/read planes; this module is the
+TPU-native analog of (c): an in-process tracer that records **spans**
+(name, thread, start, duration, attributes) and **counters**, exports them as
+Chrome trace-event JSON (loadable in chrome://tracing or Perfetto), and
+forwards span boundaries to ``jax.profiler.TraceAnnotation`` so host-side
+spans line up with device timelines in XProf captures.
+
+Zero overhead when disabled: ``span()`` returns a shared no-op context
+manager unless tracing was enabled via :func:`enable` or the
+``S3SHUFFLE_TRACE`` env var (set to the output path, or ``1`` for
+``s3shuffle_trace.json``).
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+_lock = threading.Lock()
+_events: List[dict] = []
+_counters: Dict[str, float] = {}
+_enabled = False
+_path: Optional[str] = None
+_use_jax_annotations = False
+_t0 = time.perf_counter_ns()
+
+
+def _maybe_enable_from_env() -> None:
+    val = os.environ.get("S3SHUFFLE_TRACE")
+    if val:
+        enable("s3shuffle_trace.json" if val == "1" else val)
+
+
+def enable(path: str, jax_annotations: bool = True) -> None:
+    """Start recording; the trace file is written at :func:`flush` (also
+    registered atexit)."""
+    global _enabled, _path, _use_jax_annotations
+    with _lock:
+        _enabled = True
+        _path = path
+        _use_jax_annotations = jax_annotations
+
+
+def disable() -> None:
+    global _enabled
+    with _lock:
+        _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+class _NoopSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("name", "args", "_start", "_jax_ctx")
+
+    def __init__(self, name: str, args: Dict[str, Any]):
+        self.name = name
+        self.args = args
+        self._start = 0
+        self._jax_ctx = None
+
+    def __enter__(self):
+        self._start = time.perf_counter_ns()
+        if _use_jax_annotations:
+            try:
+                import jax
+
+                self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
+                self._jax_ctx.__enter__()
+            except Exception:
+                self._jax_ctx = None
+        return self
+
+    def __exit__(self, *exc) -> None:
+        end = time.perf_counter_ns()
+        if self._jax_ctx is not None:
+            self._jax_ctx.__exit__(*exc)
+        event = {
+            "name": self.name,
+            "ph": "X",  # complete event
+            "ts": (self._start - _t0) / 1e3,  # µs
+            "dur": (end - self._start) / 1e3,
+            "pid": os.getpid(),
+            "tid": threading.get_ident() & 0xFFFFFFFF,
+        }
+        if self.args:
+            event["args"] = self.args
+        with _lock:
+            _events.append(event)
+
+
+def span(name: str, **args: Any):
+    """``with trace.span("read.prefetch", bytes=n): ...`` — no-op unless
+    tracing is enabled."""
+    if not _enabled:
+        return _NOOP
+    return _Span(name, args)
+
+
+def count(name: str, value: float = 1.0) -> None:
+    """Accumulate a named counter (exported in the trace metadata and
+    readable via :func:`counters`)."""
+    if not _enabled:
+        return
+    with _lock:
+        _counters[name] = _counters.get(name, 0.0) + value
+
+
+def counters() -> Dict[str, float]:
+    with _lock:
+        return dict(_counters)
+
+
+def events_snapshot() -> List[dict]:
+    with _lock:
+        return list(_events)
+
+
+def flush(path: Optional[str] = None) -> Optional[str]:
+    """Write the Chrome trace-event file. Returns the path written (None when
+    nothing was recorded)."""
+    target = path or _path
+    with _lock:
+        if target is None or (not _events and not _counters):
+            return None
+        doc = {
+            "traceEvents": list(_events),
+            "otherData": {"counters": dict(_counters)},
+            "displayTimeUnit": "ms",
+        }
+    with open(target, "w") as f:
+        json.dump(doc, f)
+    return target
+
+
+def reset() -> None:
+    global _events, _counters
+    with _lock:
+        _events = []
+        _counters = {}
+
+
+atexit.register(flush)
+_maybe_enable_from_env()

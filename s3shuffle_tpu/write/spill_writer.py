@@ -180,31 +180,39 @@ class ShuffleMapWriter:
             self.output_writer.abort()
             self._cleanup_spill()
             return None
+        from s3shuffle_tpu.utils import trace
+
         try:
-            for pid, pipeline in enumerate(self._pipelines):
-                final = pipeline.finalize()
-                writer = self.output_writer.get_partition_writer(pid)
-                for offset, length in pipeline.spill_segments:
-                    assert self._spill_fd is not None
-                    self._spill_fd.seek(offset)
-                    remaining = length
-                    while remaining > 0:
-                        chunk = self._spill_fd.read(min(remaining, 1 << 20))
-                        if not chunk:
-                            raise IOError("Truncated spill file")
-                        writer.write(chunk)
-                        remaining -= len(chunk)
-                if final:
-                    writer.write(final)
-                writer.close()
-            message = self.output_writer.commit_all_partitions()
-            self.on_commit(self.handle.shuffle_id, self.map_id, message.partition_lengths)
-            return message
+            with trace.span(
+                "write.commit", map_id=self.map_id, records=self._records_written
+            ):
+                return self._commit()
         except BaseException as e:
             self.output_writer.abort(e if isinstance(e, Exception) else None)
             raise
         finally:
             self._cleanup_spill()
+
+    def _commit(self) -> MapOutputCommitMessage:
+        for pid, pipeline in enumerate(self._pipelines):
+            final = pipeline.finalize()
+            writer = self.output_writer.get_partition_writer(pid)
+            for offset, length in pipeline.spill_segments:
+                assert self._spill_fd is not None
+                self._spill_fd.seek(offset)
+                remaining = length
+                while remaining > 0:
+                    chunk = self._spill_fd.read(min(remaining, 1 << 20))
+                    if not chunk:
+                        raise IOError("Truncated spill file")
+                    writer.write(chunk)
+                    remaining -= len(chunk)
+            if final:
+                writer.write(final)
+            writer.close()
+        message = self.output_writer.commit_all_partitions()
+        self.on_commit(self.handle.shuffle_id, self.map_id, message.partition_lengths)
+        return message
 
     def _cleanup_spill(self) -> None:
         if self._spill_fd is not None:

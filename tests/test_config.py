@@ -58,3 +58,48 @@ def test_bad_algorithm_raises():
 def test_unknown_key_raises():
     with pytest.raises(KeyError):
         ShuffleConfig.from_dict({"spark.shuffle.s3.nope": "1"})
+
+
+def test_trace_records_spans_and_counters(tmp_path):
+    # The tracing subsystem: spans + counters recorded end to end through a
+    # real shuffle and exported as Chrome trace-event JSON.
+    import json
+
+    from s3shuffle_tpu.utils import trace
+
+    trace.reset()
+    trace.enable(str(tmp_path / "trace.json"), jax_annotations=False)
+    try:
+        from s3shuffle_tpu.config import ShuffleConfig
+        from s3shuffle_tpu.shuffle import ShuffleContext
+        from s3shuffle_tpu.storage.dispatcher import Dispatcher
+
+        Dispatcher.reset()
+        cfg = ShuffleConfig(root_dir=f"file://{tmp_path}/shuffle", app_id="trace-app")
+        with ShuffleContext(config=cfg, num_workers=2) as ctx:
+            out = ctx.fold_by_key(
+                [[(k % 5, 1) for k in range(200)]], 0, lambda a, b: a + b, num_partitions=2
+            )
+        assert dict(out) == {k: 40 for k in range(5)}
+        names = {e["name"] for e in trace.events_snapshot()}
+        assert "write.commit" in names
+        assert "read.prefetch" in names
+        assert trace.counters().get("read.tasks", 0) >= 2
+        path = trace.flush()
+        doc = json.load(open(path))
+        assert doc["traceEvents"] and "counters" in doc["otherData"]
+    finally:
+        trace.disable()
+        trace.reset()
+
+
+def test_trace_disabled_is_noop():
+    from s3shuffle_tpu.utils import trace
+
+    trace.reset()
+    assert not trace.enabled()
+    with trace.span("x", a=1):
+        pass
+    trace.count("y")
+    assert trace.events_snapshot() == []
+    assert trace.counters() == {}
