@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import io
 import struct
+from collections import deque
 from typing import BinaryIO, Callable, List, Tuple
 
 from s3shuffle_tpu.utils.io import read_fully as _read_fully
@@ -170,37 +171,87 @@ class CodecInputStream(io.RawIOBase):
     frames are accepted (the decoder dispatches on codec_id), so readers can
     decode data written by a different configured codec."""
 
+    #: Frames read ahead and decoded per batch — one native/device call
+    #: instead of one per frame. Bounds extra buffering to
+    #: ``BATCH_FRAMES * block_size`` decoded bytes per stream.
+    BATCH_FRAMES = 16
+
     def __init__(self, codec: FrameCodec | None, source: BinaryIO):
         self._codec = codec
         self._source = source
         self._current = b""
         self._pos = 0
         self._eof = False
+        self._decoded: deque = deque()
+        # Read-ahead only pays off for codecs with a batch decompress path.
+        self._batch_frames = (
+            self.BATCH_FRAMES
+            if codec is not None
+            and type(codec).decompress_blocks is not FrameCodec.decompress_blocks
+            else 1
+        )
 
     def readable(self) -> bool:
         return True
 
-    def _fill(self) -> bool:
+    def _read_frame(self):
+        """Returns (codec_id, payload, ulen) or None at EOF."""
         header = _read_fully(self._source, HEADER_SIZE)
         if not header:
-            self._eof = True
-            return False
+            return None
         if len(header) < HEADER_SIZE:
             raise IOError(f"Truncated frame header ({len(header)} bytes)")
         codec_id, ulen, clen = HEADER.unpack(header)
         payload = _read_fully(self._source, clen)
         if len(payload) < clen:
             raise IOError(f"Truncated frame payload ({len(payload)}/{clen} bytes)")
+        if codec_id == 0 and ulen != clen:
+            raise IOError("Raw frame with mismatched lengths")
+        return codec_id, payload, ulen
+
+    def _decode_run(self, frames) -> None:
+        """Decode an in-order run of frames sharing one codec_id into
+        ``self._decoded``."""
+        codec_id = frames[0][0]
         if codec_id == 0:
-            if ulen != clen:
-                raise IOError("Raw frame with mismatched lengths")
-            self._current = payload
+            self._decoded.extend(payload for _c, payload, _u in frames)
+            return
+        if (
+            len(frames) > 1
+            and self._codec is not None
+            and codec_id == self._codec.codec_id
+        ):
+            blocks = self._codec.decompress_blocks([(p, u) for _c, p, u in frames])
         else:
-            self._current = decompress_frame_payload(codec_id, payload, ulen, self._codec)
-            if len(self._current) != ulen:
-                raise IOError(
-                    f"Decompressed length {len(self._current)} != header {ulen}"
-                )
+            blocks = [
+                decompress_frame_payload(codec_id, p, u, self._codec)
+                for _c, p, u in frames
+            ]
+        for (_c, _p, ulen), out in zip(frames, blocks):
+            if len(out) != ulen:
+                raise IOError(f"Decompressed length {len(out)} != header {ulen}")
+        self._decoded.extend(blocks)
+
+    def _fill(self) -> bool:
+        if not self._decoded:
+            run: list = []
+            while len(run) < self._batch_frames:
+                frame = self._read_frame()
+                if frame is None:
+                    break
+                if run and frame[0] != run[0][0]:
+                    self._decode_run(run)
+                    run = [frame]
+                    break  # decoded enough for now; keep the new run's frame
+                run.append(frame)
+                if self._batch_frames == 1:
+                    break
+            if run:
+                self._decode_run(run)
+        if not self._decoded:
+            self._eof = True
+            return False
+        self._current = self._decoded.popleft()
         self._pos = 0
         return True
 

@@ -194,6 +194,39 @@ class NativeLZCodec(FrameCodec):
                 out.append(dst[src_off[i] : src_off[i] + size].tobytes())
         return out
 
+    def decompress_blocks(self, blocks):
+        """One ``slz_decompress_batch`` crossing for the whole batch (the
+        read plane's frame read-ahead path)."""
+        n = len(blocks)
+        if n <= 1:
+            return [self.decompress_block(b, ulen) for b, ulen in blocks]
+        src = np.frombuffer(b"".join(b for b, _ in blocks), dtype=np.uint8)
+        src_off = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(
+            np.fromiter((len(b) for b, _ in blocks), dtype=np.int64, count=n),
+            out=src_off[1:],
+        )
+        ulens = np.fromiter((u for _, u in blocks), dtype=np.int64, count=n)
+        dst_off = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(ulens, out=dst_off[1:])
+        dst = np.empty(int(dst_off[-1]), dtype=np.uint8)
+        out_sizes = np.zeros(n, dtype=np.int64)
+        self._lib.slz_decompress_batch(
+            src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            src_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n,
+            dst.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            dst_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            out_sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        )
+        if not (out_sizes == ulens).all():
+            bad = int(np.nonzero(out_sizes != ulens)[0][0])
+            raise IOError(
+                f"SLZ batch decompression: block {bad} produced "
+                f"{int(out_sizes[bad])} bytes, expected {int(ulens[bad])}"
+            )
+        return [dst[dst_off[i] : dst_off[i + 1]].tobytes() for i in range(n)]
+
     # ------------------------------------------------------------------
     # numpy batch paths (used by the TPU host pipeline and benchmarks)
     # ------------------------------------------------------------------

@@ -100,6 +100,25 @@ static inline uint32_t load32(const uint8_t* p) {
     return v;
 }
 
+static inline uint64_t load64(const uint8_t* p) {
+    uint64_t v;
+    memcpy(&v, p, 8);
+    return v;
+}
+
+// Length of the common prefix of a and b, limited to `limit` bytes.
+// 8 bytes per step + count-trailing-zeros on the XOR (little-endian).
+static inline size_t match_length(const uint8_t* a, const uint8_t* b, size_t limit) {
+    size_t len = 0;
+    while (len + 8 <= limit) {
+        uint64_t diff = load64(a + len) ^ load64(b + len);
+        if (diff) return len + (size_t)(__builtin_ctzll(diff) >> 3);
+        len += 8;
+    }
+    while (len < limit && a[len] == b[len]) len++;
+    return len;
+}
+
 static inline uint32_t hash4(uint32_t v) {
     return (v * 2654435761u) >> (32 - HASH_BITS);
 }
@@ -143,6 +162,10 @@ size_t slz_compress(const uint8_t* src, size_t n, uint8_t* dst, size_t cap) {
     uint8_t* op = dst;
     uint8_t* oend = dst + cap;
 
+    // LZ4-style skip acceleration: each consecutive miss advances the probe
+    // a little further, so incompressible data is skipped at memory speed
+    // instead of probing every byte.
+    size_t search_accel = 1 << 6;
     while (ip < mflimit) {
         uint32_t h = hash4(load32(ip));
         uint32_t cand = table[h];
@@ -150,14 +173,8 @@ size_t slz_compress(const uint8_t* src, size_t n, uint8_t* dst, size_t cap) {
         if (cand != 0xFFFFFFFFu) {
             const uint8_t* cp = src + cand;
             if ((size_t)(ip - cp) <= 0xFFFF && load32(cp) == load32(ip)) {
-                // extend match forward
-                const uint8_t* m = ip + MIN_MATCH;
-                const uint8_t* c = cp + MIN_MATCH;
-                while (m < iend && *m == *c) {
-                    m++;
-                    c++;
-                }
-                size_t mlen = (size_t)(m - ip);
+                size_t mlen = MIN_MATCH + match_length(ip + MIN_MATCH, cp + MIN_MATCH,
+                                                      (size_t)(iend - ip) - MIN_MATCH);
                 size_t llen = (size_t)(ip - anchor);
                 // emit: varint L, literals, u16 offset, varint (M - MIN_MATCH)
                 if (op + llen + 12 > oend) return 0;
@@ -168,16 +185,19 @@ size_t slz_compress(const uint8_t* src, size_t n, uint8_t* dst, size_t cap) {
                 *op++ = (uint8_t)(off & 0xFF);
                 *op++ = (uint8_t)(off >> 8);
                 op = put_varint(op, mlen - MIN_MATCH);
-                // seed hash table inside the match (sparse, every 2nd byte)
+                // seed a few positions inside the match (long matches don't
+                // need dense coverage; dense seeding dominated the hot loop)
                 const uint8_t* seed_end = (ip + mlen < mflimit) ? ip + mlen : mflimit;
-                for (const uint8_t* s = ip + 1; s < seed_end; s += 2)
+                size_t step = mlen <= 32 ? 2 : 8;
+                for (const uint8_t* s = ip + 1; s < seed_end; s += step)
                     table[hash4(load32(s))] = (uint32_t)(s - src);
                 ip += mlen;
                 anchor = ip;
+                search_accel = 1 << 6;
                 continue;
             }
         }
-        ip++;
+        ip += (search_accel++ >> 6);
     }
     // final literal run
     size_t llen = (size_t)(iend - anchor);
@@ -216,8 +236,14 @@ size_t slz_decompress(const uint8_t* src, size_t n, uint8_t* dst, size_t ulen) {
         if (off >= mlen) {
             memcpy(op, match, mlen);
             op += mlen;
+        } else if (off >= 8) {
+            // overlapping but ≥8 apart: 8-byte steps are safe
+            size_t i = 0;
+            for (; i + 8 <= mlen; i += 8) memcpy(op + i, match + i, 8);
+            for (; i < mlen; i++) op[i] = match[i];
+            op += mlen;
         } else {
-            // overlapping copy (RLE-style) — byte-wise
+            // tight overlap (RLE-style) — byte-wise
             for (size_t i = 0; i < mlen; i++) *op++ = *match++;
         }
     }
