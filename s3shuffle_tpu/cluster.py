@@ -143,3 +143,117 @@ class LocalCluster:
         self.server.stop()
         if remove_root and self.config.cleanup:
             Dispatcher.get(self.config).remove_root()
+
+
+class DistributedDriver:
+    """Driver for :class:`~s3shuffle_tpu.worker.WorkerAgent` fleets.
+
+    The multi-host topology: this driver hosts the metadata service + task
+    queue; worker agents — on this host or any other host that can reach the
+    coordinator address and the store — pull tasks and execute. Record data
+    moves exclusively through the store (driver stages input objects; the
+    reduce stage writes output objects); the control plane carries only JSON
+    descriptors.
+    """
+
+    def __init__(self, config: ShuffleConfig, host: str = "127.0.0.1", port: int = 0):
+        from s3shuffle_tpu.storage.dispatcher import Dispatcher
+
+        self.config = config
+        self.server = MetadataServer(host=host, port=port).start()
+        self.dispatcher = Dispatcher.get(config)
+        self._next_shuffle_id = 0
+
+    @property
+    def coordinator_address(self) -> Tuple[str, int]:
+        return self.server.address
+
+    # ------------------------------------------------------------------
+    def _scratch(self, shuffle_id: int, name: str) -> str:
+        return f"{self.config.root_dir}_stage/{self.config.app_id}/{shuffle_id}/{name}"
+
+    def _wait_stage(self, stage_id: str, poll: float = 0.02) -> dict:
+        import time
+
+        while True:
+            status = self.server.task_queue.stage_status(stage_id)
+            if status["failed"]:
+                raise RuntimeError(f"stage {stage_id} failed: {status['failed']}")
+            if not status["pending"] and not status["running"]:
+                return status["done"]
+            time.sleep(poll)
+
+    def run_sort_shuffle(self, input_batches, num_partitions: int):
+        """Distributed range-partitioned sort (the terasort shape): stages
+        input to the store, runs map+reduce stages on whatever workers are
+        connected, returns the sorted output RecordBatches."""
+        from s3shuffle_tpu.batch import RecordBatch
+        from s3shuffle_tpu.dependency import RangePartitioner, natural_key, range_bounds
+        from s3shuffle_tpu.serializer import ColumnarKVSerializer
+        from s3shuffle_tpu.worker import dep_to_descriptor, read_input_batches, write_input_object
+
+        shuffle_id = self._next_shuffle_id
+        self._next_shuffle_id += 1
+
+        # range bounds from a columnar sample
+        sample: List[bytes] = []
+        for b in input_batches:
+            ko = b.koffsets
+            step = max(1, b.n // 64)
+            sample.extend(
+                b.keys[ko[i] : ko[i + 1]].tobytes() for i in range(0, b.n, step)
+            )
+        dep = ShuffleDependency(
+            shuffle_id=shuffle_id,
+            partitioner=RangePartitioner(range_bounds(sample, num_partitions)),
+            serializer=ColumnarKVSerializer(),
+            key_ordering=natural_key,
+        )
+        desc = dep_to_descriptor(dep)
+        self.server.tracker.register_shuffle(shuffle_id, dep.num_partitions)
+
+        # stage inputs to the store
+        input_paths = []
+        for map_id, batch in enumerate(input_batches):
+            path = self._scratch(shuffle_id, f"input_{map_id}")
+            write_input_object(self.dispatcher.backend, path, batch)
+            input_paths.append(path)
+
+        map_stage = f"shuffle{shuffle_id}-map"
+        self.server.task_queue.submit_stage(
+            map_stage,
+            [
+                {"task_id": m, "kind": "map", "shuffle_id": shuffle_id,
+                 "map_id": m, "dep": desc, "input_path": p}
+                for m, p in enumerate(input_paths)
+            ],
+        )
+        self._wait_stage(map_stage)
+
+        out_paths = [self._scratch(shuffle_id, f"output_{r}") for r in range(dep.num_partitions)]
+        reduce_stage = f"shuffle{shuffle_id}-reduce"
+        self.server.task_queue.submit_stage(
+            reduce_stage,
+            [
+                {"task_id": r, "kind": "reduce", "shuffle_id": shuffle_id,
+                 "reduce_id": r, "dep": desc, "output_path": p}
+                for r, p in enumerate(out_paths)
+            ],
+        )
+        self._wait_stage(reduce_stage)
+
+        out = []
+        for p in out_paths:
+            batches = read_input_batches(self.dispatcher.backend, p)
+            out.append(batches[0] if batches else RecordBatch.empty())
+        self.server.task_queue.drop_stage(map_stage)
+        self.server.task_queue.drop_stage(reduce_stage)
+        return out
+
+    # ------------------------------------------------------------------
+    def shutdown(self, remove_root: bool = True) -> None:
+        self.server.task_queue.stop_workers()
+        self.server.stop()
+        if remove_root and self.config.cleanup:
+            self.dispatcher.remove_root()
+            self.dispatcher.backend.delete_prefix(f"{self.config.root_dir}_stage")

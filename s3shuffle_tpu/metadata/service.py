@@ -70,6 +70,87 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     return bytes(buf)
 
 
+class TaskQueue:
+    """Coordinator-side stage/task queue for distributed execution.
+
+    Tasks are JSON dicts (``{"task_id": ..., "kind": ..., ...params}``) — the
+    control plane stays a data channel, never a code channel (workers dispatch
+    on registered kinds). One stage at a time is typical (map barrier, then
+    reduce), but multiple stages may be live. No lease/timeout reassignment
+    yet: a crashed worker's running task is re-queued by :meth:`requeue_lost`.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._stages: dict = {}
+        self._stopping = False
+
+    def submit_stage(self, stage_id: str, tasks: List[dict]) -> None:
+        with self._lock:
+            if stage_id in self._stages:
+                raise RuntimeError(f"stage {stage_id} already submitted")
+            ids = [t["task_id"] for t in tasks]
+            if len(set(ids)) != len(ids):
+                raise RuntimeError("duplicate task_id in stage")
+            self._stages[stage_id] = {
+                "pending": list(reversed(tasks)),  # pop() serves FIFO
+                "running": {},  # task_id -> worker_id
+                "done": {},  # task_id -> result
+                "failed": {},  # task_id -> error string
+            }
+
+    def take_task(self, worker_id: str):
+        with self._lock:
+            if self._stopping:
+                return {"action": "stop"}
+            for stage_id, st in self._stages.items():
+                if st["pending"]:
+                    task = st["pending"].pop()
+                    st["running"][task["task_id"]] = worker_id
+                    return {"action": "run", "stage_id": stage_id, "task": task}
+            return {"action": "wait"}
+
+    def complete_task(self, stage_id: str, task_id, result) -> None:
+        with self._lock:
+            st = self._stages[stage_id]
+            st["running"].pop(task_id, None)
+            st["done"][task_id] = result
+
+    def fail_task(self, stage_id: str, task_id, error: str) -> None:
+        with self._lock:
+            st = self._stages[stage_id]
+            st["running"].pop(task_id, None)
+            st["failed"][task_id] = error
+
+    def stage_status(self, stage_id: str) -> dict:
+        with self._lock:
+            st = self._stages[stage_id]
+            return {
+                "pending": len(st["pending"]),
+                "running": len(st["running"]),
+                "done": dict(st["done"]),
+                "failed": dict(st["failed"]),
+            }
+
+    def requeue_lost(self, stage_id: str, worker_id: str) -> int:
+        """Re-queue tasks a dead worker was running. Returns count."""
+        with self._lock:
+            st = self._stages[stage_id]
+            lost = [tid for tid, w in st["running"].items() if w == worker_id]
+            for tid in lost:
+                del st["running"][tid]
+            # lost task params are unknown here; the driver resubmits them
+            return len(lost)
+
+    def drop_stage(self, stage_id: str) -> None:
+        with self._lock:
+            self._stages.pop(stage_id, None)
+
+    def stop_workers(self) -> None:
+        with self._lock:
+            self._stopping = True
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self) -> None:
         tracker: MapOutputTracker = self.server.tracker  # type: ignore[attr-defined]
@@ -82,7 +163,8 @@ class _Handler(socketserver.BaseRequestHandler):
             if req is None:
                 return
             try:
-                result = self._dispatch(tracker, req)
+                result = self._dispatch_queue(req) if req.get("method", "").startswith("q_") \
+                    else self._dispatch(tracker, req)
                 resp = {"ok": True, "result": result}
             except KeyError as e:
                 resp = {"ok": False, "error": str(e), "error_type": "KeyError"}
@@ -96,6 +178,26 @@ class _Handler(socketserver.BaseRequestHandler):
                     {"ok": False, "error": f"{e} (narrow the requested range)",
                      "error_type": "RuntimeError"},
                 )
+
+    def _dispatch_queue(self, req: Any):
+        queue: TaskQueue = self.server.task_queue  # type: ignore[attr-defined]
+        method = req.get("method")
+        a = req.get("args", [])
+        if method == "q_submit_stage":
+            return queue.submit_stage(str(a[0]), list(a[1]))
+        if method == "q_take_task":
+            return queue.take_task(str(a[0]))
+        if method == "q_complete_task":
+            return queue.complete_task(str(a[0]), a[1], a[2])
+        if method == "q_fail_task":
+            return queue.fail_task(str(a[0]), a[1], str(a[2]))
+        if method == "q_stage_status":
+            return queue.stage_status(str(a[0]))
+        if method == "q_drop_stage":
+            return queue.drop_stage(str(a[0]))
+        if method == "q_stop_workers":
+            return queue.stop_workers()
+        raise RuntimeError(f"Unknown method: {method}")
 
     @staticmethod
     def _dispatch(tracker: MapOutputTracker, req: Any):
@@ -141,8 +243,10 @@ class MetadataServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  tracker: Optional[MapOutputTracker] = None):
         self.tracker = tracker or MapOutputTracker()
+        self.task_queue = TaskQueue()
         self._server = _Server((host, port), _Handler)
         self._server.tracker = self.tracker  # type: ignore[attr-defined]
+        self._server.task_queue = self.task_queue  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -257,3 +361,25 @@ class RemoteMapOutputTracker:
 
     def shuffle_ids(self) -> List[int]:
         return [int(x) for x in self._call("shuffle_ids")]
+
+    # -- task-queue interface (coordinator-hosted TaskQueue) -----------
+    def submit_stage(self, stage_id: str, tasks: List[dict]) -> None:
+        self._call("q_submit_stage", stage_id, tasks)
+
+    def take_task(self, worker_id: str) -> dict:
+        return self._call("q_take_task", worker_id)
+
+    def complete_task(self, stage_id: str, task_id, result) -> None:
+        self._call("q_complete_task", stage_id, task_id, result)
+
+    def fail_task(self, stage_id: str, task_id, error: str) -> None:
+        self._call("q_fail_task", stage_id, task_id, error)
+
+    def stage_status(self, stage_id: str) -> dict:
+        return self._call("q_stage_status", stage_id)
+
+    def drop_stage(self, stage_id: str) -> None:
+        self._call("q_drop_stage", stage_id)
+
+    def stop_workers(self) -> None:
+        self._call("q_stop_workers")

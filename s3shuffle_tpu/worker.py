@@ -1,0 +1,205 @@
+"""Standalone shuffle worker agent — the multi-host executor.
+
+Parity: the reference's executors are Spark JVMs that share nothing with the
+driver but the object store and its RPC endpoint (SURVEY.md §3.2/§3.3). A
+:class:`WorkerAgent` is the framework-native executor: started on any host
+(``python -m s3shuffle_tpu.worker --coordinator HOST:PORT``), it pulls tasks
+from the coordinator's :class:`~s3shuffle_tpu.metadata.service.TaskQueue`,
+runs them against the shared store, and reports completion. Task payloads are
+JSON descriptors dispatched on registered *kinds* ("map", "reduce") — the
+control plane carries no code, and record data moves through the store, not
+the control connection (driver writes input objects; reducers write output
+objects).
+
+Shuffle dependencies travel as JSON-safe descriptors (hash or range
+partitioner — range bounds base64-encoded — plus sort/serializer flags);
+:func:`dep_from_descriptor` reconstructs the ShuffleDependency on the worker.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import io
+import logging
+import os
+import socket
+import time
+from typing import List, Optional, Tuple
+
+from s3shuffle_tpu.config import ShuffleConfig
+from s3shuffle_tpu.dependency import (
+    HashPartitioner,
+    RangePartitioner,
+    ShuffleDependency,
+    natural_key,
+)
+from s3shuffle_tpu.metadata.service import RemoteMapOutputTracker
+from s3shuffle_tpu.serializer import ColumnarKVSerializer
+
+logger = logging.getLogger("s3shuffle_tpu.worker")
+
+
+# ---------------------------------------------------------------------------
+# JSON-safe dependency descriptors
+# ---------------------------------------------------------------------------
+
+
+def dep_to_descriptor(dep: ShuffleDependency) -> dict:
+    p = dep.partitioner
+    if isinstance(p, RangePartitioner):
+        part = {
+            "kind": "range",
+            "bounds_b64": [base64.b64encode(b).decode("ascii") for b in p.bounds],
+        }
+    elif isinstance(p, HashPartitioner):
+        part = {"kind": "hash", "num_partitions": p.num_partitions}
+    else:
+        raise ValueError(f"partitioner {type(p).__name__} has no JSON descriptor")
+    return {
+        "partitioner": part,
+        "sort": dep.key_ordering is not None,
+        "serializer": "columnar",
+    }
+
+
+def dep_from_descriptor(shuffle_id: int, desc: dict) -> ShuffleDependency:
+    part_desc = desc["partitioner"]
+    if part_desc["kind"] == "range":
+        bounds = [base64.b64decode(b) for b in part_desc["bounds_b64"]]
+        partitioner = RangePartitioner(bounds)
+    elif part_desc["kind"] == "hash":
+        partitioner = HashPartitioner(int(part_desc["num_partitions"]))
+    else:
+        raise ValueError(f"unknown partitioner kind {part_desc['kind']!r}")
+    return ShuffleDependency(
+        shuffle_id=shuffle_id,
+        partitioner=partitioner,
+        serializer=ColumnarKVSerializer(),
+        key_ordering=natural_key if desc.get("sort") else None,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Store-side input/output staging (columnar frames, no compression — these are
+# scratch objects the driver/reducers own, not shuffle data)
+# ---------------------------------------------------------------------------
+
+
+def write_input_object(backend, path: str, batch) -> None:
+    from s3shuffle_tpu.batch import write_frame
+
+    with backend.create(path) as sink:
+        write_frame(sink, batch)
+
+
+def read_input_batches(backend, path: str):
+    from s3shuffle_tpu.batch import read_frames
+
+    data = backend.read_all(path)
+    return list(read_frames(io.BytesIO(data)))
+
+
+# ---------------------------------------------------------------------------
+# The agent
+# ---------------------------------------------------------------------------
+
+
+class WorkerAgent:
+    def __init__(
+        self,
+        coordinator: Tuple[str, int],
+        config: Optional[ShuffleConfig] = None,
+        worker_id: Optional[str] = None,
+    ):
+        from s3shuffle_tpu.manager import ShuffleManager
+
+        self.client = RemoteMapOutputTracker(coordinator)
+        self.config = config or ShuffleConfig.from_env()
+        self.worker_id = worker_id or f"{socket.gethostname()}-{os.getpid()}"
+        self.manager = ShuffleManager(config=self.config, tracker=self.client)
+        self.tasks_run = 0
+
+    # -- task kinds ----------------------------------------------------
+    def _run_map(self, task: dict):
+        shuffle_id = int(task["shuffle_id"])
+        dep = dep_from_descriptor(shuffle_id, task["dep"])
+        handle = self.manager.register_shuffle(shuffle_id, dep)
+        from s3shuffle_tpu.batch import RecordBatch
+
+        batches = read_input_batches(self.manager.dispatcher.backend, task["input_path"])
+        writer = self.manager.get_writer(handle, int(task["map_id"]))
+        try:
+            for b in batches:
+                writer.write(b)
+            writer.stop(success=True)
+        except BaseException:
+            writer.stop(success=False)
+            raise
+        return {"records": int(sum(b.n for b in batches))}
+
+    def _run_reduce(self, task: dict):
+        shuffle_id = int(task["shuffle_id"])
+        dep = dep_from_descriptor(shuffle_id, task["dep"])
+        handle = self.manager.register_shuffle(shuffle_id, dep)
+        rid = int(task["reduce_id"])
+        reader = self.manager.get_reader(handle, rid, rid + 1)
+        batches = reader.read_result_batches()
+        from s3shuffle_tpu.batch import RecordBatch, write_frame
+
+        merged = RecordBatch.concat(batches)
+        with self.manager.dispatcher.backend.create(task["output_path"]) as sink:
+            write_frame(sink, merged)
+        return {"records": int(merged.n)}
+
+    KINDS = {"map": _run_map, "reduce": _run_reduce}
+
+    # -- loop ----------------------------------------------------------
+    def run_once(self) -> str:
+        """Poll for one task. Returns the action taken: run|wait|stop."""
+        resp = self.client.take_task(self.worker_id)
+        action = resp.get("action")
+        if action != "run":
+            return action
+        stage_id, task = resp["stage_id"], resp["task"]
+        kind = task.get("kind")
+        try:
+            fn = self.KINDS[kind]
+        except KeyError:
+            self.client.fail_task(stage_id, task.get("task_id"), f"unknown kind {kind!r}")
+            return "run"
+        try:
+            result = fn(self, task)
+            self.client.complete_task(stage_id, task["task_id"], result)
+        except Exception as e:
+            logger.exception("task %s failed", task.get("task_id"))
+            self.client.fail_task(stage_id, task["task_id"], f"{type(e).__name__}: {e}")
+        self.tasks_run += 1
+        return "run"
+
+    def run_forever(self, poll_interval: float = 0.05) -> int:
+        logger.info("worker %s polling coordinator %s", self.worker_id, self.client.address)
+        while True:
+            action = self.run_once()
+            if action == "stop":
+                logger.info("worker %s stopping after %d tasks", self.worker_id, self.tasks_run)
+                return self.tasks_run
+            if action == "wait":
+                time.sleep(poll_interval)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser(description="s3shuffle_tpu worker agent")
+    ap.add_argument("--coordinator", required=True, help="metadata service HOST:PORT")
+    ap.add_argument("--worker-id", default=None)
+    ap.add_argument("--poll-interval", type=float, default=0.05)
+    args = ap.parse_args(argv)
+    host, port = args.coordinator.rsplit(":", 1)
+    agent = WorkerAgent((host, int(port)), worker_id=args.worker_id)
+    agent.run_forever(args.poll_interval)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -140,3 +140,109 @@ def test_multiprocess_shuffle_survives_worker_death(tmp_path):
         assert flat == sorted(k for p in parts for k, _v in p)
     finally:
         cluster.shutdown()
+
+
+def _agent_main(coordinator, cfg_dict, worker_id):
+    # module-level so it pickles under spawn
+    from s3shuffle_tpu.config import ShuffleConfig
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+    from s3shuffle_tpu.worker import WorkerAgent
+
+    Dispatcher.reset()
+    agent = WorkerAgent(tuple(coordinator), config=ShuffleConfig(**cfg_dict), worker_id=worker_id)
+    agent.run_forever(poll_interval=0.01)
+
+
+@pytest.mark.slow
+def test_distributed_driver_with_worker_agents(tmp_path):
+    # The multi-host topology on one host: a DistributedDriver (metadata
+    # service + task queue) and two standalone WorkerAgent processes that
+    # share nothing with the driver but the store and the coordinator address.
+    import dataclasses
+    import multiprocessing as mp
+
+    from s3shuffle_tpu.batch import RecordBatch
+    from s3shuffle_tpu.cluster import DistributedDriver
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/store", app_id="dist-test", codec="zlib"
+    )
+    rng = random.Random(1)
+    recs = [(rng.randbytes(8), rng.randbytes(24)) for _ in range(4000)]
+    batches = [RecordBatch.from_records(recs[i::4]) for i in range(4)]
+
+    driver = DistributedDriver(cfg)
+    ctx = mp.get_context("spawn")
+    workers = [
+        ctx.Process(
+            target=_agent_main,
+            args=(list(driver.coordinator_address), dataclasses.asdict(cfg), f"w{i}"),
+            daemon=True,
+        )
+        for i in range(2)
+    ]
+    for w in workers:
+        w.start()
+    try:
+        out = driver.run_sort_shuffle(batches, num_partitions=3)
+        total = sum(b.n for b in out)
+        assert total == 4000
+        prev = None
+        got = []
+        for b in out:
+            if b.n == 0:
+                continue
+            sk = b.key_strings(width=8)
+            assert (sk[:-1] <= sk[1:]).all()
+            if prev is not None:
+                assert prev <= sk[0]
+            prev = sk[-1]
+            got.extend(b.to_records())
+        assert sorted(got) == sorted(recs)
+    finally:
+        driver.shutdown()
+        for w in workers:
+            w.join(timeout=10)
+            if w.is_alive():
+                w.terminate()
+    # stop_workers drained the fleet: agents exited by themselves
+    assert all(not w.is_alive() for w in workers)
+
+
+def test_task_queue_semantics():
+    from s3shuffle_tpu.metadata.service import TaskQueue
+
+    q = TaskQueue()
+    q.submit_stage("s1", [{"task_id": i, "kind": "noop"} for i in range(3)])
+    with pytest.raises(RuntimeError):
+        q.submit_stage("s1", [])  # duplicate stage
+    with pytest.raises(RuntimeError):
+        q.submit_stage("s2", [{"task_id": 0}, {"task_id": 0}])  # dup task ids
+    t0 = q.take_task("w0")
+    assert t0["action"] == "run" and t0["task"]["task_id"] == 0  # FIFO
+    q.complete_task("s1", 0, {"ok": 1})
+    t1 = q.take_task("w1")
+    q.fail_task("s1", t1["task"]["task_id"], "boom")
+    st = q.stage_status("s1")
+    assert st["pending"] == 1 and st["done"] == {0: {"ok": 1}} and "boom" in st["failed"][1]
+    q.stop_workers()
+    assert q.take_task("w0")["action"] == "stop"
+
+
+def test_dep_descriptor_roundtrip():
+    from s3shuffle_tpu.dependency import HashPartitioner, RangePartitioner, ShuffleDependency, natural_key
+    from s3shuffle_tpu.serializer import ColumnarKVSerializer
+    from s3shuffle_tpu.worker import dep_from_descriptor, dep_to_descriptor
+
+    dep = ShuffleDependency(
+        7, RangePartitioner([b"b", b"m\x00x"]), serializer=ColumnarKVSerializer(),
+        key_ordering=natural_key,
+    )
+    back = dep_from_descriptor(7, dep_to_descriptor(dep))
+    assert back.partitioner.bounds == [b"b", b"m\x00x"]
+    assert back.num_partitions == 3 and back.key_ordering is natural_key
+    dep2 = ShuffleDependency(8, HashPartitioner(5), serializer=ColumnarKVSerializer())
+    back2 = dep_from_descriptor(8, dep_to_descriptor(dep2))
+    assert back2.num_partitions == 5 and back2.key_ordering is None
