@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Distributed terasort across worker agents — the multi-host demo.
+
+One process drives (metadata service + task queue + input staging); workers
+pull tasks from anywhere that reaches the coordinator address and the store:
+
+    # coordinator (this script)
+    python examples/multihost_terasort.py --serve 0.0.0.0:7777 --size 100m
+
+    # on each worker host
+    S3SHUFFLE_ROOT_DIR=gs://bucket/shuffle/ \
+        python -m s3shuffle_tpu.worker --coordinator COORD_HOST:7777
+
+``--local-workers N`` spawns N agent processes locally instead (the one-host
+demo; same code path as real multi-host). Prints one JSON line with wall
+times and validation results, like examples/terasort.py.
+"""
+
+import argparse
+import dataclasses
+import json
+import multiprocessing as mp
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+KEY_BYTES, VALUE_BYTES = 10, 90
+
+
+def parse_size(s: str) -> int:
+    s = s.strip().lower()
+    for suffix, mult in (("g", 1 << 30), ("m", 1 << 20), ("k", 1 << 10)):
+        if s.endswith(suffix):
+            return int(float(s[:-1]) * mult)
+    return int(s)
+
+
+def _agent_main(coordinator, cfg_dict, worker_id):
+    from s3shuffle_tpu.config import ShuffleConfig
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+    from s3shuffle_tpu.worker import WorkerAgent
+
+    Dispatcher.reset()
+    WorkerAgent(
+        tuple(coordinator), config=ShuffleConfig(**cfg_dict), worker_id=worker_id
+    ).run_forever(poll_interval=0.02)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--serve", default="127.0.0.1:0", help="coordinator bind HOST:PORT")
+    ap.add_argument("--size", default="20m", help="total dataset size (e.g. 100m, 1g)")
+    ap.add_argument("--maps", type=int, default=8)
+    ap.add_argument("--partitions", type=int, default=8)
+    ap.add_argument("--root", default=None, help="store root (default: temp dir)")
+    ap.add_argument("--codec", default="native")
+    ap.add_argument("--local-workers", type=int, default=0,
+                    help="spawn N local worker agents (one-host demo)")
+    args = ap.parse_args()
+
+    from s3shuffle_tpu.batch import RecordBatch
+    from s3shuffle_tpu.cluster import DistributedDriver
+    from s3shuffle_tpu.config import ShuffleConfig
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+
+    import tempfile
+
+    root = args.root or f"file://{tempfile.mkdtemp(prefix='s3shuffle-multihost-')}"
+    host, port = args.serve.rsplit(":", 1)
+    Dispatcher.reset()
+    cfg = ShuffleConfig(root_dir=root, app_id="multihost-terasort", codec=args.codec)
+
+    n_records = max(args.maps, parse_size(args.size) // (KEY_BYTES + VALUE_BYTES))
+    per_map = n_records // args.maps
+    rng = random.Random(42)
+    fillers = [rng.randbytes(VALUE_BYTES) for _ in range(64)]
+    t0 = time.perf_counter()
+    batches = [
+        RecordBatch.from_records(
+            [(rng.randbytes(KEY_BYTES), fillers[rng.randrange(64)]) for _ in range(per_map)]
+        )
+        for _ in range(args.maps)
+    ]
+    gen_s = time.perf_counter() - t0
+
+    driver = DistributedDriver(cfg, host=host, port=int(port))
+    print(f"coordinator at {driver.coordinator_address[0]}:{driver.coordinator_address[1]}",
+          file=sys.stderr)
+
+    workers = []
+    if args.local_workers:
+        ctx = mp.get_context("spawn")
+        workers = [
+            ctx.Process(
+                target=_agent_main,
+                args=(list(driver.coordinator_address), dataclasses.asdict(cfg), f"local-{i}"),
+                daemon=True,
+            )
+            for i in range(args.local_workers)
+        ]
+        for w in workers:
+            w.start()
+
+    try:
+        t0 = time.perf_counter()
+        out = driver.run_sort_shuffle(batches, num_partitions=args.partitions)
+        shuffle_s = time.perf_counter() - t0
+
+        total = sum(b.n for b in out)
+        prev = None
+        ordered = True
+        for b in out:
+            if b.n == 0:
+                continue
+            sk = b.key_strings(width=KEY_BYTES)
+            ordered &= bool((sk[:-1] <= sk[1:]).all())
+            if prev is not None:
+                ordered &= bool(prev <= sk[0])
+            prev = sk[-1]
+        raw_bytes = total * (KEY_BYTES + VALUE_BYTES + 8)
+        print(json.dumps({
+            "workload": "multihost-terasort",
+            "records": total,
+            "valid": bool(total == args.maps * per_map and ordered),
+            "maps": args.maps,
+            "partitions": args.partitions,
+            "workers": args.local_workers or "external",
+            "gen_s": round(gen_s, 2),
+            "shuffle_s": round(shuffle_s, 2),
+            "mb_per_s": round(raw_bytes / shuffle_s / 1e6, 1),
+        }))
+        return 0
+    finally:
+        driver.shutdown()
+        for w in workers:
+            w.join(timeout=10)
+            if w.is_alive():
+                w.terminate()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
