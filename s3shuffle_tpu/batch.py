@@ -36,7 +36,7 @@ class RecordBatch:
     ``klens``/``vlens`` (int32) and ``keys``/``values`` (uint8, concatenated).
     """
 
-    __slots__ = ("klens", "vlens", "keys", "values", "_koff", "_voff")
+    __slots__ = ("klens", "vlens", "keys", "values", "_koff", "_voff", "_kw", "_vw")
 
     def __init__(
         self,
@@ -51,6 +51,9 @@ class RecordBatch:
         self.values = values
         self._koff: Optional[np.ndarray] = None
         self._voff: Optional[np.ndarray] = None
+        # cached uniform row widths: None = not computed, -1 = ragged
+        self._kw: Optional[int] = None
+        self._vw: Optional[int] = None
 
     # ------------------------------------------------------------------
     @property
@@ -131,15 +134,39 @@ class RecordBatch:
         return list(self.iter_records())
 
     # ------------------------------------------------------------------
+    def _fixed_width(self, lens: np.ndarray, slot: str) -> int:
+        """Uniform row width of ``lens``, or -1 if ragged. Cached (O(n) once)."""
+        w = getattr(self, slot)
+        if w is None:
+            if len(lens) == 0:
+                w = -1
+            else:
+                w0 = int(lens[0])
+                w = w0 if (lens == w0).all() else -1
+            setattr(self, slot, w)
+        return w
+
     def take(self, indices: np.ndarray) -> "RecordBatch":
-        """Row gather (vectorized ragged gather on both buffers)."""
+        """Row gather. Uniform-width columns (the common shuffle shape —
+        fixed-size keys/values) skip the offsets cumsum and use a fixed-stride
+        gather; ragged columns use the vectorized ragged gather."""
         idx = np.asarray(indices, dtype=np.int64)
-        return RecordBatch(
-            self.klens[idx],
-            self.vlens[idx],
-            _ragged_gather(self.keys, self.koffsets, self.klens, idx),
-            _ragged_gather(self.values, self.voffsets, self.vlens, idx),
-        )
+        kw = self._fixed_width(self.klens, "_kw")
+        vw = self._fixed_width(self.vlens, "_vw")
+        if kw >= 0:
+            klens, keys = np.full(len(idx), kw, np.int32), _gather_fixed(self.keys, kw, idx)
+        else:
+            klens = self.klens[idx]
+            keys = _ragged_gather(self.keys, self.koffsets, self.klens, idx)
+        if vw >= 0:
+            vlens, values = np.full(len(idx), vw, np.int32), _gather_fixed(self.values, vw, idx)
+        else:
+            vlens = self.vlens[idx]
+            values = _ragged_gather(self.values, self.voffsets, self.vlens, idx)
+        out = RecordBatch(klens, vlens, keys, values)
+        out._kw = kw if kw >= 0 else None
+        out._vw = vw if vw >= 0 else None
+        return out
 
     def slice_rows(self, start: int, stop: int) -> "RecordBatch":
         """Contiguous row slice — zero-copy views."""
@@ -173,13 +200,67 @@ class RecordBatch:
                 mat[rows, cols] = self.keys
         return mat.view(f"S{w}").ravel()
 
+    def _key_prefix_u64(self) -> np.ndarray:
+        """First 8 key bytes as native uint64 whose numeric order equals
+        big-endian bytes order (zero-padded on the right)."""
+        n = self.n
+        kw = self._fixed_width(self.klens, "_kw")
+        if kw >= 0:
+            mat = np.ascontiguousarray(self.keys).reshape(n, kw) if kw else None
+            p8 = min(kw, 8)
+            if kw == 8:
+                pre = np.ascontiguousarray(mat)
+            else:
+                pre = np.zeros((n, 8), dtype=np.uint8)
+                if p8:
+                    pre[:, :p8] = mat[:, :p8]
+        else:
+            pre = np.zeros((n, 8), dtype=np.uint8)
+            ko, lens = self.koffsets, np.minimum(self.klens, 8).astype(np.int64)
+            off = np.zeros(n + 1, dtype=np.int64)
+            np.cumsum(lens, out=off[1:])
+            total = int(off[-1])
+            if total:
+                rows = _segment_ids(off, total)
+                cols = np.arange(total, dtype=np.int64) - off[rows]
+                pre[rows, cols] = self.keys[ko[rows] + cols]
+        return pre.view(">u8").ravel().astype(np.uint64)
+
     def argsort_by_key(self) -> np.ndarray:
         """Stable lexicographic argsort over keys (true bytes ordering: the
         zero-pad prefix tie is broken by key length — a shorter key sorts
-        before any key it zero-pad-prefixes)."""
-        if self.n == 0:
+        before any key it zero-pad-prefixes).
+
+        Implemented as a radix argsort over the 8-byte big-endian key prefix
+        (O(n), no string compares) plus a vectorized refinement pass over
+        equal-prefix groups — which is empty for high-entropy keys, so the
+        common terasort-style case never touches numpy's string machinery."""
+        n = self.n
+        if n == 0:
             return np.empty(0, dtype=np.int64)
-        return np.lexsort((self.klens, self.key_strings()))
+        klens = self.klens
+        prefix = self._key_prefix_u64()  # also caches self._kw
+        order = np.argsort(prefix, kind="stable")
+        kw = self._kw if self._kw is not None else -1
+        kmax = kw if kw >= 0 else int(klens.max())
+        if 0 <= kw <= 8:
+            return order  # prefix IS the key; stable radix order is final
+        ps = prefix[order]
+        neq = ps[1:] != ps[:-1]
+        if neq.all():
+            return order  # no equal prefixes → order already total
+        gid = np.zeros(n, dtype=np.int64)
+        np.cumsum(neq, out=gid[1:])
+        sizes = np.bincount(gid)
+        pos = np.flatnonzero(sizes[gid] > 1)  # members of multi-element groups
+        sub = order[pos]
+        if kmax <= 8:
+            # equal prefix + ragged lens: shorter (zero-pad-prefix) key first
+            refined = np.lexsort((klens[sub], gid[pos]))
+        else:
+            refined = np.lexsort((klens[sub], self.key_strings()[sub], gid[pos]))
+        order[pos] = sub[refined]
+        return order
 
 
 def _segment_ids(boundaries: np.ndarray, total: int) -> np.ndarray:
@@ -193,21 +274,36 @@ def _segment_ids(boundaries: np.ndarray, total: int) -> np.ndarray:
 
 
 _native_gather = None
+_native_gather_fixed = None
 
 
 def _load_native_gather():
-    global _native_gather
+    global _native_gather, _native_gather_fixed
     if _native_gather is None:
         try:
             from s3shuffle_tpu.codec.native import (
                 native_available,
+                native_gather_fixed,
                 native_ragged_gather,
             )
 
-            _native_gather = native_ragged_gather if native_available() else False
+            ok = native_available()
+            _native_gather = native_ragged_gather if ok else False
+            _native_gather_fixed = native_gather_fixed if ok else False
         except Exception:
             _native_gather = False
+            _native_gather_fixed = False
     return _native_gather
+
+
+def _gather_fixed(buf: np.ndarray, row_len: int, idx: np.ndarray) -> np.ndarray:
+    """Fixed-stride row gather: rows are ``row_len`` bytes each."""
+    if row_len == 0 or len(idx) == 0:
+        return _EMPTY_U8
+    _load_native_gather()
+    if _native_gather_fixed:
+        return _native_gather_fixed(buf, row_len, idx)
+    return np.ascontiguousarray(buf).reshape(-1, row_len)[idx].ravel()
 
 
 def _ragged_gather(

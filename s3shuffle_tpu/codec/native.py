@@ -60,7 +60,13 @@ def _load() -> ctypes.CDLL:
         lib.slz_decompress_batch.argtypes = [u8p, i64p, ctypes.c_int64, u8p, i64p, i64p]
         i32p = ctypes.POINTER(ctypes.c_int32)
         lib.slz_ragged_gather.restype = None
-        lib.slz_ragged_gather.argtypes = [u8p, i64p, i32p, i64p, ctypes.c_int64, u8p]
+        lib.slz_ragged_gather.argtypes = [
+            u8p, ctypes.c_size_t, i64p, i32p, i64p, ctypes.c_int64, u8p, ctypes.c_size_t,
+        ]
+        lib.slz_gather_fixed.restype = None
+        lib.slz_gather_fixed.argtypes = [
+            u8p, ctypes.c_size_t, ctypes.c_int64, i64p, ctypes.c_int64, u8p,
+        ]
         _lib = lib
         return lib
 
@@ -89,7 +95,7 @@ def native_ragged_gather(
     buf: np.ndarray, offsets: np.ndarray, lens: np.ndarray, idx: np.ndarray, total: int
 ) -> np.ndarray:
     """Gather ragged rows ``idx`` of (buf, offsets, lens) into one contiguous
-    uint8 array of ``total`` bytes (one memcpy per row, no index arrays)."""
+    uint8 array of ``total`` bytes (one copy per row, no index arrays)."""
     lib = _load()
     buf = np.ascontiguousarray(buf, dtype=np.uint8)
     offsets = np.ascontiguousarray(offsets, dtype=np.int64)
@@ -98,13 +104,36 @@ def native_ragged_gather(
     out = np.empty(total, dtype=np.uint8)
     lib.slz_ragged_gather(
         buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        buf.nbytes,
         offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         len(idx),
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out.nbytes,
     )
     return out
+
+
+def native_gather_fixed(buf: np.ndarray, row_len: int, idx: np.ndarray) -> np.ndarray:
+    """Gather fixed-width rows ``idx`` (row i = buf[i*row_len:(i+1)*row_len])
+    into one contiguous uint8 array. The output is over-allocated by 16 bytes
+    (the kernel's branchless short-row copy may write past the last row) and
+    returned as a trimmed view."""
+    lib = _load()
+    buf = np.ascontiguousarray(buf, dtype=np.uint8)
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    total = len(idx) * row_len
+    out = np.empty(total + 16, dtype=np.uint8)
+    lib.slz_gather_fixed(
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        buf.nbytes,
+        row_len,
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(idx),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    return out[:total]
 
 
 def native_adler32(data: bytes, value: int = 1) -> int:

@@ -284,14 +284,57 @@ void slz_decompress_batch(const uint8_t* src, const int64_t* src_offsets, int64_
 // idx[0..n) of a ragged byte buffer (row i at src+offsets[i], length
 // lens[i]), concatenated. One memcpy per row — numpy fancy indexing costs
 // 8 bytes of int64 index per gathered byte; this costs nothing.
-void slz_ragged_gather(const uint8_t* src, const int64_t* offsets, const int32_t* lens,
-                       const int64_t* idx, int64_t n, uint8_t* dst) {
+//
+// Rows of ≤16 bytes (short keys dominate shuffle workloads) are copied as two
+// unconditional 8-byte loads/stores when both buffers have ≥16 bytes of slack
+// — a predictable branch instead of a variable-length memcpy call per row.
+// src_size/dst_size bound the slack check; dst may be over-allocated.
+void slz_ragged_gather(const uint8_t* src, size_t src_size, const int64_t* offsets,
+                       const int32_t* lens, const int64_t* idx, int64_t n,
+                       uint8_t* dst, size_t dst_size) {
     uint8_t* op = dst;
+    const uint8_t* ssafe = src_size >= 16 ? src + src_size - 16 : src - 1;
+    const uint8_t* dsafe = dst_size >= 16 ? dst + dst_size - 16 : dst - 1;
     for (int64_t i = 0; i < n; i++) {
         int64_t row = idx[i];
         size_t len = (size_t)lens[row];
-        memcpy(op, src + offsets[row], len);
+        const uint8_t* p = src + offsets[row];
+        if (len <= 16 && p <= ssafe && op <= dsafe) {
+            uint64_t a = load64(p), b = load64(p + 8);
+            memcpy(op, &a, 8);
+            memcpy(op + 8, &b, 8);
+        } else {
+            memcpy(op, p, len);
+        }
         op += len;
+    }
+}
+
+// Fixed-width row gather: row i lives at src + idx[i]*row_len, all rows
+// row_len bytes. No offsets/lens arrays to read; ≤16-byte rows go through
+// the branchless two-load copy. dst MUST be allocated with ≥ n*row_len + 16
+// bytes (the Python wrapper over-allocates and returns a trimmed view).
+void slz_gather_fixed(const uint8_t* src, size_t src_size, int64_t row_len,
+                      const int64_t* idx, int64_t n, uint8_t* dst) {
+    uint8_t* op = dst;
+    if (row_len <= 16) {
+        const uint8_t* ssafe = src_size >= 16 ? src + src_size - 16 : src - 1;
+        for (int64_t i = 0; i < n; i++) {
+            const uint8_t* p = src + idx[i] * row_len;
+            if (p <= ssafe) {
+                uint64_t a = load64(p), b = load64(p + 8);
+                memcpy(op, &a, 8);
+                memcpy(op + 8, &b, 8);
+            } else {
+                memcpy(op, p, (size_t)row_len);
+            }
+            op += row_len;
+        }
+    } else {
+        for (int64_t i = 0; i < n; i++) {
+            memcpy(op, src + idx[i] * row_len, (size_t)row_len);
+            op += row_len;
+        }
     }
 }
 

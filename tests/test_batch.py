@@ -207,3 +207,58 @@ def test_iter_record_batches_byte_bound_all_input_shapes():
     big = [(b"k", bytes(10_000))] * 3
     chunks = list(iter_record_batches(big, chunk_records=64, chunk_bytes=5000))
     assert [c.n for c in chunks] == [1, 1, 1]
+
+
+def _fixed_records(n, klen, vlen, seed=7):
+    rng = random.Random(seed)
+    return [(rng.randbytes(klen), rng.randbytes(vlen)) for _ in range(n)]
+
+
+def test_take_fixed_width_fast_path():
+    # uniform klen/vlen triggers the fixed-stride gather (incl. the ≤16-byte
+    # branchless copy); rows at the very end of the buffer must not read OOB
+    # and must come back byte-exact
+    records = _fixed_records(333, klen=10, vlen=90)
+    batch = RecordBatch.from_records(records)
+    idx = np.array([332, 0, 331, 5, 332, 17], dtype=np.int64)
+    assert batch.take(idx).to_records() == [records[i] for i in idx]
+    # full permutation roundtrip
+    perm = np.random.default_rng(0).permutation(333)
+    assert batch.take(perm).to_records() == [records[i] for i in perm]
+
+
+def test_take_fixed_keys_ragged_values():
+    rng = random.Random(8)
+    records = [(rng.randbytes(8), rng.randbytes(rng.randrange(0, 40))) for _ in range(200)]
+    batch = RecordBatch.from_records(records)
+    idx = np.arange(199, -1, -1, dtype=np.int64)
+    assert batch.take(idx).to_records() == records[::-1]
+
+
+def test_argsort_uniform_long_keys_with_prefix_ties():
+    # keys longer than the 8-byte radix prefix, engineered so many share the
+    # first 8 bytes — exercises the vectorized tie-refinement pass
+    rng = random.Random(9)
+    shared = [rng.randbytes(8) for _ in range(4)]
+    records = [(shared[rng.randrange(4)] + rng.randbytes(4), b"v") for _ in range(1000)]
+    batch = RecordBatch.from_records(records)
+    order = batch.argsort_by_key()
+    got = [k for k, _ in batch.take(order).iter_records()]
+    assert got == sorted(k for k, _ in records)
+
+
+def test_argsort_stability_on_equal_keys():
+    # equal keys keep their original relative order (stable sort contract —
+    # required by spill-run merging and aggregation)
+    records = [(b"samekey1", str(i).encode()) for i in range(100)]
+    records += [(b"another", str(i).encode()) for i in range(100)]
+    batch = RecordBatch.from_records(records)
+    out = batch.take(batch.argsort_by_key()).to_records()
+    assert [v for k, v in out if k == b"samekey1"] == [str(i).encode() for i in range(100)]
+    assert [v for k, v in out if k == b"another"] == [str(i).encode() for i in range(100)]
+
+
+def test_argsort_all_identical_keys_uniform():
+    batch = RecordBatch.from_records([(b"k" * 12, str(i).encode()) for i in range(50)])
+    out = batch.take(batch.argsort_by_key()).to_records()
+    assert [v for _, v in out] == [str(i).encode() for i in range(50)]
