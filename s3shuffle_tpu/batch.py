@@ -466,6 +466,9 @@ def split_by_partition(
     """Stable-group rows by partition id. Returns (grouped_batch, bounds) where
     partition p's rows are ``grouped.slice_rows(bounds[p], bounds[p+1])``."""
     pids = np.asarray(pids)
+    if num_partitions <= 0xFFFF and pids.dtype != np.uint16:
+        # narrow dtype → 2 radix passes in the stable argsort instead of 8
+        pids = pids.astype(np.uint16)
     order = np.argsort(pids, kind="stable")
     grouped = batch.take(order)
     bounds = np.searchsorted(pids[order], np.arange(num_partitions + 1))

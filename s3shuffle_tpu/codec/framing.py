@@ -70,6 +70,17 @@ class FrameCodec:
     def decompress_blocks(self, blocks: List[Tuple[bytes, int]]) -> List[bytes]:
         return [self.decompress_block(b, n) for b, n in blocks]
 
+    def decompress_blocks_concat(self, blocks: List[Tuple[bytes, int]]) -> bytes:
+        """Decompress a run of blocks into ONE contiguous bytes object.
+        Batch codecs override this to skip per-block slicing entirely — the
+        read plane serves big chunks, so bytes cross the stream stack in
+        ~``BATCH_FRAMES * block_size`` pieces instead of per frame."""
+        out = self.decompress_blocks(blocks)
+        for (_, ulen), b in zip(blocks, out):
+            if len(b) != ulen:
+                raise IOError(f"Decompressed length {len(b)} != header {ulen}")
+        return b"".join(out)
+
     # --- framing ---
     def frame_from(self, raw: bytes, compressed: bytes) -> bytes:
         """Frame a pre-compressed block, applying the raw escape — the single
@@ -174,7 +185,7 @@ class CodecInputStream(io.RawIOBase):
     #: Frames read ahead and decoded per batch — one native/device call
     #: instead of one per frame. Bounds extra buffering to
     #: ``BATCH_FRAMES * block_size`` decoded bytes per stream.
-    BATCH_FRAMES = 16
+    BATCH_FRAMES = 32
 
     def __init__(self, codec: FrameCodec | None, source: BinaryIO):
         self._codec = codec
@@ -211,26 +222,33 @@ class CodecInputStream(io.RawIOBase):
 
     def _decode_run(self, frames) -> None:
         """Decode an in-order run of frames sharing one codec_id into
-        ``self._decoded``."""
+        ``self._decoded`` as ONE contiguous chunk (fewer, bigger pieces
+        crossing the stream stack ⇒ fewer per-chunk checksum/copy calls)."""
         codec_id = frames[0][0]
         if codec_id == 0:
-            self._decoded.extend(payload for _c, payload, _u in frames)
+            self._decoded.append(
+                frames[0][1] if len(frames) == 1 else b"".join(p for _c, p, _u in frames)
+            )
             return
         if (
             len(frames) > 1
             and self._codec is not None
             and codec_id == self._codec.codec_id
         ):
-            blocks = self._codec.decompress_blocks([(p, u) for _c, p, u in frames])
-        else:
-            blocks = [
-                decompress_frame_payload(codec_id, p, u, self._codec)
-                for _c, p, u in frames
-            ]
+            total = sum(u for _c, _p, u in frames)
+            out = self._codec.decompress_blocks_concat([(p, u) for _c, p, u in frames])
+            if len(out) != total:
+                raise IOError(f"Decompressed run length {len(out)} != headers {total}")
+            self._decoded.append(out)
+            return
+        blocks = [
+            decompress_frame_payload(codec_id, p, u, self._codec)
+            for _c, p, u in frames
+        ]
         for (_c, _p, ulen), out in zip(frames, blocks):
             if len(out) != ulen:
                 raise IOError(f"Decompressed length {len(out)} != header {ulen}")
-        self._decoded.extend(blocks)
+        self._decoded.append(blocks[0] if len(blocks) == 1 else b"".join(blocks))
 
     def _fill(self) -> bool:
         if not self._decoded:

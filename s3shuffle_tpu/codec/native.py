@@ -229,6 +229,20 @@ class NativeLZCodec(FrameCodec):
         n = len(blocks)
         if n <= 1:
             return [self.decompress_block(b, ulen) for b, ulen in blocks]
+        dst, dst_off = self._decompress_batch_impl(blocks)
+        return [dst[dst_off[i] : dst_off[i + 1]].tobytes() for i in range(n)]
+
+    def decompress_blocks_concat(self, blocks):
+        """Batch-decompress straight into one contiguous buffer and hand it
+        back whole — zero per-block slicing (the frame read-ahead serves
+        multi-frame chunks to the stream stack)."""
+        if len(blocks) == 1:
+            return self.decompress_block(*blocks[0])
+        dst, _ = self._decompress_batch_impl(blocks)
+        return dst.tobytes()
+
+    def _decompress_batch_impl(self, blocks):
+        n = len(blocks)
         src = np.frombuffer(b"".join(b for b, _ in blocks), dtype=np.uint8)
         src_off = np.zeros(n + 1, dtype=np.int64)
         np.cumsum(
@@ -254,7 +268,7 @@ class NativeLZCodec(FrameCodec):
                 f"SLZ batch decompression: block {bad} produced "
                 f"{int(out_sizes[bad])} bytes, expected {int(ulens[bad])}"
             )
-        return [dst[dst_off[i] : dst_off[i + 1]].tobytes() for i in range(n)]
+        return dst, dst_off
 
     # ------------------------------------------------------------------
     # numpy batch paths (used by the TPU host pipeline and benchmarks)

@@ -55,6 +55,7 @@ class RangePartitioner(Partitioner):
         self.bounds = list(bounds)
         self.num_partitions = len(self.bounds) + 1
         self._key = key_func or natural_key
+        self._bprefix = None  # cached uint64 prefixes of bytes bounds
 
     def __call__(self, key: Any) -> int:
         import bisect
@@ -74,15 +75,42 @@ class RangePartitioner(Partitioner):
             if not self.bounds:
                 return np.zeros(batch.n, dtype=np.int64)
             return super().partition_batch(batch)
-        width = max(int(batch.klens.max()) if batch.n else 0, max(len(b) for b in self.bounds), 1)
-        skeys = batch.key_strings(width=width)
-        sbounds = np.array(self.bounds, dtype=f"S{width}")
-        pos = np.searchsorted(sbounds, skeys, side="left").astype(np.int64)
-        # Zero-pad ties: numpy S-compare is memcmp over the padded width, so a
-        # key that zero-pad-equals bounds[pos] may truly be > bounds[pos]
-        # (key = bound + b"\x00"*k). Re-resolve those rows with true bytes
-        # bisect (matches __call__ exactly).
-        cand = np.nonzero((pos < len(sbounds)) & (sbounds[np.minimum(pos, len(sbounds) - 1)] == skeys))[0]
+        # Compare on 8-byte big-endian uint64 prefixes: prefix(a) < prefix(b)
+        # decides a < b except on prefix equality. searchsorted-left over bound
+        # prefixes is exact for every key whose prefix differs from the bound
+        # at its insertion point (bounds[pos-1] < key is strict by
+        # construction); only prefix-tied rows re-resolve with true-bytes
+        # bisect (matches __call__ exactly, incl. the zero-pad ambiguity).
+        kprefix = batch._key_prefix_u64()
+        if self._bprefix is None:
+            bpre = np.zeros((len(self.bounds), 8), dtype=np.uint8)
+            for i, b in enumerate(self.bounds):
+                head = b[:8]
+                bpre[i, : len(head)] = np.frombuffer(head, dtype=np.uint8)
+            self._bprefix = bpre.view(">u8").ravel().astype(np.uint64)
+        bprefix = self._bprefix
+        pos = np.searchsorted(bprefix, kprefix, side="left").astype(np.int64)
+        cand = np.nonzero((pos < len(bprefix)) & (bprefix[np.minimum(pos, len(bprefix) - 1)] == kprefix))[0]
+        if len(cand) > 64:
+            # prefix ties are common (long shared key prefixes) — resolve the
+            # tied rows with one vectorized full-width string searchsorted
+            # over just those rows (never materialize the full batch's padded
+            # key matrix)
+            from s3shuffle_tpu.batch import _EMPTY_U8, RecordBatch, _ragged_gather
+
+            width = max(int(batch.klens[cand].max()), max(len(b) for b in self.bounds), 1)
+            sub = RecordBatch(
+                batch.klens[cand],
+                np.zeros(len(cand), dtype=np.int32),
+                _ragged_gather(batch.keys, batch.koffsets, batch.klens, cand),
+                _EMPTY_U8,
+            )
+            skeys = sub.key_strings(width=width)
+            sbounds = np.array(self.bounds, dtype=f"S{width}")
+            pos[cand] = np.searchsorted(sbounds, skeys, side="left")
+            # numpy S-compare can't see trailing \x00s: keys that zero-pad-
+            # equal their bound may truly be greater — only those re-resolve
+            cand = cand[(pos[cand] < len(sbounds)) & (sbounds[np.minimum(pos[cand], len(sbounds) - 1)] == skeys)]
         if len(cand):
             kb = batch.keys.tobytes()
             ko = batch.koffsets
