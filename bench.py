@@ -62,7 +62,7 @@ def _make_ctx(codec: str, workers: int):
     return ShuffleContext(config=cfg, num_workers=workers), root
 
 
-def _timed_shuffle(ctx, parts):
+def _timed_shuffle(ctx, parts, cleanup=True):
     from s3shuffle_tpu.serializer import ColumnarKVSerializer
 
     t0 = time.perf_counter()
@@ -71,6 +71,7 @@ def _timed_shuffle(ctx, parts):
         num_partitions=N_REDUCERS,
         serializer=ColumnarKVSerializer(),
         materialize="batches",
+        cleanup=cleanup,
     )
     return time.perf_counter() - t0, out
 
@@ -118,13 +119,34 @@ def run_comparison(parts, workers: int = 0, repeats: int = 3):
             native_s = min(native_s, dt)
             dt, _out = _timed_shuffle(ctx_z, parts)
             zlib_s = min(zlib_s, dt)
+        # compression ratio: one extra uncleaned shuffle per codec, then walk
+        # the root for stored (compressed + index/checksum) bytes
+        _timed_shuffle(ctx_n, parts, cleanup=False)
+        _timed_shuffle(ctx_z, parts, cleanup=False)
+        stored_n = _tree_bytes(root_n)
+        stored_z = _tree_bytes(root_z)
         ctx_n.stop()
         ctx_z.stop()
     finally:
         shutil.rmtree(root_n, ignore_errors=True)
         shutil.rmtree(root_z, ignore_errors=True)
     raw_bytes = N_MAPS * RECORDS_PER_MAP * (KEY_BYTES + VALUE_BYTES + 8)
-    return raw_bytes / native_s, native_s, raw_bytes / zlib_s, zlib_s
+    ratios = {
+        "native_compression_ratio": round(raw_bytes / stored_n, 3) if stored_n else 0.0,
+        "zlib_compression_ratio": round(raw_bytes / stored_z, 3) if stored_z else 0.0,
+    }
+    return raw_bytes / native_s, native_s, raw_bytes / zlib_s, zlib_s, ratios
+
+
+def _tree_bytes(root):
+    total = 0
+    for dirpath, _dirs, files in os.walk(root):
+        for f in files:
+            try:
+                total += os.path.getsize(os.path.join(dirpath, f))
+            except OSError:
+                pass
+    return total
 
 
 def device_kernel_rates():
@@ -179,8 +201,8 @@ def device_kernel_rates():
 
 def main():
     parts = gen_partitions()
-    native_bps, native_s, zlib_bps, zlib_s = run_comparison(parts)
-    extras = device_kernel_rates()
+    native_bps, native_s, zlib_bps, zlib_s, ratios = run_comparison(parts)
+    extras = {**ratios, **device_kernel_rates()}
     result = {
         "metric": "shuffle bytes/sec/chip (write+read), terasort-style, native codec",
         "value": round(native_bps / 1e6, 2),

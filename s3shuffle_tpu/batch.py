@@ -240,25 +240,34 @@ class RecordBatch:
             return np.empty(0, dtype=np.int64)
         klens = self.klens
         prefix = self._key_prefix_u64()  # also caches self._kw
-        order = np.argsort(prefix, kind="stable")
-        kw = self._kw if self._kw is not None else -1
-        kmax = kw if kw >= 0 else int(klens.max())
-        if 0 <= kw <= 8:
-            return order  # prefix IS the key; stable radix order is final
+        # UNSTABLE introsort: ~5x faster than numpy's stable radix on uint64.
+        # Stability is restored below — within every equal-prefix group the
+        # refinement key ends with the original row index.
+        order = np.argsort(prefix)
         ps = prefix[order]
         neq = ps[1:] != ps[:-1]
         if neq.all():
-            return order  # no equal prefixes → order already total
+            return order  # all prefixes distinct → total order, no ties at all
+        kw = self._kw if self._kw is not None else -1
+        kmax = kw if kw >= 0 else int(klens.max())
         gid = np.zeros(n, dtype=np.int64)
         np.cumsum(neq, out=gid[1:])
         sizes = np.bincount(gid)
         pos = np.flatnonzero(sizes[gid] > 1)  # members of multi-element groups
         sub = order[pos]
-        if kmax <= 8:
-            # equal prefix + ragged lens: shorter (zero-pad-prefix) key first
-            refined = np.lexsort((klens[sub], gid[pos]))
+        if 0 <= kw <= 8 and n < (1 << 32):
+            # uniform short keys: equal prefix == equal key → restore original
+            # index order. (group, index) pairs are unique, so one unstable
+            # u64 argsort of the packed pair is deterministic and exact.
+            refined = np.argsort(
+                (gid[pos].astype(np.uint64) << 32) | sub.astype(np.uint64)
+            )
+        elif kmax <= 8:
+            # equal prefix + ragged lens: shorter (zero-pad-prefix) key first,
+            # then original index for stability
+            refined = np.lexsort((sub, klens[sub], gid[pos]))
         else:
-            refined = np.lexsort((klens[sub], self.key_strings()[sub], gid[pos]))
+            refined = np.lexsort((sub, klens[sub], self.key_strings()[sub], gid[pos]))
         order[pos] = sub[refined]
         return order
 

@@ -24,8 +24,6 @@ import struct
 from collections import deque
 from typing import BinaryIO, Callable, List, Tuple
 
-from s3shuffle_tpu.utils.io import read_fully as _read_fully
-
 HEADER = struct.Struct("<BII")
 HEADER_SIZE = HEADER.size  # 9 bytes
 
@@ -124,6 +122,9 @@ class CodecOutputStream(io.RawIOBase):
         self._close_sink = close_sink
         self._pending: List[bytes] = []  # full blocks awaiting a batch flush
         self._batch_blocks = max(1, getattr(codec, "batch_blocks", 1))
+        # native fast path: compress + frame straight from the accumulation
+        # buffer in one call (no per-block slicing/joining/header packing)
+        self._framed = getattr(codec, "compress_framed", None)
 
     def writable(self) -> bool:
         return True
@@ -132,12 +133,22 @@ class CodecOutputStream(io.RawIOBase):
         data = bytes(b)
         self._buf.extend(data)
         bs = self._codec.block_size
+        if self._framed is not None:
+            if len(self._buf) >= bs * self._batch_blocks:
+                self._emit_framed(len(self._buf) // bs)
+            return len(data)
         while len(self._buf) >= bs:
             self._pending.append(bytes(self._buf[:bs]))
             del self._buf[:bs]
             if len(self._pending) >= self._batch_blocks:
                 self._emit_pending()
         return len(data)
+
+    def _emit_framed(self, n_blocks: int) -> None:
+        bs = self._codec.block_size
+        out = self._framed(memoryview(self._buf)[: n_blocks * bs], n_blocks, bs)
+        self._sink.write(out)
+        del self._buf[: n_blocks * bs]
 
     def _emit_pending(self) -> None:
         if not self._pending:
@@ -159,6 +170,15 @@ class CodecOutputStream(io.RawIOBase):
     def flush_block(self) -> None:
         """Force everything buffered out (used at partition boundaries so
         partitions never share a frame)."""
+        if self._framed is not None:
+            bs = self._codec.block_size
+            full = len(self._buf) // bs
+            if full:
+                self._emit_framed(full)
+            if self._buf:
+                self._sink.write(self._codec.frame_block(bytes(self._buf)))
+                self._buf.clear()
+            return
         if self._buf:
             self._pending.append(bytes(self._buf))
             self._buf.clear()
@@ -186,6 +206,11 @@ class CodecInputStream(io.RawIOBase):
     #: instead of one per frame. Bounds extra buffering to
     #: ``BATCH_FRAMES * block_size`` decoded bytes per stream.
     BATCH_FRAMES = 32
+    #: Source refill granularity: compressed bytes are pulled through the
+    #: stream stack below (prefetch → checksum) in pieces this big instead of
+    #: one read per frame header + payload — the checksum layer then hashes
+    #: ~20x fewer, bigger chunks.
+    SRC_CHUNK = 1 << 20
 
     def __init__(self, codec: FrameCodec | None, source: BinaryIO):
         self._codec = codec
@@ -194,6 +219,8 @@ class CodecInputStream(io.RawIOBase):
         self._pos = 0
         self._eof = False
         self._decoded: deque = deque()
+        self._rbuf = b""
+        self._rpos = 0
         # Read-ahead only pays off for codecs with a batch decompress path.
         self._batch_frames = (
             self.BATCH_FRAMES
@@ -205,15 +232,41 @@ class CodecInputStream(io.RawIOBase):
     def readable(self) -> bool:
         return True
 
+    def _read_exact(self, n: int) -> bytes:
+        """n bytes from the buffered source (may return fewer only at EOF).
+        Refills in ``SRC_CHUNK`` pieces so the layers below see big reads."""
+        avail = len(self._rbuf) - self._rpos
+        if avail >= n:
+            out = self._rbuf[self._rpos : self._rpos + n]
+            self._rpos += n
+            return out
+        parts = [self._rbuf[self._rpos :]] if avail else []
+        need = n - avail
+        self._rbuf = b""
+        self._rpos = 0
+        while need > 0:
+            chunk = self._source.read(max(need, self.SRC_CHUNK))
+            if not chunk:
+                break
+            if len(chunk) > need:
+                parts.append(chunk[:need])
+                self._rbuf = chunk
+                self._rpos = need
+                need = 0
+            else:
+                parts.append(chunk)
+                need -= len(chunk)
+        return b"".join(parts) if len(parts) != 1 else parts[0]
+
     def _read_frame(self):
         """Returns (codec_id, payload, ulen) or None at EOF."""
-        header = _read_fully(self._source, HEADER_SIZE)
+        header = self._read_exact(HEADER_SIZE)
         if not header:
             return None
         if len(header) < HEADER_SIZE:
             raise IOError(f"Truncated frame header ({len(header)} bytes)")
         codec_id, ulen, clen = HEADER.unpack(header)
-        payload = _read_fully(self._source, clen)
+        payload = self._read_exact(clen)
         if len(payload) < clen:
             raise IOError(f"Truncated frame payload ({len(payload)}/{clen} bytes)")
         if codec_id == 0 and ulen != clen:

@@ -67,6 +67,10 @@ def _load() -> ctypes.CDLL:
         lib.slz_gather_fixed.argtypes = [
             u8p, ctypes.c_size_t, ctypes.c_int64, i64p, ctypes.c_int64, u8p,
         ]
+        lib.slz_compress_framed.restype = ctypes.c_int64
+        lib.slz_compress_framed.argtypes = [
+            u8p, ctypes.c_int64, ctypes.c_int64, ctypes.c_uint8, u8p,
+        ]
         _lib = lib
         return lib
 
@@ -184,6 +188,31 @@ class NativeLZCodec(FrameCodec):
                 f"SLZ decompression produced {n} bytes, expected {uncompressed_len}"
             )
         return ctypes.string_at(dst, uncompressed_len)
+
+    def compress_framed(self, buf, n_blocks: int, block_size: int) -> bytes:
+        """Compress ``n_blocks`` equal-size blocks from one contiguous buffer
+        and return them FRAMED (header + payload back-to-back, raw escape
+        applied) — the write hot path: no per-block slicing, joining, or
+        header packing in Python."""
+        from s3shuffle_tpu.utils import trace
+
+        if trace.enabled():
+            with trace.span("codec.compress_batch", blocks=n_blocks):
+                return self._compress_framed_impl(buf, n_blocks, block_size)
+        return self._compress_framed_impl(buf, n_blocks, block_size)
+
+    def _compress_framed_impl(self, buf, n_blocks: int, block_size: int) -> bytes:
+        src = np.frombuffer(buf, dtype=np.uint8, count=n_blocks * block_size)
+        src = np.ascontiguousarray(src)
+        dst = np.empty(n_blocks * (block_size + 9), dtype=np.uint8)
+        total = self._lib.slz_compress_framed(
+            src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            n_blocks,
+            block_size,
+            self.codec_id,
+            dst.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+        return dst[:total].tobytes()
 
     def compress_blocks(self, blocks):
         """One native call for the whole batch (framing's batch flush path)."""

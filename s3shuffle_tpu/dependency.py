@@ -112,10 +112,9 @@ class RangePartitioner(Partitioner):
             # equal their bound may truly be greater — only those re-resolve
             cand = cand[(pos[cand] < len(sbounds)) & (sbounds[np.minimum(pos[cand], len(sbounds) - 1)] == skeys)]
         if len(cand):
-            kb = batch.keys.tobytes()
-            ko = batch.koffsets
+            keys, ko = batch.keys, batch.koffsets
             for i in cand.tolist():
-                key = kb[ko[i] : ko[i + 1]]
+                key = keys[ko[i] : ko[i + 1]].tobytes()
                 pos[i] = bisect.bisect_left(self.bounds, key)
         return pos
 

@@ -289,6 +289,11 @@ void slz_decompress_batch(const uint8_t* src, const int64_t* src_offsets, int64_
 // unconditional 8-byte loads/stores when both buffers have ≥16 bytes of slack
 // — a predictable branch instead of a variable-length memcpy call per row.
 // src_size/dst_size bound the slack check; dst may be over-allocated.
+// Gathers are memory-LATENCY bound (each row touches 1-2 cold cache lines in
+// a large buffer); prefetching the source rows a few iterations ahead
+// overlaps those misses.
+static const int64_t GATHER_PF = 8;
+
 void slz_ragged_gather(const uint8_t* src, size_t src_size, const int64_t* offsets,
                        const int32_t* lens, const int64_t* idx, int64_t n,
                        uint8_t* dst, size_t dst_size) {
@@ -296,6 +301,7 @@ void slz_ragged_gather(const uint8_t* src, size_t src_size, const int64_t* offse
     const uint8_t* ssafe = src_size >= 16 ? src + src_size - 16 : src - 1;
     const uint8_t* dsafe = dst_size >= 16 ? dst + dst_size - 16 : dst - 1;
     for (int64_t i = 0; i < n; i++) {
+        if (i + GATHER_PF < n) __builtin_prefetch(src + offsets[idx[i + GATHER_PF]]);
         int64_t row = idx[i];
         size_t len = (size_t)lens[row];
         const uint8_t* p = src + offsets[row];
@@ -320,6 +326,7 @@ void slz_gather_fixed(const uint8_t* src, size_t src_size, int64_t row_len,
     if (row_len <= 16) {
         const uint8_t* ssafe = src_size >= 16 ? src + src_size - 16 : src - 1;
         for (int64_t i = 0; i < n; i++) {
+            if (i + GATHER_PF < n) __builtin_prefetch(src + idx[i + GATHER_PF] * row_len);
             const uint8_t* p = src + idx[i] * row_len;
             if (p <= ssafe) {
                 uint64_t a = load64(p), b = load64(p + 8);
@@ -331,11 +338,53 @@ void slz_gather_fixed(const uint8_t* src, size_t src_size, int64_t row_len,
             op += row_len;
         }
     } else {
+        // rows span ≥2 cache lines: prefetch both ends of the upcoming row
         for (int64_t i = 0; i < n; i++) {
+            if (i + GATHER_PF < n) {
+                const uint8_t* f = src + idx[i + GATHER_PF] * row_len;
+                __builtin_prefetch(f);
+                __builtin_prefetch(f + row_len - 1);
+            }
             memcpy(op, src + idx[i] * row_len, (size_t)row_len);
             op += row_len;
         }
     }
+}
+
+// ---------------------------------------------------------------------------
+// Framed batch compression: compress `count` equal-size blocks from ONE
+// contiguous buffer and emit the shared 9-byte frame header
+// [u8 codec_id][u32le ulen][u32le clen] + payload back-to-back into dst
+// (raw escape: codec_id 0 when compression doesn't shrink). One native call
+// replaces per-block slicing, joining, header packing, and sink writes in
+// the Python write path. dst capacity must be >= count * (block_size + 9).
+// Returns total framed bytes.
+// ---------------------------------------------------------------------------
+
+int64_t slz_compress_framed(const uint8_t* src, int64_t count, int64_t block_size,
+                            uint8_t codec_id, uint8_t* dst) {
+    uint8_t* op = dst;
+    for (int64_t i = 0; i < count; i++) {
+        const uint8_t* block = src + i * block_size;
+        uint8_t* hdr = op;
+        op += 9;
+        // cap block_size - 1: "didn't shrink" → raw escape
+        size_t clen = slz_compress(block, (size_t)block_size, op, (size_t)block_size - 1);
+        uint8_t cid = codec_id;
+        if (clen == 0) {
+            memcpy(op, block, (size_t)block_size);
+            clen = (size_t)block_size;
+            cid = 0;
+        }
+        uint32_t ulen32 = (uint32_t)block_size, clen32 = (uint32_t)clen;
+        hdr[0] = cid;
+        for (int k = 0; k < 4; k++) {  // explicit little-endian
+            hdr[1 + k] = (uint8_t)(ulen32 >> (8 * k));
+            hdr[5 + k] = (uint8_t)(clen32 >> (8 * k));
+        }
+        op += clen;
+    }
+    return (int64_t)(op - dst);
 }
 
 }  // extern "C"

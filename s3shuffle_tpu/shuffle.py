@@ -161,6 +161,7 @@ class ShuffleContext:
         key_func: Optional[Callable[[Any], Any]] = None,
         serializer: Optional[Serializer] = None,
         materialize: str = "records",
+        cleanup: bool = True,
     ) -> List[Any]:
         """Range-partitioned, key-ordered shuffle — the terasort shape
         (S3ShuffleManagerTest.scala:146-174). Output partition i holds keys
@@ -196,6 +197,7 @@ class ShuffleContext:
             key_ordering=key,
             serializer=serializer,
             materialize=materialize,
+            cleanup=cleanup,
         )
 
     # ------------------------------------------------------------------
