@@ -1,0 +1,245 @@
+"""Codec bridge service — JVM/Spark offload gateway.
+
+Parity+north-star: SURVEY.md §7.2(7) plans an optional gateway so the
+*actual* JVM shuffle plugin can call this framework's codec path (the
+reference compresses/checksums on the JVM via Spark codec streams +
+java.util.zip — S3ShuffleReader.scala:99-110, S3ShuffleHelper.scala:94-103).
+§7.3 warns that per-block RPC round-trips would drown the codec win, so the
+protocol here is **batch-granular**: one request carries a whole batch of
+blocks in one contiguous payload, and the response comes back the same way —
+one socket round-trip per `batch_blocks` blocks, the same batching the
+in-process write path uses.
+
+Wire protocol (all integers little-endian):
+
+    request  = [u8 op][u32 n][u32 lens[n]][payload bytes (concatenated)]
+    response = [u8 status][u32 n][u32 lens[n]][payload bytes]
+
+ops:
+    1  COMPRESS_FRAMED — blocks in, framed SLZ stream out (one framed blob;
+       response n == 1). The blob is a valid codec/framing.py stream, so the
+       JVM side can upload it as the shuffle object payload unchanged.
+    2  DECOMPRESS      — framed stream in (n == 1), raw blocks out.
+    3  CRC32C_BATCH    — blocks in, one u32 checksum per block out.
+    4  ADLER32_BATCH   — blocks in, one u32 checksum per block out.
+
+status: 0 ok, 1 error (payload = utf-8 message).
+
+A JVM client needs ~40 lines of java.nio; no Python on the hot path beyond
+this service, which delegates to the native C++ batch kernels.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import socketserver
+import struct
+import threading
+from typing import List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+OP_COMPRESS_FRAMED = 1
+OP_DECOMPRESS = 2
+OP_CRC32C_BATCH = 3
+OP_ADLER32_BATCH = 4
+
+_U32 = struct.Struct("<I")
+_HDR = struct.Struct("<BI")
+
+#: Refuse absurd batch shapes before allocating (defense against a confused
+#: or malicious client writing garbage lengths).
+MAX_BLOCKS = 1 << 20
+MAX_TOTAL_BYTES = 1 << 31
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    parts = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 1 << 20))
+        if not chunk:
+            raise ConnectionError(f"peer closed mid-message ({got}/{n} bytes)")
+        parts.append(chunk)
+        got += len(chunk)
+    return b"".join(parts)
+
+
+def _read_message(sock: socket.socket) -> Optional[Tuple[int, List[bytes]]]:
+    """Returns (op, blocks) or None on clean EOF before a message starts."""
+    try:
+        hdr = _recv_exact(sock, _HDR.size)
+    except ConnectionError:
+        return None
+    op, n = _HDR.unpack(hdr)
+    if n > MAX_BLOCKS:
+        raise ValueError(f"block count {n} exceeds limit {MAX_BLOCKS}")
+    lens_raw = _recv_exact(sock, 4 * n)
+    lens = [_U32.unpack_from(lens_raw, 4 * i)[0] for i in range(n)]
+    total = sum(lens)
+    if total > MAX_TOTAL_BYTES:
+        raise ValueError(f"payload {total} exceeds limit {MAX_TOTAL_BYTES}")
+    payload = _recv_exact(sock, total)
+    blocks, off = [], 0
+    for ln in lens:
+        blocks.append(payload[off : off + ln])
+        off += ln
+    return op, blocks
+
+
+def _write_message(sock: socket.socket, status: int, blocks: List[bytes]) -> None:
+    lens = b"".join(_U32.pack(len(b)) for b in blocks)
+    sock.sendall(_HDR.pack(status, len(blocks)) + lens + b"".join(blocks))
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:  # one connection, many requests
+        codec = self.server.codec  # type: ignore[attr-defined]
+        while True:
+            try:
+                msg = _read_message(self.request)
+            except (ConnectionError, OSError):
+                return
+            if msg is None:
+                return
+            op, blocks = msg
+            try:
+                out = self._dispatch(codec, op, blocks)
+                _write_message(self.request, 0, out)
+            except BrokenPipeError:
+                return
+            except Exception as e:  # report to client, keep serving
+                logger.warning("bridge op %d failed: %s", op, e)
+                try:
+                    _write_message(self.request, 1, [str(e).encode()])
+                except OSError:
+                    return
+
+    @staticmethod
+    def _dispatch(codec, op: int, blocks: List[bytes]) -> List[bytes]:
+        import numpy as np
+
+        if op == OP_COMPRESS_FRAMED:
+            # one native batch call for the whole request, framing in Python
+            out = bytearray()
+            for raw, comp in zip(blocks, codec.compress_blocks(blocks)):
+                out += codec.frame_from(raw, comp)
+            return [bytes(out)]
+        if op == OP_DECOMPRESS:
+            if len(blocks) != 1:
+                raise ValueError("DECOMPRESS takes one framed stream")
+            return [codec.decompress_bytes(blocks[0])]
+        if op in (OP_CRC32C_BATCH, OP_ADLER32_BATCH):
+            concat = np.frombuffer(b"".join(blocks), dtype=np.uint8)
+            offsets = np.zeros(len(blocks) + 1, dtype=np.int64)
+            np.cumsum(
+                np.fromiter(map(len, blocks), dtype=np.int64, count=len(blocks)),
+                out=offsets[1:],
+            )
+            if op == OP_CRC32C_BATCH and hasattr(codec, "crc32c_batch"):
+                sums = codec.crc32c_batch(concat, offsets).astype("<u4")
+            else:
+                from s3shuffle_tpu.codec.native import native_adler32, native_crc32c
+
+                fn = native_crc32c if op == OP_CRC32C_BATCH else native_adler32
+                init = 0 if op == OP_CRC32C_BATCH else 1
+                sums = np.array([fn(b, init) for b in blocks], dtype="<u4")
+            return [sums.tobytes()]
+        raise ValueError(f"unknown op {op}")
+
+
+class CodecBridgeServer:
+    """Threaded TCP service exposing the native codec path to external (JVM)
+    clients. ``port=0`` picks a free port (see ``.port``)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, codec_name: str = "native"):
+        from s3shuffle_tpu.codec import get_codec
+
+        codec = get_codec(codec_name)
+        if codec is None:
+            raise ValueError(f"codec {codec_name!r} unavailable")
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, port), _Handler)
+        self._server.codec = codec  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> "CodecBridgeServer":
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        logger.info("codec bridge serving on port %d", self.port)
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+class CodecBridgeClient:
+    """Reference client (and the shape of the JVM-side implementation)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._sock = socket.create_connection((host, port))
+
+    def _call(self, op: int, blocks: List[bytes]) -> List[bytes]:
+        _write_message(self._sock, op, blocks)
+        msg = _read_message(self._sock)
+        if msg is None:
+            raise ConnectionError("bridge closed the connection")
+        status, out = msg
+        if status != 0:
+            raise RuntimeError(f"bridge error: {out[0].decode(errors='replace')}")
+        return out
+
+    def compress_framed(self, blocks: List[bytes]) -> bytes:
+        return self._call(OP_COMPRESS_FRAMED, blocks)[0]
+
+    def decompress(self, framed: bytes) -> bytes:
+        return self._call(OP_DECOMPRESS, [framed])[0]
+
+    def crc32c(self, blocks: List[bytes]) -> List[int]:
+        import numpy as np
+
+        raw = self._call(OP_CRC32C_BATCH, blocks)[0]
+        return np.frombuffer(raw, dtype="<u4").tolist()
+
+    def adler32(self, blocks: List[bytes]) -> List[int]:
+        import numpy as np
+
+        raw = self._call(OP_ADLER32_BATCH, blocks)[0]
+        return np.frombuffer(raw, dtype="<u4").tolist()
+
+    def close(self) -> None:
+        self._sock.close()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="s3shuffle_tpu codec bridge service")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=7717)
+    ap.add_argument("--codec", default="native")
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    server = CodecBridgeServer(args.host, args.port, args.codec).start()
+    print(f"codec bridge on {args.host}:{server.port} (codec={args.codec})")
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

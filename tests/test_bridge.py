@@ -1,0 +1,103 @@
+"""Codec bridge service tests (s3shuffle_tpu.bridge).
+
+The bridge is the SURVEY.md §7.2(7) JVM offload gateway: batch-granular codec
+RPC. These tests run a real server on a loopback socket and check roundtrips,
+cross-validation against the in-process codec, checksum agreement, error
+propagation, and concurrent clients.
+"""
+
+import random
+import threading
+import zlib
+
+import pytest
+
+from s3shuffle_tpu.bridge import CodecBridgeClient, CodecBridgeServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = CodecBridgeServer(port=0).start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def client(server):
+    c = CodecBridgeClient(port=server.port)
+    yield c
+    c.close()
+
+
+def _blocks(seed=0, n=5, size=30_000):
+    rng = random.Random(seed)
+    filler = rng.randbytes(512)
+    return [
+        (filler * (size // 512))[: rng.randrange(size // 2, size)] + rng.randbytes(64)
+        for _ in range(n)
+    ]
+
+
+def test_compress_decompress_roundtrip(client):
+    blocks = _blocks()
+    framed = client.compress_framed(blocks)
+    assert len(framed) < sum(len(b) for b in blocks)  # actually compressed
+    assert client.decompress(framed) == b"".join(blocks)
+
+
+def test_framed_output_readable_by_in_process_codec(client):
+    """The bridge's framed stream is a plain codec/framing.py stream — the
+    in-process read plane can decode it (what the JVM upload path relies on)."""
+    from s3shuffle_tpu.codec import get_codec
+
+    blocks = _blocks(seed=1)
+    framed = client.compress_framed(blocks)
+    codec = get_codec("native")
+    assert codec.decompress_bytes(framed) == b"".join(blocks)
+
+
+def test_checksums_match_reference_implementations(client):
+    blocks = _blocks(seed=2, n=4, size=10_000)
+    adler = client.adler32(blocks)
+    assert adler == [zlib.adler32(b) for b in blocks]
+    crcs = client.crc32c(blocks)
+    try:
+        from s3shuffle_tpu.codec.native import native_crc32c
+
+        assert crcs == [native_crc32c(b) for b in blocks]
+    except Exception:
+        pytest.skip("native lib unavailable")
+
+
+def test_error_propagates_and_connection_survives(client):
+    with pytest.raises(RuntimeError, match="bridge error"):
+        client.decompress(b"\xff" * 32)  # malformed framed stream
+    # connection still usable after server-side error
+    blocks = _blocks(seed=3, n=2)
+    assert client.decompress(client.compress_framed(blocks)) == b"".join(blocks)
+
+
+def test_concurrent_clients(server):
+    errors = []
+
+    def worker(seed):
+        try:
+            c = CodecBridgeClient(port=server.port)
+            blocks = _blocks(seed=seed, n=3, size=20_000)
+            for _ in range(5):
+                assert c.decompress(c.compress_framed(blocks)) == b"".join(blocks)
+            c.close()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(s,)) for s in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+
+
+def test_empty_batch_and_empty_block(client):
+    assert client.decompress(client.compress_framed([b""])) == b""
+    assert client.crc32c([b""]) == [0]
