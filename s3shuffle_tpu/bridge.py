@@ -139,12 +139,17 @@ class _Handler(socketserver.BaseRequestHandler):
             )
             if op == OP_CRC32C_BATCH and hasattr(codec, "crc32c_batch"):
                 sums = codec.crc32c_batch(concat, offsets).astype("<u4")
-            else:
-                from s3shuffle_tpu.codec.native import native_adler32, native_crc32c
+            elif op == OP_CRC32C_BATCH:
+                # pure-Python/zlib bridge (codec without native lib): reuse the
+                # framework's native-else-pure checksum dispatch
+                from s3shuffle_tpu.utils.checksums import _crc32c_fn
 
-                fn = native_crc32c if op == OP_CRC32C_BATCH else native_adler32
-                init = 0 if op == OP_CRC32C_BATCH else 1
-                sums = np.array([fn(b, init) for b in blocks], dtype="<u4")
+                fn = _crc32c_fn()
+                sums = np.array([fn(b, 0) for b in blocks], dtype="<u4")
+            else:
+                import zlib as _zlib
+
+                sums = np.array([_zlib.adler32(b) for b in blocks], dtype="<u4")
             return [sums.tobytes()]
         raise ValueError(f"unknown op {op}")
 
@@ -156,7 +161,10 @@ class CodecBridgeServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0, codec_name: str = "native"):
         from s3shuffle_tpu.codec import get_codec
 
-        codec = get_codec(codec_name)
+        try:
+            codec = get_codec(codec_name)
+        except Exception as e:
+            raise ValueError(f"codec {codec_name!r} unavailable: {e}") from e
         if codec is None:
             raise ValueError(f"codec {codec_name!r} unavailable")
 

@@ -15,9 +15,17 @@ import pytest
 from s3shuffle_tpu.bridge import CodecBridgeClient, CodecBridgeServer
 
 
+def _bridge_codec() -> str:
+    """Native when available, else the zlib bridge (the pure-python CI job
+    must still exercise the service)."""
+    from s3shuffle_tpu.codec.native import native_available
+
+    return "native" if native_available() else "zlib"
+
+
 @pytest.fixture(scope="module")
 def server():
-    srv = CodecBridgeServer(port=0).start()
+    srv = CodecBridgeServer(port=0, codec_name=_bridge_codec()).start()
     yield srv
     srv.stop()
 
@@ -52,7 +60,7 @@ def test_framed_output_readable_by_in_process_codec(client):
 
     blocks = _blocks(seed=1)
     framed = client.compress_framed(blocks)
-    codec = get_codec("native")
+    codec = get_codec(_bridge_codec())
     assert codec.decompress_bytes(framed) == b"".join(blocks)
 
 
