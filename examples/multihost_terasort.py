@@ -57,8 +57,9 @@ def main() -> int:
     ap.add_argument("--partitions", type=int, default=8)
     ap.add_argument("--root", default=None, help="store root (default: temp dir)")
     ap.add_argument("--codec", default="native")
-    ap.add_argument("--local-workers", type=int, default=0,
-                    help="spawn N local worker agents (one-host demo)")
+    ap.add_argument("--local-workers", type=int, default=2,
+                    help="spawn N local worker agents (one-host demo); pass 0 "
+                         "to wait for external workers (multi-host mode)")
     args = ap.parse_args()
 
     from s3shuffle_tpu.batch import RecordBatch
@@ -91,6 +92,10 @@ def main() -> int:
           file=sys.stderr)
 
     workers = []
+    if not args.local_workers:
+        print("waiting for external workers (start them with: "
+              f"python -m s3shuffle_tpu.worker --coordinator HOST:{driver.coordinator_address[1]})",
+              file=sys.stderr)
     if args.local_workers:
         ctx = mp.get_context("spawn")
         workers = [

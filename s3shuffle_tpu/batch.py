@@ -200,20 +200,22 @@ class RecordBatch:
                 mat[rows, cols] = self.keys
         return mat.view(f"S{w}").ravel()
 
-    def _key_prefix_u64(self) -> np.ndarray:
-        """First 8 key bytes as native uint64 whose numeric order equals
-        big-endian bytes order (zero-padded on the right)."""
+    def _key_prefix_u64(self, offset: int = 0) -> np.ndarray:
+        """8 key bytes starting at ``offset`` as native uint64 whose numeric
+        order equals big-endian bytes order (zero-padded on the right).
+        Nonzero offsets are only meaningful for uniform-width keys (batch-
+        local ordering with constant leading columns skipped)."""
         n = self.n
         kw = self._fixed_width(self.klens, "_kw")
         if kw >= 0:
             mat = np.ascontiguousarray(self.keys).reshape(n, kw) if kw else None
-            p8 = min(kw, 8)
-            if kw == 8:
+            p8 = min(kw - offset, 8)
+            if kw == 8 and offset == 0:
                 pre = np.ascontiguousarray(mat)
             else:
                 pre = np.zeros((n, 8), dtype=np.uint8)
-                if p8:
-                    pre[:, :p8] = mat[:, :p8]
+                if p8 > 0:
+                    pre[:, :p8] = mat[:, offset : offset + p8]
         else:
             pre = np.zeros((n, 8), dtype=np.uint8)
             ko, lens = self.koffsets, np.minimum(self.klens, 8).astype(np.int64)
@@ -239,7 +241,26 @@ class RecordBatch:
         if n == 0:
             return np.empty(0, dtype=np.int64)
         klens = self.klens
-        prefix = self._key_prefix_u64()  # also caches self._kw
+        kw = self._fixed_width(klens, "_kw")
+        skip = 0
+        prefix_covers_key = 0 <= kw <= 8
+        if kw > 8:
+            # start the prefix at the first column that actually differs:
+            # constant leading bytes (zero-padded decimals, shared date/URL
+            # heads) don't affect batch-local ordering. Column-by-column with
+            # early exit — high-entropy keys stop at column 0, and only the
+            # first kw-8 columns can matter (skip is capped there; all-equal
+            # keys then refine to identity through the packed index sort).
+            mat = np.ascontiguousarray(self.keys).reshape(n, kw)
+            limit = kw - 8
+            skip = limit
+            for c in range(limit):
+                col = mat[:, c]
+                if (col != col[0]).any():
+                    skip = c
+                    break
+            prefix_covers_key = skip >= limit
+        prefix = self._key_prefix_u64(skip)
         # UNSTABLE introsort: ~5x faster than numpy's stable radix on uint64.
         # Stability is restored below — within every equal-prefix group the
         # refinement key ends with the original row index.
@@ -248,17 +269,17 @@ class RecordBatch:
         neq = ps[1:] != ps[:-1]
         if neq.all():
             return order  # all prefixes distinct → total order, no ties at all
-        kw = self._kw if self._kw is not None else -1
         kmax = kw if kw >= 0 else int(klens.max())
         gid = np.zeros(n, dtype=np.int64)
         np.cumsum(neq, out=gid[1:])
         sizes = np.bincount(gid)
         pos = np.flatnonzero(sizes[gid] > 1)  # members of multi-element groups
         sub = order[pos]
-        if 0 <= kw <= 8 and n < (1 << 32):
-            # uniform short keys: equal prefix == equal key → restore original
-            # index order. (group, index) pairs are unique, so one unstable
-            # u64 argsort of the packed pair is deterministic and exact.
+        if prefix_covers_key and n < (1 << 32):
+            # the prefix spans every non-constant key byte, so equal prefix ==
+            # equal key → restore original index order. (group, index) pairs
+            # are unique, so one unstable u64 argsort of the packed pair is
+            # deterministic and exact.
             refined = np.argsort(
                 (gid[pos].astype(np.uint64) << 32) | sub.astype(np.uint64)
             )
