@@ -17,7 +17,6 @@ kernels take, so batches flow host→TPU with no re-packing.
 
 from __future__ import annotations
 
-import heapq
 import os
 import struct
 import tempfile
@@ -36,7 +35,9 @@ class RecordBatch:
     ``klens``/``vlens`` (int32) and ``keys``/``values`` (uint8, concatenated).
     """
 
-    __slots__ = ("klens", "vlens", "keys", "values", "_koff", "_voff", "_kw", "_vw")
+    __slots__ = (
+        "klens", "vlens", "keys", "values", "_koff", "_voff", "_kw", "_vw", "_ks",
+    )
 
     def __init__(
         self,
@@ -54,6 +55,8 @@ class RecordBatch:
         # cached uniform row widths: None = not computed, -1 = ragged
         self._kw: Optional[int] = None
         self._vw: Optional[int] = None
+        # cached (width, padded key strings) — spill-merge cuts reuse it
+        self._ks: Optional[Tuple[int, np.ndarray]] = None
 
     # ------------------------------------------------------------------
     @property
@@ -189,6 +192,8 @@ class RecordBatch:
         w = max(width or 0, kmax, 1)
         if n == 0:
             return np.empty(0, dtype=f"S{w}")
+        if self._ks is not None and self._ks[0] == w:
+            return self._ks[1]
         if kmax and (self.klens == kmax).all() and w == kmax:
             mat = np.ascontiguousarray(self.keys).reshape(n, kmax)
         else:
@@ -198,7 +203,9 @@ class RecordBatch:
                 rows = _segment_ids(self.koffsets, total)
                 cols = np.arange(total, dtype=np.int64) - self.koffsets[rows]
                 mat[rows, cols] = self.keys
-        return mat.view(f"S{w}").ravel()
+        out = mat.view(f"S{w}").ravel()
+        self._ks = (w, out)
+        return out
 
     def _key_prefix_u64(self, offset: int = 0) -> np.ndarray:
         """8 key bytes starting at ``offset`` as native uint64 whose numeric
@@ -549,30 +556,22 @@ class BatchSorter:
         self._spills.append(path)
         self.spill_count += 1
 
-    def _iter_run(self, path: str) -> Iterator[Tuple[bytes, bytes]]:
+    def _iter_run_batches(self, path: str) -> Iterator[RecordBatch]:
         with open(path, "rb") as f:
-            for frame in read_frames(f):
-                yield from frame.iter_records()
+            yield from read_frames(f)
 
     def sorted_records(self) -> Iterator[Tuple[bytes, bytes]]:
-        try:
-            final = self._sorted_pending()
-            if not self._spills:
-                yield from final.iter_records()
-                return
-            runs: List[Iterator[Tuple[bytes, bytes]]] = [
-                self._iter_run(p) for p in self._spills
-            ]
-            runs.append(final.iter_records())
-            yield from heapq.merge(*runs, key=lambda kv: kv[0])
-        finally:
-            self.cleanup()
+        for batch in self.sorted_batches():
+            yield from batch.iter_records()
 
     def sorted_batches(
         self, chunk_records: int = DEFAULT_CHUNK_RECORDS
     ) -> Iterator[RecordBatch]:
-        """Sorted output as columnar batches — the no-spill case never leaves
-        columnar form (no per-record Python)."""
+        """Sorted output as columnar batches. The spill case runs the
+        bounded-memory columnar k-way merge in :meth:`_merge_spills` (bulk
+        frontier rounds + run-order streaming of skewed keys); equal keys come
+        back in run (= insertion) order exactly like the record-wise heap
+        merge this replaces."""
         if not self._spills:
             try:
                 final = self._sorted_pending()
@@ -581,8 +580,97 @@ class BatchSorter:
                 raise
             yield from iter_record_batches(final, chunk_records=chunk_records)
             return
-        # spill case: merge is record-wise; regroup into batches
-        yield from iter_record_batches(self.sorted_records(), chunk_records=chunk_records)
+        try:
+            yield from self._merge_spills(chunk_records)
+        finally:
+            self.cleanup()
+
+    @staticmethod
+    def _cut(p: RecordBatch, bound: bytes, inclusive: bool) -> int:
+        """Rows at the head of sorted batch ``p`` with key < ``bound``
+        (``inclusive=False``) or ≤ ``bound`` (``inclusive=True``), exact bytes
+        order. Uses the batch's natural-width padded key strings (cached on
+        the batch, so untouched merge chunks don't re-pad every round); the
+        S-compare pad-tie is resolved with klens — pad-tied rows sort
+        short-first within a sorted run. A bound longer than the batch width
+        compares greater than every pad-tied row (each such row is a proper
+        zero-pad prefix of the bound)."""
+        width = max(int(p.klens.max()) if p.n else 0, 1)
+        ks = p.key_strings(width=width)
+        bs = np.array([bound[:width]], dtype=f"S{width}")[0]
+        lo = int(np.searchsorted(ks, bs, side="left"))
+        hi = int(np.searchsorted(ks, bs, side="right"))
+        if len(bound) > width:
+            return hi  # every pad-tied row is a proper prefix of bound → < bound
+        side = "right" if inclusive else "left"
+        return lo + int(np.searchsorted(p.klens[lo:hi], len(bound), side=side))
+
+    def _merge_spills(self, chunk_records: int) -> Iterator[RecordBatch]:
+        """Bounded-memory columnar k-way merge. Bulk rounds emit every loaded
+        row strictly below the frontier (the smallest LAST-loaded key of any
+        undrained run — later chunks of those runs hold only keys ≥ it) as one
+        concat + stable sort. When duplicates of the frontier key dominate (a
+        skewed partition — zero bulk progress), that single key is streamed
+        run-by-run in index order, loading one chunk at a time, so equal keys
+        keep run (= insertion) order and residency stays O(runs × chunk)."""
+        final = self._sorted_pending()
+        iters: List[Optional[Iterator[RecordBatch]]] = [
+            self._iter_run_batches(p) for p in self._spills
+        ]
+        iters.append(iter(iter_record_batches(final)))
+        pending: List[RecordBatch] = [RecordBatch.empty() for _ in iters]
+
+        def refill(r: int) -> None:
+            if pending[r].n == 0 and iters[r] is not None:
+                nxt = next(iters[r], None)
+                if nxt is None:
+                    iters[r] = None
+                else:
+                    pending[r] = nxt
+
+        while True:
+            for r in range(len(iters)):
+                refill(r)
+            live = [r for r in range(len(iters)) if iters[r] is not None]
+            if not live:
+                rest = RecordBatch.concat([p for p in pending if p.n])
+                if rest.n:
+                    out = rest.take(rest.argsort_by_key())
+                    yield from iter_record_batches(out, chunk_records=chunk_records)
+                return
+            frontier = min(
+                pending[r].keys[pending[r].koffsets[-2] :].tobytes() for r in live
+            )
+            cuts = [self._cut(p, frontier, inclusive=False) if p.n else 0 for p in pending]
+            if sum(cuts):
+                emit = RecordBatch.concat(
+                    [p.slice_rows(0, c) for p, c in zip(pending, cuts) if c]
+                )
+                for r, c in enumerate(cuts):
+                    if c:
+                        pending[r] = pending[r].slice_rows(c, pending[r].n)
+                out = emit.take(emit.argsort_by_key())
+                yield from iter_record_batches(out, chunk_records=chunk_records)
+                continue
+            # zero bulk progress: every loaded row is ≥ frontier, and each
+            # run's head class is == frontier. Stream the frontier key in run
+            # order, one chunk resident at a time.
+            for r in range(len(iters)):
+                while True:
+                    refill(r)
+                    p = pending[r]
+                    if p.n == 0:
+                        break  # run drained
+                    m = self._cut(p, frontier, inclusive=True)
+                    if m == 0:
+                        break  # this run is past the frontier key
+                    yield from iter_record_batches(
+                        p.slice_rows(0, m), chunk_records=chunk_records
+                    )
+                    pending[r] = p.slice_rows(m, p.n)
+                    if pending[r].n:
+                        break  # rows beyond the frontier remain loaded
+            continue
 
     def cleanup(self) -> None:
         for path in self._spills:

@@ -262,3 +262,50 @@ def test_argsort_all_identical_keys_uniform():
     batch = RecordBatch.from_records([(b"k" * 12, str(i).encode()) for i in range(50)])
     out = batch.take(batch.argsort_by_key()).to_records()
     assert [v for _, v in out] == [str(i).encode() for i in range(50)]
+
+
+def test_batch_sorter_spill_merge_columnar_correctness():
+    # force many spills; result must equal a global sort, including heavy
+    # duplicates that span spill runs and zero-pad tie keys
+    rng = random.Random(17)
+    keys = (
+        [rng.randbytes(8) for _ in range(2000)]
+        + [b"dup-key" for _ in range(500)]
+        + [b"dup-key\x00" for _ in range(300)]
+        + [b"z" * 3 for _ in range(200)]
+    )
+    rng.shuffle(keys)
+    recs = [(k, str(i).encode()) for i, k in enumerate(keys)]
+    sorter = BatchSorter(spill_bytes=8_000)  # tiny budget → many spills
+    for i in range(0, len(recs), 250):
+        sorter.add(RecordBatch.from_records(recs[i : i + 250]))
+    assert sorter.spill_count >= 2
+    out = [kv for b in sorter.sorted_batches() for kv in b.iter_records()]
+    assert [k for k, _ in out] == sorted(keys)
+    # multiset equality (no lost/duplicated records)
+    assert sorted(out) == sorted(recs)
+
+
+def test_batch_sorter_spill_merge_run_order_for_equal_keys():
+    # equal keys come back in insertion (= spill run) order, matching the
+    # record-wise heap merge this replaced
+    recs = [(b"same", str(i).encode()) for i in range(600)]
+    sorter = BatchSorter(spill_bytes=4_000)
+    for i in range(0, 600, 100):
+        sorter.add(RecordBatch.from_records(recs[i : i + 100]))
+    out = [kv for b in sorter.sorted_batches() for kv in b.iter_records()]
+    assert out == recs
+
+
+def test_batch_sorter_spill_merge_matches_no_spill():
+    rng = random.Random(18)
+    recs = [(rng.randbytes(rng.randrange(1, 12)), rng.randbytes(5)) for _ in range(3000)]
+    spilling = BatchSorter(spill_bytes=10_000)
+    memory = BatchSorter(spill_bytes=1 << 30)
+    for i in range(0, 3000, 500):
+        b = RecordBatch.from_records(recs[i : i + 500])
+        spilling.add(b)
+        memory.add(RecordBatch.from_records(recs[i : i + 500]))
+    got = [kv for b in spilling.sorted_batches() for kv in b.iter_records()]
+    want = [kv for b in memory.sorted_batches() for kv in b.iter_records()]
+    assert got == want
