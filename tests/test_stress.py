@@ -1,0 +1,148 @@
+"""Concurrency stress tests.
+
+The reference has NO race detection or stress tests (SURVEY.md §5.2 — thread
+safety is by construction only). These go further: many threads hammering the
+shared pieces (dispatcher + FileStatus cache, metadata caches,
+ConcurrentObjectMap, concurrent independent shuffles in one process) while
+asserting exact results, so cache races, double-init, or cross-shuffle
+leakage show up as failures rather than heisenbugs.
+"""
+
+import random
+import threading
+
+import pytest
+
+from s3shuffle_tpu.batch import RecordBatch
+from s3shuffle_tpu.config import ShuffleConfig
+from s3shuffle_tpu.shuffle import ShuffleContext
+from s3shuffle_tpu.storage.dispatcher import Dispatcher
+from s3shuffle_tpu.utils.concurrent_map import ConcurrentObjectMap
+
+
+def test_concurrent_object_map_compute_once_under_contention():
+    m = ConcurrentObjectMap()
+    computed = []
+    barrier = threading.Barrier(8)
+
+    def compute(key):
+        def factory(k):
+            computed.append(k)
+            return f"value-{k}"
+        barrier.wait()
+        for _ in range(200):
+            assert m.get_or_else_put(key, factory) == f"value-{key}"
+
+    threads = [threading.Thread(target=compute, args=("k",)) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert computed == ["k"]  # factory ran exactly once across 1600 gets
+
+
+def test_concurrent_independent_shuffles_one_process(tmp_path):
+    """8 threads × independent shuffles through ONE context (shared manager,
+    dispatcher, caches) — every shuffle must return exactly its own data."""
+    Dispatcher.reset()
+    cfg = ShuffleConfig(root_dir=f"file://{tmp_path}", app_id="stress", codec="native")
+    ctx = ShuffleContext(config=cfg, num_workers=4)
+    errors = []
+
+    def one_shuffle(seed):
+        try:
+            rng = random.Random(seed)
+            recs = [
+                (seed.to_bytes(2, "big") + rng.randbytes(8), rng.randbytes(30))
+                for _ in range(4_000)
+            ]
+            out = ctx.sort_by_key(
+                [RecordBatch.from_records(recs[i::2]) for i in range(2)],
+                num_partitions=3,
+                materialize="batches",
+            )
+            got = [k for p in out for b in p for k, _ in b.iter_records()]
+            assert got == sorted(k for k, _ in recs), f"seed {seed}: wrong result"
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=one_shuffle, args=(s,)) for s in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    ctx.stop()
+    assert not errors, errors
+
+
+def test_dispatcher_file_status_cache_concurrent_readers(tmp_path):
+    """Many threads opening + ranged-reading the same blocks through the
+    cached-status path must all see identical bytes."""
+    Dispatcher.reset()
+    cfg = ShuffleConfig(root_dir=f"file://{tmp_path}", app_id="stress2", codec="zlib")
+    disp = Dispatcher.get(cfg)
+    from s3shuffle_tpu.block_ids import ShuffleDataBlockId
+
+    blocks = {}
+    for m in range(6):
+        bid = ShuffleDataBlockId(7, m, 0)
+        payload = bytes([m]) * 10_000
+        with disp.create_block(bid) as f:
+            f.write(payload)
+        blocks[bid] = payload
+
+    errors = []
+
+    def reader(seed):
+        rng = random.Random(seed)
+        try:
+            for _ in range(60):
+                bid, payload = rng.choice(list(blocks.items()))
+                stream = disp.open_block(bid)
+                off = rng.randrange(0, 9_000)
+                ln = rng.randrange(1, 1_000)
+                got = stream.read_fully(off, ln)
+                assert got == payload[off : off + ln]
+                stream.close()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=reader, args=(s,)) for s in range(10)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+
+
+def test_concurrent_register_unregister_cycles(tmp_path):
+    """Shuffle churn: register → write → read → unregister across threads;
+    cache purges of one shuffle must never corrupt another's reads."""
+    Dispatcher.reset()
+    cfg = ShuffleConfig(root_dir=f"file://{tmp_path}", app_id="stress3", codec="native")
+    ctx = ShuffleContext(config=cfg, num_workers=2)
+    errors = []
+
+    def churn(seed):
+        rng = random.Random(seed)
+        try:
+            for round_i in range(3):
+                recs = [
+                    (rng.randbytes(6), str((seed, round_i)).encode())
+                    for _ in range(1_500)
+                ]
+                out = ctx.sort_by_key(
+                    [RecordBatch.from_records(recs)], num_partitions=2
+                )
+                got = sorted(kv for p in out for kv in p)
+                assert got == sorted(recs), f"seed {seed} round {round_i}"
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=churn, args=(s,)) for s in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    ctx.stop()
+    assert not errors, errors
