@@ -93,7 +93,7 @@ def _validate(out):
         prev_last = sk[-1]
 
 
-def run_comparison(parts, workers: int = 0, repeats: int = 3):
+def run_comparison(parts, workers: int = 0, repeats: int = 5):
     """Time the native-codec shuffle against the zlib baseline shuffle.
 
     The two codecs' timed runs are INTERLEAVED (warmup pass first, then
