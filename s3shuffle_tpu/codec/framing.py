@@ -27,6 +27,12 @@ from typing import BinaryIO, Callable, List, Tuple
 HEADER = struct.Struct("<BII")
 HEADER_SIZE = HEADER.size  # 9 bytes
 
+#: Upper bound on a frame's claimed uncompressed length. Real frames never
+#: exceed the writer's block_size (64 KiB default, a few MiB at most); the cap
+#: stops a corrupt/hostile header from driving a multi-GiB allocation BEFORE
+#: the decoded-length validation can reject it.
+MAX_FRAME_ULEN = 1 << 28  # 256 MiB
+
 CODEC_IDS = {
     "raw": 0,
     "zlib": 1,
@@ -266,6 +272,11 @@ class CodecInputStream(io.RawIOBase):
         if len(header) < HEADER_SIZE:
             raise IOError(f"Truncated frame header ({len(header)} bytes)")
         codec_id, ulen, clen = HEADER.unpack(header)
+        if ulen > MAX_FRAME_ULEN or clen > MAX_FRAME_ULEN:
+            raise IOError(
+                f"Frame header claims {max(ulen, clen)} bytes "
+                f"(> {MAX_FRAME_ULEN} cap) — corrupt stream"
+            )
         payload = self._read_exact(clen)
         if len(payload) < clen:
             raise IOError(f"Truncated frame payload ({len(payload)}/{clen} bytes)")
