@@ -56,7 +56,8 @@ def main() -> int:
     ap.add_argument("--maps", type=int, default=8)
     ap.add_argument("--partitions", type=int, default=8)
     ap.add_argument("--root", default=None, help="store root (default: temp dir)")
-    ap.add_argument("--codec", default="native")
+    ap.add_argument("--codec", default=None,
+                    help="codec override (default: S3SHUFFLE_CODEC env or 'native')")
     ap.add_argument("--local-workers", type=int, default=2,
                     help="spawn N local worker agents (one-host demo); pass 0 "
                          "to wait for external workers (multi-host mode)")
@@ -69,10 +70,20 @@ def main() -> int:
 
     import tempfile
 
-    root = args.root or f"file://{tempfile.mkdtemp(prefix='s3shuffle-multihost-')}"
+    # Config from S3SHUFFLE_* env first (how the k8s pods configure root and
+    # codec — deploy/coordinator.yml), CLI flags override, temp dir as the
+    # local-demo fallback. The coordinator and external workers MUST agree on
+    # root_dir: all data moves through the store.
+    overrides = {"app_id": "multihost-terasort"}
+    if args.root:
+        overrides["root_dir"] = args.root
+    elif not os.environ.get("S3SHUFFLE_ROOT_DIR"):
+        overrides["root_dir"] = f"file://{tempfile.mkdtemp(prefix='s3shuffle-multihost-')}"
+    if args.codec:
+        overrides["codec"] = args.codec
     host, port = args.serve.rsplit(":", 1)
     Dispatcher.reset()
-    cfg = ShuffleConfig(root_dir=root, app_id="multihost-terasort", codec=args.codec)
+    cfg = ShuffleConfig.from_env(**overrides)
 
     n_records = max(args.maps, parse_size(args.size) // (KEY_BYTES + VALUE_BYTES))
     per_map = n_records // args.maps

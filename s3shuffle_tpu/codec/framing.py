@@ -58,6 +58,12 @@ class FrameCodec:
     def __init__(self, block_size: int = 64 * 1024):
         if block_size <= 0:
             raise ValueError("block_size must be positive")
+        if block_size > MAX_FRAME_ULEN:
+            # keep write and read agreeing: the decoder rejects frames
+            # claiming more than MAX_FRAME_ULEN, so refuse to write them
+            raise ValueError(
+                f"block_size {block_size} exceeds MAX_FRAME_ULEN {MAX_FRAME_ULEN}"
+            )
         self.block_size = block_size
 
     # --- block granular (override) ---
