@@ -30,14 +30,6 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 KEY_BYTES, VALUE_BYTES = 10, 90
 
 
-def parse_size(s: str) -> int:
-    s = s.strip().lower()
-    for suffix, mult in (("g", 1 << 30), ("m", 1 << 20), ("k", 1 << 10)):
-        if s.endswith(suffix):
-            return int(float(s[:-1]) * mult)
-    return int(s)
-
-
 def _agent_main(coordinator, cfg_dict, worker_id):
     from s3shuffle_tpu.config import ShuffleConfig
     from s3shuffle_tpu.storage.dispatcher import Dispatcher
@@ -81,9 +73,13 @@ def main() -> int:
         overrides["root_dir"] = f"file://{tempfile.mkdtemp(prefix='s3shuffle-multihost-')}"
     if args.codec:
         overrides["codec"] = args.codec
+    elif not os.environ.get("S3SHUFFLE_CODEC"):
+        overrides["codec"] = "native"  # the documented default
     host, port = args.serve.rsplit(":", 1)
     Dispatcher.reset()
     cfg = ShuffleConfig.from_env(**overrides)
+
+    from s3shuffle_tpu.utils import parse_size
 
     n_records = max(args.maps, parse_size(args.size) // (KEY_BYTES + VALUE_BYTES))
     per_map = n_records // args.maps

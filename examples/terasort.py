@@ -28,12 +28,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 KEY_BYTES, VALUE_BYTES = 10, 90  # the terasort record shape
 
-SIZES = {
-    "100m": 100 * 1024 * 1024,
-    "1g": 1024**3,
-    "10g": 10 * 1024**3,
-    "100g": 100 * 1024**3,
-}
+
 
 
 def generate(total_bytes: int, n_maps: int, seed: int = 42):
@@ -73,7 +68,7 @@ def teravalidate(out_batches, expected_records: int) -> None:
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--size", default="100m", help=f"one of {list(SIZES)} or bytes")
+    ap.add_argument("--size", default="100m", help="bytes, with optional k/m/g suffix")
     ap.add_argument("--maps", type=int, default=8)
     ap.add_argument("--reducers", type=int, default=8)
     ap.add_argument("--workers", type=int, default=4)
@@ -90,7 +85,9 @@ def main() -> int:
     from s3shuffle_tpu.shuffle import ShuffleContext
     from s3shuffle_tpu.storage.dispatcher import Dispatcher
 
-    total_bytes = SIZES.get(args.size, None) or int(args.size)
+    from s3shuffle_tpu.utils import parse_size
+
+    total_bytes = parse_size(args.size)
     tmp = None
     root = args.root
     if root is None:

@@ -138,9 +138,7 @@ def _coerce(value: Any, typ: Any) -> Any:
     if "bool" in typ:
         return value.strip().lower() in ("1", "true", "yes", "on")
     if "int" in typ:
-        v = value.strip().lower()
-        for suffix, mult in (("k", 1024), ("m", MiB), ("g", 1024 * MiB)):
-            if v.endswith(suffix):
-                return int(float(v[:-1]) * mult)
-        return int(v)
+        from s3shuffle_tpu.utils import parse_size
+
+        return parse_size(value)
     return value

@@ -1,0 +1,14 @@
+"""Shared utilities."""
+
+from __future__ import annotations
+
+
+def parse_size(s: str) -> int:
+    """Parse a byte size with an optional k/m/g suffix ("100m", "1g", "4096").
+    The single home of the size-suffix grammar (examples and env coercion
+    share it)."""
+    s = str(s).strip().lower()
+    for suffix, mult in (("g", 1 << 30), ("m", 1 << 20), ("k", 1 << 10)):
+        if s.endswith(suffix):
+            return int(float(s[:-1]) * mult)
+    return int(s)
