@@ -49,7 +49,8 @@ def main() -> int:
     ap.add_argument("--partitions", type=int, default=8)
     ap.add_argument("--root", default=None, help="store root (default: temp dir)")
     ap.add_argument("--codec", default=None,
-                    help="codec override (default: S3SHUFFLE_CODEC env or 'native')")
+                    help="codec override (default: S3SHUFFLE_CODEC env, else "
+                         "'auto' = native if built, zlib otherwise)")
     ap.add_argument("--local-workers", type=int, default=2,
                     help="spawn N local worker agents (one-host demo); pass 0 "
                          "to wait for external workers (multi-host mode)")
@@ -73,8 +74,6 @@ def main() -> int:
         overrides["root_dir"] = f"file://{tempfile.mkdtemp(prefix='s3shuffle-multihost-')}"
     if args.codec:
         overrides["codec"] = args.codec
-    elif not os.environ.get("S3SHUFFLE_CODEC"):
-        overrides["codec"] = "native"  # the documented default
     host, port = args.serve.rsplit(":", 1)
     Dispatcher.reset()
     cfg = ShuffleConfig.from_env(**overrides)

@@ -20,9 +20,16 @@ from s3shuffle_tpu.codec.framing import (
 )
 
 
-def get_codec(name: str, block_size: int = 64 * 1024, level: int = 1) -> "FrameCodec | None":
+def get_codec(
+    name: str,
+    block_size: int = 64 * 1024,
+    level: int = 1,
+    tpu_batch_blocks: int = 256,
+) -> "FrameCodec | None":
     """Resolve a codec by config name. ``none`` → None (raw bytes, no framing,
-    still concatenatable). ``auto`` → native if built, else zlib."""
+    still concatenatable). ``auto`` → native if built, else zlib.
+    ``tpu_batch_blocks`` sizes the device round-trip batch for the tpu codec
+    (the ``tpu_batch_blocks`` config flag)."""
     name = (name or "none").lower()
     if name in ("none", "raw", "off"):
         return None
@@ -48,7 +55,7 @@ def get_codec(name: str, block_size: int = 64 * 1024, level: int = 1) -> "FrameC
     if name == "tpu":
         from s3shuffle_tpu.codec.tpu import TpuCodec
 
-        return TpuCodec(block_size=block_size)
+        return TpuCodec(block_size=block_size, batch_blocks=tpu_batch_blocks)
     raise ValueError(f"Unknown codec: {name}")
 
 

@@ -91,7 +91,9 @@ class ShuffleManager:
         self._registered: Dict[int, ShuffleHandle] = {}
         self._lock = threading.Lock()
         cfg = self.dispatcher.config
-        self._codec = get_codec(cfg.codec, cfg.codec_block_size, cfg.codec_level)
+        self._codec = get_codec(
+            cfg.codec, cfg.codec_block_size, cfg.codec_level, cfg.tpu_batch_blocks
+        )
 
     @property
     def config(self) -> ShuffleConfig:

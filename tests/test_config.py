@@ -103,3 +103,16 @@ def test_trace_disabled_is_noop():
     trace.count("y")
     assert trace.events_snapshot() == []
     assert trace.counters() == {}
+
+
+def test_tpu_batch_blocks_flag_reaches_codec():
+    # the flag must actually size the device round-trip batch (was parsed
+    # but unplumbed)
+    jax = pytest.importorskip("jax")  # noqa: F841
+    from s3shuffle_tpu.config import ShuffleConfig
+    from s3shuffle_tpu.manager import ShuffleManager
+
+    m = ShuffleManager(
+        ShuffleConfig(root_dir="memory://tpu-flag", codec="tpu", tpu_batch_blocks=16)
+    )
+    assert m._codec.batch_blocks == 16
