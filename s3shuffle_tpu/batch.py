@@ -388,18 +388,20 @@ def write_frame(sink: BinaryIO, batch: RecordBatch) -> None:
 
 
 def read_frames(source: BinaryIO) -> Iterator[RecordBatch]:
-    from s3shuffle_tpu.utils.io import read_fully
+    from s3shuffle_tpu.utils.io import read_fully_view
 
     while True:
-        # read_fully: a codec/prefetch stream may return short reads at frame
-        # boundaries — only 0 bytes means EOF.
-        header = read_fully(source, _U32.size)
-        if not header:
+        # read_fully_view: a codec/prefetch stream may return short reads at
+        # frame boundaries — only 0 bytes means EOF. Payloads come back as
+        # whatever buffer the stream holds (bytes, or a zero-copy ndarray view
+        # of a batch-decoded run) and flow into np.frombuffer uncopied.
+        header = read_fully_view(source, _U32.size)
+        if not len(header):
             return
         if len(header) < _U32.size:
             raise IOError("Truncated columnar frame header")
-        (payload_len,) = _U32.unpack(header)
-        payload = read_fully(source, payload_len)
+        (payload_len,) = _U32.unpack(header)  # accepts any buffer-protocol piece
+        payload = read_fully_view(source, payload_len)
         if len(payload) < payload_len:
             raise IOError(f"Truncated columnar frame ({len(payload)}/{payload_len})")
         yield parse_frame_payload(payload)

@@ -30,18 +30,37 @@ class ZlibCodec(FrameCodec):
 
 
 class ZstdCodec(FrameCodec):
+    """zstd behind the shared framing. ``zstandard``'s compressor/decompressor
+    objects are NOT safe for concurrent calls (the manager shares one codec
+    across task threads — concurrent ``compress()`` on one ZstdCompressor
+    segfaults in the C backend), so each thread gets its own pair."""
+
     name = "zstd"
     codec_id = CODEC_IDS["zstd"]
 
     def __init__(self, block_size: int = 64 * 1024, level: int = 1):
         super().__init__(block_size)
-        import zstandard
+        import zstandard  # noqa: F401 — fail fast if unavailable
 
-        self._c = zstandard.ZstdCompressor(level=level)
-        self._d = zstandard.ZstdDecompressor()
+        self.level = level
+        import threading
+
+        self._local = threading.local()
+
+    def _pair(self):
+        pair = getattr(self._local, "pair", None)
+        if pair is None:
+            import zstandard
+
+            pair = (
+                zstandard.ZstdCompressor(level=self.level),
+                zstandard.ZstdDecompressor(),
+            )
+            self._local.pair = pair
+        return pair
 
     def compress_block(self, data: bytes) -> bytes:
-        return self._c.compress(data)
+        return self._pair()[0].compress(data)
 
     def decompress_block(self, data: bytes, uncompressed_len: int) -> bytes:
-        return self._d.decompress(data, max_output_size=uncompressed_len)
+        return self._pair()[1].decompress(data, max_output_size=uncompressed_len)

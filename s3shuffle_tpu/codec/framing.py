@@ -351,6 +351,16 @@ class CodecInputStream(io.RawIOBase):
                 if not chunk:
                     return b"".join(chunks)
                 chunks.append(chunk)
+        out = self.readview(size)
+        return out if isinstance(out, bytes) else bytes(out)
+
+    def readview(self, size: int):
+        """Zero-copy variant of :meth:`read`: returns up to ``size`` bytes as
+        a slice of the current decoded chunk WITHOUT converting to bytes —
+        bytes, or a uint8 ndarray view for natively batch-decoded runs. The
+        columnar frame parser reads through this (buffers feed np.frombuffer
+        / struct.unpack_from directly), skipping one full copy of every
+        decoded byte."""
         while self._pos >= len(self._current):
             if self._eof or not self._fill():
                 return b""

@@ -262,17 +262,27 @@ class NativeLZCodec(FrameCodec):
         return [dst[dst_off[i] : dst_off[i + 1]].tobytes() for i in range(n)]
 
     def decompress_blocks_concat(self, blocks):
-        """Batch-decompress straight into one contiguous buffer and hand it
-        back whole — zero per-block slicing (the frame read-ahead serves
-        multi-frame chunks to the stream stack)."""
+        """Batch-decompress straight into one contiguous buffer and hand the
+        buffer back whole as a uint8 ndarray — no per-block slicing and no
+        bytes conversion (CodecInputStream serves it through ``readview``;
+        ndarrays slice zero-copy and feed np.frombuffer/struct directly)."""
         if len(blocks) == 1:
             return self.decompress_block(*blocks[0])
-        dst, _ = self._decompress_batch_impl(blocks)
-        return dst.tobytes()
+        dst, dst_off = self._decompress_batch_impl(blocks)
+        # read-only: downstream frame parses take zero-copy views of this
+        # buffer; a stray in-place write must not corrupt sibling frames.
+        # (Retention note: any view pins the whole decoded run —
+        # ~BATCH_FRAMES x block_size — until every referencing batch dies.)
+        dst.setflags(write=False)
+        return dst[: int(dst_off[-1])]
 
     def _decompress_batch_impl(self, blocks):
+        # the wild-copy batch decoder needs 16 bytes of slack after both
+        # buffers (per-block copy slop; see slz_decompress_batch contract)
         n = len(blocks)
-        src = np.frombuffer(b"".join(b for b, _ in blocks), dtype=np.uint8)
+        src = np.frombuffer(
+            b"".join([*(b for b, _ in blocks), b"\x00" * 16]), dtype=np.uint8
+        )
         src_off = np.zeros(n + 1, dtype=np.int64)
         np.cumsum(
             np.fromiter((len(b) for b, _ in blocks), dtype=np.int64, count=n),
@@ -281,7 +291,7 @@ class NativeLZCodec(FrameCodec):
         ulens = np.fromiter((u for _, u in blocks), dtype=np.int64, count=n)
         dst_off = np.zeros(n + 1, dtype=np.int64)
         np.cumsum(ulens, out=dst_off[1:])
-        dst = np.empty(int(dst_off[-1]), dtype=np.uint8)
+        dst = np.empty(int(dst_off[-1]) + 16, dtype=np.uint8)
         out_sizes = np.zeros(n, dtype=np.int64)
         self._lib.slz_decompress_batch(
             src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),

@@ -208,6 +208,62 @@ size_t slz_compress(const uint8_t* src, size_t n, uint8_t* dst, size_t cap) {
     return (size_t)(op - dst);
 }
 
+// Wild-copy decompressor: same format and validation as slz_decompress, but
+// copies run in unconditional 16-byte steps. CONTRACT: src must have ≥16
+// readable slack bytes past src+n, and dst ≥16 writable slack past dst+ulen
+// (the batch entry point arranges both; per-block slop lands in the next
+// block's region or the tail slack). Returns bytes produced, 0 if malformed.
+static size_t slz_decompress_wild(const uint8_t* src, size_t n, uint8_t* dst, size_t ulen) {
+    const uint8_t* ip = src;
+    const uint8_t* iend = src + n;
+    uint8_t* op = dst;
+    uint8_t* oend = dst + ulen;
+
+    while (ip < iend) {
+        size_t llen;
+        ip = get_varint(ip, iend, &llen);
+        if (!ip || llen > (size_t)(oend - op) || llen > (size_t)(iend - ip)) return 0;
+        for (size_t k = 0; k < llen; k += 16) {  // ≤15B slop: covered by slack
+            uint64_t a = load64(ip + k), b = load64(ip + k + 8);
+            memcpy(op + k, &a, 8);
+            memcpy(op + k + 8, &b, 8);
+        }
+        op += llen;
+        ip += llen;
+        if (op == oend) break;  // final run, no match follows
+        if (ip + 2 > iend) return 0;
+        uint16_t off = (uint16_t)(ip[0] | (ip[1] << 8));
+        ip += 2;
+        size_t mlen;
+        ip = get_varint(ip, iend, &mlen);
+        if (!ip) return 0;
+        mlen += MIN_MATCH;
+        if (off == 0 || (size_t)(op - dst) < off || mlen > (size_t)(oend - op)) return 0;
+        const uint8_t* match = op - off;
+        if (off == 1) {  // RLE: one repeated byte
+            memset(op, *match, mlen);
+        } else if (off >= 16) {
+            for (size_t k = 0; k < mlen; k += 16) {
+                uint64_t a = load64(match + k), b = load64(match + k + 8);
+                memcpy(op + k, &a, 8);
+                memcpy(op + k + 8, &b, 8);
+            }
+        } else {
+            // 2..15-byte period: seed one period, then double from the start
+            // of the match output (log2(mlen/off) memcpys, all disjoint)
+            size_t w = off < mlen ? off : mlen;
+            for (size_t c = 0; c < w; c++) op[c] = match[c];
+            while (w < mlen) {
+                size_t c = w < mlen - w ? w : mlen - w;
+                memcpy(op + w, op, c);
+                w += c;
+            }
+        }
+        op += mlen;
+    }
+    return (size_t)(op - dst);
+}
+
 // Decompress one block of known uncompressed size. Returns bytes produced,
 // or 0 on malformed input.
 size_t slz_decompress(const uint8_t* src, size_t n, uint8_t* dst, size_t ulen) {
@@ -271,12 +327,17 @@ void slz_compress_batch(const uint8_t* src, const int64_t* src_offsets, int64_t 
     }
 }
 
+// Batch decompress with the wild-copy decoder. CONTRACT: the src buffer has
+// ≥16 readable bytes past src_offsets[count], and dst ≥16 writable bytes past
+// dst_offsets[count] (per-block write slop lands in the next block's region,
+// which is written afterwards in order, or in the tail slack).
 void slz_decompress_batch(const uint8_t* src, const int64_t* src_offsets, int64_t count,
                           uint8_t* dst, const int64_t* dst_offsets, int64_t* out_sizes) {
     for (int64_t i = 0; i < count; i++) {
         size_t n = (size_t)(src_offsets[i + 1] - src_offsets[i]);
         size_t ulen = (size_t)(dst_offsets[i + 1] - dst_offsets[i]);
-        out_sizes[i] = (int64_t)slz_decompress(src + src_offsets[i], n, dst + dst_offsets[i], ulen);
+        out_sizes[i] = (int64_t)slz_decompress_wild(src + src_offsets[i], n,
+                                                    dst + dst_offsets[i], ulen);
     }
 }
 

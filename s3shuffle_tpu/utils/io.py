@@ -18,6 +18,29 @@ def read_fully(source: BinaryIO, n: int) -> bytes:
     return b"".join(chunks)
 
 
+def read_fully_view(source, n: int):
+    """Like :func:`read_fully` but prefers the source's zero-copy ``readview``
+    (CodecInputStream exposes it): a single satisfying piece is returned AS-IS
+    (bytes, memoryview, or uint8 ndarray — all support the buffer protocol and
+    zero-copy slicing); multi-piece reads fall back to one joined bytes.
+    Callers must treat the result as a read-only buffer, not assume bytes."""
+    reader = getattr(source, "readview", None)
+    if reader is None:
+        return read_fully(source, n)
+    first = reader(n)
+    if len(first) == n or len(first) == 0:
+        return first
+    chunks = [first]
+    remaining = n - len(first)
+    while remaining > 0:
+        chunk = reader(remaining)
+        if not len(chunk):
+            break
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    return b"".join(chunks)  # bytes.join accepts any buffer-protocol pieces
+
+
 def read_up_to(source: BinaryIO, n: int, chunk_limit: int = 1 << 22) -> bytes:
     """Like :func:`read_fully` but bounds each underlying read call."""
     chunks = []

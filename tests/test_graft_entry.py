@@ -31,8 +31,12 @@ def _run(code: str, env_extra: dict) -> subprocess.CompletedProcess:
 @pytest.mark.slow
 def test_entry_returns_jittable_fn_and_args():
     code = (
-        "import __graft_entry__ as g\n"
+        # config.update AFTER import is what actually forces CPU here: the
+        # machine env pins the axon TPU plugin, which can hang backend init
+        # when the tunnel is down (same dance as tests/conftest.py)
         "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import __graft_entry__ as g\n"
         "fn, args = g.entry()\n"
         "out = jax.jit(fn)(*args)\n"
         "jax.tree_util.tree_map(lambda x: x.block_until_ready(), out)\n"

@@ -146,3 +146,41 @@ def test_concurrent_register_unregister_cycles(tmp_path):
         t.join()
     ctx.stop()
     assert not errors, errors
+
+
+def test_every_codec_thread_safe_under_concurrent_shuffles(tmp_path):
+    """One shared codec instance serves all task threads — every codec must
+    survive concurrent compress/decompress (zstandard's objects are not
+    thread-safe per instance; the codec layer must shield that)."""
+    for codec in ("native", "zlib", "zstd", "none"):
+        Dispatcher.reset()
+        cfg = ShuffleConfig(
+            root_dir=f"file://{tmp_path}/{codec}", app_id=f"cstress-{codec}", codec=codec
+        )
+        try:
+            ctx = ShuffleContext(config=cfg, num_workers=4)
+        except Exception:
+            continue  # codec unavailable in this environment
+        errors = []
+
+        def one(seed, ctx=ctx):
+            try:
+                rng = random.Random(seed)
+                recs = [(rng.randbytes(10), rng.randbytes(64)) for _ in range(3_000)]
+                out = ctx.sort_by_key(
+                    [RecordBatch.from_records(recs[i::2]) for i in range(2)],
+                    num_partitions=2,
+                    materialize="batches",
+                )
+                got = [k for p in out for b in p for k, _ in b.iter_records()]
+                assert got == sorted(k for k, _ in recs)
+            except Exception as e:  # pragma: no cover
+                errors.append((codec, e))
+
+        threads = [threading.Thread(target=one, args=(s,)) for s in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        ctx.stop()
+        assert not errors, errors
