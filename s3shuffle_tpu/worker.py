@@ -188,16 +188,105 @@ class WorkerAgent:
                 time.sleep(poll_interval)
 
 
+class MetricsServer:
+    """Prometheus text-format metrics endpoint for a worker agent.
+
+    The reference's executor pods are scraped via pod annotations
+    (examples/templates/executor.yml:7-9 + spark.ui.prometheus.enabled —
+    SURVEY.md §5.5); the deploy templates here annotate the same way, and
+    this is what answers the scrape: tasks run plus every
+    :mod:`s3shuffle_tpu.utils.trace` counter (bytes written/read, codec
+    bytes, ...) as ``s3shuffle_<name>``."""
+
+    def __init__(self, agent: WorkerAgent, host: str = "0.0.0.0", port: int = 8000):
+        import http.server
+        import threading
+
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path.rstrip("/") not in ("", "/metrics", "/healthz"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = outer.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        class Server(http.server.ThreadingHTTPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self.agent = agent
+        self._server = Server((host, port), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> "MetricsServer":
+        self._thread.start()
+        logger.info("metrics endpoint on :%d/metrics", self.port)
+        return self
+
+    def render(self) -> str:
+        from s3shuffle_tpu.utils import trace
+
+        # exposition-format label escaping: \\, \" and newline
+        wid = (
+            str(self.agent.worker_id)
+            .replace("\\", "\\\\")
+            .replace('"', '\\"')
+            .replace("\n", "\\n")
+        )
+        lines = [
+            "# TYPE s3shuffle_tasks_run_total counter",
+            f's3shuffle_tasks_run_total{{worker="{wid}"}} {self.agent.tasks_run}',
+        ]
+        for name, value in sorted(trace.counters().items()):
+            metric = "s3shuffle_" + "".join(
+                c if c.isalnum() else "_" for c in name.lower()
+            )
+            lines.append(f"# TYPE {metric} counter")
+            lines.append(f'{metric}{{worker="{wid}"}} {value}')
+        return "\n".join(lines) + "\n"
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     logging.basicConfig(level=logging.INFO)
     ap = argparse.ArgumentParser(description="s3shuffle_tpu worker agent")
     ap.add_argument("--coordinator", required=True, help="metadata service HOST:PORT")
     ap.add_argument("--worker-id", default=None)
     ap.add_argument("--poll-interval", type=float, default=0.05)
+    ap.add_argument("--metrics-port", type=int, default=8000,
+                    help="Prometheus /metrics port (0 disables; matches the "
+                         "deploy templates' scrape annotations)")
     args = ap.parse_args(argv)
     host, port = args.coordinator.rsplit(":", 1)
     agent = WorkerAgent((host, int(port)), worker_id=args.worker_id)
-    agent.run_forever(args.poll_interval)
+    metrics = None
+    if args.metrics_port:
+        try:
+            metrics = MetricsServer(agent, port=args.metrics_port).start()
+        except OSError as e:
+            logger.warning("metrics endpoint disabled: %s", e)
+    try:
+        agent.run_forever(args.poll_interval)
+    finally:
+        if metrics is not None:
+            metrics.stop()
     return 0
 
 

@@ -246,3 +246,29 @@ def test_dep_descriptor_roundtrip():
     dep2 = ShuffleDependency(8, HashPartitioner(5), serializer=ColumnarKVSerializer())
     back2 = dep_from_descriptor(8, dep_to_descriptor(dep2))
     assert back2.num_partitions == 5 and back2.key_ordering is None
+
+
+def test_worker_metrics_endpoint(tmp_path):
+    """The deploy templates annotate prometheus scrape ports — the worker must
+    actually answer /metrics with text-format counters."""
+    import urllib.request
+
+    from s3shuffle_tpu.worker import MetricsServer, WorkerAgent
+
+    svc = MetadataServer(host="127.0.0.1", port=0).start()
+    try:
+        cfg = ShuffleConfig(root_dir=f"file://{tmp_path}", app_id="metrics")
+        agent = WorkerAgent(svc.address, config=cfg, worker_id="w-metrics")
+        metrics = MetricsServer(agent, host="127.0.0.1", port=0).start()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{metrics.port}/metrics", timeout=5
+            ).read().decode()
+            assert 's3shuffle_tasks_run_total{worker="w-metrics"} 0' in body
+            assert urllib.request.urlopen(
+                f"http://127.0.0.1:{metrics.port}/healthz", timeout=5
+            ).status == 200
+        finally:
+            metrics.stop()
+    finally:
+        svc.stop()

@@ -160,7 +160,9 @@ def test_every_codec_thread_safe_under_concurrent_shuffles(tmp_path):
         try:
             ctx = ShuffleContext(config=cfg, num_workers=4)
         except Exception:
-            continue  # codec unavailable in this environment
+            if codec in ("native", "zstd"):
+                continue  # genuinely optional in this environment
+            raise  # zlib/none must always construct
         errors = []
 
         def one(seed, ctx=ctx):
