@@ -20,7 +20,7 @@ from __future__ import annotations
 import os
 import struct
 import tempfile
-from typing import BinaryIO, Iterable, Iterator, List, Optional, Sequence, Tuple
+from typing import BinaryIO, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 

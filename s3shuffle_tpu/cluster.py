@@ -24,7 +24,7 @@ import dataclasses
 import logging
 import multiprocessing as mp
 import pickle
-from typing import Any, Iterable, List, Optional, Sequence, Tuple
+from typing import Any, Iterable, List, Sequence, Tuple
 
 from s3shuffle_tpu.config import ShuffleConfig
 from s3shuffle_tpu.dependency import ShuffleDependency

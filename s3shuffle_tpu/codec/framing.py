@@ -22,7 +22,7 @@ from __future__ import annotations
 import io
 import struct
 from collections import deque
-from typing import BinaryIO, Callable, List, Tuple
+from typing import BinaryIO, List, Tuple
 
 HEADER = struct.Struct("<BII")
 HEADER_SIZE = HEADER.size  # 9 bytes

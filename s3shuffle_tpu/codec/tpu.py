@@ -24,7 +24,7 @@ FusedChecksumAccumulator.
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List
 
 import numpy as np
 

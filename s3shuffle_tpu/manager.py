@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import Any, Dict, Iterable, Iterator, Optional, Tuple
+from typing import Dict, Optional
 
 import numpy as np
 

@@ -35,7 +35,6 @@ from __future__ import annotations
 
 import functools
 import threading
-import zlib
 from typing import Dict, Tuple
 
 import numpy as np

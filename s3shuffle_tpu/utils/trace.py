@@ -24,7 +24,7 @@ import json
 import os
 import threading
 import time
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Dict, List, Optional
 
 _lock = threading.Lock()
 _events: List[dict] = []

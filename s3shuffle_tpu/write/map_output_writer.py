@@ -26,7 +26,7 @@ from __future__ import annotations
 import dataclasses
 import io
 import logging
-from typing import List, Optional
+from typing import Optional
 
 import numpy as np
 

@@ -11,7 +11,6 @@ leakage show up as failures rather than heisenbugs.
 import random
 import threading
 
-import pytest
 
 from s3shuffle_tpu.batch import RecordBatch
 from s3shuffle_tpu.config import ShuffleConfig
