@@ -44,7 +44,7 @@ def test_concurrent_independent_shuffles_one_process(tmp_path):
     """8 threads × independent shuffles through ONE context (shared manager,
     dispatcher, caches) — every shuffle must return exactly its own data."""
     Dispatcher.reset()
-    cfg = ShuffleConfig(root_dir=f"file://{tmp_path}", app_id="stress", codec="native")
+    cfg = ShuffleConfig(root_dir=f"file://{tmp_path}", app_id="stress", codec="auto")
     ctx = ShuffleContext(config=cfg, num_workers=4)
     errors = []
 
@@ -118,7 +118,7 @@ def test_concurrent_register_unregister_cycles(tmp_path):
     """Shuffle churn: register → write → read → unregister across threads;
     cache purges of one shuffle must never corrupt another's reads."""
     Dispatcher.reset()
-    cfg = ShuffleConfig(root_dir=f"file://{tmp_path}", app_id="stress3", codec="native")
+    cfg = ShuffleConfig(root_dir=f"file://{tmp_path}", app_id="stress3", codec="auto")
     ctx = ShuffleContext(config=cfg, num_workers=2)
     errors = []
 
