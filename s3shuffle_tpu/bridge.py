@@ -49,9 +49,12 @@ _U32 = struct.Struct("<I")
 _HDR = struct.Struct("<BI")
 
 #: Refuse absurd batch shapes before allocating (defense against a confused
-#: or malicious client writing garbage lengths).
+#: or malicious client writing garbage lengths). The byte cap matches the
+#: framing decoder's MAX_FRAME_ULEN (256 MiB) — a request the codec path
+#: could never produce or consume is rejected before it buffers; servers
+#: handling bigger legitimate batches can raise it per-instance.
 MAX_BLOCKS = 1 << 20
-MAX_TOTAL_BYTES = 1 << 31
+MAX_TOTAL_BYTES = 1 << 28
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -66,7 +69,9 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return b"".join(parts)
 
 
-def _read_message(sock: socket.socket) -> Optional[Tuple[int, List[bytes]]]:
+def _read_message(
+    sock: socket.socket, max_total_bytes: int = MAX_TOTAL_BYTES
+) -> Optional[Tuple[int, List[bytes]]]:
     """Returns (op, blocks) or None on clean EOF before a message starts."""
     try:
         hdr = _recv_exact(sock, _HDR.size)
@@ -78,8 +83,8 @@ def _read_message(sock: socket.socket) -> Optional[Tuple[int, List[bytes]]]:
     lens_raw = _recv_exact(sock, 4 * n)
     lens = [_U32.unpack_from(lens_raw, 4 * i)[0] for i in range(n)]
     total = sum(lens)
-    if total > MAX_TOTAL_BYTES:
-        raise ValueError(f"payload {total} exceeds limit {MAX_TOTAL_BYTES}")
+    if total > max_total_bytes:
+        raise ValueError(f"payload {total} exceeds limit {max_total_bytes}")
     payload = _recv_exact(sock, total)
     blocks, off = [], 0
     for ln in lens:
@@ -96,10 +101,21 @@ def _write_message(sock: socket.socket, status: int, blocks: List[bytes]) -> Non
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self) -> None:  # one connection, many requests
         codec = self.server.codec  # type: ignore[attr-defined]
+        max_total = getattr(self.server, "max_total_bytes", MAX_TOTAL_BYTES)
         while True:
             try:
-                msg = _read_message(self.request)
+                msg = _read_message(self.request, max_total)
             except (ConnectionError, OSError):
+                return
+            except ValueError as e:
+                # Protocol-confused client (bad block count / payload size):
+                # report and drop the connection — the stream position is
+                # unrecoverable once we refuse to read the declared payload.
+                logger.warning("bridge rejected request: %s", e)
+                try:
+                    _write_message(self.request, 1, [str(e).encode()])
+                except OSError:
+                    pass
                 return
             if msg is None:
                 return
@@ -121,6 +137,15 @@ class _Handler(socketserver.BaseRequestHandler):
         import numpy as np
 
         if op == OP_COMPRESS_FRAMED:
+            from s3shuffle_tpu.codec.framing import MAX_FRAME_ULEN
+
+            # Never emit a frame our own decoder (or OP_DECOMPRESS) rejects.
+            for i, b in enumerate(blocks):
+                if len(b) > MAX_FRAME_ULEN:
+                    raise ValueError(
+                        f"block {i} is {len(b)} bytes, exceeds the "
+                        f"{MAX_FRAME_ULEN}-byte frame limit — split it"
+                    )
             # one native batch call for the whole request, framing in Python
             out = bytearray()
             for raw, comp in zip(blocks, codec.compress_blocks(blocks)):
@@ -158,7 +183,13 @@ class CodecBridgeServer:
     """Threaded TCP service exposing the native codec path to external (JVM)
     clients. ``port=0`` picks a free port (see ``.port``)."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0, codec_name: str = "native"):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        codec_name: str = "native",
+        max_total_bytes: int = MAX_TOTAL_BYTES,
+    ):
         from s3shuffle_tpu.codec import get_codec
 
         try:
@@ -174,6 +205,7 @@ class CodecBridgeServer:
 
         self._server = _Server((host, port), _Handler)
         self._server.codec = codec  # type: ignore[attr-defined]
+        self._server.max_total_bytes = max_total_bytes  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -194,14 +226,25 @@ class CodecBridgeServer:
 
 
 class CodecBridgeClient:
-    """Reference client (and the shape of the JVM-side implementation)."""
+    """Reference client (and the shape of the JVM-side implementation).
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    ``max_reply_bytes`` bounds reply buffering; it defaults far above the
+    server's request cap because replies legitimately outgrow requests
+    (DECOMPRESS inflates, COMPRESS_FRAMED adds per-frame headers).
+    """
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        max_reply_bytes: int = 1 << 31,
+    ):
         self._sock = socket.create_connection((host, port))
+        self._max_reply_bytes = max_reply_bytes
 
     def _call(self, op: int, blocks: List[bytes]) -> List[bytes]:
         _write_message(self._sock, op, blocks)
-        msg = _read_message(self._sock)
+        msg = _read_message(self._sock, self._max_reply_bytes)
         if msg is None:
             raise ConnectionError("bridge closed the connection")
         status, out = msg
@@ -238,9 +281,17 @@ def main(argv=None) -> int:
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=7717)
     ap.add_argument("--codec", default="native")
+    ap.add_argument(
+        "--max-request-bytes",
+        type=int,
+        default=MAX_TOTAL_BYTES,
+        help="reject requests whose total payload exceeds this many bytes",
+    )
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
-    server = CodecBridgeServer(args.host, args.port, args.codec).start()
+    server = CodecBridgeServer(
+        args.host, args.port, args.codec, max_total_bytes=args.max_request_bytes
+    ).start()
     print(f"codec bridge on {args.host}:{server.port} (codec={args.codec})")
     try:
         threading.Event().wait()

@@ -247,14 +247,19 @@ class MetricsServer:
             .replace('"', '\\"')
             .replace("\n", "\\n")
         )
-        lines = [
-            "# TYPE s3shuffle_tasks_run_total counter",
-            f's3shuffle_tasks_run_total{{worker="{wid}"}} {self.agent.tasks_run}',
-        ]
+        # Distinct counter names can collapse to one sanitized metric name
+        # (e.g. "io.read" and "io/read"), and a trace counter may even
+        # collide with the built-in tasks counter; Prometheus rejects a
+        # scrape with duplicate series, so aggregate collisions into one
+        # sample before emitting.
+        merged: dict = {"s3shuffle_tasks_run_total": self.agent.tasks_run}
         for name, value in sorted(trace.counters().items()):
             metric = "s3shuffle_" + "".join(
                 c if c.isalnum() else "_" for c in name.lower()
             )
+            merged[metric] = merged.get(metric, 0) + value
+        lines = []
+        for metric, value in merged.items():
             lines.append(f"# TYPE {metric} counter")
             lines.append(f'{metric}{{worker="{wid}"}} {value}')
         return "\n".join(lines) + "\n"

@@ -272,3 +272,27 @@ def test_worker_metrics_endpoint(tmp_path):
             metrics.stop()
     finally:
         svc.stop()
+
+
+def test_worker_metrics_colliding_counter_names_dedup(tmp_path):
+    """Counter names that sanitize to the same metric name must merge into one
+    series — duplicate # TYPE lines make Prometheus reject the scrape."""
+    from s3shuffle_tpu.utils import trace
+    from s3shuffle_tpu.worker import MetricsServer, WorkerAgent
+
+    svc = MetadataServer(host="127.0.0.1", port=0).start()
+    try:
+        cfg = ShuffleConfig(root_dir=f"file://{tmp_path}", app_id="metrics2")
+        agent = WorkerAgent(svc.address, config=cfg, worker_id="w-dedup")
+        metrics = MetricsServer(agent, host="127.0.0.1", port=0)
+        trace.enable(str(tmp_path / "trace.json"), jax_annotations=False)
+        try:
+            trace.count("dedup.check", 3)
+            trace.count("dedup/check", 4)
+            body = metrics.render()
+        finally:
+            trace.disable()
+        assert body.count("# TYPE s3shuffle_dedup_check counter") == 1
+        assert 's3shuffle_dedup_check{worker="w-dedup"} 7.0' in body
+    finally:
+        svc.stop()
