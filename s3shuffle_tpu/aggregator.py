@@ -1,13 +1,28 @@
-"""Reduce-side (and map-side) aggregation.
+"""Reduce-side (and map-side) aggregation with bounded memory.
 
-Parity: the reference hands records to Spark's ``Aggregator``
-(combineValuesByKey / combineCombinersByKey — S3ShuffleReader.scala:124-138);
-this is the framework-native equivalent.
+Parity: the reference hands records to Spark's ``Aggregator``, whose
+ExternalAppendOnlyMap spills hash-sorted runs to disk when the tracked
+memory estimate exceeds its budget and merges them at iteration time
+(combineValuesByKey / combineCombinersByKey — S3ShuffleReader.scala:124-138).
+Same design here: an in-memory dict of combiners with a byte estimate;
+over budget, the dict is written out as one run sorted by key hash; the
+result iterator heap-merges all runs plus the resident dict, grouping by
+hash and resolving hash collisions by exact key equality within each
+(small) group. A keyset far larger than the budget therefore streams
+through without ever being materialized at once.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Iterable, Iterator, Tuple
+import heapq
+import itertools
+import os
+import pickle
+import sys
+import tempfile
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Tuple
+
+from s3shuffle_tpu.sorter import estimate_record_bytes
 
 
 class Aggregator:
@@ -16,34 +31,117 @@ class Aggregator:
         create_combiner: Callable[[Any], Any],
         merge_value: Callable[[Any, Any], Any],
         merge_combiners: Callable[[Any, Any], Any],
+        spill_bytes: int = 256 * 1024 * 1024,
+        spill_dir: Optional[str] = None,
     ):
         self.create_combiner = create_combiner
         self.merge_value = merge_value
         self.merge_combiners = merge_combiners
+        self.spill_bytes = max(1, spill_bytes)
+        self.spill_dir = spill_dir
+        #: diagnostic: spill-file count across all combines served by this
+        #: aggregator (an aggregator may serve several reduce tasks)
+        self.spill_count = 0
 
     def combine_values_by_key(
-        self, records: Iterable[Tuple[Any, Any]]
+        self,
+        records: Iterable[Tuple[Any, Any]],
+        spill_bytes: Optional[int] = None,
     ) -> Iterator[Tuple[Any, Any]]:
         """Used when the map side did NOT pre-combine."""
-        combiners: Dict[Any, Any] = {}
-        for k, v in records:
-            if k in combiners:
-                combiners[k] = self.merge_value(combiners[k], v)
-            else:
-                combiners[k] = self.create_combiner(v)
-        return iter(combiners.items())
+        return self._combine(records, self.create_combiner, self.merge_value, spill_bytes)
 
     def combine_combiners_by_key(
-        self, records: Iterable[Tuple[Any, Any]]
+        self,
+        records: Iterable[Tuple[Any, Any]],
+        spill_bytes: Optional[int] = None,
     ) -> Iterator[Tuple[Any, Any]]:
         """Used when map-side combine already produced combiners."""
+        return self._combine(
+            records, lambda c: c, self.merge_combiners, spill_bytes
+        )
+
+    # ------------------------------------------------------------------
+
+    def _combine(
+        self,
+        records: Iterable[Tuple[Any, Any]],
+        create: Callable[[Any], Any],
+        merge: Callable[[Any, Any], Any],
+        spill_bytes: Optional[int],
+    ) -> Iterator[Tuple[Any, Any]]:
+        budget = self.spill_bytes if spill_bytes is None else max(1, spill_bytes)
         combiners: Dict[Any, Any] = {}
-        for k, c in records:
-            if k in combiners:
-                combiners[k] = self.merge_combiners(combiners[k], c)
-            else:
-                combiners[k] = c
-        return iter(combiners.items())
+        estimate = 0
+        spills: List[str] = []
+        try:
+            for k, v in records:
+                if k in combiners:
+                    old = combiners[k]
+                    before = sys.getsizeof(old)
+                    new = merge(old, v)
+                    combiners[k] = new
+                    # charge actual combiner growth: replace-style combiners
+                    # (sum/count) cost ~nothing per merge; str/bytes/bigint
+                    # growth shows in the shallow size; container combiners
+                    # additionally retain the merged value, so charge its
+                    # shallow size too. Deeply nested growth is under-counted
+                    # — like Spark's SizeEstimator, the bound is approximate.
+                    estimate += max(0, sys.getsizeof(new) - before)
+                    if isinstance(new, (list, tuple, set, dict)):
+                        estimate += sys.getsizeof(v)
+                else:
+                    combiners[k] = create(v)
+                    estimate += estimate_record_bytes((k, combiners[k]))
+                if estimate >= budget:
+                    spills.append(self._spill(combiners))
+                    self.spill_count += 1
+                    combiners = {}
+                    estimate = 0
+            if not spills:
+                yield from combiners.items()
+                return
+            runs = [self._iter_spill(p) for p in spills]
+            resident = sorted(
+                ((hash(k), k, c) for k, c in combiners.items()),
+                key=lambda row: row[0],
+            )
+            runs.append(iter(resident))
+            merged = heapq.merge(*runs, key=lambda row: row[0])
+            for _h, group in itertools.groupby(merged, key=lambda row: row[0]):
+                # combiners sharing a hash: resolve true key equality within
+                # the (tiny) group — hash collisions stay correct
+                bucket: Dict[Any, Any] = {}
+                for _hh, k, c in group:
+                    bucket[k] = (
+                        self.merge_combiners(bucket[k], c) if k in bucket else c
+                    )
+                yield from bucket.items()
+        finally:
+            for path in spills:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+
+    def _spill(self, combiners: Dict[Any, Any]) -> str:
+        rows = sorted(
+            ((hash(k), k, c) for k, c in combiners.items()), key=lambda row: row[0]
+        )
+        fd, path = tempfile.mkstemp(prefix="s3shuffle-agg-spill-", dir=self.spill_dir)
+        with os.fdopen(fd, "wb") as f:
+            for row in rows:
+                pickle.dump(row, f, protocol=pickle.HIGHEST_PROTOCOL)
+        return path
+
+    @staticmethod
+    def _iter_spill(path: str) -> Iterator[Tuple[int, Any, Any]]:
+        with open(path, "rb") as f:
+            while True:
+                try:
+                    yield pickle.load(f)
+                except EOFError:
+                    return
 
 
 def fold_by_key_aggregator(zero: Any, fn: Callable[[Any, Any], Any]) -> Aggregator:

@@ -49,10 +49,12 @@ _U32 = struct.Struct("<I")
 _HDR = struct.Struct("<BI")
 
 #: Refuse absurd batch shapes before allocating (defense against a confused
-#: or malicious client writing garbage lengths). The byte cap matches the
-#: framing decoder's MAX_FRAME_ULEN (256 MiB) — a request the codec path
-#: could never produce or consume is rejected before it buffers; servers
-#: handling bigger legitimate batches can raise it per-instance.
+#: or malicious client writing garbage lengths). The byte cap bounds how much
+#: one request can make the server buffer (the recv path materializes the
+#: whole payload, roughly twice, before dispatch) — it is a DoS bound, not a
+#: codec limit; multi-block batches above it are legitimate, and servers
+#: expecting them should raise the cap per-instance or via
+#: ``--max-request-bytes``.
 MAX_BLOCKS = 1 << 20
 MAX_TOTAL_BYTES = 1 << 28
 

@@ -46,6 +46,10 @@ class TpuCodec(FrameCodec):
     def __init__(self, block_size: int = 64 * 1024, batch_blocks: int = 256):
         if block_size % 128 != 0:
             raise ValueError("TPU codec block_size must be a multiple of 128")
+        if block_size > tlz.MAX_BLOCK:
+            raise ValueError(
+                "TPU codec block_size must be <= 64 KiB (u16 TLZ source offsets)"
+            )
         super().__init__(block_size)
         self.batch_blocks = batch_blocks
 

@@ -58,6 +58,9 @@ class ShuffleConfig:
     # in-memory budget for key-ordered reduce output before the batch sorter
     # spills sorted columnar runs (analog of Spark's ExternalSorter memory)
     sorter_spill_bytes: int = 256 * MiB
+    # in-memory budget for reduce-side combine before the aggregator spills
+    # hash-sorted runs (analog of Spark's ExternalAppendOnlyMap memory)
+    aggregator_spill_bytes: int = 256 * MiB
     use_block_manager: bool = True
     force_batch_fetch: bool = False
     # --- caches ---

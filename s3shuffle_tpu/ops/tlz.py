@@ -1,36 +1,57 @@
-"""TLZ — a TPU-native block-parallel compression format.
+"""TLZ — a TPU-native block-parallel compression format (v2).
 
 The reference compresses shuffle bytes with JVM LZ4/Snappy streams (Spark's
 ``spark.io.compression.*``; SURVEY.md §0). Byte-serial LZ parsing is hostile
 to TPUs (data-dependent control flow, scalar loops), so TLZ is designed from
 the hardware up instead of translating LZ4:
 
-- a block is split into fixed **16-byte groups** (the VPU lane shape likes
-  contiguous 16B chunks; group count per 64 KiB block = 4096 fits a u16);
-- encoding finds, for every group, the nearest previous *identical* group —
-  computed with sort-based hash matching (``argsort`` of group hashes; equal
-  hashes become sorted neighbors, so "nearest previous occurrence" is one
-  shifted compare — no hash-table scatter, no sequential scan);
-- match chains are collapsed by **pointer jumping** (log₂ G vectorized hops)
-  so every match's source is a *literal* group;
-- therefore decoding is literal placement + one parallel gather — no
-  sequential back-reference chasing like LZ77 — equally fast on TPU or in
-  vectorized numpy on the host;
-- runs (RLE) fall out naturally: a run ≥ 2 groups matches at distance 1.
+- a block is split into fixed **8-byte groups**; every group is either a
+  literal or a *match* — a copy of 8 bytes starting at **any earlier byte
+  offset** in the same block. (v1 used 16-byte groups with aligned group
+  sources only, which missed all unaligned redundancy — shuffle records are
+  rarely 16-byte-periodic);
+- a match whose source continues the previous group's source
+  (``off[g] == off[g-1] + 8`` — what any repeated region longer than one
+  group produces) is flagged in a second bitmap and stores **no offset at
+  all**, so long runs cost ~2 bits per 8 bytes — the "pair coalescing" that
+  makes the group format competitive with byte-granular LZ parses;
+- encoding hashes the 8-byte window at *every* byte position (8 shifted
+  multiply-adds — pure VPU work), then finds each group's nearest previous
+  identical window with one stable ``argsort`` per block: equal hashes land
+  adjacent in sort order, so "nearest previous occurrence" is a shifted
+  compare — no hash-table scatter, no sequential scan. Candidates are
+  verified by exact compare, so hash collisions cost missed matches, never
+  wrong output. A vectorized continuation-promotion pass then retries each
+  group at the previous group's source + 8, aligning offset chains so the
+  cont bitmap can elide them;
+- sources may overlap their destination (offset within 8 bytes of the group
+  start), so runs of ANY period — classic LZ77 RLE — fall out free;
+- decoding reconstructs elided offsets with a running max (leader of each
+  continuation run) + rank gather, builds a per-byte source map (literal
+  bytes are fixed points, match bytes point at ``offset + lane``) and
+  resolves chains with **pointer jumping**: log2(block) rounds of one
+  parallel gather each, then a final gather from the literal plane. No
+  sequential back-reference chasing — equally fast on TPU and in vectorized
+  numpy on the host.
 
 Wire format of one TLZ frame payload (fits the shared 9-byte frame header,
 codec_id = ``tpu-lz``):
 
-    [u16le n_groups]
-    [bitmap ceil(n_groups/8) bytes  — bit i set ⇒ group i is a match]
-    [u16le src_group_index × n_matches  — always a literal group]
-    [literal groups × 16 bytes (last one zero-padded to 16)]
+    [u16le n_groups | 0x8000]   — bit 15 set ⇒ v2 (this format)
+    [match bitmap ceil(n_groups/8) bytes — bit i set ⇒ group i is a match]
+    [cont  bitmap ceil(n_groups/8) bytes — bit i set ⇒ off[i]=off[i-1]+8]
+    [u16le src_byte_offset × n_new_matches — for matches with cont bit 0]
+    [literal groups × 8 bytes (last one zero-padded to 8)]
 
-Ratio characteristics: catches aligned 16-byte redundancy (runs, repeated
-records, zero padding, columnar patterns); misses unaligned text redundancy —
-the CPU SLZ codec or zstd remain better for that, and the framing's raw
-escape bounds the worst case. Encoding cost is O(G log G) sort + O(G) VPU
-work per block, fully batched over B blocks.
+v1 payloads (bit 15 clear; 16-byte groups, sources are *group indices* of
+literal groups, no cont bitmap) remain decodable on the host path. Encoders
+always emit v2.
+
+Ratio characteristics: catches aligned and unaligned repeats and runs of any
+period; misses approximate redundancy (entropy coding is out of scope — the
+framing's raw escape bounds the worst case). Encoding cost is O(N log N)
+sort + O(N) VPU work per block over N byte positions, fully batched over B
+blocks. Byte offsets are u16, so ``block_size`` must be ≤ 64 KiB.
 """
 
 from __future__ import annotations
@@ -40,7 +61,13 @@ from typing import List, Tuple
 
 import numpy as np
 
-GROUP = 16
+GROUP = 8
+#: v1 used 16-byte groups; kept for decoding legacy payloads.
+_V1_GROUP = 16
+#: bit 15 of the leading u16 marks the v2 format.
+V2_FLAG = 0x8000
+#: u16 byte offsets bound the window a source can address.
+MAX_BLOCK = 1 << 16
 
 
 def _jax():
@@ -50,6 +77,17 @@ def _jax():
     return jax, jnp
 
 
+# Odd multipliers give an invertible-ish mix; collisions are fine (they are
+# verified by exact compare) — they only cost missed matches, never wrong
+# matches.
+_MULTS_I64 = (np.arange(GROUP, dtype=np.int64) * 2 + 1) * 0x9E3779B1
+_MULTS_I32 = (_MULTS_I64 % (1 << 31)).astype(np.int32)
+
+
+def _jump_rounds(n_bytes: int) -> int:
+    return int(np.ceil(np.log2(max(2, n_bytes))))
+
+
 # ---------------------------------------------------------------------------
 # Device encoder (batched)
 # ---------------------------------------------------------------------------
@@ -57,68 +95,113 @@ def _jax():
 
 def _encode_math(blocks_u8, n_groups: int):
     """The raw (unjitted) encode computation — shared by the standalone
-    jitted kernel and larger fused traces (see __graft_entry__)."""
+    jitted kernel and larger fused traces (see __graft_entry__). Returns
+    (match_bitmap, cont_bitmap, offs_compact, lits_compact, n_new, n_match)
+    where ``offs_compact[:, :n_new]`` are the stored (non-continuation)
+    match offsets and ``lits_compact[:, :n_groups - n_match]`` the literal
+    groups."""
     jax, jnp = _jax()
 
-    # Odd multipliers give an invertible-ish mix; collisions are fine (they
-    # are verified by exact compare) — they only cost missed matches never
-    # wrong matches.
-    mults = (np.arange(GROUP, dtype=np.int64) * 2 + 1) * 0x9E3779B1
-    mults = jnp.asarray((mults % (1 << 31)).astype(np.int32))
-
+    mults = jnp.asarray(_MULTS_I32)
     b = blocks_u8.shape[0]
-    groups = blocks_u8.reshape(b, n_groups, GROUP).astype(jnp.int32)
-    h = jnp.sum(groups * mults[None, None, :], axis=2, dtype=jnp.int32)
+    n_bytes = n_groups * GROUP
+    n_pos = n_bytes - GROUP + 1  # every valid window start
+    buf = blocks_u8.astype(jnp.int32)  # (B, n_bytes)
+    rows = jnp.arange(b)[:, None]
+    lanes = jnp.arange(GROUP, dtype=jnp.int32)
+    groups = buf.reshape(b, n_groups, GROUP)
 
-    # nearest previous identical group via sort: stable-sort (h, idx);
-    # an equal-hash neighbor to the left has the largest smaller index.
-    order = jnp.argsort(h, axis=1, stable=True)  # (B, G)
+    def window_at(pos):
+        # gather the GROUP-byte window starting at each position in ``pos``
+        idx = (pos[:, :, None] + lanes).reshape(b, -1)
+        return jnp.take_along_axis(buf, idx, axis=1).reshape(b, -1, GROUP)
+
+    # hash of the window at every byte position: GROUP shifted MACs
+    h = jnp.zeros((b, n_pos), dtype=jnp.int32)
+    for k in range(GROUP):
+        h = h + buf[:, k : k + n_pos] * mults[k]
+
+    # nearest previous identical window via sort: stable-sort (h, pos);
+    # an equal-hash neighbor to the left has the largest smaller position.
+    order = jnp.argsort(h, axis=1, stable=True)  # (B, n_pos)
     h_sorted = jnp.take_along_axis(h, order, axis=1)
     prev_same = jnp.concatenate(
         [jnp.full((b, 1), False), h_sorted[:, 1:] == h_sorted[:, :-1]], axis=1
     )
-    prev_idx_sorted = jnp.concatenate(
+    prev_pos = jnp.concatenate(
         [jnp.zeros((b, 1), dtype=order.dtype), order[:, :-1]], axis=1
     )
-    cand_sorted = jnp.where(prev_same, prev_idx_sorted, -1)
-    # scatter candidates back to original positions
-    cand = jnp.zeros_like(cand_sorted).at[jnp.arange(b)[:, None], order].set(cand_sorted)
+    cand_sorted = jnp.where(prev_same, prev_pos, -1)
+    cand = jnp.zeros_like(cand_sorted).at[rows, order].set(cand_sorted)
+    dest = jnp.arange(n_groups, dtype=jnp.int32) * GROUP
+    cand_d = jnp.take(cand, dest, axis=1).astype(jnp.int32)  # (B, G)
 
     # verify exact equality (hash collisions ⇒ missed match, never wrong)
-    safe_cand = jnp.maximum(cand, 0)
-    cand_groups = jnp.take_along_axis(groups, safe_cand[:, :, None], axis=1)
-    equal = jnp.all(cand_groups == groups, axis=2) & (cand >= 0)
+    safe = jnp.maximum(cand_d, 0)
+    is_match = jnp.all(window_at(safe) == groups, axis=2) & (cand_d >= 0)
+    offs = jnp.where(is_match, safe, 0)
 
-    # pointer jumping: collapse chains so sources are literal groups
-    src = jnp.where(equal, safe_cand, jnp.arange(n_groups)[None, :])
-    for _ in range(int(np.ceil(np.log2(max(2, n_groups))))):
-        src = jnp.take_along_axis(src, src, axis=1)
+    # continuation promotion: retry each group at the previous group's
+    # source + GROUP. This (a) aligns equal-content candidates onto one
+    # chain so the cont bitmap can elide their offsets, and (b) can add
+    # matches the hash search missed. Two passes extend promotion chains
+    # far enough in practice; correctness never depends on it.
+    for _ in range(2):
+        prev_off = jnp.concatenate(
+            [jnp.zeros((b, 1), jnp.int32), offs[:, :-1] + GROUP], axis=1
+        )
+        prev_match = jnp.concatenate(
+            [jnp.zeros((b, 1), bool), is_match[:, :-1]], axis=1
+        )
+        # prev_off < dest always holds: offs[g-1] < (g-1)*GROUP + GROUP
+        c_ok = prev_match & jnp.all(window_at(prev_off) == groups, axis=2)
+        offs = jnp.where(c_ok, prev_off, offs)
+        is_match = is_match | c_ok
 
-    is_match = equal
-    n_matches = jnp.sum(is_match, axis=1, dtype=jnp.int32)
+    prev_off = jnp.concatenate(
+        [jnp.zeros((b, 1), jnp.int32), offs[:, :-1] + GROUP], axis=1
+    )
+    prev_match = jnp.concatenate([jnp.zeros((b, 1), bool), is_match[:, :-1]], axis=1)
+    is_cont = is_match & prev_match & (offs == prev_off)
+    is_new = is_match & ~is_cont
+    n_match = jnp.sum(is_match, axis=1, dtype=jnp.int32)
+    n_new = jnp.sum(is_new, axis=1, dtype=jnp.int32)
 
-    # compact match sources and literal groups via rank + scatter
-    match_rank = jnp.cumsum(is_match, axis=1) - 1
+    # compact stored offsets and literal groups via rank + scatter. Group 0
+    # can never match (no previous position), so slot n_groups-1 is always
+    # free to absorb the masked writes.
+    new_rank = jnp.cumsum(is_new, axis=1) - 1
     lit_rank = jnp.cumsum(~is_match, axis=1) - 1
-    rows = jnp.arange(b)[:, None]
-    srcs_compact = jnp.zeros((b, n_groups), dtype=jnp.int32)
-    srcs_compact = srcs_compact.at[
-        rows, jnp.where(is_match, match_rank, n_groups - 1)
-    ].set(jnp.where(is_match, src, 0), mode="drop")
+    offs_compact = jnp.zeros((b, n_groups), dtype=jnp.int32)
+    offs_compact = offs_compact.at[
+        rows, jnp.where(is_new, new_rank, n_groups - 1)
+    ].set(jnp.where(is_new, offs, 0), mode="drop")
     lits_compact = jnp.zeros((b, n_groups, GROUP), dtype=jnp.uint8)
     lits_compact = lits_compact.at[
         rows, jnp.where(is_match, n_groups - 1, lit_rank)
-    ].set(jnp.where(is_match[:, :, None], 0, groups).astype(jnp.uint8), mode="drop")
+    ].set(
+        jnp.where(is_match[:, :, None], 0, groups).astype(jnp.uint8), mode="drop"
+    )
 
-    # bitmap packed to uint8 (little-endian bit order within the byte)
+    # bitmaps packed to uint8 (little-endian bit order within the byte)
     bit_weights = jnp.asarray([1, 2, 4, 8, 16, 32, 64, 128], dtype=jnp.int32)
-    bitmap = jnp.sum(
-        is_match.reshape(b, n_groups // 8, 8).astype(jnp.int32) * bit_weights[None, None, :],
-        axis=2,
-        dtype=jnp.int32,
-    ).astype(jnp.uint8)
 
-    return bitmap, srcs_compact.astype(jnp.uint16), lits_compact, n_matches
+    def pack(bits):
+        return jnp.sum(
+            bits.reshape(b, n_groups // 8, 8).astype(jnp.int32)
+            * bit_weights[None, None, :],
+            axis=2,
+            dtype=jnp.int32,
+        ).astype(jnp.uint8)
+
+    return (
+        pack(is_match),
+        pack(is_cont),
+        offs_compact.astype(jnp.uint16),
+        lits_compact,
+        n_new,
+        n_match,
+    )
 
 
 @functools.lru_cache(maxsize=8)
@@ -132,31 +215,33 @@ def encode_blocks_device(blocks: List[bytes], block_size: int) -> List[bytes]:
     TLZ payload per block (caller applies the framing raw-escape when a
     payload fails to shrink)."""
     if block_size % (8 * GROUP) != 0:
-        raise ValueError("block_size must be a multiple of 128")
+        raise ValueError("block_size must be a multiple of 64")
+    if block_size > MAX_BLOCK:
+        raise ValueError("block_size must be <= 64 KiB (u16 source offsets)")
     n_groups = block_size // GROUP
     b = len(blocks)
     staged = np.zeros((b, block_size), dtype=np.uint8)
     for i, blk in enumerate(blocks):
         arr = np.frombuffer(blk, dtype=np.uint8)
         staged[i, : len(arr)] = arr
-    bitmap, srcs, lits, n_matches = (
+    bitmap, cont, offs, lits, n_new, n_match = (
         np.asarray(x) for x in _encode_kernel(n_groups)(staged)
     )
     out: List[bytes] = []
-    header = np.array([n_groups], dtype="<u2").tobytes()
+    header = np.array([n_groups | V2_FLAG], dtype="<u2").tobytes()
     for i, blk in enumerate(blocks):
         used_groups = (len(blk) + GROUP - 1) // GROUP
         if used_groups < n_groups:
-            # Short (final) block: re-encode host-side view of the bitmap for
-            # just the used groups. Matches among pad groups are discarded.
+            # Short (final) block: encode host-side over just the used groups.
             payload = _assemble_payload_numpy(blk)
         else:
-            m = int(n_matches[i])
+            nn, nm = int(n_new[i]), int(n_match[i])
             payload = (
                 header
                 + bitmap[i].tobytes()
-                + srcs[i, :m].astype("<u2").tobytes()
-                + lits[i, : n_groups - m].tobytes()
+                + cont[i].tobytes()
+                + offs[i, :nn].astype("<u2").tobytes()
+                + lits[i, : n_groups - nm].tobytes()
             )
         out.append(payload)
     return out
@@ -168,125 +253,222 @@ def encode_blocks_device(blocks: List[bytes], block_size: int) -> List[bytes]:
 # ---------------------------------------------------------------------------
 
 
-def _group_view(data: bytes) -> Tuple[np.ndarray, int]:
-    n_groups = (len(data) + GROUP - 1) // GROUP
-    padded = np.zeros(n_groups * GROUP, dtype=np.uint8)
+def _group_view(data: bytes, group: int = GROUP) -> Tuple[np.ndarray, int]:
+    n_groups = (len(data) + group - 1) // group
+    padded = np.zeros(n_groups * group, dtype=np.uint8)
     padded[: len(data)] = np.frombuffer(data, dtype=np.uint8)
-    return padded.reshape(n_groups, GROUP), n_groups
+    return padded.reshape(n_groups, group), n_groups
 
 
 def _assemble_payload_numpy(data: bytes) -> bytes:
     groups, n_groups = _group_view(data)
-    h = groups.astype(np.int64) @ (np.arange(GROUP, dtype=np.int64) * 2 + 1)
+    if n_groups == 0:
+        return np.array([V2_FLAG], dtype="<u2").tobytes()
+    flat = groups.reshape(-1)
+    windows = np.lib.stride_tricks.sliding_window_view(flat, GROUP)  # view
+    n_bytes = n_groups * GROUP
+    n_pos = n_bytes - GROUP + 1
+    flat64 = flat.astype(np.int64)
+    h = np.zeros(n_pos, dtype=np.int64)
+    for k in range(GROUP):
+        h += flat64[k : k + n_pos] * _MULTS_I64[k]
     order = np.argsort(h, kind="stable")
     h_sorted = h[order]
     prev_same = np.concatenate([[False], h_sorted[1:] == h_sorted[:-1]])
-    prev_idx = np.concatenate([[0], order[:-1]])
-    cand_sorted = np.where(prev_same, prev_idx, -1)
-    cand = np.zeros(n_groups, dtype=np.int64)
+    prev_pos = np.concatenate([[0], order[:-1]])
+    cand_sorted = np.where(prev_same, prev_pos, -1)
+    cand = np.zeros(n_pos, dtype=np.int64)
     cand[order] = cand_sorted
-    safe = np.maximum(cand, 0)
-    equal = (groups[safe] == groups).all(axis=1) & (cand >= 0)
-    src = np.where(equal, safe, np.arange(n_groups))
-    for _ in range(int(np.ceil(np.log2(max(2, n_groups))))):
-        src = src[src]
-    is_match = equal
-    bitmap = np.packbits(is_match.astype(np.uint8), bitorder="little")
-    srcs = src[is_match].astype("<u2")
-    lits = groups[~is_match]
+    cand_d = cand[np.arange(n_groups) * GROUP]
+    safe = np.maximum(cand_d, 0)
+    is_match = (windows[safe] == groups).all(axis=1) & (cand_d >= 0)
+    offs = np.where(is_match, safe, 0)
+    for _ in range(2):  # continuation promotion (see _encode_math)
+        prev_off = np.concatenate([[0], offs[:-1] + GROUP])
+        prev_match = np.concatenate([[False], is_match[:-1]])
+        c_ok = prev_match & (windows[prev_off] == groups).all(axis=1)
+        offs = np.where(c_ok, prev_off, offs)
+        is_match = is_match | c_ok
+    prev_off = np.concatenate([[0], offs[:-1] + GROUP])
+    prev_match = np.concatenate([[False], is_match[:-1]])
+    is_cont = is_match & prev_match & (offs == prev_off)
+    is_new = is_match & ~is_cont
     return (
-        np.array([n_groups], dtype="<u2").tobytes()
-        + bitmap.tobytes()
-        + srcs.tobytes()
-        + lits.tobytes()
+        np.array([n_groups | V2_FLAG], dtype="<u2").tobytes()
+        + np.packbits(is_match.astype(np.uint8), bitorder="little").tobytes()
+        + np.packbits(is_cont.astype(np.uint8), bitorder="little").tobytes()
+        + offs[is_new].astype("<u2").tobytes()
+        + groups[~is_match].tobytes()
     )
 
 
-def decode_payload_numpy(payload: bytes, uncompressed_len: int) -> bytes:
+def _parse_payload(payload: bytes):
+    """Split a TLZ payload into (version, n_groups, is_match, is_cont, offs,
+    lits). v1 has no cont bitmap (is_cont is None) and 16-byte groups."""
     if len(payload) < 2:
         raise IOError("TLZ payload too short")
-    n_groups = int(np.frombuffer(payload[:2], dtype="<u2")[0])
+    field = int(np.frombuffer(payload[:2], dtype="<u2")[0])
+    version = 2 if field & V2_FLAG else 1
+    n_groups = field & ~V2_FLAG
+    # v2 blocks are ≤ 64 KiB ⇒ n_groups ≤ 8192. A larger count with the flag
+    # bit set can only be a legacy v1 payload from a ≥ 512 KiB block (v1 had
+    # no block-size cap, so its 16-byte-group count could reach bit 15) —
+    # refuse loudly instead of silently decoding it as v2.
+    if version == 2 and (n_groups > MAX_BLOCK // GROUP or (n_groups == 0 and len(payload) > 2)):
+        raise IOError(
+            "ambiguous TLZ header: v2 flag set with out-of-range group count "
+            "(legacy v1 payload from a >448 KiB block?)"
+        )
     bm_len = (n_groups + 7) // 8
+    group = GROUP if version == 2 else _V1_GROUP
     off = 2
     bitmap = np.frombuffer(payload[off : off + bm_len], dtype=np.uint8)
     off += bm_len
     if len(bitmap) < bm_len:
         raise IOError("TLZ bitmap truncated")
     is_match = np.unpackbits(bitmap, count=n_groups, bitorder="little").astype(bool)
-    n_matches = int(is_match.sum())
-    srcs = np.frombuffer(payload[off : off + 2 * n_matches], dtype="<u2")
-    off += 2 * n_matches
-    if len(srcs) < n_matches:
+    is_cont = None
+    if version == 2:
+        cont_b = np.frombuffer(payload[off : off + bm_len], dtype=np.uint8)
+        off += bm_len
+        if len(cont_b) < bm_len:
+            raise IOError("TLZ cont bitmap truncated")
+        is_cont = np.unpackbits(cont_b, count=n_groups, bitorder="little").astype(bool)
+        if (is_cont & ~is_match).any():
+            raise IOError("TLZ cont flag on non-match group")
+        n_offs = int((is_match & ~is_cont).sum())
+    else:
+        n_offs = int(is_match.sum())
+    offs = np.frombuffer(payload[off : off + 2 * n_offs], dtype="<u2")
+    off += 2 * n_offs
+    if len(offs) < n_offs:
         raise IOError("TLZ sources truncated")
-    n_lits = n_groups - n_matches
-    lits = np.frombuffer(payload[off : off + n_lits * GROUP], dtype=np.uint8)
-    if len(lits) < n_lits * GROUP:
+    n_lits = n_groups - int(is_match.sum())
+    lits = np.frombuffer(payload[off : off + n_lits * group], dtype=np.uint8)
+    if len(lits) < n_lits * group:
         raise IOError("TLZ literals truncated")
-    out = np.zeros((n_groups, GROUP), dtype=np.uint8)
-    out[~is_match] = lits.reshape(n_lits, GROUP)
-    src_idx = srcs.astype(np.int64)
-    if n_matches:
-        if (src_idx >= n_groups).any() or is_match[src_idx].any():
-            raise IOError("TLZ match source is not a literal group")
-        out[is_match] = out[src_idx]
-    flat = out.reshape(-1)[:uncompressed_len]
-    return flat.tobytes()
+    return version, n_groups, is_match, is_cont, offs.astype(np.int64), lits
+
+
+def _expand_offsets_numpy(is_match, is_cont, offs, n_groups):
+    """Reconstruct each match group's source offset: continuation groups take
+    their run leader's stored offset + GROUP per step."""
+    is_new = is_match & ~is_cont
+    idx = np.arange(n_groups, dtype=np.int64)
+    if not is_match.any():
+        return np.zeros(n_groups, dtype=np.int64)
+    leader = np.maximum.accumulate(np.where(is_new, idx, -1))
+    if (leader[is_match] < 0).any() or len(offs) == 0:
+        raise IOError("TLZ continuation run has no leader")
+    new_rank = np.cumsum(is_new) - 1
+    safe_rank = np.clip(new_rank, 0, len(offs) - 1)
+    off_full = offs[safe_rank] + GROUP * (idx - np.maximum(leader, 0))
+    return off_full
+
+
+def decode_payload_numpy(payload: bytes, uncompressed_len: int) -> bytes:
+    version, n_groups, is_match, is_cont, offs, lits = _parse_payload(payload)
+    n_lits = n_groups - int(is_match.sum())
+    if version == 1:
+        # legacy format: 16-byte groups, sources are literal *group indices*
+        out = np.zeros((n_groups, _V1_GROUP), dtype=np.uint8)
+        out[~is_match] = lits.reshape(n_lits, _V1_GROUP)
+        if len(offs):
+            if (offs >= n_groups).any() or is_match[offs].any():
+                raise IOError("TLZ match source is not a literal group")
+            out[is_match] = out[offs]
+        return out.reshape(-1)[:uncompressed_len].tobytes()
+
+    n_bytes = n_groups * GROUP
+    if n_groups == 0:
+        return b""
+    off_full = _expand_offsets_numpy(is_match, is_cont, offs, n_groups)
+    group_start = np.arange(n_groups, dtype=np.int64) * GROUP
+    bad = is_match & (
+        (off_full < 0) | (off_full >= group_start) | (off_full + GROUP > n_bytes)
+    )
+    if bad.any():
+        raise IOError("TLZ v2 source offset out of range")
+    # literal plane, placed sparsely at each literal group's position
+    sparse = np.zeros((n_groups, GROUP), dtype=np.uint8)
+    sparse[~is_match] = lits.reshape(n_lits, GROUP)
+    sparse = sparse.reshape(-1)
+    # per-byte source map: literal bytes are fixed points; match bytes point
+    # at offset + lane. Pointer jumping resolves chains (sources strictly
+    # precede their destinations, so log2 rounds reach literal bytes).
+    src = np.arange(n_bytes, dtype=np.int64)
+    match_groups = np.flatnonzero(is_match)
+    if len(match_groups):
+        lanes = np.arange(GROUP, dtype=np.int64)
+        src_match = (off_full[match_groups][:, None] + lanes[None, :]).reshape(-1)
+        dst_match = (group_start[match_groups][:, None] + lanes[None, :]).reshape(-1)
+        src[dst_match] = src_match
+        for _ in range(_jump_rounds(n_bytes)):
+            src = src[src]
+    return sparse[src][:uncompressed_len].tobytes()
 
 
 @functools.lru_cache(maxsize=8)
 def _decode_kernel(n_groups: int):
-    """Batched device decoder: fixed-shape inputs (padded), parallel gather."""
+    """Batched device decoder: fixed-shape inputs (padded); log2 rounds of
+    pointer-jumping gathers, then one gather from the literal plane."""
     jax, jnp = _jax()
+    n_bytes = n_groups * GROUP
 
     @jax.jit
-    def kernel(is_match, srcs_padded, lits_padded):
-        # is_match: (B, G) bool; srcs_padded: (B, G) int32 (match slots filled
-        # in match order); lits_padded: (B, G, GROUP) uint8 (literal slots in
-        # literal order).
+    def kernel(is_match, is_cont, offs_padded, lits_padded):
+        # is_match/is_cont: (B, G) bool; offs_padded: (B, G) int32 (stored
+        # offsets in order); lits_padded: (B, G, GROUP) uint8 (literal slots
+        # in literal order).
         b = is_match.shape[0]
-        rows = jnp.arange(b)[:, None]
-        match_rank = jnp.cumsum(is_match, axis=1) - 1
+        idx = jnp.arange(n_groups, dtype=jnp.int32)
+        is_new = is_match & ~is_cont
+        new_rank = jnp.cumsum(is_new, axis=1) - 1
+        leader = jax.lax.cummax(jnp.where(is_new, idx[None, :], -1), axis=1)
+        off_of = jnp.take_along_axis(
+            offs_padded, jnp.maximum(new_rank, 0), axis=1
+        ) + GROUP * (idx[None, :] - jnp.maximum(leader, 0))
         lit_rank = jnp.cumsum(~is_match, axis=1) - 1
-        out = jnp.zeros((b, n_groups, GROUP), dtype=jnp.uint8)
         lit_vals = jnp.take_along_axis(
             lits_padded, jnp.maximum(lit_rank, 0)[:, :, None], axis=1
         )
-        out = jnp.where(is_match[:, :, None], 0, lit_vals)
-        src_of = jnp.take_along_axis(srcs_padded, jnp.maximum(match_rank, 0), axis=1)
-        gathered = jnp.take_along_axis(out, src_of[:, :, None], axis=1)
-        out = jnp.where(is_match[:, :, None], gathered, out)
-        return out.reshape(b, n_groups * GROUP)
+        sparse = jnp.where(is_match[:, :, None], 0, lit_vals).reshape(b, n_bytes)
+        # per-byte source map + pointer jumping
+        lanes = jnp.arange(GROUP, dtype=jnp.int32)
+        pos = jnp.arange(n_bytes, dtype=jnp.int32)
+        off_b = (off_of[:, :, None] + lanes[None, None, :]).reshape(b, n_bytes)
+        match_b = jnp.repeat(is_match, GROUP, axis=1)
+        # clamp corrupt offsets into range; wrong bytes are caught by the
+        # checksum layer, unlike an out-of-bounds gather
+        src = jnp.where(match_b, jnp.clip(off_b, 0, n_bytes - 1), pos[None, :])
+        for _ in range(_jump_rounds(n_bytes)):
+            src = jnp.take_along_axis(src, src, axis=1)
+        return jnp.take_along_axis(sparse, src, axis=1)
 
     return kernel
 
 
 def decode_blocks_device(payloads: List[bytes], ulens: List[int], block_size: int) -> List[bytes]:
-    """Batched device decode of full-size TLZ payloads; short blocks fall back
-    to the numpy decoder."""
+    """Batched device decode of full-size v2 TLZ payloads; short or legacy
+    blocks fall back to the numpy decoder."""
     n_groups = block_size // GROUP
     b = len(payloads)
     is_match = np.zeros((b, n_groups), dtype=bool)
-    srcs = np.zeros((b, n_groups), dtype=np.int32)
+    is_cont = np.zeros((b, n_groups), dtype=bool)
+    offs = np.zeros((b, n_groups), dtype=np.int32)
     lits = np.zeros((b, n_groups, GROUP), dtype=np.uint8)
     fallback: dict[int, bytes] = {}
     for i, payload in enumerate(payloads):
-        ng = int(np.frombuffer(payload[:2], dtype="<u2")[0])
-        if ng != n_groups:
+        version, ng, m, c, o, l = _parse_payload(payload)
+        if ng != n_groups or version != 2:
             fallback[i] = decode_payload_numpy(payload, ulens[i])
             continue
-        bm_len = (ng + 7) // 8
-        bm = np.frombuffer(payload[2 : 2 + bm_len], dtype=np.uint8)
-        m = np.unpackbits(bm, count=ng, bitorder="little").astype(bool)
-        nm = int(m.sum())
-        off = 2 + bm_len
-        s = np.frombuffer(payload[off : off + 2 * nm], dtype="<u2")
-        off += 2 * nm
-        nl = ng - nm
-        l = np.frombuffer(payload[off : off + nl * GROUP], dtype=np.uint8)
         is_match[i] = m
-        srcs[i, :nm] = s
-        lits[i, :nl] = l.reshape(nl, GROUP)
-    decoded = np.asarray(_decode_kernel(n_groups)(is_match, srcs, lits))
+        is_cont[i] = c
+        offs[i, : len(o)] = o
+        n_lits = n_groups - int(m.sum())
+        lits[i, :n_lits] = l.reshape(n_lits, GROUP)
+    decoded = np.asarray(_decode_kernel(n_groups)(is_match, is_cont, offs, lits))
     out = []
     for i in range(b):
         if i in fallback:

@@ -162,11 +162,18 @@ class ShuffleReader:
 
         if self.dep.aggregator is not None:
             if self.dep.map_side_combine:
-                records = self.dep.aggregator.combine_combiners_by_key(records)
+                records = self.dep.aggregator.combine_combiners_by_key(
+                    records, spill_bytes=self.dispatcher.config.aggregator_spill_bytes
+                )
             else:
-                records = self.dep.aggregator.combine_values_by_key(records)
+                records = self.dep.aggregator.combine_values_by_key(
+                    records, spill_bytes=self.dispatcher.config.aggregator_spill_bytes
+                )
         if self.dep.key_ordering is not None:
-            sorter = ExternalSorter(key_func=self.dep.key_ordering)
+            sorter = ExternalSorter(
+                key_func=self.dep.key_ordering,
+                spill_bytes=self.dispatcher.config.sorter_spill_bytes,
+            )
             sorter.insert_all(records)
             records = sorter.sorted_iterator()
         return records
@@ -238,7 +245,10 @@ class ShuffleReader:
             yield from self._fed_batch_sorter().sorted_records()
             return
         # custom key function: per-record external sort over batch records
-        sorter = ExternalSorter(key_func=key_ordering)
+        sorter = ExternalSorter(
+            key_func=key_ordering,
+            spill_bytes=self.dispatcher.config.sorter_spill_bytes,
+        )
         for batch in self.read_batches():
             sorter.insert_all(batch.iter_records())
         yield from sorter.sorted_iterator()

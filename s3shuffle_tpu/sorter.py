@@ -2,8 +2,12 @@
 
 Parity: the reference defers key ordering to Spark's ``ExternalSorter``
 (S3ShuffleReader.scala:141-149) — in-memory sort with spill-to-disk runs merged
-at iteration time. Same design here: accumulate records, spill sorted runs of
-``spill_threshold`` records to local temp files, then ``heapq.merge`` the runs.
+at iteration time, spilling on a tracked *byte* budget (Spark's
+``spark.shuffle.spill.*`` accounting), not a record count. Same design here:
+accumulate records, estimate their in-memory footprint, spill sorted runs to
+local temp files when the byte budget is exceeded, then ``heapq.merge`` the
+runs. A record-count cap remains as a secondary bound for workloads of many
+tiny records where per-object estimation overhead would dominate.
 """
 
 from __future__ import annotations
@@ -11,29 +15,60 @@ from __future__ import annotations
 import heapq
 import os
 import pickle
+import sys
 import tempfile
 from typing import Any, Callable, Iterable, Iterator, List, Optional, Tuple
+
+
+def estimate_record_bytes(kv: Tuple[Any, Any]) -> int:
+    """Approximate in-memory footprint of one (key, value) record.
+
+    ``sys.getsizeof`` of the tuple and both elements, descending one level
+    into list/tuple containers (the common generic-record shapes). Like
+    Spark's SizeEstimator this is an estimate, not an exact bound — deeply
+    nested values are under-counted, which only makes spills later, never
+    incorrect.
+    """
+    total = sys.getsizeof(kv)
+    for obj in kv:
+        total += sys.getsizeof(obj)
+        if isinstance(obj, (tuple, list)):
+            for item in obj:
+                total += sys.getsizeof(item)
+    return total
 
 
 class ExternalSorter:
     def __init__(
         self,
         key_func: Optional[Callable[[Any], Any]] = None,
+        spill_bytes: int = 256 * 1024 * 1024,
         spill_threshold: int = 1_000_000,
         spill_dir: Optional[str] = None,
     ):
         self._key = key_func or (lambda k: k)
+        self._spill_bytes = max(1, spill_bytes)
         self._spill_threshold = max(1, spill_threshold)
         self._spill_dir = spill_dir
         self._records: List[Tuple[Any, Any]] = []
+        self._bytes = 0
         self._spills: List[str] = []
         self.spill_count = 0
 
     def insert_all(self, records: Iterable[Tuple[Any, Any]]) -> None:
         for kv in records:
             self._records.append(kv)
-            if len(self._records) >= self._spill_threshold:
+            self._bytes += estimate_record_bytes(kv)
+            if (
+                self._bytes >= self._spill_bytes
+                or len(self._records) >= self._spill_threshold
+            ):
                 self._spill()
+
+    @property
+    def memory_bytes(self) -> int:
+        """Estimated bytes currently held in memory (pre-spill)."""
+        return self._bytes
 
     def _spill(self) -> None:
         self._records.sort(key=lambda kv: self._key(kv[0]))
@@ -44,6 +79,7 @@ class ExternalSorter:
         self._spills.append(path)
         self.spill_count += 1
         self._records = []
+        self._bytes = 0
 
     def _iter_spill(self, path: str) -> Iterator[Tuple[Any, Any]]:
         with open(path, "rb") as f:

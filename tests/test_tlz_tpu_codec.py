@@ -76,6 +76,19 @@ def test_tlz_corrupt_payload_raises():
         tlz.decode_payload_numpy(payload[:2] + b"\xff" * (len(payload) - 2), len(data))
 
 
+def test_legacy_v1_big_block_header_rejected_not_misdecoded():
+    """A v1 payload from a >=512 KiB block has bit 15 of its group count set,
+    colliding with the v2 flag — the decoder must refuse it loudly instead of
+    silently returning wrong bytes."""
+    fake_v1 = np.array([0x8000], dtype="<u2").tobytes() + b"\x00" * 64
+    with pytest.raises(IOError, match="ambiguous"):
+        tlz.decode_payload_numpy(fake_v1, 512 * 1024)
+    # v1 group count 44000 (≈688 KiB block): bit 15 set, low bits 11232 > 8192
+    fake_v1_bigger = np.array([44000], dtype="<u2").tobytes() + b"\x00" * 64
+    with pytest.raises(IOError, match="ambiguous"):
+        tlz.decode_payload_numpy(fake_v1_bigger, 688 * 1024)
+
+
 def test_tpu_codec_stream_roundtrip():
     codec = TpuCodec(block_size=BS, batch_blocks=4)
     for data in _payload_cases():
