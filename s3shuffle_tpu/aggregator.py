@@ -74,22 +74,31 @@ class Aggregator:
         combiners: Dict[Any, Any] = {}
         estimate = 0
         spills: List[str] = []
+        merge_tick = 0
         try:
             for k, v in records:
                 if k in combiners:
+                    merge_tick += 1
+                    if merge_tick & 63:
+                        combiners[k] = merge(combiners[k], v)
+                        continue
+                    # Sampled growth accounting (1-in-64 merges, scaled up —
+                    # the codebase's amortize-the-budget-check pattern, cf.
+                    # spill_writer's check_every): replace-style combiners
+                    # (sum/count) show ~zero shallow growth and never spill
+                    # on input volume; container combiners additionally
+                    # retain the merged value, so its shallow size is charged
+                    # too. Deeply nested growth is under-counted — like
+                    # Spark's SizeEstimator sampling, the bound is
+                    # approximate.
                     old = combiners[k]
                     before = sys.getsizeof(old)
                     new = merge(old, v)
                     combiners[k] = new
-                    # charge actual combiner growth: replace-style combiners
-                    # (sum/count) cost ~nothing per merge; str/bytes/bigint
-                    # growth shows in the shallow size; container combiners
-                    # additionally retain the merged value, so charge its
-                    # shallow size too. Deeply nested growth is under-counted
-                    # — like Spark's SizeEstimator, the bound is approximate.
-                    estimate += max(0, sys.getsizeof(new) - before)
+                    growth = max(0, sys.getsizeof(new) - before)
                     if isinstance(new, (list, tuple, set, dict)):
-                        estimate += sys.getsizeof(v)
+                        growth += sys.getsizeof(v)
+                    estimate += growth * 64
                 else:
                     combiners[k] = create(v)
                     estimate += estimate_record_bytes((k, combiners[k]))

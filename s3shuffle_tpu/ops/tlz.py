@@ -347,6 +347,14 @@ def _parse_payload(payload: bytes):
     lits = np.frombuffer(payload[off : off + n_lits * group], dtype=np.uint8)
     if len(lits) < n_lits * group:
         raise IOError("TLZ literals truncated")
+    # v2 payloads are exactly their declared fields — trailing bytes mean the
+    # header was misread (e.g. a legacy v1 payload from a 512-640 KiB block
+    # whose group count happens to alias a small v2 count + the flag bit)
+    if version == 2 and off + n_lits * group != len(payload):
+        raise IOError(
+            f"TLZ v2 payload has {len(payload) - off - n_lits * group} "
+            "trailing bytes — misread header (legacy v1 block?)"
+        )
     return version, n_groups, is_match, is_cont, offs.astype(np.int64), lits
 
 
@@ -408,42 +416,60 @@ def decode_payload_numpy(payload: bytes, uncompressed_len: int) -> bytes:
     return sparse[src][:uncompressed_len].tobytes()
 
 
+def _unpack_bits_math(bitmap_u8, n_groups: int):
+    """In-graph little-endian bit unpack: (B, G/8) uint8 → (B, G) bool."""
+    _jax_mod, jnp = _jax()
+    shifts = jnp.arange(8, dtype=jnp.int32)
+    bits = (bitmap_u8[:, :, None].astype(jnp.int32) >> shifts[None, None, :]) & 1
+    return bits.reshape(bitmap_u8.shape[0], n_groups).astype(bool)
+
+
+def _decode_math(is_match, is_cont, offs_padded, lits_padded, n_groups: int):
+    """The raw (unjitted) decode computation — shared by the standalone
+    jitted kernel and larger fused traces (e.g. the multichip dryrun's
+    in-graph encode→decode roundtrip check).
+
+    is_match/is_cont: (B, G) bool; offs_padded: (B, G) int32 (stored offsets
+    in order); lits_padded: (B, G, GROUP) uint8 (literal slots in literal
+    order) — exactly the (unpacked) shapes :func:`_encode_math` emits.
+    """
+    jax, jnp = _jax()
+    n_bytes = n_groups * GROUP
+    b = is_match.shape[0]
+    idx = jnp.arange(n_groups, dtype=jnp.int32)
+    is_new = is_match & ~is_cont
+    new_rank = jnp.cumsum(is_new, axis=1) - 1
+    leader = jax.lax.cummax(jnp.where(is_new, idx[None, :], -1), axis=1)
+    off_of = jnp.take_along_axis(
+        offs_padded, jnp.maximum(new_rank, 0), axis=1
+    ) + GROUP * (idx[None, :] - jnp.maximum(leader, 0))
+    lit_rank = jnp.cumsum(~is_match, axis=1) - 1
+    lit_vals = jnp.take_along_axis(
+        lits_padded, jnp.maximum(lit_rank, 0)[:, :, None], axis=1
+    )
+    sparse = jnp.where(is_match[:, :, None], 0, lit_vals).reshape(b, n_bytes)
+    # per-byte source map + pointer jumping
+    lanes = jnp.arange(GROUP, dtype=jnp.int32)
+    pos = jnp.arange(n_bytes, dtype=jnp.int32)
+    off_b = (off_of[:, :, None] + lanes[None, None, :]).reshape(b, n_bytes)
+    match_b = jnp.repeat(is_match, GROUP, axis=1)
+    # clamp corrupt offsets into range; wrong bytes are caught by the
+    # checksum layer, unlike an out-of-bounds gather
+    src = jnp.where(match_b, jnp.clip(off_b, 0, n_bytes - 1), pos[None, :])
+    for _ in range(_jump_rounds(n_bytes)):
+        src = jnp.take_along_axis(src, src, axis=1)
+    return jnp.take_along_axis(sparse, src, axis=1)
+
+
 @functools.lru_cache(maxsize=8)
 def _decode_kernel(n_groups: int):
     """Batched device decoder: fixed-shape inputs (padded); log2 rounds of
     pointer-jumping gathers, then one gather from the literal plane."""
-    jax, jnp = _jax()
-    n_bytes = n_groups * GROUP
+    jax, _jnp = _jax()
 
     @jax.jit
     def kernel(is_match, is_cont, offs_padded, lits_padded):
-        # is_match/is_cont: (B, G) bool; offs_padded: (B, G) int32 (stored
-        # offsets in order); lits_padded: (B, G, GROUP) uint8 (literal slots
-        # in literal order).
-        b = is_match.shape[0]
-        idx = jnp.arange(n_groups, dtype=jnp.int32)
-        is_new = is_match & ~is_cont
-        new_rank = jnp.cumsum(is_new, axis=1) - 1
-        leader = jax.lax.cummax(jnp.where(is_new, idx[None, :], -1), axis=1)
-        off_of = jnp.take_along_axis(
-            offs_padded, jnp.maximum(new_rank, 0), axis=1
-        ) + GROUP * (idx[None, :] - jnp.maximum(leader, 0))
-        lit_rank = jnp.cumsum(~is_match, axis=1) - 1
-        lit_vals = jnp.take_along_axis(
-            lits_padded, jnp.maximum(lit_rank, 0)[:, :, None], axis=1
-        )
-        sparse = jnp.where(is_match[:, :, None], 0, lit_vals).reshape(b, n_bytes)
-        # per-byte source map + pointer jumping
-        lanes = jnp.arange(GROUP, dtype=jnp.int32)
-        pos = jnp.arange(n_bytes, dtype=jnp.int32)
-        off_b = (off_of[:, :, None] + lanes[None, None, :]).reshape(b, n_bytes)
-        match_b = jnp.repeat(is_match, GROUP, axis=1)
-        # clamp corrupt offsets into range; wrong bytes are caught by the
-        # checksum layer, unlike an out-of-bounds gather
-        src = jnp.where(match_b, jnp.clip(off_b, 0, n_bytes - 1), pos[None, :])
-        for _ in range(_jump_rounds(n_bytes)):
-            src = jnp.take_along_axis(src, src, axis=1)
-        return jnp.take_along_axis(sparse, src, axis=1)
+        return _decode_math(is_match, is_cont, offs_padded, lits_padded, n_groups)
 
     return kernel
 

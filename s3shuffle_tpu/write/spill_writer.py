@@ -116,7 +116,10 @@ class ShuffleMapWriter:
             records = records.iter_records()
         if dep.map_side_combine:
             assert dep.aggregator is not None
-            records = dep.aggregator.combine_values_by_key(records)
+            records = dep.aggregator.combine_values_by_key(
+                records,
+                spill_bytes=self.output_writer.dispatcher.config.aggregator_spill_bytes,
+            )
         partitioner = dep.partitioner
         pipelines = self._pipelines
         check_every = 4096
