@@ -29,6 +29,10 @@ RECORDS_PER_MAP = 120_000
 N_MAPS = 6
 N_REDUCERS = 8
 KEY_BYTES, VALUE_BYTES = 10, 90  # terasort record shape
+# device-probe batch shape (overridable for CPU-backend smoke tests):
+# 64 KiB blocks match the shuffle codec's block size, so the on-chip ratio
+# is the benched workload's ratio; 32 blocks keep tunnel staging at 2 MiB
+PROBE_L, PROBE_B = 64 * 1024, 32
 
 
 def gen_partitions(seed=42):
@@ -94,10 +98,11 @@ def _validate(out):
 
 
 def run_comparison(parts, workers: int = 0, repeats: int = 5):
-    """Time the native-codec shuffle against the zlib baseline shuffle.
+    """Time the native-codec shuffle against the zlib-1 (JVM-class stand-in)
+    and real-LZ4 baseline shuffles.
 
-    The two codecs' timed runs are INTERLEAVED (warmup pass first, then
-    native/zlib alternating, best-of-N each) so process-wide drift — page
+    The codecs' timed runs are INTERLEAVED (warmup pass first, then
+    native/zlib/lz4 rotating, best-of-N each) so process-wide drift — page
     cache, allocator arena growth, CPU frequency scaling — cancels instead of
     penalizing whichever codec runs first."""
     from s3shuffle_tpu.storage.dispatcher import Dispatcher
@@ -106,36 +111,64 @@ def run_comparison(parts, workers: int = 0, repeats: int = 5):
     # contention, so size the pool to the machine.
     workers = workers or min(4, os.cpu_count() or 1)
     Dispatcher.reset()
-    ctx_n, root_n = _make_ctx("native", workers)
-    ctx_z, root_z = _make_ctx("zlib", workers)
+    names = ("native", "zlib", "lz4")
+    ctxs, roots = {}, {}
+    for name in names:
+        ctxs[name], roots[name] = _make_ctx(name, workers)
+    best = {name: float("inf") for name in names}
+    stored = {}
     try:
-        _t, out = _timed_shuffle(ctx_n, parts)  # warmup (untimed)
-        _validate(out)
-        _t, out = _timed_shuffle(ctx_z, parts)
-        _validate(out)
-        native_s = zlib_s = float("inf")
+        for name in names:  # warmup (untimed) + correctness check
+            _t, out = _timed_shuffle(ctxs[name], parts)
+            _validate(out)
         for _ in range(repeats):
-            dt, _out = _timed_shuffle(ctx_n, parts)
-            native_s = min(native_s, dt)
-            dt, _out = _timed_shuffle(ctx_z, parts)
-            zlib_s = min(zlib_s, dt)
+            for name in names:
+                dt, _out = _timed_shuffle(ctxs[name], parts)
+                best[name] = min(best[name], dt)
         # compression ratio: one extra uncleaned shuffle per codec, then walk
         # the root for stored (compressed + index/checksum) bytes
-        _timed_shuffle(ctx_n, parts, cleanup=False)
-        _timed_shuffle(ctx_z, parts, cleanup=False)
-        stored_n = _tree_bytes(root_n)
-        stored_z = _tree_bytes(root_z)
-        ctx_n.stop()
-        ctx_z.stop()
+        for name in names:
+            _timed_shuffle(ctxs[name], parts, cleanup=False)
+            stored[name] = _tree_bytes(roots[name])
+            ctxs[name].stop()
     finally:
-        shutil.rmtree(root_n, ignore_errors=True)
-        shutil.rmtree(root_z, ignore_errors=True)
+        for root in roots.values():
+            shutil.rmtree(root, ignore_errors=True)
     raw_bytes = N_MAPS * RECORDS_PER_MAP * (KEY_BYTES + VALUE_BYTES + 8)
     ratios = {
-        "native_compression_ratio": round(raw_bytes / stored_n, 3) if stored_n else 0.0,
-        "zlib_compression_ratio": round(raw_bytes / stored_z, 3) if stored_z else 0.0,
+        f"{name}_compression_ratio": (
+            round(raw_bytes / stored[name], 3) if stored.get(name) else 0.0
+        )
+        for name in names
     }
-    return raw_bytes / native_s, native_s, raw_bytes / zlib_s, zlib_s, ratios
+    bps = {name: raw_bytes / best[name] for name in names}
+    return bps, best, ratios
+
+
+def aggregate_multiworker(parts, workers: int = 4, repeats: int = 3):
+    """VERDICT r1 #3: a ≥4-worker aggregate so the headline reflects a host
+    configuration, not a single worker. Workers are threads sharing this
+    host's cores (see ``host_cores`` in the output for how much hardware
+    that actually is)."""
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+
+    Dispatcher.reset()
+    ctx, root = _make_ctx("native", workers)
+    try:
+        _timed_shuffle(ctx, parts)  # warmup
+        best = float("inf")
+        for _ in range(repeats):
+            dt, _out = _timed_shuffle(ctx, parts)
+            best = min(best, dt)
+        ctx.stop()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    raw_bytes = N_MAPS * RECORDS_PER_MAP * (KEY_BYTES + VALUE_BYTES + 8)
+    return {
+        "aggregate_workers": workers,
+        "aggregate_mb_s": round(raw_bytes / best / 1e6, 2),
+        "host_cores": os.cpu_count() or 1,
+    }
 
 
 def _tree_bytes(root):
@@ -151,8 +184,9 @@ def _tree_bytes(root):
 
 def write_cpu_comparison(parts):
     """The north-star gate (BASELINE.json): shuffle-WRITE CPU time through the
-    native codec vs the JVM-LZ4 stand-in (zlib-1), at equal-or-better ratio.
-    Times compress of the actual serialized shuffle payload (columnar frames),
+    native codec vs real LZ4 (our in-tree LZ4 block-format implementation)
+    and the zlib-1 JVM-class stand-in, at equal-or-better ratio. Times
+    compress of the actual serialized shuffle payload (columnar frames),
     best-of-3 each."""
     import io as _io
 
@@ -165,7 +199,7 @@ def write_cpu_comparison(parts):
     payload = buf.getvalue()
     out = {}
     times = {}
-    for name in ("native", "zlib"):
+    for name in ("native", "lz4", "zlib"):
         try:
             codec = get_codec(name)
         except Exception:
@@ -180,53 +214,101 @@ def write_cpu_comparison(parts):
         out[f"{name}_compress_mb_s"] = round(len(payload) / 1e6 / best, 1)
         out[f"{name}_payload_ratio"] = round(len(payload) / len(compressed), 3)
     out["write_cpu_speedup_vs_zlib"] = round(times["zlib"] / times["native"], 2)
+    out["write_cpu_speedup_vs_lz4"] = round(times["lz4"] / times["native"], 2)
     return out
 
 
-def device_kernel_rates(timeout_s: int = 420):
-    """Device-kernel rates, measured in a SUBPROCESS with a hard timeout:
-    the TPU sits behind a tunnel whose backend init can hang outright when
-    the tunnel is down, and the headline bench must still print its JSON
-    line. The child runs :func:`_device_kernel_rates_impl`."""
+def device_kernel_rates(timeout_s: int = 150, attempts: int = 3):
+    """Device-kernel rates, measured in a SUBPROCESS with a hard per-attempt
+    timeout and retry/backoff: the TPU sits behind a tunnel whose backend
+    init can hang outright when the tunnel is down (r1's probe lost the whole
+    420s budget to one hang), and the headline bench must still print its
+    JSON line. The child runs :func:`_device_kernel_rates_impl`."""
     import subprocess
 
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import sys, json; sys.path.insert(0, sys.argv[1]); import bench; "
-             "print(json.dumps(bench._device_kernel_rates_impl()))",
-             os.path.dirname(os.path.abspath(__file__))],
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
-        )
-        if r.returncode == 0 and r.stdout.strip():
-            return json.loads(r.stdout.strip().splitlines()[-1])
-        return {"tpu_probe_error": (r.stderr or "probe exited nonzero")[-120:]}
-    except subprocess.TimeoutExpired:
-        return {"tpu_probe_error": f"device probe timed out after {timeout_s}s (tunnel down?)"}
-    except Exception as e:
-        return {"tpu_probe_error": str(e)[:120]}
+    last = "no attempt ran"
+    partial: dict = {}
+    for attempt in range(attempts):
+        if attempt:
+            time.sleep(5 * attempt)  # backoff: tunnel blips are transient
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import sys, json; sys.path.insert(0, sys.argv[1]); import bench; "
+                 "print(json.dumps(bench._device_kernel_rates_impl()))",
+                 os.path.dirname(os.path.abspath(__file__))],
+                capture_output=True,
+                text=True,
+                timeout=timeout_s,
+            )
+            if r.returncode == 0 and r.stdout.strip():
+                out = json.loads(r.stdout.strip().splitlines()[-1])
+                if "tpu_probe_error" not in out:
+                    return out
+                last = out.pop("tpu_probe_error")
+                # keep the most complete partial measurement: a probe that
+                # fails partway still produced real on-chip numbers
+                if len(out) > len(partial):
+                    partial = out
+                if "decode(encode" in last:
+                    break  # deterministic failure — retrying cannot help
+            else:
+                last = (r.stderr or "probe exited nonzero")[-120:]
+        except subprocess.TimeoutExpired:
+            last = f"device probe attempt timed out after {timeout_s}s (tunnel down?)"
+        except Exception as e:
+            last = str(e)[:120]
+    return {**partial, "tpu_probe_error": f"probe attempts failed; last: {last}"}
 
 
 def _device_kernel_rates_impl():
-    """Device-kernel rates for the offload building blocks, measured on
-    device-resident data (kernel loop, block_until_ready), plus the
-    host↔device link rates. Separated because on this rig the chip sits
-    behind a slow tunnel: staged-through-link rates say nothing about the
-    kernels (measured here: CRC kernel ~71 GB/s on-chip vs ~37 MB/s H2D)."""
+    """Device-kernel rates for the offload building blocks, plus host↔device
+    link rates. Two tunnel-robustness measures (the chip sits behind a slow,
+    intermittently-degrading tunnel, and r1/r2 probes showed per-dispatch
+    latency can exceed kernel time by 1000x):
+
+    - each kernel is timed as ``lax.scan`` loops of two lengths inside
+      SINGLE dispatches; the reported rate uses the time *delta*, so
+      dispatch round-trips and result-fetch latency cancel exactly;
+    - a tiny first-touch transfer fails fast when the tunnel is down.
+
+    The TLZ batch is the real serialized terasort payload (columnar frames
+    from the same generator the headline shuffle uses), so the probe reports
+    the on-chip compression ratio of the benched workload."""
     out = {}
     try:
+        import io as _io
+
         import jax
+        import jax.numpy as jnp
         import numpy as np
 
         from s3shuffle_tpu.ops import tlz
-        from s3shuffle_tpu.ops.checksum import POLY_CRC32C, _crc_kernel, _device_weights
+        from s3shuffle_tpu.ops.checksum import POLY_CRC32C, _crc_math, _device_weights
 
-        L, B = 16 * 1024, 128  # 2 MiB per batch keeps tunnel staging sane
-        rng = np.random.default_rng(0)
-        batch = rng.integers(0, 256, size=(B, L), dtype=np.uint8)
-        iters = 10
+        L, B = PROBE_L, PROBE_B  # 2 MiB per batch keeps tunnel staging sane
+        N1, N2 = 3, 9
+        n_groups = L // tlz.GROUP
+        # tiny first touch: if the tunnel is down this fails in ms, not
+        # after staging megabytes
+        jax.device_put(np.zeros(1024, np.uint8)).block_until_ready()
+
+        # the real serialized shuffle payload (columnar frames), sliced into
+        # the staged batch — ratio below is the benched workload's ratio
+        from s3shuffle_tpu.batch import RecordBatch, write_frame
+
+        rng_py = random.Random(42)
+        filler = [rng_py.randbytes(VALUE_BYTES) for _ in range(64)]
+        recs = [
+            (rng_py.randbytes(KEY_BYTES), filler[rng_py.randrange(64)])
+            for _ in range((B * L) // (KEY_BYTES + VALUE_BYTES) + 100)
+        ]
+        buf = _io.BytesIO()
+        write_frame(buf, RecordBatch.from_records(recs))
+        payload = buf.getvalue()
+        if len(payload) < B * L:
+            payload = payload * (B * L // len(payload) + 1)
+        batch = np.frombuffer(payload[: B * L], dtype=np.uint8).reshape(B, L).copy()
 
         t0 = time.perf_counter()
         dev = jax.device_put(batch)
@@ -234,43 +316,116 @@ def _device_kernel_rates_impl():
         out["h2d_mb_s"] = round(B * L / 1e6 / (time.perf_counter() - t0), 1)
 
         w = _device_weights(POLY_CRC32C, L)
-        crc = _crc_kernel(L)
-        crc(dev, w).block_until_ready()  # compile
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            r = crc(dev, w)
-        r.block_until_ready()
-        out["tpu_crc32c_mb_s"] = round(iters * B * L / 1e6 / (time.perf_counter() - t0), 1)
 
-        n_groups = L // tlz.GROUP
+        def timed_loop(body, length):
+            """One dispatch running `body` `length` times on data re-derived
+            each iteration (XOR 1 preserves equality structure, so codec work
+            per iteration is representative); returns wall seconds."""
+            looped = jax.jit(
+                lambda data: jax.lax.scan(
+                    lambda carry, _: (carry ^ jnp.uint8(1), body(carry)),
+                    data,
+                    None,
+                    length=length,
+                )[1]
+            )
+            r = looped(dev)
+            jax.tree_util.tree_map(lambda x: x.block_until_ready(), r)  # compile
+            t0 = time.perf_counter()
+            r = looped(dev)
+            jax.tree_util.tree_map(lambda x: x.block_until_ready(), r)
+            return time.perf_counter() - t0, r
+
+        def delta_rate(body):
+            t1, _ = timed_loop(body, N1)
+            t2, r = timed_loop(body, N2)
+            dt = max(t2 - t1, 1e-9)
+            return round((N2 - N1) * B * L / 1e6 / dt, 1), r
+
+        out["tpu_crc32c_mb_s"], _r = delta_rate(
+            lambda d: _crc_math(d, w, L)
+        )
+        out["tpu_tlz_encode_mb_s"], enc_outs = delta_rate(
+            lambda d: tlz._encode_math(d, n_groups)[4:6]  # (n_new, n_match)
+        )
+
+        # ratio + correctness from one untimed encode/decode round trip
         enc = tlz._encode_kernel(n_groups)
-        jax.tree_util.tree_map(lambda x: x.block_until_ready(), enc(dev))  # compile
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            rs = enc(dev)
-        jax.tree_util.tree_map(lambda x: x.block_until_ready(), rs)
-        out["tpu_tlz_encode_mb_s"] = round(iters * B * L / 1e6 / (time.perf_counter() - t0), 1)
+        bitmap, cont, offs, lits, n_new, n_match = (np.asarray(x) for x in enc(dev))
+        comp_bytes = sum(
+            2 + 2 * ((n_groups + 7) // 8) + 2 * int(n_new[i])
+            + tlz.GROUP * (n_groups - int(n_match[i]))
+            for i in range(B)
+        )
+        out["tpu_tlz_terasort_ratio"] = round(B * L / comp_bytes, 3)
+
+        is_match = np.unpackbits(bitmap, axis=1, count=n_groups, bitorder="little").astype(bool)
+        is_cont = np.unpackbits(cont, axis=1, count=n_groups, bitorder="little").astype(bool)
+        dm = jax.device_put(is_match)
+        dc = jax.device_put(is_cont)
+        do = jax.device_put(offs.astype(np.int32))
+        dl = jax.device_put(lits)
+
+        # decode rate: same delta-of-scan-lengths trick; lits are XOR-mutated
+        # per iteration so the loop body cannot be hoisted
+        def dec_loop(length):
+            looped = jax.jit(
+                lambda m, c, o, l: jax.lax.scan(
+                    lambda carry, _: (
+                        carry ^ jnp.uint8(1),
+                        tlz._decode_math(m, c, o, carry, n_groups)[:, ::997],
+                    ),
+                    l,
+                    None,
+                    length=length,
+                )[1]
+            )
+            r = looped(dm, dc, do, dl)
+            r.block_until_ready()  # compile
+            t0 = time.perf_counter()
+            r = looped(dm, dc, do, dl)
+            r.block_until_ready()
+            return time.perf_counter() - t0
+
+        t1 = dec_loop(N1)
+        t2 = dec_loop(N2)
+        out["tpu_tlz_decode_mb_s"] = round(
+            (N2 - N1) * B * L / 1e6 / max(t2 - t1, 1e-9), 1
+        )
+
+        # decode correctness on-device: matches the staged input exactly
+        d = np.asarray(tlz._decode_kernel(n_groups)(dm, dc, do, dl))
+        if not (d == batch).all():
+            out["tpu_probe_error"] = "device decode(encode(x)) != x"
+            return out
 
         t0 = time.perf_counter()
-        _ = np.asarray(r)  # (B,) uint32 result fetch — latency-bound
+        _ = np.asarray(enc_outs[0])  # small result fetch — latency-bound
         out["d2h_result_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
     except Exception as e:  # never fail the bench over the TPU probe
-        out["tpu_probe_error"] = str(e)[:120]
+        out["tpu_probe_error"] = str(e)[:160]
     return out
 
 
 def main():
     parts = gen_partitions()
-    native_bps, native_s, zlib_bps, zlib_s, ratios = run_comparison(parts)
-    extras = {**ratios, **write_cpu_comparison(parts), **device_kernel_rates()}
+    bps, walls, ratios = run_comparison(parts)
+    extras = {
+        **ratios,
+        **write_cpu_comparison(parts),
+        **aggregate_multiworker(parts),
+        **device_kernel_rates(),
+    }
     result = {
         "metric": "shuffle bytes/sec/chip (write+read), terasort-style, native codec",
-        "value": round(native_bps / 1e6, 2),
+        "value": round(bps["native"] / 1e6, 2),
         "unit": "MB/s",
-        "vs_baseline": round(native_bps / zlib_bps, 3),
+        "vs_baseline": round(bps["native"] / bps["zlib"], 3),
         "baseline": "same shuffle through zlib-1 (JVM LZ4-class CPU codec stand-in)",
-        "native_wall_s": round(native_s, 2),
-        "zlib_wall_s": round(zlib_s, 2),
+        "vs_lz4": round(bps["native"] / bps["lz4"], 3),
+        "native_wall_s": round(walls["native"], 2),
+        "zlib_wall_s": round(walls["zlib"], 2),
+        "lz4_wall_s": round(walls["lz4"], 2),
         "shuffle_mb": round(N_MAPS * RECORDS_PER_MAP * (KEY_BYTES + VALUE_BYTES + 8) / 1e6, 1),
         **extras,
     }

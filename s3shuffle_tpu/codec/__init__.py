@@ -52,6 +52,10 @@ def get_codec(
         from s3shuffle_tpu.codec.native import NativeLZCodec
 
         return NativeLZCodec(block_size=block_size)
+    if name == "lz4":
+        from s3shuffle_tpu.codec.native import NativeLZ4Codec
+
+        return NativeLZ4Codec(block_size=block_size)
     if name == "tpu":
         from s3shuffle_tpu.codec.tpu import TpuCodec
 

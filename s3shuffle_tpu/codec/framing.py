@@ -39,6 +39,7 @@ CODEC_IDS = {
     "zstd": 2,
     "native-lz": 3,
     "tpu-lz": 4,
+    "lz4": 5,
 }
 _NAMES = {v: k for k, v in CODEC_IDS.items()}
 
@@ -387,7 +388,9 @@ def decompress_frame_payload(
         raise IOError(f"Unknown codec id in frame: {codec_id}")
     from s3shuffle_tpu.codec import get_codec
 
-    codec = get_codec({"native-lz": "native", "tpu-lz": "tpu", "zlib": "zlib", "zstd": "zstd"}[name])
+    codec = get_codec(
+        {"native-lz": "native", "tpu-lz": "tpu", "zlib": "zlib", "zstd": "zstd", "lz4": "lz4"}[name]
+    )
     assert codec is not None
     return codec.decompress_block(payload, ulen)
 

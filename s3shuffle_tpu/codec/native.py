@@ -71,6 +71,19 @@ def _load() -> ctypes.CDLL:
         lib.slz_compress_framed.argtypes = [
             u8p, ctypes.c_int64, ctypes.c_int64, ctypes.c_uint8, u8p,
         ]
+        # the LZ4 block-format codec mirrors the SLZ entry points
+        lib.lz4_compress.restype = ctypes.c_size_t
+        lib.lz4_compress.argtypes = [u8p, ctypes.c_size_t, u8p, ctypes.c_size_t]
+        lib.lz4_decompress.restype = ctypes.c_size_t
+        lib.lz4_decompress.argtypes = [u8p, ctypes.c_size_t, u8p, ctypes.c_size_t]
+        lib.lz4_compress_batch.restype = None
+        lib.lz4_compress_batch.argtypes = [u8p, i64p, ctypes.c_int64, u8p, i64p, i64p]
+        lib.lz4_decompress_batch.restype = None
+        lib.lz4_decompress_batch.argtypes = [u8p, i64p, ctypes.c_int64, u8p, i64p, i64p]
+        lib.lz4_compress_framed.restype = ctypes.c_int64
+        lib.lz4_compress_framed.argtypes = [
+            u8p, ctypes.c_int64, ctypes.c_int64, ctypes.c_uint8, u8p,
+        ]
         _lib = lib
         return lib
 
@@ -158,10 +171,19 @@ class NativeLZCodec(FrameCodec):
     name = "native-lz"
     codec_id = CODEC_IDS["native-lz"]
     batch_blocks = 64
+    #: native symbol family ({prefix}_compress, _decompress, _compress_batch,
+    #: _decompress_batch, _compress_framed) — NativeLZ4Codec swaps it
+    _prefix = "slz"
 
     def __init__(self, block_size: int = 64 * 1024):
         super().__init__(block_size)
         self._lib = _load()
+        pre = self._prefix
+        self._c_compress = getattr(self._lib, f"{pre}_compress")
+        self._c_decompress = getattr(self._lib, f"{pre}_decompress")
+        self._c_compress_batch = getattr(self._lib, f"{pre}_compress_batch")
+        self._c_decompress_batch = getattr(self._lib, f"{pre}_decompress_batch")
+        self._c_compress_framed = getattr(self._lib, f"{pre}_compress_framed")
 
     def compress_block(self, data: bytes) -> bytes:
         n = len(data)
@@ -170,7 +192,7 @@ class NativeLZCodec(FrameCodec):
         src = ctypes.cast(ctypes.c_char_p(data), ctypes.POINTER(ctypes.c_uint8))
         cap = n  # if it doesn't shrink, framing stores raw
         dst = ctypes.create_string_buffer(max(1, cap))
-        clen = self._lib.slz_compress(
+        clen = self._c_compress(
             src, n, ctypes.cast(dst, ctypes.POINTER(ctypes.c_uint8)), cap
         )
         if clen == 0:
@@ -180,12 +202,13 @@ class NativeLZCodec(FrameCodec):
     def decompress_block(self, data: bytes, uncompressed_len: int) -> bytes:
         src = ctypes.cast(ctypes.c_char_p(data), ctypes.POINTER(ctypes.c_uint8))
         dst = ctypes.create_string_buffer(max(1, uncompressed_len))
-        n = self._lib.slz_decompress(
+        n = self._c_decompress(
             src, len(data), ctypes.cast(dst, ctypes.POINTER(ctypes.c_uint8)), uncompressed_len
         )
         if n != uncompressed_len:
             raise IOError(
-                f"SLZ decompression produced {n} bytes, expected {uncompressed_len}"
+                f"{self.name} decompression produced {n} bytes, "
+                f"expected {uncompressed_len}"
             )
         return ctypes.string_at(dst, uncompressed_len)
 
@@ -205,7 +228,7 @@ class NativeLZCodec(FrameCodec):
         src = np.frombuffer(buf, dtype=np.uint8, count=n_blocks * block_size)
         src = np.ascontiguousarray(src)
         dst = np.empty(n_blocks * (block_size + 9), dtype=np.uint8)
-        total = self._lib.slz_compress_framed(
+        total = self._c_compress_framed(
             src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
             n_blocks,
             block_size,
@@ -235,7 +258,7 @@ class NativeLZCodec(FrameCodec):
         # shrink and framing's raw escape stores the original
         dst = np.empty(int(src_off[-1]), dtype=np.uint8)
         out_sizes = np.zeros(n, dtype=np.int64)
-        self._lib.slz_compress_batch(
+        self._c_compress_batch(
             src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
             src_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
             n,
@@ -293,7 +316,7 @@ class NativeLZCodec(FrameCodec):
         np.cumsum(ulens, out=dst_off[1:])
         dst = np.empty(int(dst_off[-1]) + 16, dtype=np.uint8)
         out_sizes = np.zeros(n, dtype=np.int64)
-        self._lib.slz_decompress_batch(
+        self._c_decompress_batch(
             src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
             src_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
             n,
@@ -304,7 +327,7 @@ class NativeLZCodec(FrameCodec):
         if not (out_sizes == ulens).all():
             bad = int(np.nonzero(out_sizes != ulens)[0][0])
             raise IOError(
-                f"SLZ batch decompression: block {bad} produced "
+                f"{self.name} batch decompression: block {bad} produced "
                 f"{int(out_sizes[bad])} bytes, expected {int(ulens[bad])}"
             )
         return dst, dst_off
@@ -313,15 +336,27 @@ class NativeLZCodec(FrameCodec):
     # numpy batch paths (used by the TPU host pipeline and benchmarks)
     # ------------------------------------------------------------------
     def crc32c_batch(self, concat: np.ndarray, offsets: np.ndarray) -> np.ndarray:
-        lib = self._lib
         concat = np.ascontiguousarray(concat, dtype=np.uint8)
         offsets = np.ascontiguousarray(offsets, dtype=np.int64)
         count = len(offsets) - 1
         out = np.zeros(count, dtype=np.uint32)
-        lib.slz_crc32c_batch(
+        self._lib.slz_crc32c_batch(
             concat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
             offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
             count,
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
         )
         return out
+
+
+class NativeLZ4Codec(NativeLZCodec):
+    """The LZ4 *block format* (public interchange format) behind the shared
+    framing — the measured "real LZ4" baseline for the north-star gate
+    (BASELINE.md: ≥3x lower write CPU vs JVM LZ4 at equal-or-better ratio)
+    and an interchange codec: frame payloads decode with any standard LZ4
+    implementation. Same greedy hash-chain matcher as SLZ, standard LZ4
+    sequence encoding and end-of-block rules (native/src: lz4_compress)."""
+
+    name = "lz4"
+    codec_id = CODEC_IDS["lz4"]
+    _prefix = "lz4"

@@ -307,6 +307,197 @@ size_t slz_decompress(const uint8_t* src, size_t n, uint8_t* dst, size_t ulen) {
 }
 
 // ---------------------------------------------------------------------------
+// LZ4 block format (the public interchange format; spec: token byte with
+// literal-length high nibble and matchlength-4 low nibble, 15 ⇒ 255-run
+// extension bytes; literals; u16le match offset 1..65535; matches ≥ 4 bytes
+// and may overlap). This is the "real LZ4" baseline the north star measures
+// against (BASELINE.md: ≥3x lower write CPU vs JVM LZ4 at equal-or-better
+// ratio) and an interchange codec: blocks produced here decode with any
+// standard LZ4 implementation and vice versa. End-of-block rules honored:
+// the last match starts ≥ 12 bytes before the end and never covers the
+// final 5 bytes, which are always literals.
+// ---------------------------------------------------------------------------
+
+size_t lz4_compress(const uint8_t* src, size_t n, uint8_t* dst, size_t cap) {
+    if (n == 0) return 0;
+    uint8_t* op = dst;
+    uint8_t* oend = dst + cap;
+    const uint8_t* ip = src;
+    const uint8_t* anchor = src;
+    const uint8_t* iend = src + n;
+    const uint8_t* mflimit = (n > 12) ? iend - 12 : src;
+
+    uint32_t table[1u << HASH_BITS];
+    memset(table, 0xFF, sizeof(table));
+
+    size_t search_accel = 1 << 6;
+    while (ip < mflimit) {
+        uint32_t h = hash4(load32(ip));
+        uint32_t cand = table[h];
+        table[h] = (uint32_t)(ip - src);
+        if (cand != 0xFFFFFFFFu) {
+            const uint8_t* cp = src + cand;
+            if ((size_t)(ip - cp) <= 0xFFFF && load32(cp) == load32(ip)) {
+                // matches must leave the final 5 bytes as literals
+                size_t limit = (size_t)(iend - 5 - ip);
+                size_t mlen =
+                    MIN_MATCH + match_length(ip + MIN_MATCH, cp + MIN_MATCH,
+                                             limit - MIN_MATCH);
+                size_t llen = (size_t)(ip - anchor);
+                if (op + 1 + llen / 255 + 1 + llen + 2 > oend) return 0;
+                uint8_t* token = op++;
+                if (llen >= 15) {
+                    *token = 15u << 4;
+                    size_t rem = llen - 15;
+                    while (rem >= 255) { *op++ = 255; rem -= 255; }
+                    *op++ = (uint8_t)rem;
+                } else {
+                    *token = (uint8_t)(llen << 4);
+                }
+                memcpy(op, anchor, llen);
+                op += llen;
+                uint16_t off = (uint16_t)(ip - cp);
+                *op++ = (uint8_t)(off & 0xFF);
+                *op++ = (uint8_t)(off >> 8);
+                size_t mcode = mlen - MIN_MATCH;
+                if (mcode >= 15) {
+                    *token |= 15;
+                    mcode -= 15;
+                    while (mcode >= 255) {
+                        if (op >= oend) return 0;
+                        *op++ = 255;
+                        mcode -= 255;
+                    }
+                    if (op >= oend) return 0;
+                    *op++ = (uint8_t)mcode;
+                } else {
+                    *token |= (uint8_t)mcode;
+                }
+                const uint8_t* seed_end = (ip + mlen < mflimit) ? ip + mlen : mflimit;
+                size_t step = mlen <= 32 ? 2 : 8;
+                for (const uint8_t* s = ip + 1; s < seed_end; s += step)
+                    table[hash4(load32(s))] = (uint32_t)(s - src);
+                ip += mlen;
+                anchor = ip;
+                search_accel = 1 << 6;
+                continue;
+            }
+        }
+        ip += (search_accel++ >> 6);
+    }
+    // final literal run (covers the ≥5 trailing literal bytes rule)
+    size_t llen = (size_t)(iend - anchor);
+    if (op + 1 + llen / 255 + 1 + llen > oend) return 0;
+    uint8_t* token = op++;
+    if (llen >= 15) {
+        *token = 15u << 4;
+        size_t rem = llen - 15;
+        while (rem >= 255) { *op++ = 255; rem -= 255; }
+        *op++ = (uint8_t)rem;
+    } else {
+        *token = (uint8_t)(llen << 4);
+    }
+    memcpy(op, anchor, llen);
+    op += llen;
+    return (size_t)(op - dst);
+}
+
+size_t lz4_decompress(const uint8_t* src, size_t n, uint8_t* dst, size_t ulen) {
+    const uint8_t* ip = src;
+    const uint8_t* iend = src + n;
+    uint8_t* op = dst;
+    uint8_t* oend = dst + ulen;
+
+    while (ip < iend) {
+        uint8_t token = *ip++;
+        size_t llen = token >> 4;
+        if (llen == 15) {
+            uint8_t b;
+            do {
+                if (ip >= iend) return 0;
+                b = *ip++;
+                llen += b;
+            } while (b == 255);
+        }
+        if (llen > (size_t)(iend - ip) || llen > (size_t)(oend - op)) return 0;
+        memcpy(op, ip, llen);
+        op += llen;
+        ip += llen;
+        if (ip >= iend) break;  // last sequence: literals only
+        if (ip + 2 > iend) return 0;
+        size_t off = (size_t)(ip[0] | (ip[1] << 8));
+        ip += 2;
+        size_t mlen = (size_t)(token & 15);
+        if (mlen == 15) {
+            uint8_t b;
+            do {
+                if (ip >= iend) return 0;
+                b = *ip++;
+                mlen += b;
+            } while (b == 255);
+        }
+        mlen += MIN_MATCH;
+        if (off == 0 || (size_t)(op - dst) < off || mlen > (size_t)(oend - op)) return 0;
+        const uint8_t* match = op - off;
+        if (off >= 8) {
+            size_t i = 0;
+            for (; i + 8 <= mlen; i += 8) memcpy(op + i, match + i, 8);
+            for (; i < mlen; i++) op[i] = match[i];
+            op += mlen;
+        } else {
+            for (size_t i = 0; i < mlen; i++) *op++ = *match++;
+        }
+    }
+    return (size_t)(op - dst);
+}
+
+void lz4_compress_batch(const uint8_t* src, const int64_t* src_offsets, int64_t count,
+                        uint8_t* dst, const int64_t* dst_offsets, int64_t* out_sizes) {
+    for (int64_t i = 0; i < count; i++) {
+        size_t n = (size_t)(src_offsets[i + 1] - src_offsets[i]);
+        size_t cap = (size_t)(dst_offsets[i + 1] - dst_offsets[i]);
+        out_sizes[i] = (int64_t)lz4_compress(src + src_offsets[i], n, dst + dst_offsets[i], cap);
+    }
+}
+
+void lz4_decompress_batch(const uint8_t* src, const int64_t* src_offsets, int64_t count,
+                          uint8_t* dst, const int64_t* dst_offsets, int64_t* out_sizes) {
+    for (int64_t i = 0; i < count; i++) {
+        size_t n = (size_t)(src_offsets[i + 1] - src_offsets[i]);
+        size_t ulen = (size_t)(dst_offsets[i + 1] - dst_offsets[i]);
+        out_sizes[i] = (int64_t)lz4_decompress(src + src_offsets[i], n,
+                                               dst + dst_offsets[i], ulen);
+    }
+}
+
+// Framed batch compression with the LZ4 block codec — same contract as
+// slz_compress_framed.
+int64_t lz4_compress_framed(const uint8_t* src, int64_t count, int64_t block_size,
+                            uint8_t codec_id, uint8_t* dst) {
+    uint8_t* op = dst;
+    for (int64_t i = 0; i < count; i++) {
+        const uint8_t* block = src + i * block_size;
+        uint8_t* hdr = op;
+        op += 9;
+        size_t clen = lz4_compress(block, (size_t)block_size, op, (size_t)block_size - 1);
+        uint8_t cid = codec_id;
+        if (clen == 0) {
+            memcpy(op, block, (size_t)block_size);
+            clen = (size_t)block_size;
+            cid = 0;
+        }
+        uint32_t ulen32 = (uint32_t)block_size, clen32 = (uint32_t)clen;
+        hdr[0] = cid;
+        for (int k = 0; k < 4; k++) {
+            hdr[1 + k] = (uint8_t)(ulen32 >> (8 * k));
+            hdr[5 + k] = (uint8_t)(clen32 >> (8 * k));
+        }
+        op += clen;
+    }
+    return (int64_t)(op - dst);
+}
+
+// ---------------------------------------------------------------------------
 // Batch entry points (one call per frame batch → fewer ctypes crossings)
 // ---------------------------------------------------------------------------
 

@@ -171,6 +171,7 @@ MODE_MATRIX = [
     dict(codec="none"),
     dict(codec="zlib"),
     dict(codec="zstd", codec_block_size=4096),
+    dict(codec="lz4"),
     dict(cleanup=False),
     dict(folder_prefixes=1),
     dict(buffer_size=7),  # pathological buffering
@@ -183,6 +184,11 @@ MODE_MATRIX = [
 def test_mode_matrix_fold_by_key(tmp_path, overrides):
     # The reference only flips these via CI env (ci.yml:52-65); here the whole
     # matrix runs as one parametrized correctness sweep.
+    if overrides.get("codec") == "lz4":
+        from s3shuffle_tpu.codec.native import native_available
+
+        if not native_available():
+            pytest.skip("native toolchain unavailable (pure-python job)")
     parts = kv_partitions(3, 400, 15, seed=3)
     expected = collections.Counter()
     for part in parts:
