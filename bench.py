@@ -336,17 +336,25 @@ def _device_kernel_rates_impl():
             jax.tree_util.tree_map(lambda x: x.block_until_ready(), r)
             return time.perf_counter() - t0, r
 
-        def delta_rate(body):
+        def delta_rate(body, metric):
+            """Writes `metric` only when the delta is trustworthy: a jitter
+            spike making t2 <= t1 would otherwise publish an absurd rate
+            indistinguishable from a real measurement."""
             t1, _ = timed_loop(body, N1)
             t2, r = timed_loop(body, N2)
-            dt = max(t2 - t1, 1e-9)
-            return round((N2 - N1) * B * L / 1e6 / dt, 1), r
+            dt = t2 - t1
+            if dt > 1e-6:
+                out[metric] = round((N2 - N1) * B * L / 1e6 / dt, 1)
+            else:
+                out[f"{metric}_error"] = (
+                    f"timing jitter (t{N1}={t1:.3f}s, t{N2}={t2:.3f}s)"
+                )
+            return r
 
-        out["tpu_crc32c_mb_s"], _r = delta_rate(
-            lambda d: _crc_math(d, w, L)
-        )
-        out["tpu_tlz_encode_mb_s"], enc_outs = delta_rate(
-            lambda d: tlz._encode_math(d, n_groups)[4:6]  # (n_new, n_match)
+        delta_rate(lambda d: _crc_math(d, w, L), "tpu_crc32c_mb_s")
+        enc_outs = delta_rate(
+            lambda d: tlz._encode_math(d, n_groups)[4:6],  # (n_new, n_match)
+            "tpu_tlz_encode_mb_s",
         )
 
         # ratio + correctness from one untimed encode/decode round trip
@@ -389,9 +397,12 @@ def _device_kernel_rates_impl():
 
         t1 = dec_loop(N1)
         t2 = dec_loop(N2)
-        out["tpu_tlz_decode_mb_s"] = round(
-            (N2 - N1) * B * L / 1e6 / max(t2 - t1, 1e-9), 1
-        )
+        if t2 - t1 > 1e-6:
+            out["tpu_tlz_decode_mb_s"] = round((N2 - N1) * B * L / 1e6 / (t2 - t1), 1)
+        else:
+            out["tpu_tlz_decode_mb_s_error"] = (
+                f"timing jitter (t{N1}={t1:.3f}s, t{N2}={t2:.3f}s)"
+            )
 
         # decode correctness on-device: matches the staged input exactly
         d = np.asarray(tlz._decode_kernel(n_groups)(dm, dc, do, dl))

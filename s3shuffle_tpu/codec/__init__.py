@@ -3,8 +3,10 @@
 The reference delegates compression to Spark's codec streams
 (``spark.io.compression.*`` — SURVEY.md §0, §7.1); this framework owns the
 codec seam so it can be offloaded: ``none``/``zlib``/``zstd`` (CPU, stdlib),
-``native`` (C++ LZ-class, :mod:`s3shuffle_tpu.codec.native`), and ``tpu``
-(batched Pallas kernels, :mod:`s3shuffle_tpu.codec.tpu`). All codecs share the
+``native`` (C++ SLZ, :mod:`s3shuffle_tpu.codec.native`), ``lz4`` (C++
+implementation of the public LZ4 block format — the measured real-LZ4
+baseline and an interchange codec), and ``tpu`` (batched device kernels,
+:mod:`s3shuffle_tpu.codec.tpu`). All codecs share the
 concatenatable block framing in :mod:`s3shuffle_tpu.codec.framing`, which is
 what makes batch fetch legal (the reference requires a concatenatable codec
 for batch reads — S3ShuffleReader.scala:55-75).

@@ -388,9 +388,9 @@ def decompress_frame_payload(
         raise IOError(f"Unknown codec id in frame: {codec_id}")
     from s3shuffle_tpu.codec import get_codec
 
-    codec = get_codec(
-        {"native-lz": "native", "tpu-lz": "tpu", "zlib": "zlib", "zstd": "zstd", "lz4": "lz4"}[name]
-    )
+    # frame-name → registry-name: only two names are genuinely aliased;
+    # every other codec registers under its frame name
+    codec = get_codec({"native-lz": "native", "tpu-lz": "tpu"}.get(name, name))
     assert codec is not None
     return codec.decompress_block(payload, ulen)
 

@@ -74,7 +74,7 @@ class ShuffleConfig:
     checksum_enabled: bool = True
     checksum_algorithm: str = "ADLER32"  # ADLER32 | CRC32 | CRC32C
     # --- codec (TPU-first addition; reference delegates to Spark codec streams) ---
-    codec: str = "auto"  # none | zlib | zstd | native | tpu | auto
+    codec: str = "auto"  # none | zlib | zstd | native | lz4 | tpu | auto
     codec_block_size: int = 64 * 1024
     codec_level: int = 1
     tpu_batch_blocks: int = 256  # blocks staged per device round-trip
