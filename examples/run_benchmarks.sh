@@ -41,7 +41,7 @@ done
 echo ">>> real query execution (verified join/aggregate/rank pipelines)" >&2
 for codec in $CODECS; do
   python examples/sql_queries.py --query all --sf "${SQL_SF:-1}" \
-    --codec "$codec" --workers "$WORKERS" >> "$OUT"
+    --codec "$codec" --workers "$WORKERS" "${ROOT_ARG[@]}" >> "$OUT"
 done
 
 echo "results in $OUT" >&2

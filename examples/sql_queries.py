@@ -298,16 +298,24 @@ QUERIES = {"q5": q5, "q49": q49, "q75": q75, "q67": q67}
 
 
 def run_query(name: str, sf: float, codec: str, workers: int, verify: bool,
-              root: str | None = None) -> dict:
+              root: str | None = None, root_uri: str | None = None) -> dict:
+    """``root`` is a caller-owned local directory (tests); ``root_uri`` a
+    storage root URI (file://, memory://, s3://, ...) so the sweep can point
+    the query pipelines at a real object store like its sibling workloads."""
+    import uuid as _uuid
+
     from s3shuffle_tpu.config import ShuffleConfig
     from s3shuffle_tpu.shuffle import ShuffleContext
     from s3shuffle_tpu.storage.dispatcher import Dispatcher
 
-    tmp = root or tempfile.mkdtemp(prefix=f"s3shuffle-sql-{name}-")
+    tmp = None
+    if root_uri:
+        root_dir = f"{root_uri.rstrip('/')}/sql-{name}-{_uuid.uuid4().hex[:8]}"
+    else:
+        tmp = root or tempfile.mkdtemp(prefix=f"s3shuffle-sql-{name}-")
+        root_dir = f"file://{tmp}"
     Dispatcher.reset()
-    cfg = ShuffleConfig(
-        root_dir=f"file://{tmp}", app_id=f"sql-{name}", codec=codec
-    )
+    cfg = ShuffleConfig(root_dir=root_dir, app_id=f"sql-{name}", codec=codec)
     items, sales, returns = gen_tables(sf)
     try:
         with ShuffleContext(config=cfg, num_workers=workers) as ctx:
@@ -333,7 +341,7 @@ def run_query(name: str, sf: float, codec: str, workers: int, verify: bool,
             "verified": bool(verify),
         }
     finally:
-        if root is None:
+        if root is None and tmp is not None:
             shutil.rmtree(tmp, ignore_errors=True)
 
 
@@ -347,11 +355,15 @@ def main(argv=None) -> int:
     ap.add_argument("--no-verify", action="store_true",
                     help="skip the single-process reference check "
                          "(use at large --sf)")
+    ap.add_argument("--root", default=None,
+                    help="storage root URI (file://, s3://, ...; "
+                         "default: local temp dir)")
     args = ap.parse_args(argv)
     names = list(QUERIES) if args.query == "all" else [args.query]
     for name in names:
         out = run_query(
-            name, args.sf, args.codec, args.workers, verify=not args.no_verify
+            name, args.sf, args.codec, args.workers,
+            verify=not args.no_verify, root_uri=args.root,
         )
         print(json.dumps(out))
     return 0

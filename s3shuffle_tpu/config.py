@@ -81,6 +81,11 @@ class ShuffleConfig:
     # --- misc ---
     app_id: str = "app"
     supports_rename: bool | None = None  # None → probe backend
+    # Driver options passed to the object-store client (fsspec storage
+    # options: credentials, endpoint_url, multipart sizing ...). The analog
+    # of the reference delegating S3A tuning to Hadoop FS config
+    # (README.md:146-178). NEVER logged or repr'd (may hold secrets).
+    storage_options: dict = dataclasses.field(default_factory=dict, repr=False)
 
     def __post_init__(self) -> None:
         if self.folder_prefixes < 1:
@@ -127,6 +132,12 @@ class ShuffleConfig:
         (helper/S3ShuffleDispatcher.scala:81-102) — the only way to know what a
         run actually did."""
         for f in dataclasses.fields(self):
+            if f.name == "storage_options":
+                # keys only — values may hold credentials
+                logger.info(
+                    "config: storage_options keys=%r", sorted(self.storage_options)
+                )
+                continue
             logger.info("config: %s=%r", f.name, getattr(self, f.name))
 
     @property
@@ -144,4 +155,11 @@ def _coerce(value: Any, typ: Any) -> Any:
         from s3shuffle_tpu.utils import parse_size
 
         return parse_size(value)
+    if "dict" in typ:
+        import json as _json
+
+        parsed = _json.loads(value)
+        if not isinstance(parsed, dict):
+            raise ValueError(f"expected a JSON object, got {type(parsed).__name__}")
+        return parsed
     return value

@@ -209,9 +209,11 @@ _memory_backends: Dict[str, MemoryBackend] = {}
 _registry_lock = threading.Lock()
 
 
-def get_backend(root_dir: str) -> StorageBackend:
+def get_backend(root_dir: str, storage_options: Dict | None = None) -> StorageBackend:
     """Pick a backend from the root URI scheme, like the reference's
-    ``FileSystem.get(rootDir URI, hadoopConf)`` (S3ShuffleDispatcher.scala:72-76)."""
+    ``FileSystem.get(rootDir URI, hadoopConf)`` (S3ShuffleDispatcher.scala:72-76).
+    ``storage_options`` are passed to the fsspec driver (credentials,
+    endpoint_url, ... — the Hadoop-FS-config analog)."""
     scheme = root_dir.split("://", 1)[0] if "://" in root_dir else "file"
     if scheme == "file":
         from s3shuffle_tpu.storage.local import LocalBackend
@@ -228,4 +230,4 @@ def get_backend(root_dir: str) -> StorageBackend:
             return backend
     from s3shuffle_tpu.storage.fsspec_backend import FsspecBackend
 
-    return FsspecBackend(scheme)
+    return FsspecBackend(scheme, **(storage_options or {}))

@@ -41,7 +41,9 @@ class Dispatcher:
 
     def __init__(self, config: ShuffleConfig):
         self.config = config
-        self.backend: StorageBackend = get_backend(config.root_dir)
+        self.backend: StorageBackend = get_backend(
+            config.root_dir, config.storage_options
+        )
         self.app_id = config.app_id
         self._status_cache: ConcurrentObjectMap[str, FileStatus] = ConcurrentObjectMap()
         # Callbacks run on reinitialize() so dependent caches (e.g. the

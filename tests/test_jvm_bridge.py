@@ -1,0 +1,45 @@
+"""Cross-language conformance: the real JVM client (examples/jvm/
+CodecBridgeClient.java) round-trips compress/decompress/CRC batches through
+the codec bridge and verifies checksums against java.util.zip — the
+SURVEY.md §7.2(7) Spark-interop proof. Skips when no JDK is present
+(CI runs it under setup-java; the TPU rig has no JVM)."""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+from s3shuffle_tpu.bridge import CodecBridgeServer
+
+java = shutil.which("java")
+# opt-in env gate (like the MinIO suite): GitHub's base runner images ship a
+# JDK, so a PATH-only gate would redundantly run this 120s subprocess test in
+# every unit-matrix job rather than just the dedicated jvm-bridge job
+pytestmark = pytest.mark.skipif(
+    java is None or not os.environ.get("S3SHUFFLE_TEST_JVM"),
+    reason="JDK absent or S3SHUFFLE_TEST_JVM not set",
+)
+
+
+def _bridge_codec() -> str:
+    from s3shuffle_tpu.codec.native import native_available
+
+    return "native" if native_available() else "zlib"
+
+
+def test_jvm_client_roundtrip_and_checksums():
+    srv = CodecBridgeServer(port=0, codec_name=_bridge_codec()).start()
+    try:
+        # JDK 11+ single-file source launch — no separate compile step
+        r = subprocess.run(
+            [java, "examples/jvm/CodecBridgeClient.java", "127.0.0.1", str(srv.port)],
+            capture_output=True,
+            text=True,
+            timeout=120,
+            cwd=__file__.rsplit("/tests/", 1)[0],
+        )
+        assert r.returncode == 0, f"java client failed:\n{r.stdout}\n{r.stderr}"
+        assert "JVM BRIDGE OK" in r.stdout
+    finally:
+        srv.stop()
