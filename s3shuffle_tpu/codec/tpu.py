@@ -43,7 +43,12 @@ class TpuCodec(FrameCodec):
     name = "tpu-lz"
     codec_id = CODEC_IDS["tpu-lz"]
 
-    def __init__(self, block_size: int = 64 * 1024, batch_blocks: int = 256):
+    def __init__(
+        self,
+        block_size: int = 64 * 1024,
+        batch_blocks: int = 256,
+        use_device: bool | None = None,
+    ):
         if block_size % 128 != 0:
             raise ValueError("TPU codec block_size must be a multiple of 128")
         if block_size > tlz.MAX_BLOCK:
@@ -52,6 +57,28 @@ class TpuCodec(FrameCodec):
             )
         super().__init__(block_size)
         self.batch_blocks = batch_blocks
+        self._use_device = use_device
+
+    def _device_path(self) -> bool:
+        """Batch work goes to the device only when an accelerator backend is
+        actually attached — XLA:CPU runs the sort/gather kernels orders of
+        magnitude slower than the vectorized numpy path, and readers of
+        tpu-lz data are often plain CPU hosts. Overridable per instance
+        (``use_device=``) or via S3SHUFFLE_TPU_CODEC_DEVICE=0/1."""
+        if self._use_device is None:
+            import os
+
+            env = os.environ.get("S3SHUFFLE_TPU_CODEC_DEVICE")
+            if env is not None:
+                self._use_device = env.strip().lower() in ("1", "true", "yes", "on")
+            else:
+                try:
+                    import jax
+
+                    self._use_device = jax.default_backend() not in ("cpu",)
+                except Exception:
+                    self._use_device = False
+        return self._use_device
 
     # --- single block (short tails / compatibility path: numpy) ---
     def compress_block(self, data: bytes) -> bytes:
@@ -60,15 +87,16 @@ class TpuCodec(FrameCodec):
     def decompress_block(self, data: bytes, uncompressed_len: int) -> bytes:
         return tlz.decode_payload_numpy(data, uncompressed_len)
 
-    # --- batch (device) ---
+    # --- batch (device, with a vectorized-numpy host fallback) ---
     def compress_blocks(self, blocks: List[bytes]) -> List[bytes]:
         full = [b for b in blocks if len(b) == self.block_size]
-        if not full:
+        if not full or not self._device_path():
             return [self.compress_block(b) for b in blocks]
-        encoded = tlz.encode_blocks_device(blocks, self.block_size)
-        return encoded
+        return tlz.encode_blocks_device(blocks, self.block_size)
 
     def decompress_blocks(self, blocks) -> List[bytes]:
+        if not self._device_path():
+            return [self.decompress_block(b, n) for b, n in blocks]
         payloads = [b for b, _n in blocks]
         ulens = [n for _b, n in blocks]
         return tlz.decode_blocks_device(payloads, ulens, self.block_size)

@@ -402,18 +402,25 @@ def decode_payload_numpy(payload: bytes, uncompressed_len: int) -> bytes:
     sparse[~is_match] = lits.reshape(n_lits, GROUP)
     sparse = sparse.reshape(-1)
     # per-byte source map: literal bytes are fixed points; match bytes point
-    # at offset + lane. Pointer jumping resolves chains (sources strictly
-    # precede their destinations, so log2 rounds reach literal bytes).
-    src = np.arange(n_bytes, dtype=np.int64)
+    # at offset + lane. Pointer jumping (src = src[src] — the DOUBLING update;
+    # following a fixed map would advance one hop per round and never resolve
+    # long periodic chains) reaches literal bytes in log2 rounds; the host
+    # loop exits early once converged — typical data needs 2-5 rounds.
+    out = sparse
     match_groups = np.flatnonzero(is_match)
     if len(match_groups):
         lanes = np.arange(GROUP, dtype=np.int64)
         src_match = (off_full[match_groups][:, None] + lanes[None, :]).reshape(-1)
         dst_match = (group_start[match_groups][:, None] + lanes[None, :]).reshape(-1)
+        src = np.arange(n_bytes, dtype=np.int64)
         src[dst_match] = src_match
         for _ in range(_jump_rounds(n_bytes)):
-            src = src[src]
-    return sparse[src][:uncompressed_len].tobytes()
+            nxt = src[src]
+            if np.array_equal(nxt, src):
+                break
+            src = nxt
+        out = sparse[src]
+    return out[:uncompressed_len].tobytes()
 
 
 def _unpack_bits_math(bitmap_u8, n_groups: int):

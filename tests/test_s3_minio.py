@@ -82,6 +82,28 @@ def test_backend_ops_against_real_s3(cfg):
     assert d.backend.list_prefix(cfg.root_dir + "probe") == []
 
 
+def test_multipart_write_and_ranged_reads_on_s3(cfg):
+    """A 12 MiB object crosses s3fs's 5 MiB part threshold, so the streaming
+    write exercises real multipart initiate/upload-part/complete."""
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+
+    d = Dispatcher.get(cfg)
+    path = cfg.root_dir + "probe/big.bin"
+    chunk = bytes(range(256)) * 4096  # 1 MiB
+    with d.backend.create(path) as f:
+        for _ in range(12):
+            f.write(chunk)
+    st = d.backend.status(path)
+    assert st.size == 12 * len(chunk)
+    r = d.backend.open_ranged(path, size_hint=st.size)
+    # reads spanning part boundaries (5 MiB, 10 MiB)
+    for pos in (5 * 1024 * 1024 - 100, 10 * 1024 * 1024 - 7):
+        got = r.read_fully(pos, 300)
+        expect = (chunk * 13)[pos : pos + 300]
+        assert got == expect, f"ranged read at {pos} mismatched"
+    d.backend.delete(path)
+
+
 def test_end_to_end_shuffle_on_s3(cfg):
     from s3shuffle_tpu.shuffle import ShuffleContext
 

@@ -76,6 +76,28 @@ def test_tlz_corrupt_payload_raises():
         tlz.decode_payload_numpy(payload[:2] + b"\xff" * (len(payload) - 2), len(data))
 
 
+def test_tlz_long_continuation_chains_roundtrip():
+    """Period-p data creates per-byte source chains ~n/p hops long — only the
+    pointer-DOUBLING update resolves them in log2 rounds (a fixed-map walk
+    advances one hop per round and silently corrupts; caught by fuzzing)."""
+    for period in (1, 3, 7, 13):
+        pat = bytes(range(1, period + 1))
+        for n in (BS, BS * 2 + 333, 64 * 1024):
+            data = (pat * (n // period + 1))[:n]
+            payload = tlz._assemble_payload_numpy(data)
+            assert tlz.decode_payload_numpy(payload, n) == data, (period, n)
+
+
+def test_tpu_codec_host_routing_on_cpu_backend(monkeypatch):
+    """On a CPU jax backend the batch paths must route to vectorized numpy,
+    not XLA:CPU (orders of magnitude slower for the sort/gather kernels)."""
+    monkeypatch.delenv("S3SHUFFLE_TPU_CODEC_DEVICE", raising=False)
+    codec = TpuCodec(block_size=BS, batch_blocks=4)
+    assert codec._device_path() is False  # conftest pins the cpu platform
+    data = (b"route-check-1234" * 600) + os.urandom(100)
+    assert codec.decompress_bytes(codec.compress_bytes(data)) == data
+
+
 def test_legacy_v1_big_block_header_rejected_not_misdecoded():
     """A v1 payload from a >=512 KiB block has bit 15 of its group count set,
     colliding with the v2 flag — the decoder must refuse it loudly instead of
