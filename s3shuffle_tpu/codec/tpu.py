@@ -52,9 +52,7 @@ class TpuCodec(FrameCodec):
         if block_size % 128 != 0:
             raise ValueError("TPU codec block_size must be a multiple of 128")
         if block_size > tlz.MAX_BLOCK:
-            raise ValueError(
-                "TPU codec block_size must be <= 64 KiB (u16 TLZ source offsets)"
-            )
+            raise ValueError("TPU codec block_size must be <= 256 KiB")
         super().__init__(block_size)
         self.batch_blocks = batch_blocks
         self._use_device = use_device

@@ -37,11 +37,19 @@ the hardware up instead of translating LZ4:
 Wire format of one TLZ frame payload (fits the shared 9-byte frame header,
 codec_id = ``tpu-lz``):
 
-    [u16le n_groups | 0x8000]   — bit 15 set ⇒ v2 (this format)
+    [u16le n_groups | 0x8000 (| 0x4000)] — bit 15 ⇒ v2; bit 14 ⇒ packed meta
     [match bitmap ceil(n_groups/8) bytes — bit i set ⇒ group i is a match]
     [cont  bitmap ceil(n_groups/8) bytes — bit i set ⇒ off[i]=off[i-1]+8]
     [u16le src_byte_offset × n_new_matches — for matches with cont bit 0]
     [literal groups × 8 bytes (last one zero-padded to 8)]
+
+With bit 14 set, the three metadata planes (both bitmaps + offsets) are
+stored as ``[u32le clen][zlib deflate of them]`` instead — they are highly
+structured (long match runs ⇒ long bit runs, clustered offsets) and
+otherwise impose a ~3% floor on every block's size. Packing is applied only
+when it shrinks. The metadata is parsed on the HOST in both the numpy and
+device decode paths (the device kernel consumes unpacked bitmaps either
+way), so the byte-plane decode remains pure parallel gathers.
 
 v1 payloads (bit 15 clear; 16-byte groups, sources are *group indices* of
 literal groups, no cont bitmap) remain decodable on the host path. Encoders
@@ -66,8 +74,34 @@ GROUP = 8
 _V1_GROUP = 16
 #: bit 15 of the leading u16 marks the v2 format.
 V2_FLAG = 0x8000
-#: u16 byte offsets bound the window a source can address.
-MAX_BLOCK = 1 << 16
+#: bit 14 (v2 only) marks zlib-packed metadata planes.
+PACKED_FLAG = 0x4000
+#: u16 match DISTANCES bound the window a source can reach back (the same
+#: 64 KiB window as LZ4); block size is independent of it.
+MAX_DIST = (1 << 16) - 1
+#: block-size cap: pointer-jump rounds, sort length, and decode map memory
+#: scale with it. 256 KiB amortizes per-block first-occurrence literals 4x
+#: vs 64 KiB at modest extra sort cost.
+MAX_BLOCK = 1 << 18
+
+
+def _pack_meta(bitmap_b: bytes, cont_b: bytes, offs_b: bytes, n_groups: int):
+    """Assemble the header + metadata section, deflating the three metadata
+    planes when that shrinks them. Returns the payload prefix (everything
+    before the literal plane)."""
+    import zlib
+
+    meta = bitmap_b + cont_b + offs_b
+    ng_field = n_groups & 0x3FFF  # low 14 bits: consistency check only —
+    # the true count derives from the frame's uncompressed length
+    packed = zlib.compress(meta, 6)
+    if len(packed) + 4 < len(meta):
+        return (
+            np.array([ng_field | V2_FLAG | PACKED_FLAG], dtype="<u2").tobytes()
+            + np.array([len(packed)], dtype="<u4").tobytes()
+            + packed
+        )
+    return np.array([ng_field | V2_FLAG], dtype="<u2").tobytes() + meta
 
 
 def _jax():
@@ -96,9 +130,9 @@ def _jump_rounds(n_bytes: int) -> int:
 def _encode_math(blocks_u8, n_groups: int):
     """The raw (unjitted) encode computation — shared by the standalone
     jitted kernel and larger fused traces (see __graft_entry__). Returns
-    (match_bitmap, cont_bitmap, offs_compact, lits_compact, n_new, n_match)
-    where ``offs_compact[:, :n_new]`` are the stored (non-continuation)
-    match offsets and ``lits_compact[:, :n_groups - n_match]`` the literal
+    (match_bitmap, cont_bitmap, dists_compact, lits_compact, n_new, n_match)
+    where ``dists_compact[:, :n_new]`` are the stored (non-continuation)
+    match distances and ``lits_compact[:, :n_groups - n_match]`` the literal
     groups."""
     jax, jnp = _jax()
 
@@ -136,33 +170,47 @@ def _encode_math(blocks_u8, n_groups: int):
     dest = jnp.arange(n_groups, dtype=jnp.int32) * GROUP
     cand_d = jnp.take(cand, dest, axis=1).astype(jnp.int32)  # (B, G)
 
-    # verify exact equality (hash collisions ⇒ missed match, never wrong)
+    # verify exact equality (hash collisions ⇒ missed match, never wrong);
+    # matches are stored as DISTANCES (dest - src, 1..MAX_DIST) — constant
+    # along a continued run and capped at the same 64 KiB window as LZ4,
+    # which decouples block size from the u16 wire width
     safe = jnp.maximum(cand_d, 0)
-    is_match = jnp.all(window_at(safe) == groups, axis=2) & (cand_d >= 0)
-    offs = jnp.where(is_match, safe, 0)
+    cand_dist = dest[None, :] - cand_d
+    is_match = (
+        jnp.all(window_at(safe) == groups, axis=2)
+        & (cand_d >= 0)
+        & (cand_dist <= MAX_DIST)
+    )
+    dists = jnp.where(is_match, cand_dist, 0)
 
     # continuation promotion: retry each group at the previous group's
-    # source + GROUP. This (a) aligns equal-content candidates onto one
-    # chain so the cont bitmap can elide their offsets, and (b) can add
-    # matches the hash search missed. Two passes extend promotion chains
-    # far enough in practice; correctness never depends on it.
+    # distance (same distance ⇒ source advanced by GROUP). This (a) aligns
+    # equal-content candidates onto one chain so the cont bitmap can elide
+    # their offsets, and (b) can add matches the hash search missed. Two
+    # passes extend promotion chains far enough in practice; correctness
+    # never depends on it.
     for _ in range(2):
-        prev_off = jnp.concatenate(
-            [jnp.zeros((b, 1), jnp.int32), offs[:, :-1] + GROUP], axis=1
+        prev_dist = jnp.concatenate(
+            [jnp.zeros((b, 1), jnp.int32), dists[:, :-1]], axis=1
         )
         prev_match = jnp.concatenate(
             [jnp.zeros((b, 1), bool), is_match[:, :-1]], axis=1
         )
-        # prev_off < dest always holds: offs[g-1] < (g-1)*GROUP + GROUP
-        c_ok = prev_match & jnp.all(window_at(prev_off) == groups, axis=2)
-        offs = jnp.where(c_ok, prev_off, offs)
+        # source = dest - prev_dist >= 0 holds: prev_dist <= 8(g-1) < 8g
+        c_src = dest[None, :] - prev_dist
+        c_ok = (
+            prev_match
+            & (prev_dist > 0)
+            & jnp.all(window_at(jnp.maximum(c_src, 0)) == groups, axis=2)
+        )
+        dists = jnp.where(c_ok, prev_dist, dists)
         is_match = is_match | c_ok
 
-    prev_off = jnp.concatenate(
-        [jnp.zeros((b, 1), jnp.int32), offs[:, :-1] + GROUP], axis=1
+    prev_dist = jnp.concatenate(
+        [jnp.zeros((b, 1), jnp.int32), dists[:, :-1]], axis=1
     )
     prev_match = jnp.concatenate([jnp.zeros((b, 1), bool), is_match[:, :-1]], axis=1)
-    is_cont = is_match & prev_match & (offs == prev_off)
+    is_cont = is_match & prev_match & (dists == prev_dist)
     is_new = is_match & ~is_cont
     n_match = jnp.sum(is_match, axis=1, dtype=jnp.int32)
     n_new = jnp.sum(is_new, axis=1, dtype=jnp.int32)
@@ -175,7 +223,7 @@ def _encode_math(blocks_u8, n_groups: int):
     offs_compact = jnp.zeros((b, n_groups), dtype=jnp.int32)
     offs_compact = offs_compact.at[
         rows, jnp.where(is_new, new_rank, n_groups - 1)
-    ].set(jnp.where(is_new, offs, 0), mode="drop")
+    ].set(jnp.where(is_new, dists, 0), mode="drop")
     lits_compact = jnp.zeros((b, n_groups, GROUP), dtype=jnp.uint8)
     lits_compact = lits_compact.at[
         rows, jnp.where(is_match, n_groups - 1, lit_rank)
@@ -217,7 +265,7 @@ def encode_blocks_device(blocks: List[bytes], block_size: int) -> List[bytes]:
     if block_size % (8 * GROUP) != 0:
         raise ValueError("block_size must be a multiple of 64")
     if block_size > MAX_BLOCK:
-        raise ValueError("block_size must be <= 64 KiB (u16 source offsets)")
+        raise ValueError("block_size must be <= 256 KiB")
     n_groups = block_size // GROUP
     b = len(blocks)
     staged = np.zeros((b, block_size), dtype=np.uint8)
@@ -228,7 +276,6 @@ def encode_blocks_device(blocks: List[bytes], block_size: int) -> List[bytes]:
         np.asarray(x) for x in _encode_kernel(n_groups)(staged)
     )
     out: List[bytes] = []
-    header = np.array([n_groups | V2_FLAG], dtype="<u2").tobytes()
     for i, blk in enumerate(blocks):
         used_groups = (len(blk) + GROUP - 1) // GROUP
         if used_groups < n_groups:
@@ -236,13 +283,12 @@ def encode_blocks_device(blocks: List[bytes], block_size: int) -> List[bytes]:
             payload = _assemble_payload_numpy(blk)
         else:
             nn, nm = int(n_new[i]), int(n_match[i])
-            payload = (
-                header
-                + bitmap[i].tobytes()
-                + cont[i].tobytes()
-                + offs[i, :nn].astype("<u2").tobytes()
-                + lits[i, : n_groups - nm].tobytes()
-            )
+            payload = _pack_meta(
+                bitmap[i].tobytes(),
+                cont[i].tobytes(),
+                offs[i, :nn].astype("<u2").tobytes(),
+                n_groups,
+            ) + lits[i, : n_groups - nm].tobytes()
         out.append(payload)
     return out
 
@@ -279,58 +325,104 @@ def _assemble_payload_numpy(data: bytes) -> bytes:
     cand_sorted = np.where(prev_same, prev_pos, -1)
     cand = np.zeros(n_pos, dtype=np.int64)
     cand[order] = cand_sorted
-    cand_d = cand[np.arange(n_groups) * GROUP]
+    dest = np.arange(n_groups) * GROUP
+    cand_d = cand[dest]
     safe = np.maximum(cand_d, 0)
-    is_match = (windows[safe] == groups).all(axis=1) & (cand_d >= 0)
-    offs = np.where(is_match, safe, 0)
-    for _ in range(2):  # continuation promotion (see _encode_math)
-        prev_off = np.concatenate([[0], offs[:-1] + GROUP])
-        prev_match = np.concatenate([[False], is_match[:-1]])
-        c_ok = prev_match & (windows[prev_off] == groups).all(axis=1)
-        offs = np.where(c_ok, prev_off, offs)
-        is_match = is_match | c_ok
-    prev_off = np.concatenate([[0], offs[:-1] + GROUP])
-    prev_match = np.concatenate([[False], is_match[:-1]])
-    is_cont = is_match & prev_match & (offs == prev_off)
-    is_new = is_match & ~is_cont
-    return (
-        np.array([n_groups | V2_FLAG], dtype="<u2").tobytes()
-        + np.packbits(is_match.astype(np.uint8), bitorder="little").tobytes()
-        + np.packbits(is_cont.astype(np.uint8), bitorder="little").tobytes()
-        + offs[is_new].astype("<u2").tobytes()
-        + groups[~is_match].tobytes()
+    cand_dist = dest - cand_d
+    is_match = (
+        (windows[safe] == groups).all(axis=1)
+        & (cand_d >= 0)
+        & (cand_dist <= MAX_DIST)
     )
+    dists = np.where(is_match, cand_dist, 0)
+    for _ in range(2):  # continuation promotion (see _encode_math)
+        prev_dist = np.concatenate([[0], dists[:-1]])
+        prev_match = np.concatenate([[False], is_match[:-1]])
+        c_src = dest - prev_dist
+        c_ok = (
+            prev_match
+            & (prev_dist > 0)
+            & (windows[np.maximum(c_src, 0)] == groups).all(axis=1)
+        )
+        dists = np.where(c_ok, prev_dist, dists)
+        is_match = is_match | c_ok
+    prev_dist = np.concatenate([[0], dists[:-1]])
+    prev_match = np.concatenate([[False], is_match[:-1]])
+    is_cont = is_match & prev_match & (dists == prev_dist)
+    is_new = is_match & ~is_cont
+    return _pack_meta(
+        np.packbits(is_match.astype(np.uint8), bitorder="little").tobytes(),
+        np.packbits(is_cont.astype(np.uint8), bitorder="little").tobytes(),
+        dists[is_new].astype("<u2").tobytes(),
+        n_groups,
+    ) + groups[~is_match].tobytes()
 
 
-def _parse_payload(payload: bytes):
-    """Split a TLZ payload into (version, n_groups, is_match, is_cont, offs,
-    lits). v1 has no cont bitmap (is_cont is None) and 16-byte groups."""
+def _parse_payload(payload: bytes, uncompressed_len: int):
+    """Split a TLZ payload into (version, n_groups, is_match, is_cont, dists,
+    lits). v1 has no cont bitmap (is_cont is None), 16-byte groups, and
+    literal-group-index sources. For v2 the group count derives from the
+    frame's uncompressed length; the header's low 14 bits are a consistency
+    check (the count can exceed 14 bits at 256 KiB blocks)."""
     if len(payload) < 2:
         raise IOError("TLZ payload too short")
     field = int(np.frombuffer(payload[:2], dtype="<u2")[0])
     version = 2 if field & V2_FLAG else 1
-    n_groups = field & ~V2_FLAG
-    # v2 blocks are ≤ 64 KiB ⇒ n_groups ≤ 8192. A larger count with the flag
-    # bit set can only be a legacy v1 payload from a ≥ 512 KiB block (v1 had
-    # no block-size cap, so its 16-byte-group count could reach bit 15) —
-    # refuse loudly instead of silently decoding it as v2.
-    if version == 2 and (n_groups > MAX_BLOCK // GROUP or (n_groups == 0 and len(payload) > 2)):
-        raise IOError(
-            "ambiguous TLZ header: v2 flag set with out-of-range group count "
-            "(legacy v1 payload from a >448 KiB block?)"
-        )
+    packed = bool(field & PACKED_FLAG) and version == 2
+    if version == 2:
+        n_groups = (uncompressed_len + GROUP - 1) // GROUP
+        # A legacy v1 payload from a >=512 KiB block has bit 15 set in its
+        # 16-byte-group count and would otherwise be misread as v2 — the
+        # count consistency check and the size cap both refuse loudly.
+        if n_groups > MAX_BLOCK // GROUP:
+            raise IOError(
+                "ambiguous TLZ header: v2 flag set with out-of-range group "
+                "count (legacy v1 payload from an oversized block?)"
+            )
+        if (field & 0x3FFF) != (n_groups & 0x3FFF):
+            raise IOError(
+                f"TLZ v2 header count {field & 0x3FFF} inconsistent with "
+                f"frame length ({n_groups} groups) — corrupt or legacy header"
+            )
+    else:
+        n_groups = field
     bm_len = (n_groups + 7) // 8
     group = GROUP if version == 2 else _V1_GROUP
     off = 2
-    bitmap = np.frombuffer(payload[off : off + bm_len], dtype=np.uint8)
-    off += bm_len
+    if packed:
+        import zlib
+
+        if len(payload) < 6:
+            raise IOError("TLZ packed metadata length truncated")
+        clen = int(np.frombuffer(payload[2:6], dtype="<u4")[0])
+        if 6 + clen > len(payload):
+            raise IOError("TLZ packed metadata truncated")
+        # the deflated section can never legitimately exceed the plain
+        # metadata planes; cap the inflation so a crafted deflate bomb in a
+        # corrupt frame cannot allocate unbounded memory (clen is untrusted)
+        max_meta = 2 * ((n_groups + 7) // 8) + 2 * n_groups
+        try:
+            d = zlib.decompressobj()
+            meta = d.decompress(payload[6 : 6 + clen], max_meta + 1)
+        except zlib.error as e:
+            raise IOError(f"TLZ packed metadata corrupt: {e}") from e
+        if len(meta) > max_meta or d.unconsumed_tail:
+            raise IOError("TLZ packed metadata inflates beyond any valid size")
+        off = 6 + clen
+        src = meta
+        moff = 0
+    else:
+        src = payload
+        moff = off
+    bitmap = np.frombuffer(src[moff : moff + bm_len], dtype=np.uint8)
+    moff += bm_len
     if len(bitmap) < bm_len:
         raise IOError("TLZ bitmap truncated")
     is_match = np.unpackbits(bitmap, count=n_groups, bitorder="little").astype(bool)
     is_cont = None
     if version == 2:
-        cont_b = np.frombuffer(payload[off : off + bm_len], dtype=np.uint8)
-        off += bm_len
+        cont_b = np.frombuffer(src[moff : moff + bm_len], dtype=np.uint8)
+        moff += bm_len
         if len(cont_b) < bm_len:
             raise IOError("TLZ cont bitmap truncated")
         is_cont = np.unpackbits(cont_b, count=n_groups, bitorder="little").astype(bool)
@@ -339,10 +431,18 @@ def _parse_payload(payload: bytes):
         n_offs = int((is_match & ~is_cont).sum())
     else:
         n_offs = int(is_match.sum())
-    offs = np.frombuffer(payload[off : off + 2 * n_offs], dtype="<u2")
-    off += 2 * n_offs
-    if len(offs) < n_offs:
-        raise IOError("TLZ sources truncated")
+    offs_raw = src[moff : moff + 2 * n_offs]
+    if len(offs_raw) < 2 * n_offs:  # before frombuffer: an odd-length slice
+        raise IOError("TLZ sources truncated")  # would raise ValueError there
+    offs = np.frombuffer(offs_raw, dtype="<u2")
+    moff += 2 * n_offs
+    if packed:
+        if moff != len(meta):
+            raise IOError(
+                f"TLZ packed metadata has {len(meta) - moff} trailing bytes"
+            )
+    else:
+        off = moff
     n_lits = n_groups - int(is_match.sum())
     lits = np.frombuffer(payload[off : off + n_lits * group], dtype=np.uint8)
     if len(lits) < n_lits * group:
@@ -358,45 +458,46 @@ def _parse_payload(payload: bytes):
     return version, n_groups, is_match, is_cont, offs.astype(np.int64), lits
 
 
-def _expand_offsets_numpy(is_match, is_cont, offs, n_groups):
-    """Reconstruct each match group's source offset: continuation groups take
-    their run leader's stored offset + GROUP per step."""
+def _expand_dists_numpy(is_match, is_cont, dists, n_groups):
+    """Reconstruct each match group's source DISTANCE: continuation groups
+    share their run leader's stored distance (source advances in lockstep
+    with the destination, so the distance is constant along a run)."""
     is_new = is_match & ~is_cont
     idx = np.arange(n_groups, dtype=np.int64)
     if not is_match.any():
         return np.zeros(n_groups, dtype=np.int64)
     leader = np.maximum.accumulate(np.where(is_new, idx, -1))
-    if (leader[is_match] < 0).any() or len(offs) == 0:
+    if (leader[is_match] < 0).any() or len(dists) == 0:
         raise IOError("TLZ continuation run has no leader")
     new_rank = np.cumsum(is_new) - 1
-    safe_rank = np.clip(new_rank, 0, len(offs) - 1)
-    off_full = offs[safe_rank] + GROUP * (idx - np.maximum(leader, 0))
-    return off_full
+    safe_rank = np.clip(new_rank, 0, len(dists) - 1)
+    return dists[safe_rank]
 
 
 def decode_payload_numpy(payload: bytes, uncompressed_len: int) -> bytes:
-    version, n_groups, is_match, is_cont, offs, lits = _parse_payload(payload)
+    version, n_groups, is_match, is_cont, dists, lits = _parse_payload(
+        payload, uncompressed_len
+    )
     n_lits = n_groups - int(is_match.sum())
     if version == 1:
         # legacy format: 16-byte groups, sources are literal *group indices*
         out = np.zeros((n_groups, _V1_GROUP), dtype=np.uint8)
         out[~is_match] = lits.reshape(n_lits, _V1_GROUP)
-        if len(offs):
-            if (offs >= n_groups).any() or is_match[offs].any():
+        if len(dists):
+            if (dists >= n_groups).any() or is_match[dists].any():
                 raise IOError("TLZ match source is not a literal group")
-            out[is_match] = out[offs]
+            out[is_match] = out[dists]
         return out.reshape(-1)[:uncompressed_len].tobytes()
 
     n_bytes = n_groups * GROUP
     if n_groups == 0:
         return b""
-    off_full = _expand_offsets_numpy(is_match, is_cont, offs, n_groups)
+    dist_full = _expand_dists_numpy(is_match, is_cont, dists, n_groups)
     group_start = np.arange(n_groups, dtype=np.int64) * GROUP
-    bad = is_match & (
-        (off_full < 0) | (off_full >= group_start) | (off_full + GROUP > n_bytes)
-    )
+    off_full = group_start - dist_full
+    bad = is_match & ((dist_full < 1) | (off_full < 0))
     if bad.any():
-        raise IOError("TLZ v2 source offset out of range")
+        raise IOError("TLZ v2 source distance out of range")
     # literal plane, placed sparsely at each literal group's position
     sparse = np.zeros((n_groups, GROUP), dtype=np.uint8)
     sparse[~is_match] = lits.reshape(n_lits, GROUP)
@@ -436,20 +537,21 @@ def _decode_math(is_match, is_cont, offs_padded, lits_padded, n_groups: int):
     jitted kernel and larger fused traces (e.g. the multichip dryrun's
     in-graph encode→decode roundtrip check).
 
-    is_match/is_cont: (B, G) bool; offs_padded: (B, G) int32 (stored offsets
-    in order); lits_padded: (B, G, GROUP) uint8 (literal slots in literal
-    order) — exactly the (unpacked) shapes :func:`_encode_math` emits.
+    is_match/is_cont: (B, G) bool; offs_padded: (B, G) int32 (stored match
+    DISTANCES in order); lits_padded: (B, G, GROUP) uint8 (literal slots in
+    literal order) — exactly the (unpacked) shapes :func:`_encode_math`
+    emits. Continuation groups share their run leader's distance, so the
+    absolute source is ``group_start - distance``.
     """
-    jax, jnp = _jax()
+    _jax_mod, jnp = _jax()
     n_bytes = n_groups * GROUP
     b = is_match.shape[0]
     idx = jnp.arange(n_groups, dtype=jnp.int32)
     is_new = is_match & ~is_cont
     new_rank = jnp.cumsum(is_new, axis=1) - 1
-    leader = jax.lax.cummax(jnp.where(is_new, idx[None, :], -1), axis=1)
-    off_of = jnp.take_along_axis(
+    off_of = GROUP * idx[None, :] - jnp.take_along_axis(
         offs_padded, jnp.maximum(new_rank, 0), axis=1
-    ) + GROUP * (idx[None, :] - jnp.maximum(leader, 0))
+    )
     lit_rank = jnp.cumsum(~is_match, axis=1) - 1
     lit_vals = jnp.take_along_axis(
         lits_padded, jnp.maximum(lit_rank, 0)[:, :, None], axis=1
@@ -492,7 +594,7 @@ def decode_blocks_device(payloads: List[bytes], ulens: List[int], block_size: in
     lits = np.zeros((b, n_groups, GROUP), dtype=np.uint8)
     fallback: dict[int, bytes] = {}
     for i, payload in enumerate(payloads):
-        version, ng, m, c, o, l = _parse_payload(payload)
+        version, ng, m, c, o, l = _parse_payload(payload, ulens[i])
         if ng != n_groups or version != 2:
             fallback[i] = decode_payload_numpy(payload, ulens[i])
             continue

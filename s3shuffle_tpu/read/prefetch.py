@@ -221,7 +221,10 @@ class BufferedPrefetchIterator:
                     self._buffers_in_flight + bsize > self._max_buffer_size
                     and self._error is None
                 ):
-                    self._lock.wait(timeout=0.5)
+                    # Every transition that can unblock this wait notifies
+                    # (budget release on stream close, error) — the timeout
+                    # is only a deadlock backstop, not a polling interval.
+                    self._lock.wait(timeout=5.0)
                 self._buffers_in_flight += bsize
             try:
                 from s3shuffle_tpu.utils import trace
@@ -268,7 +271,11 @@ class BufferedPrefetchIterator:
                 if self._source_exhausted and self._active_fetches == 0 and not self._threads_alive():
                     self._print_statistics()
                     raise StopIteration
-                self._lock.wait(timeout=0.1)
+                # Completion pushes, errors, exhaustion, and thread retirement
+                # all notify — the timeout is only a backstop against a missed
+                # wakeup, not a polling interval (no latency is added: a push
+                # wakes this wait immediately).
+                self._lock.wait(timeout=2.0)
             item = self._completed.pop()  # LIFO pop (:146, 209)
             wait_ns = time.perf_counter_ns() - t0
             self._stat_wait_ns += wait_ns

@@ -66,6 +66,43 @@ def test_tlz_compresses_aligned_redundancy():
     assert len(payload) < len(data) // 4
 
 
+def test_tlz_packed_metadata_bomb_rejected_without_allocation():
+    """A corrupt packed frame whose deflate section inflates far beyond any
+    valid metadata size must be rejected by the inflation cap, not buffered
+    (clen is an untrusted u32 on the read path)."""
+    import zlib
+
+    bomb = zlib.compress(b"\x00" * (64 << 20), 9)
+    field = np.array([100 | tlz.V2_FLAG | tlz.PACKED_FLAG], dtype="<u2").tobytes()
+    payload = field + np.array([len(bomb)], dtype="<u4").tobytes() + bomb
+    with pytest.raises(IOError, match="inflates beyond"):
+        tlz.decode_payload_numpy(payload, 100 * tlz.GROUP)
+
+
+def test_tlz_truncated_packed_offsets_raise_ioerror_not_valueerror():
+    """Odd-length offsets plane inside packed metadata: the corruption
+    contract is IOError (read-path handlers catch OSError), never a leaked
+    numpy ValueError."""
+    import zlib
+
+    ng = 16
+    m = np.zeros(ng, np.uint8)
+    m[1] = 1
+    meta = (
+        np.packbits(m, bitorder="little").tobytes()
+        + np.packbits(np.zeros(ng, np.uint8), bitorder="little").tobytes()
+        + b"\x07"  # 1 byte where a u16 offset belongs
+    )
+    z = zlib.compress(meta)
+    payload = (
+        np.array([ng | tlz.V2_FLAG | tlz.PACKED_FLAG], dtype="<u2").tobytes()
+        + np.array([len(z)], dtype="<u4").tobytes()
+        + z
+    )
+    with pytest.raises(IOError, match="sources truncated"):
+        tlz.decode_payload_numpy(payload, ng * tlz.GROUP)
+
+
 def test_tlz_corrupt_payload_raises():
     data = b"0123456789abcdef" * 8
     payload = bytearray(tlz._assemble_payload_numpy(data))
@@ -96,6 +133,39 @@ def test_tpu_codec_host_routing_on_cpu_backend(monkeypatch):
     assert codec._device_path() is False  # conftest pins the cpu platform
     data = (b"route-check-1234" * 600) + os.urandom(100)
     assert codec.decompress_bytes(codec.compress_bytes(data)) == data
+
+
+def test_tlz_256k_blocks_roundtrip_and_improve_ratio():
+    """Distance encoding decouples block size from the u16 wire width:
+    256 KiB blocks must roundtrip and compress repetitive-with-gaps data
+    better than 64 KiB blocks (first-occurrence literals amortize)."""
+    import random
+
+    rng = random.Random(9)
+    pool = [rng.randbytes(90) for _ in range(64)]
+    data = b"".join(pool[rng.randrange(64)] for _ in range(6000))  # 540 KB
+    small = TpuCodec(block_size=64 * 1024, batch_blocks=16)
+    big = TpuCodec(block_size=256 * 1024, batch_blocks=4)
+    c_small = small.compress_bytes(data)
+    c_big = big.compress_bytes(data)
+    assert small.decompress_bytes(c_small) == data
+    assert big.decompress_bytes(c_big) == data
+    # cross-decoding: block size is a writer-side choice only
+    assert small.decompress_bytes(c_big) == data
+    assert len(c_big) < len(c_small)
+
+
+def test_tlz_match_window_capped_at_64k_distance():
+    """A repeat farther back than MAX_DIST must not be matched (and must
+    still roundtrip as literals)."""
+    import random
+
+    rng = random.Random(10)
+    pat = rng.randbytes(256)
+    gap = rng.randbytes(tlz.MAX_DIST + 1000)
+    data = pat + gap + pat
+    payload = tlz._assemble_payload_numpy(data)
+    assert tlz.decode_payload_numpy(payload, len(data)) == data
 
 
 def test_legacy_v1_big_block_header_rejected_not_misdecoded():
