@@ -354,7 +354,7 @@ def _device_kernel_rates_impl():
 
         delta_rate(lambda d: _crc_math(d, w, L), "tpu_crc32c_mb_s")
         enc_outs = delta_rate(
-            lambda d: tlz._encode_math(d, n_groups)[4:6],  # (n_new, n_match)
+            lambda d: tlz._encode_math(d, n_groups)[6:9],  # (n_new, n_split, n_match)
             "tpu_tlz_encode_mb_s",
         )
 
@@ -362,44 +362,51 @@ def _device_kernel_rates_impl():
         # real payload sizes (including packed-metadata savings) via the
         # same host assembly the production write path uses
         enc = tlz._encode_kernel(n_groups)
-        bitmap, cont, offs, lits, n_new, n_match = (np.asarray(x) for x in enc(dev))
+        bitmap, cont, split, offs, ks, lits, n_new, n_split, n_match = (
+            np.asarray(x) for x in enc(dev)
+        )
         comp_bytes = 0
         for i in range(B):
-            nn, nm = int(n_new[i]), int(n_match[i])
+            nn, ns, nm = int(n_new[i]), int(n_split[i]), int(n_match[i])
             prefix = tlz._pack_meta(
                 bitmap[i].tobytes(),
                 cont[i].tobytes(),
+                split[i].tobytes(),
                 offs[i, :nn].astype("<u2").tobytes(),
+                ks[i, :ns].tobytes(),
                 n_groups,
             )
-            comp_bytes += len(prefix) + tlz.GROUP * (n_groups - nm)
+            comp_bytes += len(prefix) + tlz.GROUP * (n_groups - nm - ns)
         out["tpu_tlz_terasort_ratio"] = round(B * L / comp_bytes, 3)
 
-        is_match = np.unpackbits(bitmap, axis=1, count=n_groups, bitorder="little").astype(bool)
-        is_cont = np.unpackbits(cont, axis=1, count=n_groups, bitorder="little").astype(bool)
-        dm = jax.device_put(is_match)
-        dc = jax.device_put(is_cont)
+        unpack = lambda a: np.unpackbits(  # noqa: E731
+            a, axis=1, count=n_groups, bitorder="little"
+        ).astype(bool)
+        dm = jax.device_put(unpack(bitmap))
+        dc = jax.device_put(unpack(cont))
+        ds = jax.device_put(unpack(split))
         do = jax.device_put(offs.astype(np.int32))
+        dk = jax.device_put(ks.astype(np.int32))
         dl = jax.device_put(lits)
 
         # decode rate: same delta-of-scan-lengths trick; lits are XOR-mutated
         # per iteration so the loop body cannot be hoisted
         def dec_loop(length):
             looped = jax.jit(
-                lambda m, c, o, l: jax.lax.scan(
+                lambda m, c, sp, o, k, l: jax.lax.scan(
                     lambda carry, _: (
                         carry ^ jnp.uint8(1),
-                        tlz._decode_math(m, c, o, carry, n_groups)[:, ::997],
+                        tlz._decode_math(m, c, sp, o, k, carry, n_groups)[:, ::997],
                     ),
                     l,
                     None,
                     length=length,
                 )[1]
             )
-            r = looped(dm, dc, do, dl)
+            r = looped(dm, dc, ds, do, dk, dl)
             r.block_until_ready()  # compile
             t0 = time.perf_counter()
-            r = looped(dm, dc, do, dl)
+            r = looped(dm, dc, ds, do, dk, dl)
             r.block_until_ready()
             return time.perf_counter() - t0
 
@@ -413,7 +420,7 @@ def _device_kernel_rates_impl():
             )
 
         # decode correctness on-device: matches the staged input exactly
-        d = np.asarray(tlz._decode_kernel(n_groups)(dm, dc, do, dl))
+        d = np.asarray(tlz._decode_kernel(n_groups)(dm, dc, ds, do, dk, dl))
         if not (d == batch).all():
             out["tpu_probe_error"] = "device decode(encode(x)) != x"
             return out

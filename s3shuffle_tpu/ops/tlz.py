@@ -5,16 +5,19 @@ The reference compresses shuffle bytes with JVM LZ4/Snappy streams (Spark's
 to TPUs (data-dependent control flow, scalar loops), so TLZ is designed from
 the hardware up instead of translating LZ4:
 
-- a block is split into fixed **8-byte groups**; every group is either a
-  literal or a *match* — a copy of 8 bytes starting at **any earlier byte
-  offset** in the same block. (v1 used 16-byte groups with aligned group
-  sources only, which missed all unaligned redundancy — shuffle records are
-  rarely 16-byte-periodic);
-- a match whose source continues the previous group's source
-  (``off[g] == off[g-1] + 8`` — what any repeated region longer than one
-  group produces) is flagged in a second bitmap and stores **no offset at
-  all**, so long runs cost ~2 bits per 8 bytes — the "pair coalescing" that
-  makes the group format competitive with byte-granular LZ parses;
+- a block is split into fixed **8-byte groups**; every group is a literal, a
+  *match* — a copy of 8 bytes from ``group_start - distance`` for a u16
+  DISTANCE (the same 64 KiB reach-back window as LZ4; block size is
+  independent of it, up to 256 KiB) — or a *split literal* (below);
+- a match whose distance equals the previous group's (what any repeated
+  region longer than one group produces — the source advances in lockstep)
+  is flagged in the cont bitmap and stores **no distance at all**, so long
+  runs cost ~2 bits per 8 bytes;
+- a group straddling two repeated regions fails as a whole match but its
+  prefix matches at the LEFT neighbor's distance and its suffix at the
+  RIGHT neighbor's: the split bitmap flags it and only the split point k
+  (u8) is stored — both distances are reconstructed from the neighbors at
+  decode, so a boundary group costs ~1 byte instead of 8;
 - encoding hashes the 8-byte window at *every* byte position (8 shifted
   multiply-adds — pure VPU work), then finds each group's nearest previous
   identical window with one stable ``argsort`` per block: equal hashes land
@@ -22,44 +25,52 @@ the hardware up instead of translating LZ4:
   compare — no hash-table scatter, no sequential scan. Candidates are
   verified by exact compare, so hash collisions cost missed matches, never
   wrong output. A vectorized continuation-promotion pass then retries each
-  group at the previous group's source + 8, aligning offset chains so the
-  cont bitmap can elide them;
-- sources may overlap their destination (offset within 8 bytes of the group
-  start), so runs of ANY period — classic LZ77 RLE — fall out free;
-- decoding reconstructs elided offsets with a running max (leader of each
-  continuation run) + rank gather, builds a per-byte source map (literal
-  bytes are fixed points, match bytes point at ``offset + lane``) and
-  resolves chains with **pointer jumping**: log2(block) rounds of one
+  group at the previous group's distance, aligning chains so the cont
+  bitmap can elide them;
+- sources may overlap their destination (distance < 8), so runs of ANY
+  period — classic LZ77 RLE — fall out free;
+- decoding reconstructs elided distances with a rank gather (constant along
+  a run), builds a per-byte source map (literal bytes are fixed points;
+  match bytes point at ``pos - distance``; split-group bytes at
+  ``pos - d_left`` before k and ``pos - d_right`` after) and resolves
+  chains with **pointer jumping**: log2(block) doubling rounds of one
   parallel gather each, then a final gather from the literal plane. No
-  sequential back-reference chasing — equally fast on TPU and in vectorized
-  numpy on the host.
+  sequential back-reference chasing — equally fast on TPU and in
+  vectorized numpy on the host.
 
 Wire format of one TLZ frame payload (fits the shared 9-byte frame header,
 codec_id = ``tpu-lz``):
 
-    [u16le n_groups | 0x8000 (| 0x4000)] — bit 15 ⇒ v2; bit 14 ⇒ packed meta
-    [match bitmap ceil(n_groups/8) bytes — bit i set ⇒ group i is a match]
-    [cont  bitmap ceil(n_groups/8) bytes — bit i set ⇒ off[i]=off[i-1]+8]
-    [u16le src_byte_offset × n_new_matches — for matches with cont bit 0]
+    [u16le flags+count] — bit 15 ⇒ v2; bit 14 ⇒ packed meta; low 14 bits =
+                          n_groups mod 16384 (consistency only — the true
+                          count derives from the frame's uncompressed len)
+    [match bitmap ceil(n_groups/8) bytes — bit i ⇒ group i is a match]
+    [cont  bitmap ceil(n_groups/8) bytes — bit i ⇒ dist[i] == dist[i-1]]
+    [split bitmap ceil(n_groups/8) bytes — bit i ⇒ split literal]
+    [u16le distance × n_new_matches — matches with cont bit 0, in order]
+    [u8 split point k × n_splits — in order, 1..7]
     [literal groups × 8 bytes (last one zero-padded to 8)]
 
-With bit 14 set, the three metadata planes (both bitmaps + offsets) are
-stored as ``[u32le clen][zlib deflate of them]`` instead — they are highly
-structured (long match runs ⇒ long bit runs, clustered offsets) and
-otherwise impose a ~3% floor on every block's size. Packing is applied only
-when it shrinks. The metadata is parsed on the HOST in both the numpy and
-device decode paths (the device kernel consumes unpacked bitmaps either
-way), so the byte-plane decode remains pure parallel gathers.
+With bit 14 set, the five metadata planes (three bitmaps + distances +
+split points) are stored as ``[u32le clen][zlib deflate of them]`` instead —
+they are highly structured (long match runs ⇒ long bit runs, clustered
+distances) and otherwise impose a ~3% floor on every block's size. Packing
+is applied only when it shrinks. The metadata is parsed on the HOST in both
+the numpy and device decode paths (the device kernel consumes unpacked
+bitmaps either way), so the byte-plane decode remains pure parallel gathers.
 
-v1 payloads (bit 15 clear; 16-byte groups, sources are *group indices* of
-literal groups, no cont bitmap) remain decodable on the host path. Encoders
-always emit v2.
+Compatibility: v1 payloads (bit 15 clear; 16-byte groups, literal-group-
+index sources, no cont/split bitmaps) remain decodable on the host path.
+The v2 layout above is the FINAL v2 — in-development snapshots of v2 from
+round 2 (absolute offsets, no split plane) are not readable, which is fine
+because shuffle payloads are ephemeral job traffic, never an archival
+format. Encoders always emit v2.
 
-Ratio characteristics: catches aligned and unaligned repeats and runs of any
-period; misses approximate redundancy (entropy coding is out of scope — the
-framing's raw escape bounds the worst case). Encoding cost is O(N log N)
-sort + O(N) VPU work per block over N byte positions, fully batched over B
-blocks. Byte offsets are u16, so ``block_size`` must be ≤ 64 KiB.
+Ratio characteristics: catches aligned and unaligned repeats and runs of
+any period; misses approximate redundancy (entropy coding beyond the packed
+metadata is out of scope — the framing's raw escape bounds the worst case).
+Encoding cost is O(N log N) sort + O(N) VPU work per block over N byte
+positions, fully batched over B blocks.
 """
 
 from __future__ import annotations
@@ -85,13 +96,16 @@ MAX_DIST = (1 << 16) - 1
 MAX_BLOCK = 1 << 18
 
 
-def _pack_meta(bitmap_b: bytes, cont_b: bytes, offs_b: bytes, n_groups: int):
-    """Assemble the header + metadata section, deflating the three metadata
-    planes when that shrinks them. Returns the payload prefix (everything
-    before the literal plane)."""
+def _pack_meta(
+    bitmap_b: bytes, cont_b: bytes, split_b: bytes, offs_b: bytes,
+    ks_b: bytes, n_groups: int,
+):
+    """Assemble the header + metadata section (match/cont/split bitmaps,
+    match distances, split points), deflating it when that shrinks.
+    Returns the payload prefix (everything before the literal plane)."""
     import zlib
 
-    meta = bitmap_b + cont_b + offs_b
+    meta = bitmap_b + cont_b + split_b + offs_b + ks_b
     ng_field = n_groups & 0x3FFF  # low 14 bits: consistency check only —
     # the true count derives from the frame's uncompressed length
     packed = zlib.compress(meta, 6)
@@ -130,10 +144,11 @@ def _jump_rounds(n_bytes: int) -> int:
 def _encode_math(blocks_u8, n_groups: int):
     """The raw (unjitted) encode computation — shared by the standalone
     jitted kernel and larger fused traces (see __graft_entry__). Returns
-    (match_bitmap, cont_bitmap, dists_compact, lits_compact, n_new, n_match)
-    where ``dists_compact[:, :n_new]`` are the stored (non-continuation)
-    match distances and ``lits_compact[:, :n_groups - n_match]`` the literal
-    groups."""
+    (match_bitmap, cont_bitmap, split_bitmap, dists_compact, ks_compact,
+    lits_compact, n_new, n_split, n_match) where ``dists_compact[:, :n_new]``
+    are the stored (non-continuation) match distances,
+    ``ks_compact[:, :n_split]`` the split points, and
+    ``lits_compact[:, :n_groups - n_match - n_split]`` the literal groups."""
     jax, jnp = _jax()
 
     mults = jnp.asarray(_MULTS_I32)
@@ -211,24 +226,69 @@ def _encode_math(blocks_u8, n_groups: int):
     )
     prev_match = jnp.concatenate([jnp.zeros((b, 1), bool), is_match[:, :-1]], axis=1)
     is_cont = is_match & prev_match & (dists == prev_dist)
+
+    # split-literal tier: a group straddling two repeated regions fails as a
+    # whole (its halves match at DIFFERENT distances — the previous group's
+    # and the next group's). Store only the split point k: prefix bytes
+    # [0,k) copy at the left neighbor's distance, suffix bytes [k,8) at the
+    # right neighbor's — both distances are reconstructed from the
+    # neighbors at decode, so a boundary group costs ~1 byte instead of 8.
+    next_dist = jnp.concatenate(
+        [dists[:, 1:], jnp.zeros((b, 1), jnp.int32)], axis=1
+    )
+    next_match = jnp.concatenate(
+        [is_match[:, 1:], jnp.zeros((b, 1), bool)], axis=1
+    )
+    byte_pos = dest[None, :, None] + lanes[None, None, :]  # (1, G, GROUP)
+    pre_src = byte_pos - prev_dist[:, :, None]  # ≥ 8 when prev is a match
+    suf_src = byte_pos - next_dist[:, :, None]  # may be < 0 near the front
+    gather = lambda idx: jnp.take_along_axis(  # noqa: E731
+        buf, jnp.clip(idx, 0, n_bytes - 1).reshape(b, -1), axis=1
+    ).reshape(b, n_groups, GROUP)
+    pre_eq = gather(pre_src) == groups
+    suf_eq = (gather(suf_src) == groups) & (suf_src >= 0)
+    # longest all-true prefix of pre_eq; first index with all-true suffix
+    prefix_run = jnp.sum(jnp.cumprod(pre_eq, axis=2), axis=2)
+    suffix_start = GROUP - jnp.sum(
+        jnp.cumprod(suf_eq[:, :, ::-1], axis=2), axis=2
+    )
+    ks = suffix_start.astype(jnp.int32)
+    is_split = (
+        ~is_match
+        & prev_match
+        & next_match
+        & (prev_dist > 0)
+        & (next_dist > 0)
+        & (ks >= 1)
+        & (ks <= GROUP - 1)
+        & (ks <= prefix_run)
+    )
+    is_lit = ~is_match & ~is_split
+
     is_new = is_match & ~is_cont
     n_match = jnp.sum(is_match, axis=1, dtype=jnp.int32)
     n_new = jnp.sum(is_new, axis=1, dtype=jnp.int32)
+    n_split = jnp.sum(is_split, axis=1, dtype=jnp.int32)
 
-    # compact stored offsets and literal groups via rank + scatter. Group 0
-    # can never match (no previous position), so slot n_groups-1 is always
-    # free to absorb the masked writes.
+    # compact stored distances, split points, and literal groups via rank +
+    # scatter. Group 0 can never match or split (no previous position), so
+    # slot n_groups-1 is always free to absorb the masked writes.
     new_rank = jnp.cumsum(is_new, axis=1) - 1
-    lit_rank = jnp.cumsum(~is_match, axis=1) - 1
+    split_rank = jnp.cumsum(is_split, axis=1) - 1
+    lit_rank = jnp.cumsum(is_lit, axis=1) - 1
     offs_compact = jnp.zeros((b, n_groups), dtype=jnp.int32)
     offs_compact = offs_compact.at[
         rows, jnp.where(is_new, new_rank, n_groups - 1)
     ].set(jnp.where(is_new, dists, 0), mode="drop")
+    ks_compact = jnp.zeros((b, n_groups), dtype=jnp.int32)
+    ks_compact = ks_compact.at[
+        rows, jnp.where(is_split, split_rank, n_groups - 1)
+    ].set(jnp.where(is_split, ks, 0), mode="drop")
     lits_compact = jnp.zeros((b, n_groups, GROUP), dtype=jnp.uint8)
     lits_compact = lits_compact.at[
-        rows, jnp.where(is_match, n_groups - 1, lit_rank)
+        rows, jnp.where(is_lit, lit_rank, n_groups - 1)
     ].set(
-        jnp.where(is_match[:, :, None], 0, groups).astype(jnp.uint8), mode="drop"
+        jnp.where(is_lit[:, :, None], groups, 0).astype(jnp.uint8), mode="drop"
     )
 
     # bitmaps packed to uint8 (little-endian bit order within the byte)
@@ -245,9 +305,12 @@ def _encode_math(blocks_u8, n_groups: int):
     return (
         pack(is_match),
         pack(is_cont),
+        pack(is_split),
         offs_compact.astype(jnp.uint16),
+        ks_compact.astype(jnp.uint8),
         lits_compact,
         n_new,
+        n_split,
         n_match,
     )
 
@@ -272,7 +335,7 @@ def encode_blocks_device(blocks: List[bytes], block_size: int) -> List[bytes]:
     for i, blk in enumerate(blocks):
         arr = np.frombuffer(blk, dtype=np.uint8)
         staged[i, : len(arr)] = arr
-    bitmap, cont, offs, lits, n_new, n_match = (
+    bitmap, cont, split, offs, ks, lits, n_new, n_split, n_match = (
         np.asarray(x) for x in _encode_kernel(n_groups)(staged)
     )
     out: List[bytes] = []
@@ -282,13 +345,15 @@ def encode_blocks_device(blocks: List[bytes], block_size: int) -> List[bytes]:
             # Short (final) block: encode host-side over just the used groups.
             payload = _assemble_payload_numpy(blk)
         else:
-            nn, nm = int(n_new[i]), int(n_match[i])
+            nn, ns, nm = int(n_new[i]), int(n_split[i]), int(n_match[i])
             payload = _pack_meta(
                 bitmap[i].tobytes(),
                 cont[i].tobytes(),
+                split[i].tobytes(),
                 offs[i, :nn].astype("<u2").tobytes(),
+                ks[i, :ns].tobytes(),
                 n_groups,
-            ) + lits[i, : n_groups - nm].tobytes()
+            ) + lits[i, : n_groups - nm - ns].tobytes()
         out.append(payload)
     return out
 
@@ -350,20 +415,48 @@ def _assemble_payload_numpy(data: bytes) -> bytes:
     prev_match = np.concatenate([[False], is_match[:-1]])
     is_cont = is_match & prev_match & (dists == prev_dist)
     is_new = is_match & ~is_cont
+    # split-literal tier (see _encode_math): boundary groups store only the
+    # split point; both copy distances come from the neighbors at decode
+    next_dist = np.concatenate([dists[1:], [0]])
+    next_match = np.concatenate([is_match[1:], [False]])
+    byte_pos = dest[:, None] + np.arange(GROUP)
+    flat_i = groups.reshape(-1).astype(np.int64)
+    pre_src = byte_pos - prev_dist[:, None]
+    suf_src = byte_pos - next_dist[:, None]
+    n_bytes_total = n_groups * GROUP
+    take = lambda idx: flat_i[np.clip(idx, 0, n_bytes_total - 1)]  # noqa: E731
+    pre_eq = take(pre_src) == groups
+    suf_eq = (take(suf_src) == groups) & (suf_src >= 0)
+    prefix_run = np.cumprod(pre_eq, axis=1).sum(axis=1)
+    ks = (GROUP - np.cumprod(suf_eq[:, ::-1], axis=1).sum(axis=1)).astype(np.int64)
+    is_split = (
+        ~is_match
+        & prev_match
+        & next_match
+        & (prev_dist > 0)
+        & (next_dist > 0)
+        & (ks >= 1)
+        & (ks <= GROUP - 1)
+        & (ks <= prefix_run)
+    )
+    is_lit = ~is_match & ~is_split
     return _pack_meta(
         np.packbits(is_match.astype(np.uint8), bitorder="little").tobytes(),
         np.packbits(is_cont.astype(np.uint8), bitorder="little").tobytes(),
+        np.packbits(is_split.astype(np.uint8), bitorder="little").tobytes(),
         dists[is_new].astype("<u2").tobytes(),
+        ks[is_split].astype(np.uint8).tobytes(),
         n_groups,
-    ) + groups[~is_match].tobytes()
+    ) + groups[is_lit].tobytes()
 
 
 def _parse_payload(payload: bytes, uncompressed_len: int):
-    """Split a TLZ payload into (version, n_groups, is_match, is_cont, dists,
-    lits). v1 has no cont bitmap (is_cont is None), 16-byte groups, and
-    literal-group-index sources. For v2 the group count derives from the
-    frame's uncompressed length; the header's low 14 bits are a consistency
-    check (the count can exceed 14 bits at 256 KiB blocks)."""
+    """Split a TLZ payload into (version, n_groups, is_match, is_cont,
+    is_split, dists, ks, lits). v1 has no cont/split bitmaps (both None),
+    16-byte groups, and literal-group-index sources. For v2 the group count
+    derives from the frame's uncompressed length; the header's low 14 bits
+    are a consistency check (the count can exceed 14 bits at 256 KiB
+    blocks)."""
     if len(payload) < 2:
         raise IOError("TLZ payload too short")
     field = int(np.frombuffer(payload[:2], dtype="<u2")[0])
@@ -398,9 +491,10 @@ def _parse_payload(payload: bytes, uncompressed_len: int):
         if 6 + clen > len(payload):
             raise IOError("TLZ packed metadata truncated")
         # the deflated section can never legitimately exceed the plain
-        # metadata planes; cap the inflation so a crafted deflate bomb in a
-        # corrupt frame cannot allocate unbounded memory (clen is untrusted)
-        max_meta = 2 * ((n_groups + 7) // 8) + 2 * n_groups
+        # metadata planes (3 bitmaps + u16 distances + u8 split points); cap
+        # the inflation so a crafted deflate bomb in a corrupt frame cannot
+        # allocate unbounded memory (clen is untrusted)
+        max_meta = 3 * ((n_groups + 7) // 8) + 3 * n_groups
         try:
             d = zlib.decompressobj()
             meta = d.decompress(payload[6 : 6 + clen], max_meta + 1)
@@ -419,7 +513,7 @@ def _parse_payload(payload: bytes, uncompressed_len: int):
     if len(bitmap) < bm_len:
         raise IOError("TLZ bitmap truncated")
     is_match = np.unpackbits(bitmap, count=n_groups, bitorder="little").astype(bool)
-    is_cont = None
+    is_cont = is_split = ks = None
     if version == 2:
         cont_b = np.frombuffer(src[moff : moff + bm_len], dtype=np.uint8)
         moff += bm_len
@@ -428,14 +522,30 @@ def _parse_payload(payload: bytes, uncompressed_len: int):
         is_cont = np.unpackbits(cont_b, count=n_groups, bitorder="little").astype(bool)
         if (is_cont & ~is_match).any():
             raise IOError("TLZ cont flag on non-match group")
+        split_b = np.frombuffer(src[moff : moff + bm_len], dtype=np.uint8)
+        moff += bm_len
+        if len(split_b) < bm_len:
+            raise IOError("TLZ split bitmap truncated")
+        is_split = np.unpackbits(
+            split_b, count=n_groups, bitorder="little"
+        ).astype(bool)
+        if (is_split & is_match).any():
+            raise IOError("TLZ split flag on match group")
         n_offs = int((is_match & ~is_cont).sum())
+        n_split = int(is_split.sum())
     else:
         n_offs = int(is_match.sum())
+        n_split = 0
     offs_raw = src[moff : moff + 2 * n_offs]
     if len(offs_raw) < 2 * n_offs:  # before frombuffer: an odd-length slice
         raise IOError("TLZ sources truncated")  # would raise ValueError there
     offs = np.frombuffer(offs_raw, dtype="<u2")
     moff += 2 * n_offs
+    if version == 2:
+        ks = np.frombuffer(src[moff : moff + n_split], dtype=np.uint8)
+        moff += n_split
+        if len(ks) < n_split:
+            raise IOError("TLZ split points truncated")
     if packed:
         if moff != len(meta):
             raise IOError(
@@ -443,7 +553,7 @@ def _parse_payload(payload: bytes, uncompressed_len: int):
             )
     else:
         off = moff
-    n_lits = n_groups - int(is_match.sum())
+    n_lits = n_groups - int(is_match.sum()) - n_split
     lits = np.frombuffer(payload[off : off + n_lits * group], dtype=np.uint8)
     if len(lits) < n_lits * group:
         raise IOError("TLZ literals truncated")
@@ -455,7 +565,10 @@ def _parse_payload(payload: bytes, uncompressed_len: int):
             f"TLZ v2 payload has {len(payload) - off - n_lits * group} "
             "trailing bytes — misread header (legacy v1 block?)"
         )
-    return version, n_groups, is_match, is_cont, offs.astype(np.int64), lits
+    return (
+        version, n_groups, is_match, is_cont, is_split,
+        offs.astype(np.int64), ks, lits,
+    )
 
 
 def _expand_dists_numpy(is_match, is_cont, dists, n_groups):
@@ -475,12 +588,12 @@ def _expand_dists_numpy(is_match, is_cont, dists, n_groups):
 
 
 def decode_payload_numpy(payload: bytes, uncompressed_len: int) -> bytes:
-    version, n_groups, is_match, is_cont, dists, lits = _parse_payload(
-        payload, uncompressed_len
+    version, n_groups, is_match, is_cont, is_split, dists, ks, lits = (
+        _parse_payload(payload, uncompressed_len)
     )
-    n_lits = n_groups - int(is_match.sum())
     if version == 1:
         # legacy format: 16-byte groups, sources are literal *group indices*
+        n_lits = n_groups - int(is_match.sum())
         out = np.zeros((n_groups, _V1_GROUP), dtype=np.uint8)
         out[~is_match] = lits.reshape(n_lits, _V1_GROUP)
         if len(dists):
@@ -492,15 +605,32 @@ def decode_payload_numpy(payload: bytes, uncompressed_len: int) -> bytes:
     n_bytes = n_groups * GROUP
     if n_groups == 0:
         return b""
+    n_lits = n_groups - int(is_match.sum()) - int(is_split.sum())
     dist_full = _expand_dists_numpy(is_match, is_cont, dists, n_groups)
     group_start = np.arange(n_groups, dtype=np.int64) * GROUP
     off_full = group_start - dist_full
     bad = is_match & ((dist_full < 1) | (off_full < 0))
     if bad.any():
         raise IOError("TLZ v2 source distance out of range")
+    # split groups copy their prefix at the LEFT neighbor's distance and
+    # their suffix at the RIGHT neighbor's — both neighbors must be matches
+    split_idx = np.flatnonzero(is_split)
+    if len(split_idx):
+        if split_idx[0] == 0 or split_idx[-1] == n_groups - 1:
+            raise IOError("TLZ split group at block edge")
+        if (~is_match[split_idx - 1]).any() or (~is_match[split_idx + 1]).any():
+            raise IOError("TLZ split group without match neighbors")
+        kvals = ks.astype(np.int64)
+        if ((kvals < 1) | (kvals > GROUP - 1)).any():
+            raise IOError("TLZ split point out of range")
+        d_prev = dist_full[split_idx - 1]
+        d_next = dist_full[split_idx + 1]
+        if ((group_start[split_idx] + kvals - d_next) < 0).any():
+            raise IOError("TLZ split suffix distance out of range")
     # literal plane, placed sparsely at each literal group's position
+    is_lit = ~is_match & ~is_split
     sparse = np.zeros((n_groups, GROUP), dtype=np.uint8)
-    sparse[~is_match] = lits.reshape(n_lits, GROUP)
+    sparse[is_lit] = lits.reshape(n_lits, GROUP)
     sparse = sparse.reshape(-1)
     # per-byte source map: literal bytes are fixed points; match bytes point
     # at offset + lane. Pointer jumping (src = src[src] — the DOUBLING update;
@@ -509,12 +639,17 @@ def decode_payload_numpy(payload: bytes, uncompressed_len: int) -> bytes:
     # loop exits early once converged — typical data needs 2-5 rounds.
     out = sparse
     match_groups = np.flatnonzero(is_match)
-    if len(match_groups):
+    if len(match_groups) or len(split_idx):
         lanes = np.arange(GROUP, dtype=np.int64)
-        src_match = (off_full[match_groups][:, None] + lanes[None, :]).reshape(-1)
-        dst_match = (group_start[match_groups][:, None] + lanes[None, :]).reshape(-1)
         src = np.arange(n_bytes, dtype=np.int64)
-        src[dst_match] = src_match
+        if len(match_groups):
+            src_match = (off_full[match_groups][:, None] + lanes[None, :]).reshape(-1)
+            dst_match = (group_start[match_groups][:, None] + lanes[None, :]).reshape(-1)
+            src[dst_match] = src_match
+        if len(split_idx):
+            pos = group_start[split_idx][:, None] + lanes[None, :]
+            d = np.where(lanes[None, :] < kvals[:, None], d_prev[:, None], d_next[:, None])
+            src[pos.reshape(-1)] = (pos - d).reshape(-1)
         for _ in range(_jump_rounds(n_bytes)):
             nxt = src[src]
             if np.array_equal(nxt, src):
@@ -532,16 +667,22 @@ def _unpack_bits_math(bitmap_u8, n_groups: int):
     return bits.reshape(bitmap_u8.shape[0], n_groups).astype(bool)
 
 
-def _decode_math(is_match, is_cont, offs_padded, lits_padded, n_groups: int):
+def _decode_math(
+    is_match, is_cont, is_split, offs_padded, ks_padded, lits_padded,
+    n_groups: int,
+):
     """The raw (unjitted) decode computation — shared by the standalone
     jitted kernel and larger fused traces (e.g. the multichip dryrun's
     in-graph encode→decode roundtrip check).
 
-    is_match/is_cont: (B, G) bool; offs_padded: (B, G) int32 (stored match
-    DISTANCES in order); lits_padded: (B, G, GROUP) uint8 (literal slots in
-    literal order) — exactly the (unpacked) shapes :func:`_encode_math`
+    is_match/is_cont/is_split: (B, G) bool; offs_padded: (B, G) int32
+    (stored match DISTANCES in order); ks_padded: (B, G) int32 (stored
+    split points in order); lits_padded: (B, G, GROUP) uint8 (literal slots
+    in literal order) — exactly the (unpacked) shapes :func:`_encode_math`
     emits. Continuation groups share their run leader's distance, so the
-    absolute source is ``group_start - distance``.
+    absolute source is ``group_start - distance``; split groups copy their
+    prefix at the left neighbor's distance and suffix at the right
+    neighbor's.
     """
     _jax_mod, jnp = _jax()
     n_bytes = n_groups * GROUP
@@ -549,22 +690,37 @@ def _decode_math(is_match, is_cont, offs_padded, lits_padded, n_groups: int):
     idx = jnp.arange(n_groups, dtype=jnp.int32)
     is_new = is_match & ~is_cont
     new_rank = jnp.cumsum(is_new, axis=1) - 1
-    off_of = GROUP * idx[None, :] - jnp.take_along_axis(
-        offs_padded, jnp.maximum(new_rank, 0), axis=1
-    )
-    lit_rank = jnp.cumsum(~is_match, axis=1) - 1
+    dist_of = jnp.take_along_axis(offs_padded, jnp.maximum(new_rank, 0), axis=1)
+    off_of = GROUP * idx[None, :] - dist_of
+    split_rank = jnp.cumsum(is_split, axis=1) - 1
+    k_of = jnp.take_along_axis(ks_padded, jnp.maximum(split_rank, 0), axis=1)
+    # neighbors' distances for split groups (edge groups can't split)
+    d_prev = jnp.concatenate([jnp.zeros((b, 1), jnp.int32), dist_of[:, :-1]], axis=1)
+    d_next = jnp.concatenate([dist_of[:, 1:], jnp.zeros((b, 1), jnp.int32)], axis=1)
+    is_lit = ~is_match & ~is_split
+    lit_rank = jnp.cumsum(is_lit, axis=1) - 1
     lit_vals = jnp.take_along_axis(
         lits_padded, jnp.maximum(lit_rank, 0)[:, :, None], axis=1
     )
-    sparse = jnp.where(is_match[:, :, None], 0, lit_vals).reshape(b, n_bytes)
+    sparse = jnp.where(is_lit[:, :, None], lit_vals, 0).reshape(b, n_bytes)
     # per-byte source map + pointer jumping
     lanes = jnp.arange(GROUP, dtype=jnp.int32)
     pos = jnp.arange(n_bytes, dtype=jnp.int32)
     off_b = (off_of[:, :, None] + lanes[None, None, :]).reshape(b, n_bytes)
+    split_d = jnp.where(
+        lanes[None, None, :] < k_of[:, :, None],
+        d_prev[:, :, None],
+        d_next[:, :, None],
+    )
+    split_src = (
+        GROUP * idx[None, :, None] + lanes[None, None, :] - split_d
+    ).reshape(b, n_bytes)
     match_b = jnp.repeat(is_match, GROUP, axis=1)
+    split_b = jnp.repeat(is_split, GROUP, axis=1)
     # clamp corrupt offsets into range; wrong bytes are caught by the
     # checksum layer, unlike an out-of-bounds gather
     src = jnp.where(match_b, jnp.clip(off_b, 0, n_bytes - 1), pos[None, :])
+    src = jnp.where(split_b, jnp.clip(split_src, 0, n_bytes - 1), src)
     for _ in range(_jump_rounds(n_bytes)):
         src = jnp.take_along_axis(src, src, axis=1)
     return jnp.take_along_axis(sparse, src, axis=1)
@@ -577,8 +733,11 @@ def _decode_kernel(n_groups: int):
     jax, _jnp = _jax()
 
     @jax.jit
-    def kernel(is_match, is_cont, offs_padded, lits_padded):
-        return _decode_math(is_match, is_cont, offs_padded, lits_padded, n_groups)
+    def kernel(is_match, is_cont, is_split, offs_padded, ks_padded, lits_padded):
+        return _decode_math(
+            is_match, is_cont, is_split, offs_padded, ks_padded, lits_padded,
+            n_groups,
+        )
 
     return kernel
 
@@ -590,20 +749,26 @@ def decode_blocks_device(payloads: List[bytes], ulens: List[int], block_size: in
     b = len(payloads)
     is_match = np.zeros((b, n_groups), dtype=bool)
     is_cont = np.zeros((b, n_groups), dtype=bool)
+    is_split = np.zeros((b, n_groups), dtype=bool)
     offs = np.zeros((b, n_groups), dtype=np.int32)
+    ks = np.zeros((b, n_groups), dtype=np.int32)
     lits = np.zeros((b, n_groups, GROUP), dtype=np.uint8)
     fallback: dict[int, bytes] = {}
     for i, payload in enumerate(payloads):
-        version, ng, m, c, o, l = _parse_payload(payload, ulens[i])
+        version, ng, m, c, sp, o, kv, l = _parse_payload(payload, ulens[i])
         if ng != n_groups or version != 2:
             fallback[i] = decode_payload_numpy(payload, ulens[i])
             continue
         is_match[i] = m
         is_cont[i] = c
+        is_split[i] = sp
         offs[i, : len(o)] = o
-        n_lits = n_groups - int(m.sum())
+        ks[i, : len(kv)] = kv
+        n_lits = n_groups - int(m.sum()) - int(sp.sum())
         lits[i, :n_lits] = l.reshape(n_lits, GROUP)
-    decoded = np.asarray(_decode_kernel(n_groups)(is_match, is_cont, offs, lits))
+    decoded = np.asarray(
+        _decode_kernel(n_groups)(is_match, is_cont, is_split, offs, ks, lits)
+    )
     out = []
     for i in range(b):
         if i in fallback:

@@ -88,9 +88,11 @@ def test_tlz_truncated_packed_offsets_raise_ioerror_not_valueerror():
     ng = 16
     m = np.zeros(ng, np.uint8)
     m[1] = 1
+    zeros = np.packbits(np.zeros(ng, np.uint8), bitorder="little").tobytes()
     meta = (
         np.packbits(m, bitorder="little").tobytes()
-        + np.packbits(np.zeros(ng, np.uint8), bitorder="little").tobytes()
+        + zeros  # cont bitmap
+        + zeros  # split bitmap
         + b"\x07"  # 1 byte where a u16 offset belongs
     )
     z = zlib.compress(meta)
@@ -156,8 +158,10 @@ def test_tlz_256k_blocks_roundtrip_and_improve_ratio():
 
 
 def test_tlz_match_window_capped_at_64k_distance():
-    """A repeat farther back than MAX_DIST must not be matched (and must
-    still roundtrip as literals)."""
+    """A repeat farther back than MAX_DIST must not be matched: it still
+    roundtrips AND the far repeat is stored as literals (the match bitmap
+    proves the cap fired — a plain roundtrip would pass even with the cap
+    dropped, since an uncapped distance only corrupts at the u16 wire)."""
     import random
 
     rng = random.Random(10)
@@ -166,6 +170,11 @@ def test_tlz_match_window_capped_at_64k_distance():
     data = pat + gap + pat
     payload = tlz._assemble_payload_numpy(data)
     assert tlz.decode_payload_numpy(payload, len(data)) == data
+    _v, ng, is_match, _c, _sp, _d, _k, _l = tlz._parse_payload(payload, len(data))
+    tail_groups = len(pat) // tlz.GROUP
+    assert not is_match[ng - tail_groups :].any(), (
+        "far repeat was matched — the MAX_DIST window cap is not enforced"
+    )
 
 
 def test_legacy_v1_big_block_header_rejected_not_misdecoded():
