@@ -76,7 +76,7 @@ def main() -> int:
                     help="none | zlib | zstd | native | tpu | auto")
     ap.add_argument("--checksum", default="CRC32C", help="ADLER32|CRC32|CRC32C|off")
     ap.add_argument("--root", default=None, help="storage root URI (default: temp dir)")
-    ap.add_argument("--block-size", type=int, default=64 * 1024, help="codec block size")
+    ap.add_argument("--block-size", type=int, default=None, help="codec block size")
     ap.add_argument("--repeat", type=int, default=1)
     args = ap.parse_args()
 

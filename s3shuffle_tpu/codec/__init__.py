@@ -24,44 +24,49 @@ from s3shuffle_tpu.codec.framing import (
 
 def get_codec(
     name: str,
-    block_size: int = 64 * 1024,
+    block_size: int | None = None,
     level: int = 1,
     tpu_batch_blocks: int = 256,
 ) -> "FrameCodec | None":
     """Resolve a codec by config name. ``none`` → None (raw bytes, no framing,
     still concatenatable). ``auto`` → native if built, else zlib.
-    ``tpu_batch_blocks`` sizes the device round-trip batch for the tpu codec
-    (the ``tpu_batch_blocks`` config flag)."""
+    ``block_size=None`` → the codec's own default: 64 KiB for the CPU codecs,
+    256 KiB for the TPU codec (ratio improves with block length; its match
+    window is a separate 64 KiB distance cap). ``tpu_batch_blocks`` sizes the
+    device round-trip batch for the tpu codec."""
     name = (name or "none").lower()
     if name in ("none", "raw", "off"):
         return None
+    # None → omit the kwarg so each codec class's own constructor default
+    # applies (the registry holds no per-codec size knowledge)
+    bs = {} if block_size is None else {"block_size": block_size}
     if name == "auto":
         try:
             from s3shuffle_tpu.codec.native import NativeLZCodec
 
-            return NativeLZCodec(block_size=block_size)
+            return NativeLZCodec(**bs)
         except Exception:
             name = "zlib"
     if name == "zlib":
         from s3shuffle_tpu.codec.cpu import ZlibCodec
 
-        return ZlibCodec(block_size=block_size, level=level)
+        return ZlibCodec(level=level, **bs)
     if name == "zstd":
         from s3shuffle_tpu.codec.cpu import ZstdCodec
 
-        return ZstdCodec(block_size=block_size, level=level)
+        return ZstdCodec(level=level, **bs)
     if name == "native":
         from s3shuffle_tpu.codec.native import NativeLZCodec
 
-        return NativeLZCodec(block_size=block_size)
+        return NativeLZCodec(**bs)
     if name == "lz4":
         from s3shuffle_tpu.codec.native import NativeLZ4Codec
 
-        return NativeLZ4Codec(block_size=block_size)
+        return NativeLZ4Codec(**bs)
     if name == "tpu":
         from s3shuffle_tpu.codec.tpu import TpuCodec
 
-        return TpuCodec(block_size=block_size, batch_blocks=tpu_batch_blocks)
+        return TpuCodec(batch_blocks=tpu_batch_blocks, **bs)
     raise ValueError(f"Unknown codec: {name}")
 
 

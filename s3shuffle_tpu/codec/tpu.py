@@ -45,8 +45,11 @@ class TpuCodec(FrameCodec):
 
     def __init__(
         self,
-        block_size: int = 64 * 1024,
-        batch_blocks: int = 256,
+        # 256 KiB default: TLZ's ratio improves with block length (per-block
+        # first-occurrence literals amortize) while its match window is a
+        # separate 64 KiB distance cap; CPU codecs keep 64 KiB blocks
+        block_size: int = 256 * 1024,
+        batch_blocks: int = 64,
         use_device: bool | None = None,
     ):
         if block_size % 128 != 0:

@@ -75,9 +75,14 @@ class ShuffleConfig:
     checksum_algorithm: str = "ADLER32"  # ADLER32 | CRC32 | CRC32C
     # --- codec (TPU-first addition; reference delegates to Spark codec streams) ---
     codec: str = "auto"  # none | zlib | zstd | native | lz4 | tpu | auto
-    codec_block_size: int = 64 * 1024
+    # None → each codec's own default (64 KiB for the CPU codecs' cache-sized
+    # blocks; 256 KiB for the TPU codec, whose ratio improves with block
+    # length while its match window stays a separate 64 KiB distance cap)
+    codec_block_size: int | None = None
     codec_level: int = 1
-    tpu_batch_blocks: int = 256  # blocks staged per device round-trip
+    # blocks staged per device round-trip: 64 x the 256 KiB default block
+    # keeps one staging batch at 16 MiB
+    tpu_batch_blocks: int = 64
     # --- misc ---
     app_id: str = "app"
     supports_rename: bool | None = None  # None → probe backend

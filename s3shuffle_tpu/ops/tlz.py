@@ -766,6 +766,9 @@ def decode_blocks_device(payloads: List[bytes], ulens: List[int], block_size: in
         ks[i, : len(kv)] = kv
         n_lits = n_groups - int(m.sum()) - int(sp.sum())
         lits[i, :n_lits] = l.reshape(n_lits, GROUP)
+    if len(fallback) == b:  # nothing device-shaped (e.g. a reader whose
+        # block_size differs from the writer's) — skip the kernel entirely
+        return [fallback[i] for i in range(b)]
     decoded = np.asarray(
         _decode_kernel(n_groups)(is_match, is_cont, is_split, offs, ks, lits)
     )
