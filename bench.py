@@ -3,13 +3,15 @@
 
 Mirrors BASELINE.json config #1: terasort-shaped KV shuffle against a
 ``file://`` root. The measured configuration uses the framework's native C++
-SLZ codec (the CPU data plane); the baseline is the same shuffle through
-zlib-1 — the stand-in for the reference's JVM LZ4-class codec stream
-("examples/terasort 1GB, local[4] ... JVM LZ4 (CPU baseline)").
+SLZ codec (the CPU data plane); baselines are the same shuffle through
+zlib-1 (the JVM-codec-stream stand-in) AND through the in-tree
+spec-conformant LZ4 block codec (the real LZ4 the north star compares
+against), plus a 4-worker aggregate run.
 
 Also reports (extra JSON keys) the TPU device-kernel rates measured on the
-attached chip: batched CRC32C and TLZ encode, plus host-link bandwidth —
-the offload path's building blocks.
+attached chip — batched CRC32C, TLZ encode/decode, the on-chip compression
+ratio of this very payload, and host-link bandwidth — via a tunnel-robust
+probe (subprocess isolation, retries, scan-loop delta timing).
 
 Prints exactly ONE JSON line:
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ..., ...extras}

@@ -151,7 +151,7 @@ def test_every_codec_thread_safe_under_concurrent_shuffles(tmp_path):
     """One shared codec instance serves all task threads — every codec must
     survive concurrent compress/decompress (zstandard's objects are not
     thread-safe per instance; the codec layer must shield that)."""
-    for codec in ("native", "lz4", "zlib", "zstd", "none"):
+    for codec in ("native", "lz4", "zlib", "zstd", "tpu", "none"):
         Dispatcher.reset()
         cfg = ShuffleConfig(
             root_dir=f"file://{tmp_path}/{codec}", app_id=f"cstress-{codec}", codec=codec
@@ -159,7 +159,7 @@ def test_every_codec_thread_safe_under_concurrent_shuffles(tmp_path):
         try:
             ctx = ShuffleContext(config=cfg, num_workers=4)
         except Exception:
-            if codec in ("native", "lz4", "zstd"):
+            if codec in ("native", "lz4", "zstd", "tpu"):
                 continue  # genuinely optional in this environment
             raise  # zlib/none must always construct
         errors = []
