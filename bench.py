@@ -221,12 +221,23 @@ def write_cpu_comparison(parts):
     return out
 
 
+#: last successful on-chip probe, persisted so an artifact produced while
+#: the flaky tunnel is down still carries real (clearly timestamped)
+#: chip measurements from the last time it answered.
+TPU_CACHE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "bench_tpu_last_good.json"
+)
+
+
 def device_kernel_rates(timeout_s: int = 150, attempts: int = 3):
     """Device-kernel rates, measured in a SUBPROCESS with a hard per-attempt
     timeout and retry/backoff: the TPU sits behind a tunnel whose backend
     init can hang outright when the tunnel is down (r1's probe lost the whole
     420s budget to one hang), and the headline bench must still print its
-    JSON line. The child runs :func:`_device_kernel_rates_impl`."""
+    JSON line. The child runs :func:`_device_kernel_rates_impl`. Successful
+    probes are cached to :data:`TPU_CACHE_PATH`; when every attempt fails,
+    the cached measurement is reported under ``tpu_last_good`` (with its
+    timestamp) alongside the error — never as the live fields."""
     import subprocess
 
     last = "no attempt ran"
@@ -247,6 +258,16 @@ def device_kernel_rates(timeout_s: int = 150, attempts: int = 3):
             if r.returncode == 0 and r.stdout.strip():
                 out = json.loads(r.stdout.strip().splitlines()[-1])
                 if "tpu_probe_error" not in out:
+                    try:
+                        with open(TPU_CACHE_PATH, "w") as f:
+                            json.dump(
+                                {"measured_at_utc": time.strftime(
+                                    "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+                                ), **out},
+                                f,
+                            )
+                    except OSError:
+                        pass
                     return out
                 last = out.pop("tpu_probe_error")
                 # keep the most complete partial measurement: a probe that
@@ -261,7 +282,13 @@ def device_kernel_rates(timeout_s: int = 150, attempts: int = 3):
             last = f"device probe attempt timed out after {timeout_s}s (tunnel down?)"
         except Exception as e:
             last = str(e)[:120]
-    return {**partial, "tpu_probe_error": f"probe attempts failed; last: {last}"}
+    result = {**partial, "tpu_probe_error": f"probe attempts failed; last: {last}"}
+    try:
+        with open(TPU_CACHE_PATH) as f:
+            result["tpu_last_good"] = json.load(f)
+    except (OSError, ValueError):
+        pass
+    return result
 
 
 def _device_kernel_rates_impl():
