@@ -26,7 +26,7 @@ def get_codec(
     name: str,
     block_size: int | None = None,
     level: int = 1,
-    tpu_batch_blocks: int = 256,
+    tpu_batch_blocks: int | None = None,
 ) -> "FrameCodec | None":
     """Resolve a codec by config name. ``none`` → None (raw bytes, no framing,
     still concatenatable). ``auto`` → native if built, else zlib.
@@ -66,7 +66,9 @@ def get_codec(
     if name == "tpu":
         from s3shuffle_tpu.codec.tpu import TpuCodec
 
-        return TpuCodec(batch_blocks=tpu_batch_blocks, **bs)
+        if tpu_batch_blocks is not None:
+            bs["batch_blocks"] = tpu_batch_blocks
+        return TpuCodec(**bs)
     raise ValueError(f"Unknown codec: {name}")
 
 

@@ -55,10 +55,23 @@ class ExternalSorter:
         self._spills: List[str] = []
         self.spill_count = 0
 
+    #: estimate 1-in-N records and scale once the resident run is large —
+    #: the per-record getsizeof walk would dominate on many-tiny-record
+    #: sorts (cf. aggregator.py's 1-in-64 merge sampling and spill_writer's
+    #: check_every amortization). Small runs estimate every record so a
+    #: handful of huge values still trips the budget promptly.
+    _SAMPLE = 16
+    _EXACT_BELOW = 64
+
     def insert_all(self, records: Iterable[Tuple[Any, Any]]) -> None:
+        tick = 0
         for kv in records:
             self._records.append(kv)
-            self._bytes += estimate_record_bytes(kv)
+            tick += 1
+            if len(self._records) <= self._EXACT_BELOW:
+                self._bytes += estimate_record_bytes(kv)
+            elif tick & (self._SAMPLE - 1) == 0:
+                self._bytes += estimate_record_bytes(kv) * self._SAMPLE
             if (
                 self._bytes >= self._spill_bytes
                 or len(self._records) >= self._spill_threshold
