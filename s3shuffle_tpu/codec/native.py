@@ -88,10 +88,6 @@ def _load() -> ctypes.CDLL:
         return lib
 
 
-def _u8(buf) -> ctypes.POINTER(ctypes.c_uint8):  # type: ignore[valid-type]
-    return ctypes.cast(ctypes.c_char_p(bytes(buf)) if isinstance(buf, (bytes, bytearray)) else buf, ctypes.POINTER(ctypes.c_uint8))
-
-
 def native_available() -> bool:
     try:
         _load()
