@@ -650,6 +650,10 @@ def decode_payload_numpy(payload: bytes, uncompressed_len: int) -> bytes:
             pos = group_start[split_idx][:, None] + lanes[None, :]
             d = np.where(lanes[None, :] < kvals[:, None], d_prev[:, None], d_next[:, None])
             src[pos.reshape(-1)] = (pos - d).reshape(-1)
+        # whole-array pointer doubling with an early convergence exit.
+        # (An active-set variant — updating only unresolved positions — was
+        # measured 2.5x SLOWER here: numpy's contiguous whole-array gather
+        # beats scattered fancy-index updates even at more total elements.)
         for _ in range(_jump_rounds(n_bytes)):
             nxt = src[src]
             if np.array_equal(nxt, src):
