@@ -84,6 +84,11 @@ def _load() -> ctypes.CDLL:
         lib.lz4_compress_framed.argtypes = [
             u8p, ctypes.c_int64, ctypes.c_int64, ctypes.c_uint8, u8p,
         ]
+        u16p = ctypes.POINTER(ctypes.c_uint16)
+        lib.tlz_decode_groups.restype = ctypes.c_int64
+        lib.tlz_decode_groups.argtypes = [
+            u8p, u16p, u8p, u16p, u8p, ctypes.c_int64, ctypes.c_int64, u8p,
+        ]
         _lib = lib
         return lib
 

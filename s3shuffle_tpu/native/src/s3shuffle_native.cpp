@@ -498,6 +498,57 @@ int64_t lz4_compress_framed(const uint8_t* src, int64_t count, int64_t block_siz
 }
 
 // ---------------------------------------------------------------------------
+// TLZ v2 group decoder — the CPU host path for tpu-lz frames. The device
+// decodes with parallel pointer-jumping gathers; on a sequential CPU the
+// same semantics are a plain backward byte-copy per 8-byte group (kind 0 =
+// literal, 1 = match at `dists[g]` back, 2 = split: bytes [0,k) copy at
+// dists[g] back, bytes [k,8) at d2[g] back). Metadata parsing/validation
+// happens in Python (ops/tlz.py); this loop re-checks reach-back bounds so
+// corrupt inputs fail closed (-1) instead of reading out of bounds.
+// ---------------------------------------------------------------------------
+
+int64_t tlz_decode_groups(const uint8_t* kinds, const uint16_t* dists,
+                          const uint8_t* ks, const uint16_t* d2,
+                          const uint8_t* lits, int64_t n_lit_groups,
+                          int64_t n_groups, uint8_t* out) {
+    const uint8_t* lp = lits;
+    const uint8_t* lend = lits + n_lit_groups * 8;
+    uint8_t* op = out;
+    for (int64_t g = 0; g < n_groups; g++) {
+        int64_t produced = op - out;
+        switch (kinds[g]) {
+            case 0: {
+                if (lp + 8 > lend) return -1;
+                memcpy(op, lp, 8);
+                lp += 8;
+                break;
+            }
+            case 1: {
+                int64_t d = dists[g];
+                if (d == 0 || d > produced) return -1;
+                const uint8_t* srcp = op - d;
+                for (int j = 0; j < 8; j++) op[j] = srcp[j];  // overlap-safe
+                break;
+            }
+            case 2: {
+                int64_t dp = dists[g], dn = d2[g];
+                int k = ks[g];
+                if (k < 1 || k > 7 || dp == 0 || dn == 0 || dp > produced ||
+                    dn > produced + k)
+                    return -1;
+                for (int j = 0; j < k; j++) op[j] = op[j - dp];
+                for (int j = k; j < 8; j++) op[j] = op[j - dn];
+                break;
+            }
+            default:
+                return -1;
+        }
+        op += 8;
+    }
+    return op - out;
+}
+
+// ---------------------------------------------------------------------------
 // Batch entry points (one call per frame batch → fewer ctypes crossings)
 // ---------------------------------------------------------------------------
 

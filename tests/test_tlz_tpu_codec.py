@@ -268,3 +268,45 @@ def test_end_to_end_shuffle_with_tpu_codec(tmp_path):
     with ShuffleContext(config=cfg, num_workers=2) as ctx:
         result = dict(ctx.fold_by_key(parts, 0, lambda a, b: a + b, num_partitions=3))
     assert result == dict(expected)
+
+
+@pytest.mark.parametrize("idx", range(7))
+def test_tlz_native_and_numpy_decoders_agree(idx):
+    """Differential pin: the C group decoder and the numpy pointer-jumping
+    fallback must produce identical output for every payload shape (which
+    path `use_native=None` takes depends on the environment, so each is
+    forced explicitly)."""
+    from s3shuffle_tpu.codec.native import native_available
+
+    data = _payload_cases()[idx]
+    payload = tlz._assemble_payload_numpy(data)
+    via_numpy = tlz.decode_payload_numpy(payload, len(data), use_native=False)
+    assert via_numpy == data
+    if not native_available():
+        pytest.skip("native toolchain unavailable")
+    via_c = tlz.decode_payload_numpy(payload, len(data), use_native=True)
+    assert via_c == data
+
+
+def test_tlz_native_decoder_rejects_corrupt_split(monkeypatch):
+    """The C decoder fails closed (IOError) on inputs whose reach-back is
+    corrupt, exercised by bypassing the Python-side validation."""
+    import numpy as np
+
+    from s3shuffle_tpu.codec.native import native_available
+
+    if not native_available():
+        pytest.skip("native toolchain unavailable")
+    # group 1 claims a match at distance 200 with only 8 bytes produced
+    with pytest.raises(IOError, match="rejected the payload"):
+        tlz._decode_groups_native(
+            np.array([False, True]),
+            np.array([0, 200], dtype=np.int64),
+            np.array([], dtype=np.int64),
+            None,
+            None,
+            None,
+            np.zeros(8, np.uint8),
+            1,
+            2,
+        )
