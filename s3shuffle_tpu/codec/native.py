@@ -21,26 +21,37 @@ _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
 _SO_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libs3shuffle_native.so"))
 
 _lib = None
+_lib_error: Exception | None = None
 _lib_lock = threading.Lock()
 
 
 def _load() -> ctypes.CDLL:
-    global _lib
+    global _lib, _lib_error
     if os.environ.get("S3SHUFFLE_DISABLE_NATIVE"):
         raise RuntimeError("native library disabled via S3SHUFFLE_DISABLE_NATIVE")
     if _lib is not None:
         return _lib
+    if _lib_error is not None:
+        # a failed load (missing toolchain, bad platform) is permanent for
+        # this process — never re-spawn `make` per call on a hot path
+        raise _lib_error
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_SO_PATH):
-            subprocess.run(
-                ["make", "-C", os.path.abspath(_NATIVE_DIR)],
-                check=True,
-                capture_output=True,
-                timeout=120,
-            )
-        lib = ctypes.CDLL(_SO_PATH)
+        if _lib_error is not None:
+            raise _lib_error
+        try:
+            if not os.path.exists(_SO_PATH):
+                subprocess.run(
+                    ["make", "-C", os.path.abspath(_NATIVE_DIR)],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            lib = ctypes.CDLL(_SO_PATH)
+        except Exception as e:
+            _lib_error = e
+            raise
         u8p = ctypes.POINTER(ctypes.c_uint8)
         i64p = ctypes.POINTER(ctypes.c_int64)
         u32p = ctypes.POINTER(ctypes.c_uint32)
@@ -85,9 +96,10 @@ def _load() -> ctypes.CDLL:
             u8p, ctypes.c_int64, ctypes.c_int64, ctypes.c_uint8, u8p,
         ]
         u16p = ctypes.POINTER(ctypes.c_uint16)
-        lib.tlz_decode_groups.restype = ctypes.c_int64
-        lib.tlz_decode_groups.argtypes = [
-            u8p, u16p, u8p, u16p, u8p, ctypes.c_int64, ctypes.c_int64, u8p,
+        lib.tlz_decode_block.restype = ctypes.c_int64
+        lib.tlz_decode_block.argtypes = [
+            u8p, u8p, u8p, u16p, ctypes.c_int64, u8p, ctypes.c_int64,
+            u8p, ctypes.c_int64, ctypes.c_int64, u8p,
         ]
         _lib = lib
         return lib

@@ -507,44 +507,71 @@ int64_t lz4_compress_framed(const uint8_t* src, int64_t count, int64_t block_siz
 // corrupt inputs fail closed (-1) instead of reading out of bounds.
 // ---------------------------------------------------------------------------
 
-int64_t tlz_decode_groups(const uint8_t* kinds, const uint16_t* dists,
-                          const uint8_t* ks, const uint16_t* d2,
-                          const uint8_t* lits, int64_t n_lit_groups,
-                          int64_t n_groups, uint8_t* out) {
+// Single-pass variant consuming the PACKED metadata planes directly: walks
+// the three bitmaps bit by bit, maintaining the running distance for cont
+// elision and peeking the next stored distance for split groups. Strict
+// consumption (-1 unless every dists/ks/lits byte is used exactly) makes
+// mis-sized planes fail closed without any host-side pre-validation.
+int64_t tlz_decode_block(const uint8_t* match_bm, const uint8_t* cont_bm,
+                         const uint8_t* split_bm,
+                         const uint16_t* dists, int64_t n_dists,
+                         const uint8_t* ks, int64_t n_ks,
+                         const uint8_t* lits, int64_t n_lit_groups,
+                         int64_t n_groups, uint8_t* out) {
     const uint8_t* lp = lits;
     const uint8_t* lend = lits + n_lit_groups * 8;
+    const uint16_t* dq = dists;
+    const uint16_t* dend = dists + n_dists;
+    const uint8_t* kq = ks;
+    const uint8_t* kend = ks + n_ks;
     uint8_t* op = out;
+    int64_t prev_dist = 0;
+    int prev_match = 0;
     for (int64_t g = 0; g < n_groups; g++) {
+        int m = (match_bm[g >> 3] >> (g & 7)) & 1;
+        int c = (cont_bm[g >> 3] >> (g & 7)) & 1;
+        int sp = (split_bm[g >> 3] >> (g & 7)) & 1;
         int64_t produced = op - out;
-        switch (kinds[g]) {
-            case 0: {
-                if (lp + 8 > lend) return -1;
-                memcpy(op, lp, 8);
-                lp += 8;
-                break;
+        if (m) {
+            if (sp) return -1;  // split flag on a match group
+            int64_t d;
+            if (c) {
+                if (!prev_match) return -1;
+                d = prev_dist;
+            } else {
+                if (dq >= dend) return -1;
+                d = *dq++;
             }
-            case 1: {
-                int64_t d = dists[g];
-                if (d == 0 || d > produced) return -1;
-                const uint8_t* srcp = op - d;
-                for (int j = 0; j < 8; j++) op[j] = srcp[j];  // overlap-safe
-                break;
-            }
-            case 2: {
-                int64_t dp = dists[g], dn = d2[g];
-                int k = ks[g];
-                if (k < 1 || k > 7 || dp == 0 || dn == 0 || dp > produced ||
-                    dn > produced + k)
-                    return -1;
-                for (int j = 0; j < k; j++) op[j] = op[j - dp];
-                for (int j = k; j < 8; j++) op[j] = op[j - dn];
-                break;
-            }
-            default:
+            if (d == 0 || d > produced) return -1;
+            const uint8_t* srcp = op - d;
+            for (int j = 0; j < 8; j++) op[j] = srcp[j];  // overlap-safe
+            prev_dist = d;
+            prev_match = 1;
+        } else if (sp) {
+            if (c) return -1;  // cont flag on a non-match group
+            if (!prev_match || g + 1 >= n_groups) return -1;
+            int nm = (match_bm[(g + 1) >> 3] >> ((g + 1) & 7)) & 1;
+            int nc = (cont_bm[(g + 1) >> 3] >> ((g + 1) & 7)) & 1;
+            if (!nm || nc) return -1;  // right neighbor must be a NEW match
+            if (dq >= dend || kq >= kend) return -1;
+            int64_t dn = *dq;  // peeked — the next match consumes it
+            int k = *kq++;
+            int64_t dp = prev_dist;
+            if (k < 1 || k > 7 || dn == 0 || dp > produced || dn > produced + k)
                 return -1;
+            for (int j = 0; j < k; j++) op[j] = op[j - dp];
+            for (int j = k; j < 8; j++) op[j] = op[j - dn];
+            prev_match = 0;
+        } else {
+            if (c) return -1;
+            if (lp + 8 > lend) return -1;
+            memcpy(op, lp, 8);
+            lp += 8;
+            prev_match = 0;
         }
         op += 8;
     }
+    if (lp != lend || dq != dend || kq != kend) return -1;
     return op - out;
 }
 

@@ -136,6 +136,11 @@ def _jump_rounds(n_bytes: int) -> int:
     return int(np.ceil(np.log2(max(2, n_bytes))))
 
 
+#: per-byte popcount — plane boundaries from packed bitmaps without
+#: unpacking them to bools (32x less data touched)
+_POP = np.array([bin(i).count("1") for i in range(256)], dtype=np.int64)
+
+
 # ---------------------------------------------------------------------------
 # Device encoder (batched)
 # ---------------------------------------------------------------------------
@@ -590,11 +595,22 @@ def _expand_dists_numpy(is_match, is_cont, dists, n_groups):
 def decode_payload_numpy(
     payload: bytes, uncompressed_len: int, use_native: bool | None = None
 ) -> bytes:
-    """Host decode of one TLZ payload. After the (host) metadata parse and
-    validation, the byte plane is produced either by the C group decoder
-    (``libs3shuffle_native`` — sequential backward copies, ~GB/s) or by the
-    vectorized numpy pointer-jumping fallback. ``use_native=None`` → C when
-    the library loads."""
+    """Host decode of one TLZ payload. v2 payloads go through the C
+    single-pass block decoder (``libs3shuffle_native`` — header + inflate +
+    popcount plane-splitting in Python, everything else sequential backward
+    copies in C) when the library loads; otherwise — and whenever the C
+    decoder rejects the payload — the vectorized numpy path parses,
+    validates with precise errors, and pointer-jumps. ``use_native=False``
+    forces the numpy path (the differential-testing oracle)."""
+    if use_native is not False:
+        fast = _decode_block_native_fast(payload, uncompressed_len)
+        if fast is not None:
+            return fast
+        if use_native:  # explicitly forced: do not silently fall back
+            raise RuntimeError(
+                "native TLZ decoder unavailable or rejected the payload"
+            )
+        # fall through: the numpy path raises precise errors on corruption
     version, n_groups, is_match, is_cont, is_split, dists, ks, lits = (
         _parse_payload(payload, uncompressed_len)
     )
@@ -634,17 +650,6 @@ def decode_payload_numpy(
         d_next = dist_full[split_idx + 1]
         if ((group_start[split_idx] + kvals - d_next) < 0).any():
             raise IOError("TLZ split suffix distance out of range")
-    if use_native is not False:
-        native_out = _decode_groups_native(
-            is_match, dist_full, ks, split_idx if len(split_idx) else None,
-            d_prev if len(split_idx) else None,
-            d_next if len(split_idx) else None,
-            lits, n_lits, n_groups,
-        )
-        if native_out is not None:
-            return native_out[:uncompressed_len].tobytes()
-        if use_native:
-            raise RuntimeError("native TLZ decoder unavailable")
     # literal plane, placed sparsely at each literal group's position
     is_lit = ~is_match & ~is_split
     sparse = np.zeros((n_groups, GROUP), dtype=np.uint8)
@@ -748,12 +753,14 @@ def _decode_math(
     return jnp.take_along_axis(sparse, src, axis=1)
 
 
-def _decode_groups_native(
-    is_match, dist_full, ks, split_idx, d_prev, d_next,
-    lits, n_lits: int, n_groups: int,
-):
-    """Run the C group decoder; returns the decoded uint8 array or None when
-    the native library is unavailable."""
+def _decode_block_native_fast(payload: bytes, ulen: int):
+    """Whole-block host decode through the C single-pass decoder, straight
+    from the packed payload: header + (optional) inflate + popcount plane
+    splitting in Python, everything else in C. Returns the decoded bytes, or
+    None when the native library is unavailable or the payload doesn't parse
+    cleanly — the caller then falls through to the validating numpy path,
+    which raises precise errors (the C decoder enforces the same invariants
+    but reports only accept/reject)."""
     try:
         import ctypes
 
@@ -762,35 +769,85 @@ def _decode_groups_native(
         lib = _load()
     except Exception:
         return None
-    kinds = np.zeros(n_groups, dtype=np.uint8)
-    kinds[is_match] = 1
-    dists_arr = np.zeros(n_groups, dtype="<u2")
-    dists_arr[is_match] = dist_full[is_match].astype("<u2")
-    ks_arr = np.zeros(n_groups, dtype=np.uint8)
-    d2_arr = np.zeros(n_groups, dtype="<u2")
-    if split_idx is not None:
-        kinds[split_idx] = 2
-        dists_arr[split_idx] = d_prev.astype("<u2")
-        ks_arr[split_idx] = ks.astype(np.uint8)
-        d2_arr[split_idx] = d_next.astype("<u2")
-    lits_c = np.ascontiguousarray(lits, dtype=np.uint8)
+    if len(payload) < 2:
+        return None
+    field = int(np.frombuffer(payload[:2], dtype="<u2")[0])
+    if not field & V2_FLAG:
+        return None
+    if ulen <= 0:
+        return b"" if field == V2_FLAG and len(payload) == 2 else None
+    n_groups = (ulen + GROUP - 1) // GROUP
+    if n_groups == 0 or n_groups > MAX_BLOCK // GROUP:
+        return None
+    if (field & 0x3FFF) != (n_groups & 0x3FFF):
+        return None
+    bm = (n_groups + 7) // 8
+    if field & PACKED_FLAG:
+        import zlib
+
+        if len(payload) < 6:
+            return None
+        clen = int(np.frombuffer(payload[2:6], dtype="<u4")[0])
+        if 6 + clen > len(payload):
+            return None
+        max_meta = 3 * bm + 3 * n_groups
+        try:
+            d = zlib.decompressobj()
+            meta = d.decompress(payload[6 : 6 + clen], max_meta + 1)
+        except zlib.error:
+            return None
+        if len(meta) > max_meta or d.unconsumed_tail:
+            return None
+        lit_off = 6 + clen
+        src, soff = meta, 0
+    else:
+        src, soff = payload, 2
+        lit_off = None
+    if len(src) - soff < 3 * bm:
+        return None
+    mb = np.frombuffer(src[soff : soff + bm], dtype=np.uint8)
+    cb = np.frombuffer(src[soff + bm : soff + 2 * bm], dtype=np.uint8)
+    sb = np.frombuffer(src[soff + 2 * bm : soff + 3 * bm], dtype=np.uint8)
+    n_new = int(_POP[mb & ~cb].sum())
+    n_split = int(_POP[sb].sum())
+    n_lits = n_groups - int(_POP[mb].sum()) - n_split
+    if n_lits < 0:
+        return None
+    meta_len = 3 * bm + 2 * n_new + n_split
+    if len(src) - soff < meta_len:
+        return None
+    dists = np.frombuffer(
+        src[soff + 3 * bm : soff + 3 * bm + 2 * n_new], dtype="<u2"
+    ).copy()  # copy: frombuffer slices may be misaligned for u16
+    ks = np.frombuffer(
+        src[soff + 3 * bm + 2 * n_new : soff + meta_len], dtype=np.uint8
+    )
+    if lit_off is None:
+        lit_off = 2 + meta_len
+    elif len(meta) != meta_len:
+        return None
+    if len(payload) != lit_off + n_lits * GROUP:
+        return None
+    lits = np.frombuffer(payload[lit_off:], dtype=np.uint8)
     out = np.empty(n_groups * GROUP, dtype=np.uint8)
     u8p = ctypes.POINTER(ctypes.c_uint8)
     u16p = ctypes.POINTER(ctypes.c_uint16)
-    rc = lib.tlz_decode_groups(
-        kinds.ctypes.data_as(u8p),
-        dists_arr.ctypes.data_as(u16p),
-        ks_arr.ctypes.data_as(u8p),
-        d2_arr.ctypes.data_as(u16p),
-        lits_c.ctypes.data_as(u8p),
+    rc = lib.tlz_decode_block(
+        np.ascontiguousarray(mb).ctypes.data_as(u8p),
+        np.ascontiguousarray(cb).ctypes.data_as(u8p),
+        np.ascontiguousarray(sb).ctypes.data_as(u8p),
+        dists.ctypes.data_as(u16p),
+        n_new,
+        np.ascontiguousarray(ks).ctypes.data_as(u8p),
+        n_split,
+        np.ascontiguousarray(lits).ctypes.data_as(u8p),
         n_lits,
         n_groups,
         out.ctypes.data_as(u8p),
     )
     if rc != n_groups * GROUP:
-        # the C decoder fails closed with a bare -1 (no position information)
-        raise IOError("native TLZ decode rejected the payload as corrupt")
-    return out
+        return None
+    return out[:ulen].tobytes()
 
 
 @functools.lru_cache(maxsize=8)

@@ -288,25 +288,29 @@ def test_tlz_native_and_numpy_decoders_agree(idx):
     assert via_c == data
 
 
-def test_tlz_native_decoder_rejects_corrupt_split(monkeypatch):
-    """The C decoder fails closed (IOError) on inputs whose reach-back is
-    corrupt, exercised by bypassing the Python-side validation."""
-    import numpy as np
+def test_tlz_native_fast_path_rejects_corrupt_reachback():
+    """A payload whose match distance exceeds the bytes produced so far must
+    be refused by BOTH decoders: the C fast path returns None (fail closed)
+    and the validating numpy path raises the precise IOError."""
+    import zlib
 
     from s3shuffle_tpu.codec.native import native_available
 
-    if not native_available():
-        pytest.skip("native toolchain unavailable")
-    # group 1 claims a match at distance 200 with only 8 bytes produced
-    with pytest.raises(IOError, match="rejected the payload"):
-        tlz._decode_groups_native(
-            np.array([False, True]),
-            np.array([0, 200], dtype=np.int64),
-            np.array([], dtype=np.int64),
-            None,
-            None,
-            None,
-            np.zeros(8, np.uint8),
-            1,
-            2,
-        )
+    ng = 16
+    m = np.zeros(ng, np.uint8)
+    m[1] = 1  # one match at group 1 ...
+    zeros = np.packbits(np.zeros(ng, np.uint8), bitorder="little").tobytes()
+    meta = (
+        np.packbits(m, bitorder="little").tobytes()
+        + zeros
+        + zeros
+        + np.array([5000], dtype="<u2").tobytes()  # ... claiming 5000 back
+    )
+    lits = b"L" * (8 * (ng - 1))
+    payload = (
+        np.array([ng | tlz.V2_FLAG], dtype="<u2").tobytes() + meta + lits
+    )
+    if native_available():
+        assert tlz._decode_block_native_fast(payload, ng * tlz.GROUP) is None
+    with pytest.raises(IOError, match="distance out of range"):
+        tlz.decode_payload_numpy(payload, ng * tlz.GROUP, use_native=False)
