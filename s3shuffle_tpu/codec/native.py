@@ -101,6 +101,12 @@ def _load() -> ctypes.CDLL:
             u8p, u8p, u8p, u16p, ctypes.c_int64, u8p, ctypes.c_int64,
             u8p, ctypes.c_int64, ctypes.c_int64, u8p,
         ]
+        i64pp = ctypes.POINTER(ctypes.c_int64)
+        lib.tlz_encode_block.restype = ctypes.c_int64
+        lib.tlz_encode_block.argtypes = [
+            u8p, ctypes.c_int64, u8p, u8p, u8p, u16p, i64pp, u8p, i64pp,
+            u8p, i64pp,
+        ]
         _lib = lib
         return lib
 

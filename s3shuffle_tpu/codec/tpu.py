@@ -81,8 +81,11 @@ class TpuCodec(FrameCodec):
                     self._use_device = False
         return self._use_device
 
-    # --- single block (short tails / compatibility path: numpy) ---
+    # --- single block (host path: C encoder, numpy fallback/oracle) ---
     def compress_block(self, data: bytes) -> bytes:
+        native = tlz._encode_block_native(data)
+        if native is not None:
+            return native
         return tlz._assemble_payload_numpy(data)
 
     def decompress_block(self, data: bytes, uncompressed_len: int) -> bytes:

@@ -507,6 +507,122 @@ int64_t lz4_compress_framed(const uint8_t* src, int64_t count, int64_t block_siz
 // corrupt inputs fail closed (-1) instead of reading out of bounds.
 // ---------------------------------------------------------------------------
 
+// ---------------------------------------------------------------------------
+// TLZ v2 group encoder — the CPU fallback for the TPU codec's write path,
+// emitting the same wire planes the device kernel produces (so mixed
+// TPU/CPU fleets share one format). Greedy, sequential: a hash table over
+// 8-byte windows at every byte position gives nearest-previous candidates;
+// the previous group's distance is tried FIRST so continuation runs stay
+// aligned for the cont bitmap; failed groups get a one-group-lookahead
+// split check (prefix at the left run's distance, suffix at the next
+// group's). Outputs: the three bitmaps + dists (u16) + ks (u8) + literal
+// plane; counts via the return struct-free out params.
+// ---------------------------------------------------------------------------
+
+static const uint32_t TLZ_HASH_BITS = 15;
+
+static inline uint32_t tlz_hash8(uint64_t v) {
+    return (uint32_t)((v * 0x9E3779B185EBCA87ull) >> (64 - TLZ_HASH_BITS));
+}
+
+static inline void tlz_setbit(uint8_t* bm, int64_t i) {
+    bm[i >> 3] |= (uint8_t)(1u << (i & 7));
+}
+
+int64_t tlz_encode_block(const uint8_t* src, int64_t n_groups,
+                         uint8_t* match_bm, uint8_t* cont_bm, uint8_t* split_bm,
+                         uint16_t* dists, int64_t* n_dists,
+                         uint8_t* ks, int64_t* n_ks,
+                         uint8_t* lits, int64_t* n_lit_groups) {
+    // fail closed on oversized blocks: the alloca'd decision arrays below
+    // must stay bounded regardless of the caller (the Python wrapper also
+    // enforces MAX_BLOCK, but the C ABI cannot rely on it)
+    if (n_groups < 0 || n_groups > (int64_t)(1 << 15)) return -1;
+    int64_t n_bytes = n_groups * 8;
+    int64_t bm_len = (n_groups + 7) / 8;
+    memset(match_bm, 0, (size_t)bm_len);
+    memset(cont_bm, 0, (size_t)bm_len);
+    memset(split_bm, 0, (size_t)bm_len);
+
+    // candidate table: last position seen per 8-byte-window hash
+    static thread_local int64_t table[1u << TLZ_HASH_BITS];
+    for (uint32_t i = 0; i < (1u << TLZ_HASH_BITS); i++) table[i] = -1;
+
+    // per-group decisions, one-group lookahead for splits:
+    //   kind[g]: 0 literal, 1 match; dist[g] valid for matches
+    // (stack arrays sized for the 256 KiB cap = 32768 groups)
+    uint16_t* gdist = (uint16_t*)__builtin_alloca((size_t)n_groups * 2);
+    uint8_t* gkind = (uint8_t*)__builtin_alloca((size_t)n_groups);
+
+    int64_t seeded = 0;  // table covers windows starting < seeded
+    int64_t prev_dist = 0;
+    int prev_match = 0;
+    for (int64_t g = 0; g < n_groups; g++) {
+        int64_t d = g * 8;
+        // seed every byte position up to this group's start
+        for (; seeded < d && seeded + 8 <= n_bytes; seeded++)
+            table[tlz_hash8(load64(src + seeded))] = seeded;
+        uint64_t w = load64(src + d);
+        int64_t dist = 0;
+        if (prev_match && d >= prev_dist && load64(src + d - prev_dist) == w) {
+            dist = prev_dist;  // continuation-first keeps runs aligned
+        } else {
+            int64_t cand = table[tlz_hash8(w)];
+            if (cand >= 0 && d - cand <= 0xFFFF && load64(src + cand) == w)
+                dist = d - cand;
+        }
+        if (dist > 0) {
+            gkind[g] = 1;
+            gdist[g] = (uint16_t)dist;
+            prev_dist = dist;
+            prev_match = 1;
+        } else {
+            gkind[g] = 0;
+            prev_match = 0;
+        }
+    }
+
+    // emit planes with split detection between two match groups
+    uint16_t* dq = dists;
+    uint8_t* kq = ks;
+    uint8_t* lp = lits;
+    for (int64_t g = 0; g < n_groups; g++) {
+        if (gkind[g] == 1) {
+            tlz_setbit(match_bm, g);
+            if (g > 0 && gkind[g - 1] == 1 && gdist[g] == gdist[g - 1])
+                tlz_setbit(cont_bm, g);
+            else
+                *dq++ = gdist[g];
+            continue;
+        }
+        int64_t d = g * 8;
+        if (g > 0 && g + 1 < n_groups && gkind[g - 1] == 1 && gkind[g + 1] == 1) {
+            int64_t dp = gdist[g - 1], dn = gdist[g + 1];
+            // prefix run at the left distance; earliest suffix start at the
+            // right distance. (The right neighbor always consumes a NEW
+            // distance entry for the decoder to peek: its predecessor — this
+            // split — is not a match, so its cont bit is never set.)
+            int pref = 0;
+            while (pref < 8 && src[d + pref] == src[d + pref - dp]) pref++;
+            int suf = 8;
+            while (suf > 0 && d + suf - 1 - dn >= 0 &&
+                   src[d + suf - 1] == src[d + suf - 1 - dn])
+                suf--;
+            if (suf >= 1 && suf <= 7 && suf <= pref && d + suf - dn >= 0) {
+                tlz_setbit(split_bm, g);
+                *kq++ = (uint8_t)suf;
+                continue;
+            }
+        }
+        memcpy(lp, src + d, 8);
+        lp += 8;
+    }
+    *n_dists = dq - dists;
+    *n_ks = kq - ks;
+    *n_lit_groups = (lp - lits) / 8;
+    return 0;
+}
+
 // Single-pass variant consuming the PACKED metadata planes directly: walks
 // the three bitmaps bit by bit, maintaining the running distance for cont
 // elision and peeking the next stored distance for split groups. Strict
