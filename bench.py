@@ -31,6 +31,8 @@ RECORDS_PER_MAP = 120_000
 N_MAPS = 6
 N_REDUCERS = 8
 KEY_BYTES, VALUE_BYTES = 10, 90  # terasort record shape
+# raw shuffle volume: records x (key + value + u32 key-len + u32 value-len)
+RAW_BYTES = N_MAPS * RECORDS_PER_MAP * (KEY_BYTES + VALUE_BYTES + 8)
 # device-probe batch shape (overridable for CPU-backend smoke tests):
 # 256 KiB blocks are the TPU codec's ratio-optimal block size (first-
 # occurrence literals amortize with block length; the match window is a
@@ -137,15 +139,69 @@ def run_comparison(parts, workers: int = 0, repeats: int = 5):
     finally:
         for root in roots.values():
             shutil.rmtree(root, ignore_errors=True)
-    raw_bytes = N_MAPS * RECORDS_PER_MAP * (KEY_BYTES + VALUE_BYTES + 8)
     ratios = {
         f"{name}_compression_ratio": (
-            round(raw_bytes / stored[name], 3) if stored.get(name) else 0.0
+            round(RAW_BYTES / stored[name], 3) if stored.get(name) else 0.0
         )
         for name in names
     }
-    bps = {name: raw_bytes / best[name] for name in names}
+    bps = {name: RAW_BYTES / best[name] for name in names}
     return bps, best, ratios
+
+
+def tpu_codec_ratio_run(parts):
+    """The north-star ratio gate, measured two ways (honestly labeled — the
+    two TLZ encoders share the wire format but make different match
+    decisions, so their ratios differ):
+
+    - ``tpu_hostenc_compression_ratio``: end-to-end stored bytes of one full
+      shuffle with codec=tpu through the HOST C encoder
+      (S3SHUFFLE_TPU_CODEC_DEVICE=0 for the duration, so this can never hang
+      on the TPU tunnel);
+    - ``tpu_device_algorithm_payload_ratio``: the serialized shuffle payload
+      through the numpy encoder, which makes byte-identical match decisions
+      to the batched device kernel (sort-based nearest-previous) — the ratio
+      the chip produces.
+    """
+    import io as _io
+
+    from s3shuffle_tpu.batch import write_frame
+    from s3shuffle_tpu.ops import tlz
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+
+    saved = os.environ.get("S3SHUFFLE_TPU_CODEC_DEVICE")
+    os.environ["S3SHUFFLE_TPU_CODEC_DEVICE"] = "0"
+    try:
+        Dispatcher.reset()
+        ctx, root = _make_ctx("tpu", min(4, os.cpu_count() or 1))
+        try:
+            wall, out = _timed_shuffle(ctx, parts, cleanup=False)
+            _validate(out)
+            stored = _tree_bytes(root)
+            ctx.stop()
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+        buf = _io.BytesIO()
+        for p in parts:
+            write_frame(buf, p)
+        payload = buf.getvalue()
+        bs = 256 * 1024
+        comp = sum(
+            min(len(tlz._assemble_payload_numpy(payload[i : i + bs])) + 9, bs + 9)
+            for i in range(0, len(payload), bs)
+        )
+    except Exception as e:
+        return {"tpu_codec_ratio_error": str(e)[:120]}
+    finally:
+        if saved is None:
+            os.environ.pop("S3SHUFFLE_TPU_CODEC_DEVICE", None)
+        else:
+            os.environ["S3SHUFFLE_TPU_CODEC_DEVICE"] = saved
+    return {
+        "tpu_hostenc_compression_ratio": round(RAW_BYTES / stored, 3) if stored else 0.0,
+        "tpu_device_algorithm_payload_ratio": round(len(payload) / comp, 3),
+        "tpu_hostpath_wall_s": round(wall, 2),
+    }
 
 
 def aggregate_multiworker(parts, workers: int = 4, repeats: int = 3):
@@ -166,10 +222,9 @@ def aggregate_multiworker(parts, workers: int = 4, repeats: int = 3):
         ctx.stop()
     finally:
         shutil.rmtree(root, ignore_errors=True)
-    raw_bytes = N_MAPS * RECORDS_PER_MAP * (KEY_BYTES + VALUE_BYTES + 8)
     return {
         "aggregate_workers": workers,
-        "aggregate_mb_s": round(raw_bytes / best / 1e6, 2),
+        "aggregate_mb_s": round(RAW_BYTES / best / 1e6, 2),
         "host_cores": os.cpu_count() or 1,
     }
 
@@ -471,6 +526,7 @@ def main():
     bps, walls, ratios = run_comparison(parts)
     extras = {
         **ratios,
+        **tpu_codec_ratio_run(parts),
         **write_cpu_comparison(parts),
         **aggregate_multiworker(parts),
         **device_kernel_rates(),
@@ -485,7 +541,7 @@ def main():
         "native_wall_s": round(walls["native"], 2),
         "zlib_wall_s": round(walls["zlib"], 2),
         "lz4_wall_s": round(walls["lz4"], 2),
-        "shuffle_mb": round(N_MAPS * RECORDS_PER_MAP * (KEY_BYTES + VALUE_BYTES + 8) / 1e6, 1),
+        "shuffle_mb": round(RAW_BYTES / 1e6, 1),
         **extras,
     }
     print(json.dumps(result))
