@@ -314,3 +314,25 @@ def test_tlz_native_fast_path_rejects_corrupt_reachback():
         assert tlz._decode_block_native_fast(payload, ng * tlz.GROUP) is None
     with pytest.raises(IOError, match="distance out of range"):
         tlz.decode_payload_numpy(payload, ng * tlz.GROUP, use_native=False)
+
+
+def test_tlz_meta_pack_levels_all_roundtrip():
+    """META_PACK_LEVEL trades host CPU for ~3% ratio; every level (including
+    0 = plain metadata) must produce decodable payloads for both decoders."""
+    import random
+
+    rng = random.Random(21)
+    pool = [rng.randbytes(90) for _ in range(16)]
+    data = b"".join(pool[rng.randrange(16)] for _ in range(800))
+    for level in (0, 1, 6):
+        old = tlz.META_PACK_LEVEL
+        tlz.META_PACK_LEVEL = level
+        try:
+            p = tlz._assemble_payload_numpy(data)
+            assert tlz.decode_payload_numpy(p, len(data), use_native=False) == data
+            from s3shuffle_tpu.codec.native import native_available
+
+            if native_available():
+                assert tlz.decode_payload_numpy(p, len(data)) == data
+        finally:
+            tlz.META_PACK_LEVEL = old
