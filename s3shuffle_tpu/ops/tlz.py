@@ -99,12 +99,13 @@ MAX_DIST = (1 << 16) - 1
 MAX_BLOCK = 1 << 18
 
 #: deflate level for the packed metadata section. The knob trades write-side
-#: HOST CPU (the offload pipeline's only non-trivial host work) for ~3% of
-#: ratio — measured on the terasort payload at 256 KiB blocks:
-#:   level 6: assembly 476 MB/s/core, ratio 7.32x
-#:   level 1: assembly 1127 MB/s/core, ratio 7.10x   (default)
-#:   level 0: plain meta, assembly memcpy-bound,  ratio ~6.4x
-#: every level stays well above real LZ4's 4.96x on the same payload.
+#: HOST CPU (the offload pipeline's only non-trivial host work) for ratio —
+#: measured on the terasort payload at 256 KiB blocks (framed, device-
+#: algorithm encoder):
+#:   level 6: assembly 476 MB/s/core,  ratio 7.28x
+#:   level 1: assembly 1127 MB/s/core, ratio 7.06x   (default)
+#:   level 0: plain meta, memcpy-bound assembly, ratio 5.54x
+#: every level stays above real LZ4's 4.96x on the same payload.
 META_PACK_LEVEL = 1
 
 
@@ -123,7 +124,9 @@ def _pack_meta(
     meta = bitmap_b + cont_b + split_b + offs_b + ks_b
     ng_field = n_groups & 0x3FFF  # low 14 bits: consistency check only —
     # the true count derives from the frame's uncompressed length
-    if level <= 0:
+    if level == 0:
+        # exactly 0 ⇒ plain metadata; negative values (zlib's own
+        # Z_DEFAULT_COMPRESSION sentinel) pass through to zlib below
         return np.array([ng_field | V2_FLAG], dtype="<u2").tobytes() + meta
     packed = zlib.compress(meta, level)
     if len(packed) + 4 < len(meta):
