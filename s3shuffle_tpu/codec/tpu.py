@@ -39,6 +39,47 @@ from s3shuffle_tpu.ops.checksum import (
 )
 
 
+#: process-wide backend-probe verdict (None = not probed yet). One probe
+#: per process: each TpuCodec instance re-paying the timeout — and leaking
+#: another thread parked on jax's init lock — would multiply the stall.
+_BACKEND_VERDICT: bool | None = None
+
+
+def _probe_device_backend() -> bool:
+    global _BACKEND_VERDICT
+    import os
+
+    # the env var is an explicit operator override — always honored, never
+    # shadowed by an earlier probe's cached verdict
+    env = os.environ.get("S3SHUFFLE_TPU_CODEC_DEVICE")
+    if env is not None:
+        return env.strip().lower() in ("1", "true", "yes", "on")
+    if _BACKEND_VERDICT is not None:
+        return _BACKEND_VERDICT
+    import threading
+
+    try:
+        timeout = float(os.environ.get("S3SHUFFLE_BACKEND_PROBE_S", "20"))
+    except ValueError:
+        timeout = 20.0
+    result: dict = {}
+
+    def probe() -> None:
+        try:
+            import jax
+
+            result["backend"] = jax.default_backend()
+        except Exception:
+            result["backend"] = None
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout=timeout)
+    backend = result.get("backend")  # None: failed OR still hung
+    _BACKEND_VERDICT = backend is not None and backend != "cpu"
+    return _BACKEND_VERDICT
+
+
 class TpuCodec(FrameCodec):
     name = "tpu-lz"
     codec_id = CODEC_IDS["tpu-lz"]
@@ -65,20 +106,19 @@ class TpuCodec(FrameCodec):
         actually attached — XLA:CPU runs the sort/gather kernels orders of
         magnitude slower than the vectorized numpy path, and readers of
         tpu-lz data are often plain CPU hosts. Overridable per instance
-        (``use_device=``) or via S3SHUFFLE_TPU_CODEC_DEVICE=0/1."""
+        (``use_device=``) or via S3SHUFFLE_TPU_CODEC_DEVICE=0/1.
+
+        The backend probe runs ONCE PER PROCESS in a daemon thread with a
+        timeout: on this rig the TPU sits behind a tunnel whose PJRT init
+        HANGS outright when the tunnel is down, and a shuffle must degrade
+        to the (fast) host C paths rather than block forever at the first
+        batch. A timed-out probe leaves that one thread parked inside
+        backend init — callers that import jax themselves afterwards (the
+        device-only helpers like :func:`fused_compress_and_checksum`) can
+        still block on jax's init lock; the shuffle data plane never does
+        once the verdict is host."""
         if self._use_device is None:
-            import os
-
-            env = os.environ.get("S3SHUFFLE_TPU_CODEC_DEVICE")
-            if env is not None:
-                self._use_device = env.strip().lower() in ("1", "true", "yes", "on")
-            else:
-                try:
-                    import jax
-
-                    self._use_device = jax.default_backend() not in ("cpu",)
-                except Exception:
-                    self._use_device = False
+            self._use_device = _probe_device_backend()
         return self._use_device
 
     # --- single block (host path: C encoder, numpy fallback/oracle) ---
