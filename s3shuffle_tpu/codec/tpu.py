@@ -24,6 +24,7 @@ FusedChecksumAccumulator.
 
 from __future__ import annotations
 
+import threading
 from typing import List
 
 import numpy as np
@@ -42,7 +43,10 @@ from s3shuffle_tpu.ops.checksum import (
 #: process-wide backend-probe verdict (None = not probed yet). One probe
 #: per process: each TpuCodec instance re-paying the timeout — and leaking
 #: another thread parked on jax's init lock — would multiply the stall.
+#: Guarded by _PROBE_LOCK: all task-pool threads hit the first batch at
+#: once, and each would otherwise spawn its own probe thread.
 _BACKEND_VERDICT: bool | None = None
+_PROBE_LOCK = threading.Lock()
 
 
 def _probe_device_backend() -> bool:
@@ -56,7 +60,15 @@ def _probe_device_backend() -> bool:
         return env.strip().lower() in ("1", "true", "yes", "on")
     if _BACKEND_VERDICT is not None:
         return _BACKEND_VERDICT
-    import threading
+    with _PROBE_LOCK:
+        if _BACKEND_VERDICT is not None:  # double-checked under the lock
+            return _BACKEND_VERDICT
+        return _probe_device_backend_locked()
+
+
+def _probe_device_backend_locked() -> bool:
+    global _BACKEND_VERDICT
+    import os
 
     try:
         timeout = float(os.environ.get("S3SHUFFLE_BACKEND_PROBE_S", "20"))
