@@ -324,3 +324,44 @@ def test_private_dispatcher_per_config(tmp_path):
     assert d2.config.codec == "zlib" and d1.config.codec == "native"
     assert Dispatcher.get(c2) is d2
     assert Dispatcher.get() is d1
+
+
+def test_kitchen_sink_tpu_codec_spills_checksums_listing(tmp_path):
+    """One shuffle combining the round-2 surfaces: tpu codec at its 256 KiB
+    default block size, sorter forced to spill, CRC32C validation on, and
+    listing-mode block enumeration (no driver metadata)."""
+    import random
+
+    from s3shuffle_tpu.batch import RecordBatch
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/sink",
+        app_id="kitchen-sink",
+        codec="tpu",
+        checksum_algorithm="CRC32C",
+        use_block_manager=False,  # listing enumeration
+        sorter_spill_bytes=256 * 1024,
+    )
+    rng = random.Random(29)
+    pool = [rng.randbytes(90) for _ in range(64)]
+    parts = [
+        RecordBatch.from_records(
+            [(rng.randbytes(10), pool[rng.randrange(64)]) for _ in range(20_000)]
+        )
+        for _ in range(3)
+    ]
+    with ShuffleContext(config=cfg, num_workers=3) as ctx:
+        out = ctx.sort_by_key(parts, num_partitions=4, materialize="batches")
+    merged = [RecordBatch.concat(p) for p in out]
+    assert sum(b.n for b in merged) == 60_000
+    prev = None
+    for b in merged:
+        if b.n == 0:
+            continue
+        ks = b.key_strings(width=10)
+        assert (ks[:-1] <= ks[1:]).all()
+        if prev is not None:
+            assert prev <= ks[0]
+        prev = ks[-1]
