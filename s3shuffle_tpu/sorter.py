@@ -52,6 +52,7 @@ class ExternalSorter:
         self._spill_dir = spill_dir
         self._records: List[Tuple[Any, Any]] = []
         self._bytes = 0
+        self._tick = 0
         self._spills: List[str] = []
         self.spill_count = 0
 
@@ -64,13 +65,16 @@ class ExternalSorter:
     _EXACT_BELOW = 64
 
     def insert_all(self, records: Iterable[Tuple[Any, Any]]) -> None:
-        tick = 0
+        # the sampling tick is INSTANCE state: callers feed records in many
+        # small insert_all calls (one per shuffle batch — read/reader.py), and
+        # a per-call counter would never reach the sampling stride again
+        # after the exact-estimation window, freezing the byte accounting
         for kv in records:
             self._records.append(kv)
-            tick += 1
+            self._tick += 1
             if len(self._records) <= self._EXACT_BELOW:
                 self._bytes += estimate_record_bytes(kv)
-            elif tick & (self._SAMPLE - 1) == 0:
+            elif self._tick & (self._SAMPLE - 1) == 0:
                 self._bytes += estimate_record_bytes(kv) * self._SAMPLE
             if (
                 self._bytes >= self._spill_bytes

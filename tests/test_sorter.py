@@ -87,3 +87,17 @@ def test_end_to_end_sort_with_tiny_budget(tmp_path):
         (k for part in parts for k, _ in part), key=lambda k: (k % 7, k)
     )
     assert got == expected
+
+
+def test_per_batch_feeding_still_hits_byte_budget():
+    """reader.py feeds one insert_all call per shuffle batch; a per-call
+    sampling counter would never sample again after the exact-estimation
+    window, freezing the byte accounting (found in review, reproduced with
+    a 20x budget overrun and zero spills)."""
+    s = ExternalSorter(spill_bytes=256 * 1024)
+    for i in range(2_000):  # 2000 calls x 5 records of ~10 KB
+        s.insert_all([(i * 5 + j, b"v" * 10_000) for j in range(5)])
+    assert s.spill_count >= 10, (s.spill_count, s.memory_bytes)
+    out = list(s.sorted_iterator())
+    assert len(out) == 10_000
+    assert [k for k, _ in out] == sorted(k for k, _ in out)
