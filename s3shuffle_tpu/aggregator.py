@@ -48,7 +48,11 @@ class Aggregator:
         records: Iterable[Tuple[Any, Any]],
         spill_bytes: Optional[int] = None,
     ) -> Iterator[Tuple[Any, Any]]:
-        """Used when the map side did NOT pre-combine."""
+        """Used when the map side did NOT pre-combine.
+
+        LAZY: returns a generator — no input is consumed, no combining runs,
+        and no spill files are created (or cleaned) until the result is
+        iterated."""
         return self._combine(records, self.create_combiner, self.merge_value, spill_bytes)
 
     def combine_combiners_by_key(
@@ -56,7 +60,9 @@ class Aggregator:
         records: Iterable[Tuple[Any, Any]],
         spill_bytes: Optional[int] = None,
     ) -> Iterator[Tuple[Any, Any]]:
-        """Used when map-side combine already produced combiners."""
+        """Used when map-side combine already produced combiners.
+
+        LAZY: returns a generator — see :meth:`combine_values_by_key`."""
         return self._combine(
             records, lambda c: c, self.merge_combiners, spill_bytes
         )

@@ -154,6 +154,10 @@ def _coerce(value: Any, typ: Any) -> Any:
     if not isinstance(value, str):
         return value
     typ = str(typ)
+    if "None" in typ and value.strip().lower() in ("", "none", "null"):
+        # optional fields (codec_block_size: int|None, supports_rename:
+        # bool|None="probe backend") accept their None default from strings
+        return None
     if "bool" in typ:
         return value.strip().lower() in ("1", "true", "yes", "on")
     if "int" in typ:

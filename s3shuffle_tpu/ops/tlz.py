@@ -620,6 +620,42 @@ def _expand_dists_numpy(is_match, is_cont, dists, n_groups):
     return dists[safe_rank]
 
 
+def _validate_planes_v2(n_groups, is_match, is_cont, is_split, dists, ks):
+    """Vectorized structural validation of parsed v2 planes; raises
+    :class:`IOError` on out-of-range match distances or malformed split
+    groups. Shared by the numpy decoder and the device staging path
+    (:func:`decode_blocks_device`) so corruption fails loudly on EVERY
+    decode path even with ``checksum_enabled=False`` — the in-graph kernel
+    clamps offsets (an out-of-bounds gather is undefined under XLA) and
+    would otherwise decode corrupt frames to silently wrong bytes.
+
+    Returns ``(dist_full, group_start, split_idx, kvals, d_prev, d_next)``
+    so the numpy decoder can reuse the intermediates."""
+    dist_full = _expand_dists_numpy(is_match, is_cont, dists, n_groups)
+    group_start = np.arange(n_groups, dtype=np.int64) * GROUP
+    off_full = group_start - dist_full
+    bad = is_match & ((dist_full < 1) | (off_full < 0))
+    if bad.any():
+        raise IOError("TLZ v2 source distance out of range")
+    # split groups copy their prefix at the LEFT neighbor's distance and
+    # their suffix at the RIGHT neighbor's — both neighbors must be matches
+    split_idx = np.flatnonzero(is_split)
+    kvals = d_prev = d_next = None
+    if len(split_idx):
+        if split_idx[0] == 0 or split_idx[-1] == n_groups - 1:
+            raise IOError("TLZ split group at block edge")
+        if (~is_match[split_idx - 1]).any() or (~is_match[split_idx + 1]).any():
+            raise IOError("TLZ split group without match neighbors")
+        kvals = ks.astype(np.int64)
+        if ((kvals < 1) | (kvals > GROUP - 1)).any():
+            raise IOError("TLZ split point out of range")
+        d_prev = dist_full[split_idx - 1]
+        d_next = dist_full[split_idx + 1]
+        if ((group_start[split_idx] + kvals - d_next) < 0).any():
+            raise IOError("TLZ split suffix distance out of range")
+    return dist_full, group_start, split_idx, kvals, d_prev, d_next
+
+
 def decode_payload_numpy(
     payload: bytes, uncompressed_len: int, use_native: bool | None = None
 ) -> bytes:
@@ -657,27 +693,10 @@ def decode_payload_numpy(
     if n_groups == 0:
         return b""
     n_lits = n_groups - int(is_match.sum()) - int(is_split.sum())
-    dist_full = _expand_dists_numpy(is_match, is_cont, dists, n_groups)
-    group_start = np.arange(n_groups, dtype=np.int64) * GROUP
+    dist_full, group_start, split_idx, kvals, d_prev, d_next = (
+        _validate_planes_v2(n_groups, is_match, is_cont, is_split, dists, ks)
+    )
     off_full = group_start - dist_full
-    bad = is_match & ((dist_full < 1) | (off_full < 0))
-    if bad.any():
-        raise IOError("TLZ v2 source distance out of range")
-    # split groups copy their prefix at the LEFT neighbor's distance and
-    # their suffix at the RIGHT neighbor's — both neighbors must be matches
-    split_idx = np.flatnonzero(is_split)
-    if len(split_idx):
-        if split_idx[0] == 0 or split_idx[-1] == n_groups - 1:
-            raise IOError("TLZ split group at block edge")
-        if (~is_match[split_idx - 1]).any() or (~is_match[split_idx + 1]).any():
-            raise IOError("TLZ split group without match neighbors")
-        kvals = ks.astype(np.int64)
-        if ((kvals < 1) | (kvals > GROUP - 1)).any():
-            raise IOError("TLZ split point out of range")
-        d_prev = dist_full[split_idx - 1]
-        d_next = dist_full[split_idx + 1]
-        if ((group_start[split_idx] + kvals - d_next) < 0).any():
-            raise IOError("TLZ split suffix distance out of range")
     # literal plane, placed sparsely at each literal group's position
     is_lit = ~is_match & ~is_split
     sparse = np.zeros((n_groups, GROUP), dtype=np.uint8)
@@ -965,6 +984,7 @@ def decode_blocks_device(payloads: List[bytes], ulens: List[int], block_size: in
         if ng != n_groups or version != 2:
             fallback[i] = decode_payload_numpy(payload, ulens[i])
             continue
+        _validate_planes_v2(ng, m, c, sp, o, kv)
         is_match[i] = m
         is_cont[i] = c
         is_split[i] = sp

@@ -49,6 +49,20 @@ def test_from_env(monkeypatch):
     assert not c.checksum_enabled
 
 
+def test_from_env_optional_int_accepts_none(monkeypatch):
+    """A string "none"/"null"/"" must express the None default of optional
+    int fields like codec_block_size (ADVICE r2) instead of raising from
+    parse_size."""
+    for s in ("none", "NULL", ""):
+        monkeypatch.setenv("S3SHUFFLE_CODEC_BLOCK_SIZE", s)
+        assert ShuffleConfig.from_env().codec_block_size is None
+    monkeypatch.setenv("S3SHUFFLE_CODEC_BLOCK_SIZE", "64k")
+    assert ShuffleConfig.from_env().codec_block_size == 64 * 1024
+    # optional BOOLS too: "none" must mean probe-the-backend, not False
+    monkeypatch.setenv("S3SHUFFLE_SUPPORTS_RENAME", "none")
+    assert ShuffleConfig.from_env().supports_rename is None
+
+
 def test_bad_algorithm_raises():
     # Parity: unsupported algorithms raise (S3ShuffleHelper.scala:94-103)
     with pytest.raises(ValueError):

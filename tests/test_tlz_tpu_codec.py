@@ -105,6 +105,36 @@ def test_tlz_truncated_packed_offsets_raise_ioerror_not_valueerror():
         tlz.decode_payload_numpy(payload, ng * tlz.GROUP)
 
 
+def test_tlz_device_decode_rejects_corrupt_distance():
+    """The in-graph decode kernel clamps offsets (out-of-bounds gathers are
+    undefined under XLA), so decode_blocks_device must validate the parsed
+    planes BEFORE staging — otherwise a corrupt distance decodes to silently
+    wrong bytes whenever checksum_enabled=False (ADVICE r2)."""
+    ng = BS // tlz.GROUP
+    m = np.zeros(ng, np.uint8)
+    m[1] = 1  # group 1 is a match...
+    zeros = np.packbits(np.zeros(ng, np.uint8), bitorder="little").tobytes()
+    lits = os.urandom((ng - 1) * tlz.GROUP)
+    for bad_dist in (0, 60000):  # below minimum / reaches before the block
+        meta = (
+            np.packbits(m, bitorder="little").tobytes()
+            + zeros  # cont bitmap
+            + zeros  # split bitmap
+            + np.array([bad_dist], dtype="<u2").tobytes()
+        )
+        z = zlib.compress(meta)
+        payload = (
+            np.array([(ng & 0x3FFF) | tlz.V2_FLAG | tlz.PACKED_FLAG], dtype="<u2").tobytes()
+            + np.array([len(z)], dtype="<u4").tobytes()
+            + z
+            + lits
+        )
+        with pytest.raises(IOError, match="distance out of range"):
+            tlz.decode_blocks_device([payload], [BS], BS)
+        with pytest.raises(IOError, match="distance out of range"):
+            tlz.decode_payload_numpy(payload, BS, use_native=False)
+
+
 def test_tlz_corrupt_payload_raises():
     data = b"0123456789abcdef" * 8
     payload = bytearray(tlz._assemble_payload_numpy(data))
