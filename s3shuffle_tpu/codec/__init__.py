@@ -27,6 +27,7 @@ def get_codec(
     block_size: int | None = None,
     level: int = 1,
     tpu_batch_blocks: int | None = None,
+    tpu_host_fallback: bool = False,
 ) -> "FrameCodec | None":
     """Resolve a codec by config name. ``none`` → None (raw bytes, no framing,
     still concatenatable). ``auto`` → native if built, else zlib.
@@ -68,7 +69,7 @@ def get_codec(
 
         if tpu_batch_blocks is not None:
             bs["batch_blocks"] = tpu_batch_blocks
-        return TpuCodec(**bs)
+        return TpuCodec(host_encode_fallback=tpu_host_fallback, **bs)
     raise ValueError(f"Unknown codec: {name}")
 
 

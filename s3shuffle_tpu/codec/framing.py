@@ -301,13 +301,17 @@ class CodecInputStream(io.RawIOBase):
                 frames[0][1] if len(frames) == 1 else b"".join(p for _c, p, _u in frames)
             )
             return
-        if (
-            len(frames) > 1
-            and self._codec is not None
-            and codec_id == self._codec.codec_id
-        ):
+        if len(frames) > 1:
+            # batch the whole run through its codec — the configured codec
+            # when it matches, else the cached registry instance (a stream
+            # legally mixes codec ids, e.g. SLZ frames written by the
+            # codec=tpu host fallback read back under a TpuCodec hint)
+            if self._codec is not None and codec_id == self._codec.codec_id:
+                codec = self._codec
+            else:
+                codec = _codec_for_frame_id(codec_id)
             total = sum(u for _c, _p, u in frames)
-            out = self._codec.decompress_blocks_concat([(p, u) for _c, p, u in frames])
+            out = codec.decompress_blocks_concat([(p, u) for _c, p, u in frames])
             if len(out) != total:
                 raise IOError(f"Decompressed run length {len(out)} != headers {total}")
             self._decoded.append(out)
@@ -376,13 +380,14 @@ class CodecInputStream(io.RawIOBase):
         super().close()
 
 
-def decompress_frame_payload(
-    codec_id: int, payload: bytes, ulen: int, hint: FrameCodec | None
-) -> bytes:
-    """Dispatch on the frame's codec id; ``hint`` avoids a registry lookup when
-    the configured codec matches (the common case)."""
-    if hint is not None and codec_id == hint.codec_id:
-        return hint.decompress_block(payload, ulen)
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _codec_for_frame_id(codec_id: int) -> FrameCodec:
+    """Registry codec for a frame's codec id, constructed once per process —
+    cross-codec reads (frames whose id differs from the configured codec's)
+    must not rebuild the codec (ctypes load + symbol lookups) per frame."""
     name = _NAMES.get(codec_id)
     if name is None:
         raise IOError(f"Unknown codec id in frame: {codec_id}")
@@ -392,6 +397,16 @@ def decompress_frame_payload(
     # every other codec registers under its frame name
     codec = get_codec({"native-lz": "native", "tpu-lz": "tpu"}.get(name, name))
     assert codec is not None
-    return codec.decompress_block(payload, ulen)
+    return codec
+
+
+def decompress_frame_payload(
+    codec_id: int, payload: bytes, ulen: int, hint: FrameCodec | None
+) -> bytes:
+    """Dispatch on the frame's codec id; ``hint`` avoids a registry lookup when
+    the configured codec matches (the common case)."""
+    if hint is not None and codec_id == hint.codec_id:
+        return hint.decompress_block(payload, ulen)
+    return _codec_for_frame_id(codec_id).decompress_block(payload, ulen)
 
 

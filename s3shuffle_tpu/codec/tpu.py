@@ -24,6 +24,7 @@ FusedChecksumAccumulator.
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import List
 
@@ -38,6 +39,8 @@ from s3shuffle_tpu.ops.checksum import (
     crc_combine,
     stage_right_aligned,
 )
+
+logger = logging.getLogger("s3shuffle_tpu.codec.tpu")
 
 
 #: process-wide backend-probe verdict (None = not probed yet). One probe
@@ -104,6 +107,7 @@ class TpuCodec(FrameCodec):
         block_size: int = 256 * 1024,
         batch_blocks: int = 64,
         use_device: bool | None = None,
+        host_encode_fallback: bool = False,
     ):
         if block_size % 128 != 0:
             raise ValueError("TPU codec block_size must be a multiple of 128")
@@ -112,6 +116,16 @@ class TpuCodec(FrameCodec):
         super().__init__(block_size)
         self.batch_blocks = batch_blocks
         self._use_device = use_device
+        #: ``codec=tpu`` chosen but no accelerator attached: reroute ENCODE to
+        #: SLZ frames (a different codec_id — readers dispatch per frame, so
+        #: mixing is legal within a shuffle) instead of eating the ~5x-slower
+        #: host C TLZ encoder. TLZ DECODE stays available for existing data.
+        #: Deployment-level knob (config ``tpu_host_fallback``, default on);
+        #: direct constructions default off so the host TLZ write path stays
+        #: directly testable.
+        self.host_encode_fallback = host_encode_fallback
+        self._fallback_codec = None
+        self._fallback_lock = threading.Lock()
 
     def _device_path(self) -> bool:
         """Batch work goes to the device only when an accelerator backend is
@@ -133,8 +147,52 @@ class TpuCodec(FrameCodec):
             self._use_device = _probe_device_backend()
         return self._use_device
 
+    def _encode_delegate(self):
+        """The SLZ codec encode should reroute to, or None to encode TLZ.
+
+        Decided once, stickily, at the first compress call: enabled fallback +
+        host probe verdict activates the delegate forever (readers dispatch on
+        each frame's codec_id, so a stream legally mixes SLZ frames after TLZ
+        ones — but a stable choice keeps ratios predictable)."""
+        if not self.host_encode_fallback:
+            return None
+        if self._fallback_codec is None:
+            with self._fallback_lock:
+                if self._fallback_codec is not None or not self.host_encode_fallback:
+                    return self._fallback_codec
+                if self._device_path():
+                    self.host_encode_fallback = False  # chip attached: TLZ on device
+                    return None
+                try:
+                    from s3shuffle_tpu.codec.native import NativeLZCodec
+
+                    self._fallback_codec = NativeLZCodec(block_size=self.block_size)
+                except Exception:
+                    # no native lib either — host TLZ encode is all we have
+                    self.host_encode_fallback = False
+                    return None
+                logger.warning(
+                    "codec=tpu selected but no accelerator backend is attached "
+                    "(tunnel down or CPU-only host): rerouting shuffle WRITES to "
+                    "SLZ ('native') frames — the host C TLZ encoder would be "
+                    "~5x slower at write. TLZ decode stays active for existing "
+                    "data. Set tpu_host_fallback=false (or "
+                    "S3SHUFFLE_TPU_CODEC_DEVICE=1 with a live chip) to override."
+                )
+        return self._fallback_codec
+
+    def frame_from(self, raw: bytes, compressed: bytes) -> bytes:
+        if self._fallback_codec is not None and self.host_encode_fallback:
+            # frames must carry the codec_id of the payloads the delegate
+            # produced (compress_* always ran first, so the choice is made)
+            return self._fallback_codec.frame_from(raw, compressed)
+        return super().frame_from(raw, compressed)
+
     # --- single block (host path: C encoder, numpy fallback/oracle) ---
     def compress_block(self, data: bytes) -> bytes:
+        delegate = self._encode_delegate()
+        if delegate is not None:
+            return delegate.compress_block(data)
         native = tlz._encode_block_native(data)
         if native is not None:
             return native
@@ -143,8 +201,50 @@ class TpuCodec(FrameCodec):
     def decompress_block(self, data: bytes, uncompressed_len: int) -> bytes:
         return tlz.decode_payload_numpy(data, uncompressed_len)
 
+    def compress_framed(self, buf, n_blocks: int, block_size: int) -> bytes:
+        """Contiguous-buffer fast path (framing.CodecOutputStream hook): the
+        accumulated write buffer IS the staging batch, so the device path
+        never copies raw bytes on the host — ``np.frombuffer`` view straight
+        into the H2D transfer. The host's remaining work per batch is
+        metadata packing + payload/frame assembly (the bench's
+        ``tpu_devwrite_host_mb_s`` fields time exactly this path)."""
+        from s3shuffle_tpu.codec.framing import HEADER
+
+        delegate = self._encode_delegate()
+        if delegate is not None:
+            return delegate.compress_framed(buf, n_blocks, block_size)
+        mv = memoryview(buf)
+        if self._device_path():
+            # fixed-size device batches: a varying batch dim would recompile
+            # the kernel per distinct size (XLA traces once per shape)
+            payloads = []
+            for s in range(0, n_blocks, self.batch_blocks):
+                e = min(n_blocks, s + self.batch_blocks)
+                payloads.extend(
+                    tlz.encode_buffer_device(
+                        mv[s * block_size : e * block_size], e - s, block_size
+                    )
+                )
+        else:
+            payloads = [
+                self.compress_block(bytes(mv[i * block_size : (i + 1) * block_size]))
+                for i in range(n_blocks)
+            ]
+        out = bytearray()
+        for i, pl in enumerate(payloads):
+            if len(pl) >= block_size:  # framing raw escape
+                out += HEADER.pack(0, block_size, block_size)
+                out += mv[i * block_size : (i + 1) * block_size]
+            else:
+                out += HEADER.pack(self.codec_id, block_size, len(pl))
+                out += pl
+        return bytes(out)
+
     # --- batch (device, with a vectorized-numpy host fallback) ---
     def compress_blocks(self, blocks: List[bytes]) -> List[bytes]:
+        delegate = self._encode_delegate()
+        if delegate is not None:
+            return delegate.compress_blocks(blocks)
         full = [b for b in blocks if len(b) == self.block_size]
         if not full or not self._device_path():
             return [self.compress_block(b) for b in blocks]

@@ -83,6 +83,10 @@ class ShuffleConfig:
     # blocks staged per device round-trip: 64 x the 256 KiB default block
     # keeps one staging batch at 16 MiB
     tpu_batch_blocks: int = 64
+    # codec=tpu with no accelerator attached: reroute shuffle-write encode to
+    # SLZ frames (loud warning) instead of the ~5x-slower host C TLZ encoder;
+    # TLZ decode stays active for existing data. false = always encode TLZ.
+    tpu_host_fallback: bool = True
     # --- misc ---
     app_id: str = "app"
     supports_rename: bool | None = None  # None → probe backend

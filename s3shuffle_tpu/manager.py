@@ -92,7 +92,8 @@ class ShuffleManager:
         self._lock = threading.Lock()
         cfg = self.dispatcher.config
         self._codec = get_codec(
-            cfg.codec, cfg.codec_block_size, cfg.codec_level, cfg.tpu_batch_blocks
+            cfg.codec, cfg.codec_block_size, cfg.codec_level, cfg.tpu_batch_blocks,
+            tpu_host_fallback=cfg.tpu_host_fallback,
         )
 
     @property

@@ -340,6 +340,7 @@ def test_kitchen_sink_tpu_codec_spills_checksums_listing(tmp_path):
         root_dir=f"file://{tmp_path}/sink",
         app_id="kitchen-sink",
         codec="tpu",
+        tpu_host_fallback=False,  # exercise the host TLZ write path itself
         checksum_algorithm="CRC32C",
         use_block_manager=False,  # listing enumeration
         sorter_spill_bytes=256 * 1024,

@@ -154,7 +154,8 @@ def test_every_codec_thread_safe_under_concurrent_shuffles(tmp_path):
     for codec in ("native", "lz4", "zlib", "zstd", "tpu", "none"):
         Dispatcher.reset()
         cfg = ShuffleConfig(
-            root_dir=f"file://{tmp_path}/{codec}", app_id=f"cstress-{codec}", codec=codec
+            root_dir=f"file://{tmp_path}/{codec}", app_id=f"cstress-{codec}", codec=codec,
+            tpu_host_fallback=False,  # exercise the host TLZ write path itself
         )
         try:
             ctx = ShuffleContext(config=cfg, num_workers=4)

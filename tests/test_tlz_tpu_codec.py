@@ -187,6 +187,44 @@ def test_tlz_256k_blocks_roundtrip_and_improve_ratio():
     assert len(c_big) < len(c_small)
 
 
+def test_tpu_codec_host_fallback_reroutes_encode_with_warning(monkeypatch, caplog):
+    """codec=tpu with no accelerator (VERDICT r2 #6): when tpu_host_fallback
+    is enabled (the ShuffleConfig default) encode reroutes to SLZ frames with
+    a loud warning — never a silent 2.6x write regression through the host C
+    TLZ encoder — while TLZ frames written earlier still decode."""
+    import logging
+
+    from s3shuffle_tpu.codec import CODEC_IDS, get_codec
+    from s3shuffle_tpu.codec.native import native_available
+
+    if not native_available():
+        pytest.skip("native SLZ library not built")
+    monkeypatch.setenv("S3SHUFFLE_TPU_CODEC_DEVICE", "0")  # force host verdict
+    codec = get_codec("tpu", block_size=BS, tpu_host_fallback=True)
+    data = (b"fallback-payload" * 600) + os.urandom(123)
+    with caplog.at_level(logging.WARNING, logger="s3shuffle_tpu.codec.tpu"):
+        framed = codec.compress_bytes(data)
+    assert any("rerouting shuffle WRITES" in r.message for r in caplog.records)
+    # emitted frames carry the SLZ codec_id (or the raw escape), never tpu-lz
+    ids = set()
+    ofs = 0
+    while ofs < len(framed):
+        cid = framed[ofs]
+        clen = int(np.frombuffer(framed[ofs + 5 : ofs + 9], dtype="<u4")[0])
+        ids.add(cid)
+        ofs += 9 + clen
+    assert CODEC_IDS["tpu-lz"] not in ids
+    assert ids <= {0, CODEC_IDS["native-lz"]}
+    # and the codec still round-trips its own output AND existing TLZ frames
+    assert codec.decompress_bytes(framed) == data
+    pure_tlz = TpuCodec(block_size=BS).compress_bytes(data)
+    assert codec.decompress_bytes(pure_tlz) == data
+    # explicit opt-out keeps the host TLZ encoder
+    off = get_codec("tpu", block_size=BS, tpu_host_fallback=False)
+    framed_tlz = off.compress_bytes(b"fallback-payload" * 600)
+    assert CODEC_IDS["tpu-lz"] in {framed_tlz[0]}
+
+
 def test_tlz_match_window_capped_at_64k_distance():
     """A repeat farther back than MAX_DIST must not be matched: it still
     roundtrips AND the far repeat is stored as literals (the match bitmap
@@ -288,6 +326,7 @@ def test_end_to_end_shuffle_with_tpu_codec(tmp_path):
         app_id="tpu-e2e",
         codec="tpu",
         codec_block_size=BS,
+        tpu_host_fallback=False,  # exercise the host TLZ write path itself
     )
     rng = random.Random(3)
     parts = [[(rng.randrange(20), 1) for _ in range(2000)] for _ in range(3)]
