@@ -28,6 +28,11 @@ Queries (simplified schemas, faithful shapes):
   q67  top items per category: rollup sumsales by (category, item,
        store, month) with a broadcast item dimension, rank top K
        within category                                 — 2 shuffle stages
+  q64  cross-channel repeat purchases: per-(item,year) and per-item
+       aggregates, cogroup join, year self-join, growth sort
+       (join-heavy profile)                            — 4 shuffle stages
+  q95  returned-order analysis: order-level semi-join, per-store
+       aggregate, total rollup (semi-join profile)     — 3 shuffle stages
 
 Usage:
     python examples/sql_queries.py --query all --sf 0.1 --codec native
@@ -294,7 +299,118 @@ def q67(ts, items, sales, returns):
     return result, reference
 
 
-QUERIES = {"q5": q5, "q49": q49, "q75": q75, "q67": q67}
+def q64(ts, items, sales, returns):
+    """Cross-channel repeat purchases (q64's join-heavy profile, simplified
+    schema): per (item, year) sales stats, per-item return stats, a cogroup
+    join of the two, then a self-join across years emitting items whose 2002
+    amount grew despite returns. Four shuffle stages — the widest join
+    pipeline in the suite, matching q64's role in the reference benchmark
+    config (BASELINE.json #3; reference examples/sql/run_benchmark.sh)."""
+    by_item_year = ts.fold_by_key(
+        _partition([((s[0], s[3]), (s[5], s[5] * s[6])) for s in sales]),
+        (0, 0),
+        lambda a, b: (a[0] + b[0], a[1] + b[1]),
+        num_partitions=N_REDUCERS,
+    )  # (item, year) -> (qty, amt)
+    ret_by_item = ts.fold_by_key(
+        _partition([(r[0], r[2]) for r in returns]),
+        0,
+        lambda a, b: a + b,
+        num_partitions=N_REDUCERS,
+    )  # item -> returned qty
+    tagged = [(item, ("y", year, qty, amt)) for (item, year), (qty, amt) in by_item_year]
+    tagged += [(item, ("r", 0, rq, 0)) for item, rq in ret_by_item]
+    joined = ts.group_by_key(_partition(tagged), num_partitions=N_REDUCERS)
+    cross = []
+    for item, vals in joined:
+        y1 = next(((q, a) for t, y, q, a in vals if t == "y" and y == 2001), None)
+        y2 = next(((q, a) for t, y, q, a in vals if t == "y" and y == 2002), None)
+        ret = sum(q for t, _y, q, _a in vals if t == "r")
+        if y1 and y2 and y2[1] > y1[1]:
+            cross.append(((y2[1] - y1[1], item), (y1, y2, ret)))
+    parts = ts.sort_by_key(_partition(cross), num_partitions=N_REDUCERS)
+    result = [
+        (item, y1, y2, ret)
+        for part in parts
+        for (_growth, item), (y1, y2, ret) in part
+    ]
+
+    def reference():
+        acc = defaultdict(lambda: [0, 0])
+        for s in sales:
+            acc[(s[0], s[3])][0] += s[5]
+            acc[(s[0], s[3])][1] += s[5] * s[6]
+        rets = defaultdict(int)
+        for r in returns:
+            rets[r[0]] += r[2]
+        rows = []
+        for item in {i for i, _y in acc}:
+            y1 = acc.get((item, 2001))
+            y2 = acc.get((item, 2002))
+            if y1 and y2 and y2[1] > y1[1]:
+                rows.append((y2[1] - y1[1], item, tuple(y1), tuple(y2), rets[item]))
+        rows.sort()
+        return [(item, y1, y2, ret) for _g, item, y1, y2, ret in rows]
+
+    return result, reference
+
+
+def q95(ts, items, sales, returns):
+    """Returned-order analysis (q95's semi-join profile, simplified schema):
+    orders that have a matching return (semi-join on order), aggregated per
+    store — distinct order count, total quantity, total returned amount —
+    with a final total rollup row. Three shuffle stages (cogroup semi-join,
+    per-store aggregate, rollup), matching q95's role in the reference
+    benchmark config (BASELINE.json #3)."""
+    tagged = [((s[2],), ("s", s[1], s[5])) for s in sales] + [
+        ((r[1],), ("r", 0, r[3])) for r in returns
+    ]
+    joined = ts.group_by_key(_partition(tagged), num_partitions=N_REDUCERS)
+    per_store = []
+    for (_order,), vals in joined:
+        ret_amt = sum(a for t, _st, a in vals if t == "r")
+        if not ret_amt:
+            continue  # semi-join: orders with at least one return
+        store = next(st for t, st, _q in vals if t == "s")
+        qty = sum(q for t, _st, q in vals if t == "s")
+        per_store.append((store, (1, qty, ret_amt)))
+    agg = ts.fold_by_key(
+        _partition(per_store),
+        (0, 0, 0),
+        lambda a, b: (a[0] + b[0], a[1] + b[1], a[2] + b[2]),
+        num_partitions=N_REDUCERS,
+    )
+    total = ts.fold_by_key(
+        _partition([("ALL", v) for _s, v in agg]),
+        (0, 0, 0),
+        lambda a, b: (a[0] + b[0], a[1] + b[1], a[2] + b[2]),
+        num_partitions=1,
+    )
+    result = (sorted(agg), sorted(total))
+
+    def reference():
+        ret_amt_of = defaultdict(int)
+        for r in returns:
+            ret_amt_of[r[1]] += r[3]
+        acc = defaultdict(lambda: [0, 0, 0])
+        for s in sales:
+            ra = ret_amt_of.get(s[2])
+            if ra:
+                acc[s[1]][0] += 1
+                acc[s[1]][1] += s[5]
+                acc[s[1]][2] += ra
+        agg_ref = sorted((st, tuple(v)) for st, v in acc.items())
+        t = [0, 0, 0]
+        for _st, (c, q, a) in agg_ref:
+            t[0] += c
+            t[1] += q
+            t[2] += a
+        return (agg_ref, [("ALL", tuple(t))] if agg_ref else [])
+
+    return result, reference
+
+
+QUERIES = {"q5": q5, "q49": q49, "q75": q75, "q67": q67, "q64": q64, "q95": q95}
 
 
 def run_query(name: str, sf: float, codec: str, workers: int, verify: bool,
@@ -315,7 +431,10 @@ def run_query(name: str, sf: float, codec: str, workers: int, verify: bool,
         tmp = root or tempfile.mkdtemp(prefix=f"s3shuffle-sql-{name}-")
         root_dir = f"file://{tmp}"
     Dispatcher.reset()
-    cfg = ShuffleConfig(root_dir=root_dir, app_id=f"sql-{name}", codec=codec)
+    # measure the codec named on the CLI: auto-fallback (codec=tpu with no
+    # chip -> SLZ encode) would silently benchmark the wrong codec
+    cfg = ShuffleConfig(root_dir=root_dir, app_id=f"sql-{name}", codec=codec,
+                        tpu_host_fallback=False)
     items, sales, returns = gen_tables(sf)
     try:
         with ShuffleContext(config=cfg, num_workers=workers) as ctx:

@@ -12,13 +12,15 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "examples"))
 import sql_queries  # noqa: E402
 
 
-@pytest.mark.parametrize("name", ["q5", "q49", "q75", "q67"])
+@pytest.mark.parametrize("name", ["q5", "q49", "q75", "q67", "q64", "q95"])
 def test_query_verified_against_reference(name, tmp_path):
     out = sql_queries.run_query(
         name, sf=0.02, codec="zlib", workers=2, verify=True, root=str(tmp_path)
     )
     assert out["verified"] and out["rows_out"] > 0
-    assert out["shuffle_stages"] == {"q5": 1, "q49": 3, "q75": 3, "q67": 2}[name]
+    assert out["shuffle_stages"] == {
+        "q5": 1, "q49": 3, "q75": 3, "q67": 2, "q64": 4, "q95": 3,
+    }[name]
     assert out["shuffle_stage_wall_s"] <= out["wall_s"] + 1e-9
 
 
