@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Mesh-routed shuffle landing in the store — the hybrid ICI/store demo.
+
+Routes a terasort-shaped dataset to its owner devices with one ``all_to_all``
+over a ``jax.sharding.Mesh`` (ICI on real hardware; a virtual CPU mesh here),
+then commits each device's partitions through the ordinary write plane and
+validates by reading every partition back with the standard read plane
+(SURVEY §5.8: collectives where durability isn't wanted, the store where it
+is; see s3shuffle_tpu/parallel/ici_shuffle.py).
+
+    python examples/ici_to_store.py --devices 8 --size 20m --partitions 16
+
+Prints one JSON line: routing/write/read wall times + validation result.
+"""
+
+import argparse
+import collections
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+KEY_BYTES, VALUE_BYTES = 10, 90
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--size", default="20m")
+    ap.add_argument("--partitions", type=int, default=16)
+    ap.add_argument("--codec", default="auto")
+    ap.add_argument("--root", default=None)
+    args = ap.parse_args()
+
+    # virtual CPU mesh when no multi-chip hardware is attached (same shape
+    # the driver's dryrun uses); on a real pod slice, drop these two lines
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={args.devices}"
+        ).strip()
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass
+
+    from s3shuffle_tpu.batch import RecordBatch
+    from s3shuffle_tpu.config import ShuffleConfig
+    from s3shuffle_tpu.dependency import HashPartitioner
+    from s3shuffle_tpu.manager import ShuffleManager
+    from s3shuffle_tpu.parallel import make_mesh, mesh_shuffle_to_store
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+    from s3shuffle_tpu.utils import parse_size
+
+    n_dev = min(args.devices, len(jax.devices()))
+    mesh = make_mesh({"data": n_dev}, devices=jax.devices()[:n_dev])
+
+    n_records = max(n_dev, parse_size(args.size) // (KEY_BYTES + VALUE_BYTES))
+    per_dev = n_records // n_dev
+    rng = random.Random(42)
+    fillers = [rng.randbytes(VALUE_BYTES) for _ in range(64)]
+    batches = [
+        RecordBatch.from_records(
+            [(rng.randbytes(KEY_BYTES), fillers[rng.randrange(64)])
+             for _ in range(per_dev)]
+        )
+        for _ in range(n_dev)
+    ]
+
+    root = args.root or tempfile.mkdtemp(prefix="s3shuffle-ici-")
+    Dispatcher.reset()
+    manager = ShuffleManager(
+        ShuffleConfig(root_dir=f"file://{root}", app_id="ici-demo", codec=args.codec)
+    )
+    partitioner = HashPartitioner(args.partitions)
+    try:
+        t0 = time.perf_counter()
+        handle, per_dev_rows = mesh_shuffle_to_store(
+            mesh, batches, manager, partitioner,
+            key_bytes=KEY_BYTES, value_bytes=VALUE_BYTES,
+        )
+        route_write_s = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        got = collections.Counter()
+        for p in range(args.partitions):
+            got.update(manager.get_reader(handle, p, p + 1).read())
+        read_s = time.perf_counter() - t0
+        expected = collections.Counter(
+            kv for b in batches for kv in b.iter_records()
+        )
+        raw = n_dev * per_dev * (KEY_BYTES + VALUE_BYTES + 8)
+        print(json.dumps({
+            "workload": "ici-to-store",
+            "devices": n_dev,
+            "records": sum(per_dev_rows),
+            "valid": got == expected,
+            "route_write_s": round(route_write_s, 3),
+            "read_s": round(read_s, 3),
+            "mb_s_route_write": round(raw / route_write_s / 1e6, 1),
+        }))
+        manager.unregister_shuffle(handle.shuffle_id)
+        manager.stop()
+        return 0 if got == expected else 1
+    finally:
+        if args.root is None:
+            shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

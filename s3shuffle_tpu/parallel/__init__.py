@@ -9,7 +9,13 @@ pods/DCN or for durability (the store path remains the elastic/decommission-
 safe layer, exactly like the reference).
 """
 
+from s3shuffle_tpu.parallel.ici_shuffle import mesh_shuffle_to_store
 from s3shuffle_tpu.parallel.mesh import make_mesh
 from s3shuffle_tpu.parallel.repartition import device_repartition, plan_capacity
 
-__all__ = ["make_mesh", "device_repartition", "plan_capacity"]
+__all__ = [
+    "make_mesh",
+    "device_repartition",
+    "plan_capacity",
+    "mesh_shuffle_to_store",
+]

@@ -1,0 +1,158 @@
+"""Mesh-routed shuffle that lands in the store: ICI all_to_all for the data
+motion, the write/read planes for durability.
+
+The hybrid flow SURVEY §5.8 calls for — "collectives where durability isn't
+wanted, the store where it is" — made end-to-end (VERDICT r2 next-#5): record
+rows route to their owner devices over the mesh (``parallel/repartition.py``,
+XLA ``all_to_all`` riding ICI — no host round-trip, no object store traffic
+for the exchange), and each device then commits ITS partitions through the
+ordinary write plane (codec, index, checksum sidecars), so reducers —
+including plain CPU hosts with no mesh — read the result with the standard
+read plane. The store write is one map output per device, and because routing
+already moved every row to its partition's owner, each map output contains
+exactly the partitions that device owns (partition p lives on device
+``p % n_devices``).
+
+Reference analog: the reference's only data plane is the store
+(S3ShuffleManager.scala vends writers/readers; NCCL/MPI never appears) — this
+module is the TPU-first addition where the mesh does the network leg.
+
+Fixed-shape contract: XLA collectives need static shapes, so rows are
+fixed-width (uniform key/value widths — the terasort/TPC-DS record shape) and
+each device contributes the same local row count, padded with flagged rows
+that receivers drop. Variable-width or heavily skewed data stays on the
+host/store path (the default `ShuffleContext.run_shuffle`).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from s3shuffle_tpu.parallel.repartition import device_repartition, plan_capacity
+
+#: leading row byte: 1 = real row, 0 = padding (dropped by receivers)
+_FLAG_BYTES = 1
+
+
+def batch_to_rows(batch, key_bytes: int, value_bytes: int) -> np.ndarray:
+    """Pack a uniform-width RecordBatch into flagged fixed-width rows:
+    ``(n, 1 + key_bytes + value_bytes)`` uint8 with the flag byte set."""
+    if batch.n == 0:
+        return np.zeros((0, _FLAG_BYTES + key_bytes + value_bytes), dtype=np.uint8)
+    if not ((batch.klens == key_bytes).all() and (batch.vlens == value_bytes).all()):
+        raise ValueError(
+            "mesh routing needs uniform key/value widths "
+            f"({key_bytes}/{value_bytes}); got ragged records"
+        )
+    rows = np.empty((batch.n, _FLAG_BYTES + key_bytes + value_bytes), dtype=np.uint8)
+    rows[:, 0] = 1
+    rows[:, _FLAG_BYTES : _FLAG_BYTES + key_bytes] = batch.keys.reshape(
+        batch.n, key_bytes
+    )
+    rows[:, _FLAG_BYTES + key_bytes :] = batch.values.reshape(
+        batch.n, value_bytes
+    )
+    return rows
+
+
+def rows_to_batch(rows: np.ndarray, key_bytes: int, value_bytes: int):
+    """Unpack flagged fixed-width rows (already filtered to real rows) into a
+    RecordBatch."""
+    from s3shuffle_tpu.batch import RecordBatch
+
+    n = rows.shape[0]
+    return RecordBatch(
+        klens=np.full(n, key_bytes, dtype=np.int32),
+        vlens=np.full(n, value_bytes, dtype=np.int32),
+        keys=np.ascontiguousarray(
+            rows[:, _FLAG_BYTES : _FLAG_BYTES + key_bytes]
+        ).reshape(-1),
+        values=np.ascontiguousarray(rows[:, _FLAG_BYTES + key_bytes :]).reshape(-1),
+    )
+
+
+def mesh_shuffle_to_store(
+    mesh,
+    batches: Sequence,
+    manager,
+    partitioner,
+    key_bytes: int,
+    value_bytes: int,
+    shuffle_id: int | None = None,
+    axis: str = "data",
+    capacity: int | None = None,
+) -> Tuple[object, List[int]]:
+    """Route ``batches`` (one RecordBatch per mesh device along ``axis``) to
+    their owner devices over ICI, then commit each device's received rows
+    through the write plane as that device's map output.
+
+    Returns ``(handle, rows_per_device)``. Afterwards any reader —
+    ``manager.get_reader(handle, p, p + 1)`` — serves partition ``p`` from the
+    store with the standard read plane; no mesh needed on the read side.
+    """
+    import jax
+
+    from s3shuffle_tpu.dependency import ShuffleDependency
+
+    n_dev = mesh.shape[axis]
+    if len(batches) != n_dev:
+        raise ValueError(f"need one batch per device: {len(batches)} != {n_dev}")
+    num_partitions = partitioner.num_partitions
+    row_bytes = _FLAG_BYTES + key_bytes + value_bytes
+
+    # equal local counts (static shapes): pad every device to the max with
+    # flagged rows routed to the padding device's own lane and dropped on
+    # receipt
+    locals_ = [batch_to_rows(b, key_bytes, value_bytes) for b in batches]
+    ids_ = [
+        partitioner.partition_batch(b).astype(np.int32)
+        if b.n
+        else np.zeros(0, np.int32)
+        for b in batches
+    ]
+    local_n = max((r.shape[0] for r in locals_), default=1) or 1
+    rows = np.zeros((n_dev * local_n, row_bytes), dtype=np.uint8)
+    part_ids = np.zeros(n_dev * local_n, dtype=np.int32)
+    for d, (r, pid) in enumerate(zip(locals_, ids_)):
+        rows[d * local_n : d * local_n + r.shape[0]] = r
+        part_ids[d * local_n : d * local_n + r.shape[0]] = pid
+        # padding rows carry flag 0 and round-robin destinations, so no
+        # single lane absorbs a device's whole pad count (receivers drop
+        # them by flag; capacity only needs ~pad/n_dev headroom per lane)
+        n_pad = local_n - r.shape[0]
+        part_ids[d * local_n + r.shape[0] : (d + 1) * local_n] = (
+            np.arange(n_pad, dtype=np.int32) % n_dev
+        )
+
+    if capacity is None:
+        capacity = plan_capacity(local_n, n_dev)
+    recv, recv_ids, valid = device_repartition(
+        mesh, rows, part_ids, axis=axis, capacity=capacity
+    )
+    recv = np.asarray(jax.device_get(recv))
+    valid = np.asarray(jax.device_get(valid))
+
+    # --- store leg: one map output per device through the write plane ---
+    if shuffle_id is None:
+        shuffle_id = 0
+    dep = ShuffleDependency(
+        shuffle_id=shuffle_id, partitioner=partitioner
+    )
+    handle = manager.register_shuffle(shuffle_id, dep)
+    chunk = recv.shape[0] // n_dev
+    rows_per_device: List[int] = []
+    for d in range(n_dev):
+        shard = recv[d * chunk : (d + 1) * chunk]
+        ok = valid[d * chunk : (d + 1) * chunk] & (shard[:, 0] == 1)
+        real = shard[ok]
+        rows_per_device.append(int(real.shape[0]))
+        writer = manager.get_writer(handle, map_id=d)
+        try:
+            writer.write(rows_to_batch(real, key_bytes, value_bytes))
+            writer.stop(success=True)
+        except BaseException:
+            writer.stop(success=False)
+            raise
+    return handle, rows_per_device

@@ -66,3 +66,57 @@ def test_device_repartition_overflow_raises():
 def test_plan_capacity():
     assert plan_capacity(1000, 8) == 250
     assert plan_capacity(0, 8) == 1
+
+
+def test_mesh_shuffle_to_store_end_to_end(tmp_path):
+    """VERDICT r2 next-#5: route on the mesh (all_to_all over ICI), land in
+    the store through the write plane, read back with the standard read
+    plane — the full hybrid flow on the virtual 8-device mesh."""
+    import collections
+    import random
+
+    from s3shuffle_tpu.batch import RecordBatch
+    from s3shuffle_tpu.config import ShuffleConfig
+    from s3shuffle_tpu.dependency import HashPartitioner
+    from s3shuffle_tpu.manager import ShuffleManager
+    from s3shuffle_tpu.parallel import make_mesh, mesh_shuffle_to_store
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh({"data": n_dev})
+    KW, VW = 10, 22
+    rng = random.Random(5)
+    # unequal per-device batch sizes: exercises the padding lane
+    batches = [
+        RecordBatch.from_records(
+            [(rng.randbytes(KW), rng.randbytes(VW)) for _ in range(120 + 31 * d)]
+        )
+        for d in range(n_dev)
+    ]
+    expected = collections.Counter(
+        kv for b in batches for kv in b.iter_records()
+    )
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/ici", app_id="ici-e2e", codec="zlib"
+    )
+    manager = ShuffleManager(cfg)
+    partitioner = HashPartitioner(16)
+    handle, per_dev = mesh_shuffle_to_store(
+        mesh, batches, manager, partitioner, key_bytes=KW, value_bytes=VW,
+        shuffle_id=3,
+    )
+    assert sum(per_dev) == sum(b.n for b in batches)  # nothing dropped
+
+    # ICI routing invariant: device d wrote only partitions with p % n_dev == d
+    # (verified indirectly: every partition is readable and complete)
+    got = collections.Counter()
+    for p in range(16):
+        reader = manager.get_reader(handle, p, p + 1)
+        for k, v in reader.read():
+            assert partitioner(k) == p  # read plane serves the right rows
+            got[(k, v)] += 1
+    assert got == expected
+    manager.unregister_shuffle(3)
+    manager.stop()
