@@ -39,9 +39,42 @@ static void crc32c_init() {
     crc32c_init_done = true;
 }
 
+#if defined(__x86_64__) && defined(__GNUC__)
+// Hardware path: the SSE4.2 crc32 instruction implements exactly the
+// Castagnoli polynomial (runtime-dispatched; the tables stay the portable
+// fallback). Serial 8-byte feeding runs ~7-20 GB/s vs ~1.5 GB/s for
+// slicing-by-8 — this pass runs over every stored byte on both the write
+// (partition checksum) and read (validation) planes.
+__attribute__((target("sse4.2")))
+static uint32_t crc32c_hw(const uint8_t* data, size_t n, uint32_t state) {
+    uint64_t c = state;
+    while (n >= 8) {
+        uint64_t v;
+        memcpy(&v, data, 8);
+        c = __builtin_ia32_crc32di(c, v);
+        data += 8;
+        n -= 8;
+    }
+    uint32_t c32 = (uint32_t)c;
+    if (n >= 4) {
+        uint32_t v;
+        memcpy(&v, data, 4);
+        c32 = __builtin_ia32_crc32si(c32, v);
+        data += 4;
+        n -= 4;
+    }
+    while (n--) c32 = __builtin_ia32_crc32qi(c32, *data++);
+    return c32;
+}
+#endif
+
 uint32_t slz_crc32c(const uint8_t* data, size_t n, uint32_t prev) {
-    if (!crc32c_init_done) crc32c_init();
     uint32_t crc = prev ^ 0xFFFFFFFFu;
+#if defined(__x86_64__) && defined(__GNUC__)
+    static const bool hw = __builtin_cpu_supports("sse4.2");
+    if (hw) return crc32c_hw(data, n, crc) ^ 0xFFFFFFFFu;
+#endif
+    if (!crc32c_init_done) crc32c_init();
     while (n >= 8) {
         uint32_t lo, hi;
         memcpy(&lo, data, 4);

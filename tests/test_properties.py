@@ -184,3 +184,24 @@ def test_checksums_match_zlib_reference_property(blocks):
         assert a.value == zlib.adler32(b)
         assert native_adler32(b) == zlib.adler32(b)
         assert native_crc32c(b) == crc32c_py(b)
+
+
+def test_native_crc32c_hw_path_boundaries():
+    """The SSE4.2 hardware CRC32C path (runtime-dispatched in
+    slz_crc32c) must agree with the table implementation at every
+    8/4/1-byte tail combination and across incremental updates."""
+    import random
+
+    from s3shuffle_tpu.codec.native import native_available, native_crc32c
+    from s3shuffle_tpu.utils.checksums import crc32c_py
+
+    if not native_available():
+        pytest.skip("native lib unavailable")
+    rng = random.Random(3)
+    blob = rng.randbytes(4096 + 13)
+    for n in (0, 1, 3, 4, 7, 8, 9, 15, 16, 17, 63, 64, 65, 4096, len(blob)):
+        assert native_crc32c(blob[:n]) == crc32c_py(blob[:n]), n
+    # incremental == one-shot at unaligned split points
+    for split in (1, 7, 100, 4095):
+        mid = native_crc32c(blob[:split])
+        assert native_crc32c(blob[split:], mid) == native_crc32c(blob)
