@@ -15,7 +15,11 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Iterable, List, Optional, Sequence, Tuple
 
-from s3shuffle_tpu.aggregator import Aggregator, fold_by_key_aggregator
+from s3shuffle_tpu.aggregator import (
+    Aggregator,
+    GroupingAggregator,
+    fold_by_key_aggregator,
+)
 from s3shuffle_tpu.config import ShuffleConfig
 from s3shuffle_tpu.dependency import (
     HashPartitioner,
@@ -143,12 +147,10 @@ class ShuffleContext:
         num_partitions: int,
     ) -> List[Tuple[Any, List[Any]]]:
         """No map-side combine — the dependency shape of the reference's
-        runWithSparkConf_noMapSideCombine test (:56-73)."""
-        agg = Aggregator(
-            create_combiner=lambda v: [v],
-            merge_value=lambda acc, v: acc + [v],
-            merge_combiners=lambda a, b: a + b,
-        )
+        runWithSparkConf_noMapSideCombine test (:56-73). Uses the grouping
+        fast path (dict.get + list.append per record instead of a Python
+        merge call + list copy — see GroupingAggregator)."""
+        agg = GroupingAggregator()
         out = self.run_shuffle(
             input_partitions, num_partitions, aggregator=agg, map_side_combine=False
         )

@@ -137,3 +137,29 @@ def test_end_to_end_fold_with_tiny_budget(tmp_path):
     with ShuffleContext(config=cfg, num_workers=2) as ctx:
         result = dict(ctx.fold_by_key(parts, 0, lambda a, b: a + b, num_partitions=4))
     assert result == expected
+
+
+def test_grouping_aggregator_fast_path_with_spills():
+    """GroupingAggregator (group_by_key's specialization) must produce the
+    same per-key value multisets as the generic path, including across
+    spill runs, and keep values in insertion order."""
+    from s3shuffle_tpu.aggregator import Aggregator, GroupingAggregator
+
+    records = [(f"k{i % 97}", i) for i in range(20_000)]
+    fast = dict(GroupingAggregator(spill_bytes=8 * 1024).combine_values_by_key(records))
+    assert sum(1 for _ in fast) == 97
+    generic = dict(
+        Aggregator(
+            create_combiner=lambda v: [v],
+            merge_value=lambda acc, v: acc + [v],
+            merge_combiners=lambda a, b: a + b,
+            spill_bytes=8 * 1024,
+        ).combine_values_by_key(records)
+    )
+    assert fast.keys() == generic.keys()
+    for k in fast:
+        assert fast[k] == generic[k] == sorted(fast[k])  # insertion order
+    # spilling actually happened (the budget is tiny)
+    agg = GroupingAggregator(spill_bytes=8 * 1024)
+    list(agg.combine_values_by_key(records))
+    assert agg.spill_count > 0

@@ -111,3 +111,32 @@ def test_checksum_algorithms():
 
     with pytest.raises(ValueError):
         create_checksum("MD5")
+
+
+def test_stable_key_hash_subclasses_hash_like_their_builtins():
+    """Equal keys MUST land in one partition: int/str/bytes/tuple subclasses
+    (IntEnum, namedtuple, ...) compare equal to builtin counterparts, so the
+    fast-path type dispatch must hash them identically (r3 review finding)."""
+    from collections import namedtuple
+    from enum import IntEnum
+
+    from s3shuffle_tpu.dependency import _stable_key_hash
+
+    class E(IntEnum):
+        A = 7
+
+    NT = namedtuple("NT", "a b")
+
+    class S(str):
+        pass
+
+    class B(bytes):
+        pass
+
+    assert _stable_key_hash(E.A) == _stable_key_hash(7)
+    assert _stable_key_hash(NT(1, "x")) == _stable_key_hash((1, "x"))
+    assert _stable_key_hash(S("hey")) == _stable_key_hash("hey")
+    assert _stable_key_hash(B(b"raw")) == _stable_key_hash(b"raw")
+    assert _stable_key_hash(True) == _stable_key_hash(1)
+    # deep tuples recurse; results stay in the 31-bit range
+    assert 0 <= _stable_key_hash((1, ("a", b"b", (2, 3)))) < 2**31

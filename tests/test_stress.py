@@ -186,3 +186,46 @@ def test_every_codec_thread_safe_under_concurrent_shuffles(tmp_path):
             t.join()
         ctx.stop()
         assert not errors, errors
+
+
+def test_tpu_fallback_delegate_race_free_under_concurrent_writers(tmp_path, monkeypatch):
+    """codec=tpu with the host fallback ENABLED (the deployment default):
+    many task threads hit the codec's first compress simultaneously, racing
+    the lazy delegate activation. Every write must come out as a decodable
+    SLZ/raw frame and the shuffle roundtrip must hold."""
+    from s3shuffle_tpu.codec.native import native_available
+
+    if not native_available():
+        import pytest
+
+        pytest.skip("native SLZ library not built")
+    monkeypatch.setenv("S3SHUFFLE_TPU_CODEC_DEVICE", "0")
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/fb", app_id="fb-stress", codec="tpu",
+    )
+    assert cfg.tpu_host_fallback  # the default under test
+    ctx = ShuffleContext(config=cfg, num_workers=4)
+    errors = []
+
+    def one(seed):
+        try:
+            rng = random.Random(seed)
+            recs = [(rng.randbytes(10), rng.randbytes(64)) for _ in range(3_000)]
+            out = ctx.sort_by_key(
+                [RecordBatch.from_records(recs[i::2]) for i in range(2)],
+                num_partitions=2,
+                materialize="batches",
+            )
+            got = [k for p in out for b in p for k, _ in b.iter_records()]
+            assert got == sorted(k for k, _ in recs)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=one, args=(s,)) for s in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    ctx.stop()
+    assert not errors, errors
