@@ -141,7 +141,12 @@ def _stable_key_hash(key: Any) -> int:
     if t is bool:
         return int(key)
     if t is int:
-        return key & 0x7FFFFFFF
+        # built-in hash(): numeric types that compare equal hash equal
+        # (1 == 1.0 == Decimal(1) must share a partition), and numeric
+        # hashing is NOT salted by PYTHONHASHSEED — only str/bytes are
+        return hash(key) & 0x7FFFFFFF
+    if t is float:
+        return hash(key) & 0x7FFFFFFF
     if t is bytes:
         return zlib.crc32(key) & 0x7FFFFFFF
     if t is str:
@@ -152,19 +157,22 @@ def _stable_key_hash(key: Any) -> int:
             # int elements inline (the dominant join-key shape): a recursive
             # call per element doubled the per-record hash cost
             eh = (
-                item & 0x7FFFFFFF
+                hash(item) & 0x7FFFFFFF
                 if type(item) is int
                 else _stable_key_hash(item)
             )
             h = (h * 0x9E3779B1 + eh) & 0xFFFFFFFF
         return h & 0x7FFFFFFF
-    # subclasses (IntEnum, namedtuple, str/bytes subclasses) compare equal to
-    # their builtin counterparts, so they MUST hash like them — equal keys
-    # landing in different partitions would split a group
+    # subclasses (IntEnum, namedtuple, str/bytes subclasses) and the other
+    # numeric types (Decimal, Fraction, complex) compare equal to builtin
+    # counterparts, so they MUST hash like them — equal keys landing in
+    # different partitions would split a group
     if isinstance(key, bool):
         return int(key)
-    if isinstance(key, int):
-        return int(key) & 0x7FFFFFFF
+    import numbers
+
+    if isinstance(key, numbers.Number):
+        return hash(key) & 0x7FFFFFFF
     if isinstance(key, bytes):
         return zlib.crc32(key) & 0x7FFFFFFF
     if isinstance(key, str):

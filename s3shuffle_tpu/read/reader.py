@@ -156,9 +156,12 @@ class ShuffleReader:
         if self.dep.serializer.supports_batches and self.dep.aggregator is None:
             return self._read_batched()
 
+        import itertools
+
         prefetcher = self._make_prefetcher()
-        records = self._record_iterator(prefetcher)
-        records = self._counted(records)
+        # chunk-level iteration + C-level flattening: 3 fewer Python frames
+        # per record than per-record generators, with counting per chunk
+        records = itertools.chain.from_iterable(self._chunk_iterator(prefetcher))
 
         if self.dep.aggregator is not None:
             if self.dep.map_side_combine:
@@ -198,14 +201,26 @@ class ShuffleReader:
             stream = CodecInputStream(self.codec, stream)
         return stream
 
-    def _record_iterator(self, prefetcher: BufferedPrefetchIterator):
+    def _chunk_iterator(self, prefetcher: BufferedPrefetchIterator):
+        """Record chunks (lists) from every prefetched block.
+
+        ``records_read`` is counted at chunk granularity, and a chunk is
+        charged only once fully consumed (the flattening consumer asks for
+        chunk N+1 after draining chunk N) — an early-stopping caller never
+        over-counts; at most the final, partially-consumed chunk goes
+        uncounted."""
+        pending = 0
         for prefetched in prefetcher:
             stream = self._wrapped_stream(prefetched)
             try:
-                yield from self.dep.serializer.new_read_stream(stream)  # type: ignore[arg-type]
+                for chunk in self.dep.serializer.new_chunk_read_stream(stream):  # type: ignore[arg-type]
+                    self.metrics.records_read += pending
+                    pending = len(chunk)
+                    yield chunk
             finally:
                 stream.close()
                 prefetched.close()
+        self.metrics.records_read += pending
         # fold prefetcher stats into task metrics on drain
         stats = prefetcher.stats
         self.metrics.wait_ns += stats["wait_ns"]
@@ -292,7 +307,3 @@ class ShuffleReader:
             return list(self._fed_batch_sorter().sorted_batches())
         return fallback()
 
-    def _counted(self, records):
-        for kv in records:
-            self.metrics.records_read += 1
-            yield kv

@@ -38,6 +38,30 @@ class Serializer:
         """Yield RecordBatches (only when ``supports_batches``)."""
         raise NotImplementedError(f"{self.name} does not support batch reads")
 
+    def new_chunk_read_stream(self, source: BinaryIO) -> Iterator[list]:
+        """Yield LISTS of (key, value) records. The read plane consumes this
+        and flattens with ``itertools.chain.from_iterable`` (C-level), so the
+        per-record path crosses 3 fewer Python generator frames than stacking
+        per-record iterators. Default: re-chunk ``new_read_stream`` bounded
+        by records AND bytes (a record-count-only chunk of multi-MB values
+        would buffer gigabytes that the per-record path streamed one at a
+        time); serializers whose wire format already batches override with
+        the natural unit."""
+        chunk: list = []
+        nbytes = 0
+        for kv in self.new_read_stream(source):
+            chunk.append(kv)
+            try:
+                nbytes += len(kv[0]) + len(kv[1])
+            except TypeError:
+                nbytes += 64
+            if len(chunk) >= 4096 or nbytes >= (4 << 20):
+                yield chunk
+                chunk = []
+                nbytes = 0
+        if chunk:
+            yield chunk
+
     def dumps(self, records: Iterable[Tuple[Any, Any]]) -> bytes:
         import io
 
@@ -112,6 +136,12 @@ class PickleBatchSerializer(Serializer):
         return _PickleBatchWriter(sink, self.batch_size)
 
     def new_read_stream(self, source: BinaryIO) -> Iterator[Tuple[Any, Any]]:
+        import itertools
+
+        return itertools.chain.from_iterable(self.new_chunk_read_stream(source))
+
+    def new_chunk_read_stream(self, source: BinaryIO) -> Iterator[list]:
+        """One pickled frame IS the natural chunk — no re-batching."""
         while True:
             # read_fully: codec streams return short reads at frame boundaries
             header = _read_fully(source, _U32.size)
@@ -123,7 +153,7 @@ class PickleBatchSerializer(Serializer):
             payload = _read_fully(source, n)
             if len(payload) < n:
                 raise IOError(f"Truncated record batch ({len(payload)}/{n})")
-            yield from pickle.loads(payload)
+            yield pickle.loads(payload)
 
 
 # ----------------------------------------------------------------------------
