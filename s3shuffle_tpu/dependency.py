@@ -146,6 +146,8 @@ def _stable_key_hash(key: Any) -> int:
         # hashing is NOT salted by PYTHONHASHSEED — only str/bytes are
         return hash(key) & 0x7FFFFFFF
     if t is float:
+        if key != key:  # NaN: hash() is id-based on CPython >= 3.10 —
+            return 0x7F8AAAAA  # nondeterministic across processes/retries
         return hash(key) & 0x7FFFFFFF
     if t is bytes:
         return zlib.crc32(key) & 0x7FFFFFFF
@@ -172,6 +174,8 @@ def _stable_key_hash(key: Any) -> int:
     import numbers
 
     if isinstance(key, numbers.Number):
+        if key != key:  # Decimal('NaN')/complex NaN: see the float branch
+            return 0x7F8AAAAA
         return hash(key) & 0x7FFFFFFF
     if isinstance(key, bytes):
         return zlib.crc32(key) & 0x7FFFFFFF

@@ -51,10 +51,13 @@ class Serializer:
         nbytes = 0
         for kv in self.new_read_stream(source):
             chunk.append(kv)
-            try:
-                nbytes += len(kv[0]) + len(kv[1])
-            except TypeError:
-                nbytes += 64
+            # per-element sizing: an unsized KEY (int) must not hide a
+            # multi-MB VALUE from the byte bound
+            for x in kv:
+                try:
+                    nbytes += len(x)
+                except TypeError:
+                    nbytes += 32
             if len(chunk) >= 4096 or nbytes >= (4 << 20):
                 yield chunk
                 chunk = []

@@ -65,22 +65,25 @@ class ExternalSorter:
     _EXACT_BELOW = 64
 
     def insert_all(self, records: Iterable[Tuple[Any, Any]]) -> None:
+        from s3shuffle_tpu.utils import gc_paused
+
         # the sampling tick is INSTANCE state: callers feed records in many
         # small insert_all calls (one per shuffle batch — read/reader.py), and
         # a per-call counter would never reach the sampling stride again
         # after the exact-estimation window, freezing the byte accounting
-        for kv in records:
-            self._records.append(kv)
-            self._tick += 1
-            if len(self._records) <= self._EXACT_BELOW:
-                self._bytes += estimate_record_bytes(kv)
-            elif self._tick & (self._SAMPLE - 1) == 0:
-                self._bytes += estimate_record_bytes(kv) * self._SAMPLE
-            if (
-                self._bytes >= self._spill_bytes
-                or len(self._records) >= self._spill_threshold
-            ):
-                self._spill()
+        with gc_paused:  # bulk acyclic build — cf. aggregator._combine
+            for kv in records:
+                self._records.append(kv)
+                self._tick += 1
+                if len(self._records) <= self._EXACT_BELOW:
+                    self._bytes += estimate_record_bytes(kv)
+                elif self._tick & (self._SAMPLE - 1) == 0:
+                    self._bytes += estimate_record_bytes(kv) * self._SAMPLE
+                if (
+                    self._bytes >= self._spill_bytes
+                    or len(self._records) >= self._spill_threshold
+                ):
+                    self._spill()
 
     @property
     def memory_bytes(self) -> int:
@@ -91,8 +94,13 @@ class ExternalSorter:
         self._records.sort(key=lambda kv: self._key(kv[0]))
         fd, path = tempfile.mkstemp(prefix="s3shuffle-spill-", dir=self._spill_dir)
         with os.fdopen(fd, "wb") as f:
-            for kv in self._records:
-                pickle.dump(kv, f, protocol=pickle.HIGHEST_PROTOCOL)
+            # chunked dumps, like the aggregator's spill plane: per-row
+            # dump/load calls dominated spill cycles at scale
+            for i in range(0, len(self._records), 4096):
+                pickle.dump(
+                    self._records[i : i + 4096], f,
+                    protocol=pickle.HIGHEST_PROTOCOL,
+                )
         self._spills.append(path)
         self.spill_count += 1
         self._records = []
@@ -102,7 +110,7 @@ class ExternalSorter:
         with open(path, "rb") as f:
             while True:
                 try:
-                    yield pickle.load(f)
+                    yield from pickle.load(f)
                 except EOFError:
                     return
 
