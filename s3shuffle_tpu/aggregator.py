@@ -119,6 +119,7 @@ class Aggregator:
                         self.spill_count += 1
                         combiners = {}
                         estimate = 0
+                        gc_paused.tick()
             if not spills:
                 yield from combiners.items()
                 return
@@ -246,6 +247,7 @@ class GroupingAggregator(Aggregator):
                         combiners = {}
                         get = combiners.get
                         estimate = 0
+                        gc_paused.tick()
             if not spills:
                 yield from combiners.items()
                 return

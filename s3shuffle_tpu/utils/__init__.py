@@ -55,6 +55,24 @@ class _GcPause:
         if collect:  # outside the lock: collection can take a while
             gc.collect(1)
 
+    def tick(self) -> None:
+        """Bounded collection opportunity for LONG single-threaded pause
+        holders (a map task driving arbitrary upstream user compute for
+        minutes): the timed valve in ``__exit__`` only fires on nested
+        exits, so loops call this at coarse checkpoints (every few thousand
+        records / at spill boundaries)."""
+        collect = False
+        with self._lock:
+            if (
+                self._depth > 0
+                and self._we_disabled
+                and time.monotonic() - self._last_collect > self.COLLECT_EVERY_S
+            ):
+                self._last_collect = time.monotonic()
+                collect = True
+        if collect:
+            gc.collect(1)
+
 
 #: module-level instance: ``with gc_paused: ...``
 gc_paused = _GcPause()

@@ -120,17 +120,24 @@ class ShuffleMapWriter:
                 records,
                 spill_bytes=self.output_writer.dispatcher.config.aggregator_spill_bytes,
             )
+        from s3shuffle_tpu.utils import gc_paused
+
         partitioner = dep.partitioner
         pipelines = self._pipelines
         check_every = 4096
         # Running total across write() calls — incremental callers writing
         # small batches must still hit the budget check.
         n = self._records_written
-        for k, v in records:
-            pipelines[partitioner(k)].record_writer.write(k, v)
-            n += 1
-            if n % check_every == 0 and self._buffered_total() > self.spill_memory_budget:
-                self._spill()
+        # The pause also covers the upstream iterator (user compute); the
+        # periodic tick bounds any reference cycles it creates.
+        with gc_paused:
+            for k, v in records:
+                pipelines[partitioner(k)].record_writer.write(k, v)
+                n += 1
+                if n % check_every == 0:
+                    gc_paused.tick()
+                    if self._buffered_total() > self.spill_memory_budget:
+                        self._spill()
         self._records_written = n
 
     def _write_batched(self, records: Iterable[Tuple[Any, Any]]) -> None:
