@@ -172,15 +172,28 @@ class DistributedDriver:
     def _scratch(self, shuffle_id: int, name: str) -> str:
         return f"{self.config.root_dir}_stage/{self.config.app_id}/{shuffle_id}/{name}"
 
+    #: worker-silence lease: the stage-wait loop re-queues tasks whose worker
+    #: sent no heartbeat for this long (crash/kill detection — WorkerAgent
+    #: beats every ~5s, so a LONG task on a healthy worker is never reaped).
+    #: Re-execution is idempotent (task outputs are store objects keyed by
+    #: task identity, index-is-commit), and stale zombie reports are refused
+    #: by the lease-holder check in the task queue.
+    task_lease_s = 30.0
+
     def _wait_stage(self, stage_id: str, poll: float = 0.02) -> dict:
         import time
 
+        last_reap = time.monotonic()
         while True:
             status = self.server.task_queue.stage_status(stage_id)
             if status["failed"]:
                 raise RuntimeError(f"stage {stage_id} failed: {status['failed']}")
             if not status["pending"] and not status["running"]:
                 return status["done"]
+            now = time.monotonic()
+            if now - last_reap > min(5.0, self.task_lease_s / 4):
+                last_reap = now
+                self.server.task_queue.reap_expired(stage_id, self.task_lease_s)
             time.sleep(poll)
 
     def run_sort_shuffle(self, input_batches, num_partitions: int):

@@ -76,14 +76,33 @@ class TaskQueue:
     Tasks are JSON dicts (``{"task_id": ..., "kind": ..., ...params}``) — the
     control plane stays a data channel, never a code channel (workers dispatch
     on registered kinds). One stage at a time is typical (map barrier, then
-    reduce), but multiple stages may be live. No lease/timeout reassignment
-    yet: a crashed worker's running task is re-queued by :meth:`requeue_lost`.
+    reduce), but multiple stages may be live.
+
+    Failure handling: workers HEARTBEAT while alive (WorkerAgent runs a
+    daemon heartbeat thread; take_task also counts); :meth:`reap_expired` —
+    driven by the driver's stage-wait loop — re-queues running tasks whose
+    worker went silent for the lease duration (process crash/kill), up to
+    ``MAX_ATTEMPTS`` total attempts, after which the task is failed. A task
+    that runs long on a HEALTHY worker is never reaped — liveness is the
+    worker's heartbeat, not task runtime (Spark's executor-heartbeat model).
+    Re-execution is safe because tasks are idempotent: map and reduce
+    outputs are store objects keyed by task identity, and the index write is
+    the commit point (write/map_output_writer.py) — Spark's speculative-
+    execution contract. Completion/failure reports are accepted only from
+    the CURRENT lease holder, so a reaped-but-alive zombie attempt can
+    neither release the stage barrier early nor crash on a dropped stage.
+    :meth:`requeue_lost` remains the explicit per-worker variant for callers
+    that *observe* a death; it honors the same attempts cap.
     """
+
+    #: total attempts per task before the stage is failed (first + retries)
+    MAX_ATTEMPTS = 3
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._stages: dict = {}
         self._stopping = False
+        self._heartbeats: dict = {}  # worker_id -> monotonic timestamp
 
     def submit_stage(self, stage_id: str, tasks: List[dict]) -> None:
         with self._lock:
@@ -94,33 +113,79 @@ class TaskQueue:
                 raise RuntimeError("duplicate task_id in stage")
             self._stages[stage_id] = {
                 "pending": list(reversed(tasks)),  # pop() serves FIFO
-                "running": {},  # task_id -> worker_id
+                "running": {},  # task_id -> {worker, task, taken_at}
                 "done": {},  # task_id -> result
                 "failed": {},  # task_id -> error string
+                "attempts": {},  # task_id -> count handed out
             }
 
-    def take_task(self, worker_id: str):
+    def heartbeat(self, worker_id: str) -> None:
+        import time as _time
+
         with self._lock:
+            self._heartbeats[worker_id] = _time.monotonic()
+
+    def take_task(self, worker_id: str):
+        import time as _time
+
+        with self._lock:
+            self._heartbeats[worker_id] = _time.monotonic()
             if self._stopping:
                 return {"action": "stop"}
             for stage_id, st in self._stages.items():
                 if st["pending"]:
                     task = st["pending"].pop()
-                    st["running"][task["task_id"]] = worker_id
+                    tid = task["task_id"]
+                    st["attempts"][tid] = st["attempts"].get(tid, 0) + 1
+                    st["running"][tid] = {
+                        "worker": worker_id,
+                        "task": task,
+                        "taken_at": _time.monotonic(),
+                    }
                     return {"action": "run", "stage_id": stage_id, "task": task}
             return {"action": "wait"}
 
-    def complete_task(self, stage_id: str, task_id, result) -> None:
+    def _holds_lease(self, stage_id: str, task_id, worker_id) -> bool:
+        """Under the lock: is ``worker_id`` the current lease holder? A
+        report from a reaped (zombie) attempt or for a dropped stage is
+        stale and must be ignored — accepting it would release the stage
+        barrier while the replacement attempt is mid-write."""
+        st = self._stages.get(stage_id)
+        if st is None:
+            return False
+        entry = st["running"].get(task_id)
+        # legacy callers (worker_id None) keep the old unguarded behavior
+        return entry is not None and (worker_id is None or entry["worker"] == worker_id)
+
+    def can_commit(self, stage_id: str, task_id, worker_id: str) -> bool:
+        """Commit authorization (Spark's OutputCommitCoordinator analog):
+        granted only to the current lease holder, so a reaped zombie attempt
+        is refused BEFORE it writes the index / output object — the commit
+        point — and walks away without touching shared store paths. The
+        residual hazard window (zombie still streaming data bytes while the
+        replacement commits) requires a worker that is partitioned from the
+        coordinator yet can reach the store, because reaping is driven by
+        worker-liveness heartbeats, not task runtime."""
         with self._lock:
+            return self._holds_lease(stage_id, task_id, worker_id)
+
+    def complete_task(self, stage_id: str, task_id, result, worker_id=None) -> bool:
+        with self._lock:
+            if not self._holds_lease(stage_id, task_id, worker_id):
+                return False  # stale attempt / dropped stage: quietly ignored
             st = self._stages[stage_id]
             st["running"].pop(task_id, None)
             st["done"][task_id] = result
+            return True
 
-    def fail_task(self, stage_id: str, task_id, error: str) -> None:
+    def fail_task(self, stage_id: str, task_id, error: str, worker_id=None) -> bool:
         with self._lock:
+            if not self._holds_lease(stage_id, task_id, worker_id):
+                return False
             st = self._stages[stage_id]
             st["running"].pop(task_id, None)
             st["failed"][task_id] = error
+            return True
 
     def stage_status(self, stage_id: str) -> dict:
         with self._lock:
@@ -132,15 +197,62 @@ class TaskQueue:
                 "failed": dict(st["failed"]),
             }
 
+    def _requeue_or_fail(self, st, tid, entry, why: str) -> bool:
+        """Under the lock: return a reaped task to pending, or fail it once
+        it has exhausted MAX_ATTEMPTS. True = requeued."""
+        attempts = st["attempts"].get(tid, 1)
+        if attempts >= self.MAX_ATTEMPTS:
+            st["failed"][tid] = (
+                f"{why} after {attempts} attempts (worker {entry['worker']})"
+            )
+            requeued = False
+        else:
+            st["pending"].append(entry["task"])
+            requeued = True
+        logger.warning(
+            "task %s %s on worker %s (attempt %d) — %s",
+            tid, why, entry["worker"], attempts,
+            "requeued" if requeued else "FAILED",
+        )
+        return requeued
+
     def requeue_lost(self, stage_id: str, worker_id: str) -> int:
-        """Re-queue tasks a dead worker was running. Returns count."""
+        """Re-queue tasks a dead worker was running (explicit observation of
+        a death). Honors the MAX_ATTEMPTS cap. Returns the count requeued."""
         with self._lock:
             st = self._stages[stage_id]
-            lost = [tid for tid, w in st["running"].items() if w == worker_id]
+            lost = [
+                tid for tid, r in st["running"].items() if r["worker"] == worker_id
+            ]
+            n = 0
             for tid in lost:
-                del st["running"][tid]
-            # lost task params are unknown here; the driver resubmits them
-            return len(lost)
+                entry = st["running"].pop(tid)
+                if self._requeue_or_fail(st, tid, entry, "worker reported lost"):
+                    n += 1
+            return n
+
+    def reap_expired(self, stage_id: str, lease_s: float) -> int:
+        """Re-queue running tasks whose WORKER went silent for ``lease_s``
+        (no heartbeat and no poll since then) — crash/kill detection, driven
+        by the driver's stage-wait loop. A long task on a heartbeat-healthy
+        worker is never reaped. Tasks past MAX_ATTEMPTS are failed instead.
+        Returns the number re-queued."""
+        import time as _time
+
+        now = _time.monotonic()
+        reaped = 0
+        with self._lock:
+            st = self._stages[stage_id]
+            for tid in [
+                t for t, r in st["running"].items()
+                if now - max(
+                    r["taken_at"], self._heartbeats.get(r["worker"], 0.0)
+                ) > lease_s
+            ]:
+                entry = st["running"].pop(tid)
+                if self._requeue_or_fail(st, tid, entry, "lease expired"):
+                    reaped += 1
+        return reaped
 
     def drop_stage(self, stage_id: str) -> None:
         with self._lock:
@@ -188,13 +300,21 @@ class _Handler(socketserver.BaseRequestHandler):
         if method == "q_take_task":
             return queue.take_task(str(a[0]))
         if method == "q_complete_task":
-            return queue.complete_task(str(a[0]), a[1], a[2])
+            w = a[3] if len(a) > 3 and a[3] is not None else None
+            return queue.complete_task(str(a[0]), a[1], a[2], w)
         if method == "q_fail_task":
-            return queue.fail_task(str(a[0]), a[1], str(a[2]))
+            w = a[3] if len(a) > 3 and a[3] is not None else None
+            return queue.fail_task(str(a[0]), a[1], str(a[2]), w)
+        if method == "q_heartbeat":
+            return queue.heartbeat(str(a[0]))
+        if method == "q_can_commit":
+            return queue.can_commit(str(a[0]), a[1], str(a[2]))
         if method == "q_stage_status":
             return queue.stage_status(str(a[0]))
         if method == "q_drop_stage":
             return queue.drop_stage(str(a[0]))
+        if method == "q_reap_expired":
+            return queue.reap_expired(str(a[0]), float(a[1]))
         if method == "q_stop_workers":
             return queue.stop_workers()
         raise RuntimeError(f"Unknown method: {method}")
@@ -369,17 +489,26 @@ class RemoteMapOutputTracker:
     def take_task(self, worker_id: str) -> dict:
         return self._call("q_take_task", worker_id)
 
-    def complete_task(self, stage_id: str, task_id, result) -> None:
-        self._call("q_complete_task", stage_id, task_id, result)
+    def complete_task(self, stage_id: str, task_id, result, worker_id=None) -> bool:
+        return self._call("q_complete_task", stage_id, task_id, result, worker_id)
 
-    def fail_task(self, stage_id: str, task_id, error: str) -> None:
-        self._call("q_fail_task", stage_id, task_id, error)
+    def fail_task(self, stage_id: str, task_id, error: str, worker_id=None) -> bool:
+        return self._call("q_fail_task", stage_id, task_id, error, worker_id)
+
+    def heartbeat(self, worker_id: str) -> None:
+        self._call("q_heartbeat", worker_id)
+
+    def can_commit(self, stage_id: str, task_id, worker_id: str) -> bool:
+        return self._call("q_can_commit", stage_id, task_id, worker_id)
 
     def stage_status(self, stage_id: str) -> dict:
         return self._call("q_stage_status", stage_id)
 
     def drop_stage(self, stage_id: str) -> None:
         self._call("q_drop_stage", stage_id)
+
+    def reap_expired(self, stage_id: str, lease_s: float) -> int:
+        return self._call("q_reap_expired", stage_id, lease_s)
 
     def stop_workers(self) -> None:
         self._call("q_stop_workers")

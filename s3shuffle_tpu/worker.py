@@ -24,6 +24,7 @@ import io
 import logging
 import os
 import socket
+import threading
 import time
 from typing import List, Optional, Tuple
 
@@ -105,6 +106,11 @@ def read_input_batches(backend, path: str):
 # ---------------------------------------------------------------------------
 
 
+class StaleAttemptError(RuntimeError):
+    """This attempt's lease was reaped (worker presumed dead) and another
+    attempt owns the task now — abandon quietly, touch nothing shared."""
+
+
 class WorkerAgent:
     def __init__(
         self,
@@ -121,7 +127,20 @@ class WorkerAgent:
         self.tasks_run = 0
 
     # -- task kinds ----------------------------------------------------
-    def _run_map(self, task: dict):
+    def _commit_allowed(self, stage_id: str, task: dict) -> bool:
+        """Commit fence (TaskQueue.can_commit): only the current lease
+        holder may write the commit point (index / output object). Refused
+        ALSO when the coordinator is unreachable — the unreachable case IS
+        the zombie scenario the fence exists for; the attempt is retried
+        elsewhere (idempotent tasks)."""
+        try:
+            return bool(
+                self.client.can_commit(stage_id, task["task_id"], self.worker_id)
+            )
+        except Exception:
+            return False
+
+    def _run_map(self, task: dict, stage_id: str):
         shuffle_id = int(task["shuffle_id"])
         dep = dep_from_descriptor(shuffle_id, task["dep"])
         handle = self.manager.register_shuffle(shuffle_id, dep)
@@ -132,13 +151,22 @@ class WorkerAgent:
         try:
             for b in batches:
                 writer.write(b)
+            if not self._commit_allowed(stage_id, task):
+                # stale attempt: no index commit, and NO delete — the shared
+                # data path may already belong to the replacement attempt
+                writer.disown()
+                raise StaleAttemptError(
+                    f"commit refused for task {task['task_id']}"
+                )
             writer.stop(success=True)
+        except StaleAttemptError:
+            raise
         except BaseException:
             writer.stop(success=False)
             raise
         return {"records": int(sum(b.n for b in batches))}
 
-    def _run_reduce(self, task: dict):
+    def _run_reduce(self, task: dict, stage_id: str):
         shuffle_id = int(task["shuffle_id"])
         dep = dep_from_descriptor(shuffle_id, task["dep"])
         handle = self.manager.register_shuffle(shuffle_id, dep)
@@ -148,6 +176,8 @@ class WorkerAgent:
         from s3shuffle_tpu.batch import RecordBatch, write_frame
 
         merged = RecordBatch.concat(batches)
+        if not self._commit_allowed(stage_id, task):
+            raise StaleAttemptError(f"commit refused for task {task['task_id']}")
         with self.manager.dispatcher.backend.create(task["output_path"]) as sink:
             write_frame(sink, merged)
         return {"records": int(merged.n)}
@@ -166,26 +196,72 @@ class WorkerAgent:
         try:
             fn = self.KINDS[kind]
         except KeyError:
-            self.client.fail_task(stage_id, task.get("task_id"), f"unknown kind {kind!r}")
+            self.client.fail_task(
+                stage_id, task.get("task_id"), f"unknown kind {kind!r}",
+                self.worker_id,
+            )
             return "run"
         try:
-            result = fn(self, task)
-            self.client.complete_task(stage_id, task["task_id"], result)
+            result = fn(self, task, stage_id)
+            accepted = self.client.complete_task(
+                stage_id, task["task_id"], result, self.worker_id
+            )
+        except StaleAttemptError as e:
+            logger.warning("worker %s: %s — attempt abandoned", self.worker_id, e)
+            accepted = True  # nothing to report; the lease moved on
         except Exception as e:
             logger.exception("task %s failed", task.get("task_id"))
-            self.client.fail_task(stage_id, task["task_id"], f"{type(e).__name__}: {e}")
+            accepted = self.client.fail_task(
+                stage_id, task["task_id"], f"{type(e).__name__}: {e}",
+                self.worker_id,
+            )
+        if accepted is False:
+            # our lease was reaped while we ran (coordinator thought us dead
+            # — e.g. a long GC or network partition); the attempt was stale
+            # and the report was ignored. Keep serving.
+            logger.warning(
+                "worker %s: stale attempt for task %s ignored by coordinator",
+                self.worker_id, task.get("task_id"),
+            )
         self.tasks_run += 1
         return "run"
 
-    def run_forever(self, poll_interval: float = 0.05) -> int:
+    def _start_heartbeat(self, interval_s: float) -> None:
+        """Daemon thread: liveness signal while a (long) task runs — the
+        coordinator reaps only tasks whose worker went SILENT (crash/kill),
+        never long tasks on a heartbeat-healthy worker. A separate client
+        connection: the main one is busy inside the running task."""
+
+        def beat():
+            hb_client = RemoteMapOutputTracker(self.client.address)
+            while not self._stopped:
+                try:
+                    hb_client.heartbeat(self.worker_id)
+                except Exception:
+                    pass  # coordinator briefly away — take_task also beats
+                time.sleep(interval_s)
+
+        threading.Thread(target=beat, daemon=True, name="worker-heartbeat").start()
+
+    def run_forever(
+        self, poll_interval: float = 0.05, heartbeat_s: float = 5.0
+    ) -> int:
         logger.info("worker %s polling coordinator %s", self.worker_id, self.client.address)
-        while True:
-            action = self.run_once()
-            if action == "stop":
-                logger.info("worker %s stopping after %d tasks", self.worker_id, self.tasks_run)
-                return self.tasks_run
-            if action == "wait":
-                time.sleep(poll_interval)
+        self._stopped = False
+        self._start_heartbeat(heartbeat_s)
+        try:
+            while True:
+                action = self.run_once()
+                if action == "stop":
+                    logger.info(
+                        "worker %s stopping after %d tasks",
+                        self.worker_id, self.tasks_run,
+                    )
+                    return self.tasks_run
+                if action == "wait":
+                    time.sleep(poll_interval)
+        finally:
+            self._stopped = True
 
 
 class MetricsServer:

@@ -142,7 +142,7 @@ def test_multiprocess_shuffle_survives_worker_death(tmp_path):
         cluster.shutdown()
 
 
-def _agent_main(coordinator, cfg_dict, worker_id):
+def _agent_main(coordinator, cfg_dict, worker_id, heartbeat_s=5.0):
     # module-level so it pickles under spawn
     from s3shuffle_tpu.config import ShuffleConfig
     from s3shuffle_tpu.storage.dispatcher import Dispatcher
@@ -150,7 +150,7 @@ def _agent_main(coordinator, cfg_dict, worker_id):
 
     Dispatcher.reset()
     agent = WorkerAgent(tuple(coordinator), config=ShuffleConfig(**cfg_dict), worker_id=worker_id)
-    agent.run_forever(poll_interval=0.01)
+    agent.run_forever(poll_interval=0.01, heartbeat_s=heartbeat_s)
 
 
 @pytest.mark.slow
@@ -229,6 +229,169 @@ def test_task_queue_semantics():
     assert st["pending"] == 1 and st["done"] == {0: {"ok": 1}} and "boom" in st["failed"][1]
     q.stop_workers()
     assert q.take_task("w0")["action"] == "stop"
+
+
+def test_task_queue_lease_reap_and_attempt_cap():
+    """§5.3: a crashed/hung worker's running task is re-queued once its
+    lease expires (idempotent re-execution), and a task that keeps dying is
+    failed after MAX_ATTEMPTS so the stage errors instead of looping."""
+    from s3shuffle_tpu.metadata.service import TaskQueue
+
+    q = TaskQueue()
+    q.submit_stage("s", [{"task_id": 0, "kind": "noop"}])
+    for attempt in range(TaskQueue.MAX_ATTEMPTS):
+        t = q.take_task(f"w{attempt}")
+        assert t["action"] == "run"
+        # fresh lease: nothing reaped
+        assert q.reap_expired("s", lease_s=60.0) == 0
+        # expired lease: requeued, except on the final attempt -> failed
+        reaped = q.reap_expired("s", lease_s=0.0)
+        st = q.stage_status("s")
+        if attempt < TaskQueue.MAX_ATTEMPTS - 1:
+            assert reaped == 1 and st["pending"] == 1 and not st["failed"]
+        else:
+            assert reaped == 0 and "attempts" in st["failed"][0]
+    # requeue_lost returns the task itself to pending (explicit variant)
+    q.submit_stage("s2", [{"task_id": 7, "kind": "noop"}])
+    q.take_task("dead-worker")
+    assert q.requeue_lost("s2", "dead-worker") == 1
+    t = q.take_task("w9")
+    assert t["task"]["task_id"] == 7
+
+
+def test_task_queue_refuses_zombie_reports():
+    """A reaped-but-alive attempt must be unable to release the stage
+    barrier or crash on a dropped stage: completion/failure reports are
+    accepted only from the current lease holder."""
+    from s3shuffle_tpu.metadata.service import TaskQueue
+
+    q = TaskQueue()
+    q.submit_stage("s", [{"task_id": 0, "kind": "noop"}])
+    q.take_task("zombie")
+    assert q.reap_expired("s", lease_s=0.0) == 1  # zombie presumed dead
+    t2 = q.take_task("live")  # replacement attempt
+    assert t2["action"] == "run"
+    # the zombie comes back: its report must be ignored, not crash
+    assert q.complete_task("s", 0, {"stale": True}, worker_id="zombie") is False
+    st = q.stage_status("s")
+    assert st["running"] == 1 and not st["done"]  # barrier still held
+    # the live holder's report lands
+    assert q.complete_task("s", 0, {"ok": True}, worker_id="live") is True
+    assert q.stage_status("s")["done"] == {0: {"ok": True}}
+    # reports for a dropped stage are quietly refused (no KeyError)
+    q.drop_stage("s")
+    assert q.complete_task("s", 0, {"late": True}, worker_id="live") is False
+    assert q.fail_task("s", 0, "late", worker_id="live") is False
+    # heartbeat keeps a long task alive: fresh beat -> nothing reaped
+    q.submit_stage("s3", [{"task_id": 1, "kind": "noop"}])
+    q.take_task("slowpoke")
+    q.heartbeat("slowpoke")
+    assert q.reap_expired("s3", lease_s=10.0) == 0
+
+
+def test_commit_fence_and_disown(tmp_path):
+    """can_commit (OutputCommitCoordinator analog): only the current lease
+    holder is authorized; a refused attempt disowns — closing its stream
+    without publishing an index (readers never see it) and without deleting
+    the shared path."""
+    import os
+
+    from s3shuffle_tpu.dependency import HashPartitioner, ShuffleDependency
+    from s3shuffle_tpu.manager import ShuffleManager
+    from s3shuffle_tpu.metadata.service import TaskQueue
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+
+    q = TaskQueue()
+    q.submit_stage("s", [{"task_id": 0, "kind": "map"}])
+    q.take_task("zombie")
+    q.reap_expired("s", lease_s=0.0)
+    q.take_task("live")
+    assert q.can_commit("s", 0, "zombie") is False
+    assert q.can_commit("s", 0, "live") is True
+    assert q.can_commit("dropped-stage", 0, "live") is False
+
+    Dispatcher.reset()
+    m = ShuffleManager(
+        ShuffleConfig(root_dir=f"file://{tmp_path}/fence", app_id="fence", codec="zlib")
+    )
+    handle = m.register_shuffle(0, ShuffleDependency(0, HashPartitioner(2)))
+    w = m.get_writer(handle, 0)
+    w.write([(b"k1", b"v1"), (b"k2", b"v2")])
+    w.disown()
+    files = [
+        f for _d, _s, fs in os.walk(f"{tmp_path}/fence") for f in fs
+    ]
+    assert not any(f.endswith(".index") for f in files), files  # no commit
+    # idempotent + stop() after disown is a no-op
+    w.disown()
+    assert w.stop(success=True) is None
+    m.stop()
+
+
+def test_distributed_driver_recovers_from_hung_worker(tmp_path):
+    """A worker takes a task and never completes it (hang/crash): the
+    driver's stage-wait loop reaps the expired lease and a live agent
+    re-runs the task — the shuffle completes with full results."""
+    import dataclasses
+    import multiprocessing as mp
+
+    from s3shuffle_tpu.batch import RecordBatch
+    from s3shuffle_tpu.cluster import DistributedDriver
+    from s3shuffle_tpu.metadata.service import RemoteMapOutputTracker
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/store", app_id="reap-test", codec="zlib"
+    )
+    rng = random.Random(4)
+    recs = [(rng.randbytes(8), rng.randbytes(24)) for _ in range(2000)]
+    batches = [RecordBatch.from_records(recs[i::2]) for i in range(2)]
+
+    driver = DistributedDriver(cfg)
+    # fast reap, with the live worker heartbeating at lease/6 so a loaded CI
+    # machine cannot falsely reap a healthy worker (invariant: heartbeat
+    # interval << lease)
+    driver.task_lease_s = 3.0
+    # the "hung worker": steals the first map task and never finishes it
+    thief = RemoteMapOutputTracker(driver.coordinator_address)
+    stolen = {"n": 0}
+
+    def steal_once():
+        import time as _t
+
+        for _ in range(200):
+            t = thief.take_task("hung-worker")
+            if t["action"] == "run":
+                stolen["n"] += 1
+                return  # never complete/fail it — simulate a hang
+            _t.sleep(0.02)
+
+    import threading
+
+    stealer = threading.Thread(target=steal_once, daemon=True)
+    stealer.start()
+
+    ctx = mp.get_context("spawn")
+    worker = ctx.Process(
+        target=_agent_main,
+        args=(list(driver.coordinator_address), dataclasses.asdict(cfg), "live", 0.5),
+        daemon=True,
+    )
+    worker.start()
+    try:
+        out = driver.run_sort_shuffle(batches, num_partitions=3)
+        assert sum(b.n for b in out) == 2000
+        got = [kv for b in out for kv in b.to_records()]
+        assert sorted(got) == sorted(recs)
+        stealer.join(timeout=5)
+        assert stolen["n"] == 1  # the hang actually happened and was recovered
+    finally:
+        thief.close()
+        driver.shutdown()
+        worker.join(timeout=10)
+        if worker.is_alive():
+            worker.terminate()
 
 
 def test_dep_descriptor_roundtrip():
