@@ -253,11 +253,15 @@ class DistributedDriver:
                 for r, p in enumerate(out_paths)
             ],
         )
-        self._wait_stage(reduce_stage)
+        done = self._wait_stage(reduce_stage)
 
         out = []
-        for p in out_paths:
-            batches = read_input_batches(self.dispatcher.backend, p)
+        for r, base in enumerate(out_paths):
+            # the COMMITTED attempt's result names the actual (attempt-
+            # suffixed) object — a zombie attempt's object is never read
+            result = done.get(r) or done.get(str(r)) or {}
+            path = result.get("path", base)
+            batches = read_input_batches(self.dispatcher.backend, path)
             out.append(batches[0] if batches else RecordBatch.empty())
         self.server.task_queue.drop_stage(map_stage)
         self.server.task_queue.drop_stage(reduce_stage)

@@ -142,7 +142,15 @@ class TaskQueue:
                         "task": task,
                         "taken_at": _time.monotonic(),
                     }
-                    return {"action": "run", "stage_id": stage_id, "task": task}
+                    # the attempt number rides along so workers can write
+                    # attempt-unique output objects (Spark-3 semantics: the
+                    # shuffle mapId IS the attempt-unique task id) — a
+                    # zombie attempt then cannot clobber the winner's bytes
+                    return {
+                        "action": "run",
+                        "stage_id": stage_id,
+                        "task": {**task, "_attempt": st["attempts"][tid]},
+                    }
             return {"action": "wait"}
 
     def _holds_lease(self, stage_id: str, task_id, worker_id) -> bool:
@@ -161,18 +169,26 @@ class TaskQueue:
         """Commit authorization (Spark's OutputCommitCoordinator analog):
         granted only to the current lease holder, so a reaped zombie attempt
         is refused BEFORE it writes the index / output object — the commit
-        point — and walks away without touching shared store paths. The
-        residual hazard window (zombie still streaming data bytes while the
-        replacement commits) requires a worker that is partitioned from the
-        coordinator yet can reach the store, because reaping is driven by
-        worker-liveness heartbeats, not task runtime."""
+        point — and walks away. Combined with attempt-unique output object
+        names (WorkerAgent.ATTEMPT_STRIDE; take_task attaches ``_attempt``),
+        a zombie can neither commit nor clobber the winner's bytes: its
+        writes land on its own attempt's paths, which no reader ever
+        resolves."""
         with self._lock:
             return self._holds_lease(stage_id, task_id, worker_id)
 
-    def complete_task(self, stage_id: str, task_id, result, worker_id=None) -> bool:
+    def complete_task(
+        self, stage_id: str, task_id, result, worker_id=None, on_accept=None
+    ) -> bool:
+        """``on_accept`` runs UNDER the queue lock iff the report is
+        accepted — side effects that must be atomic with acceptance (the
+        winning attempt's MapStatus registration) go here, so a zombie whose
+        report is refused can never register its outputs either."""
         with self._lock:
             if not self._holds_lease(stage_id, task_id, worker_id):
                 return False  # stale attempt / dropped stage: quietly ignored
+            if on_accept is not None:
+                on_accept()
             st = self._stages[stage_id]
             st["running"].pop(task_id, None)
             st["done"][task_id] = result
@@ -301,7 +317,22 @@ class _Handler(socketserver.BaseRequestHandler):
             return queue.take_task(str(a[0]))
         if method == "q_complete_task":
             w = a[3] if len(a) > 3 and a[3] is not None else None
-            return queue.complete_task(str(a[0]), a[1], a[2], w)
+            on_accept = None
+            if len(a) > 4 and a[4] is not None:
+                # map-output registration rides the completion atomically:
+                # accepted ⇒ registered; refused (zombie) ⇒ never registered
+                m_shuffle, m_map, m_loc, m_sizes = a[4]
+                tracker = self.server.tracker  # type: ignore[attr-defined]
+                status = MapStatus(
+                    map_id=int(m_map),
+                    location=str(m_loc),
+                    sizes=np.asarray(m_sizes, dtype=np.int64),
+                )
+
+                def on_accept(s=status, sid=int(m_shuffle), t=tracker):
+                    t.register_map_output(sid, s)
+
+            return queue.complete_task(str(a[0]), a[1], a[2], w, on_accept)
         if method == "q_fail_task":
             w = a[3] if len(a) > 3 and a[3] is not None else None
             return queue.fail_task(str(a[0]), a[1], str(a[2]), w)
@@ -489,8 +520,14 @@ class RemoteMapOutputTracker:
     def take_task(self, worker_id: str) -> dict:
         return self._call("q_take_task", worker_id)
 
-    def complete_task(self, stage_id: str, task_id, result, worker_id=None) -> bool:
-        return self._call("q_complete_task", stage_id, task_id, result, worker_id)
+    def complete_task(
+        self, stage_id: str, task_id, result, worker_id=None, map_output=None
+    ) -> bool:
+        """``map_output``: optional ``[shuffle_id, map_id, location, sizes]``
+        registered atomically with acceptance (see TaskQueue.complete_task)."""
+        return self._call(
+            "q_complete_task", stage_id, task_id, result, worker_id, map_output
+        )
 
     def fail_task(self, stage_id: str, task_id, error: str, worker_id=None) -> bool:
         return self._call("q_fail_task", stage_id, task_id, error, worker_id)

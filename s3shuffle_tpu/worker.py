@@ -35,6 +35,9 @@ from s3shuffle_tpu.dependency import (
     ShuffleDependency,
     natural_key,
 )
+import numpy as np
+
+from s3shuffle_tpu.metadata.map_output import STORE_LOCATION
 from s3shuffle_tpu.metadata.service import RemoteMapOutputTracker
 from s3shuffle_tpu.serializer import ColumnarKVSerializer
 
@@ -129,16 +132,24 @@ class WorkerAgent:
     # -- task kinds ----------------------------------------------------
     def _commit_allowed(self, stage_id: str, task: dict) -> bool:
         """Commit fence (TaskQueue.can_commit): only the current lease
-        holder may write the commit point (index / output object). Refused
-        ALSO when the coordinator is unreachable — the unreachable case IS
-        the zombie scenario the fence exists for; the attempt is retried
-        elsewhere (idempotent tasks)."""
-        try:
-            return bool(
-                self.client.can_commit(stage_id, task["task_id"], self.worker_id)
-            )
-        except Exception:
-            return False
+        holder may write the commit point. An authoritative refusal returns
+        False (→ stale-attempt abandon); a TRANSPORT error propagates so the
+        normal failure path runs — and if the coordinator is truly
+        unreachable, the worker loop dies, its heartbeats stop, and the
+        lease is reaped. Silently treating transport errors as refusal
+        would leave the task 'running' forever under a healthy heartbeat."""
+        return bool(
+            self.client.can_commit(stage_id, task["task_id"], self.worker_id)
+        )
+
+    #: attempt-unique map output ids: ``logical * STRIDE + (attempt - 1)``.
+    #: Spark-3 semantics (the shuffle mapId is the attempt-unique task id,
+    #: SortShuffleManager's mapTaskAttemptId): every attempt writes DISTINCT
+    #: data/index/checksum object names, so a zombie attempt can never
+    #: clobber the committed winner's bytes; readers find outputs through
+    #: the tracker's registered MapStatus ids, and only the fence-authorized
+    #: attempt ever commits/registers.
+    ATTEMPT_STRIDE = 1000
 
     def _run_map(self, task: dict, stage_id: str):
         shuffle_id = int(task["shuffle_id"])
@@ -147,14 +158,24 @@ class WorkerAgent:
         from s3shuffle_tpu.batch import RecordBatch
 
         batches = read_input_batches(self.manager.dispatcher.backend, task["input_path"])
-        writer = self.manager.get_writer(handle, int(task["map_id"]))
+        attempt = int(task.get("_attempt", 1))
+        map_id = int(task["map_id"]) * self.ATTEMPT_STRIDE + (attempt - 1)
+        writer = self.manager.get_writer(handle, map_id)
+        # defer MapStatus registration: it rides the complete_task RPC and is
+        # registered ATOMICALLY with acceptance (TaskQueue.complete_task), so
+        # a stalled attempt that passed the pre-write fence still cannot
+        # register outputs after being reaped
+        captured: dict = {}
+        writer.on_commit = lambda sid, mid, lengths: captured.update(
+            map_output=[sid, mid, STORE_LOCATION, np.asarray(lengths).tolist()]
+        )
         try:
             for b in batches:
                 writer.write(b)
             if not self._commit_allowed(stage_id, task):
-                # stale attempt: no index commit, and NO delete — the shared
-                # data path may already belong to the replacement attempt
-                writer.disown()
+                # stale attempt: abort — this attempt's objects are
+                # attempt-unique, so the delete cannot touch the winner's
+                writer.stop(success=False)
                 raise StaleAttemptError(
                     f"commit refused for task {task['task_id']}"
                 )
@@ -164,7 +185,10 @@ class WorkerAgent:
         except BaseException:
             writer.stop(success=False)
             raise
-        return {"records": int(sum(b.n for b in batches))}
+        return {
+            "records": int(sum(b.n for b in batches)),
+            "_map_output": captured.get("map_output"),
+        }
 
     def _run_reduce(self, task: dict, stage_id: str):
         shuffle_id = int(task["shuffle_id"])
@@ -178,9 +202,13 @@ class WorkerAgent:
         merged = RecordBatch.concat(batches)
         if not self._commit_allowed(stage_id, task):
             raise StaleAttemptError(f"commit refused for task {task['task_id']}")
-        with self.manager.dispatcher.backend.create(task["output_path"]) as sink:
+        # attempt-suffixed output object (same rationale as map ids): the
+        # driver learns the actual path from this attempt's RESULT, so a
+        # zombie's late write to its own path is invisible
+        out_path = f"{task['output_path']}.a{int(task.get('_attempt', 1))}"
+        with self.manager.dispatcher.backend.create(out_path) as sink:
             write_frame(sink, merged)
-        return {"records": int(merged.n)}
+        return {"records": int(merged.n), "path": out_path}
 
     KINDS = {"map": _run_map, "reduce": _run_reduce}
 
@@ -203,8 +231,9 @@ class WorkerAgent:
             return "run"
         try:
             result = fn(self, task, stage_id)
+            map_output = result.pop("_map_output", None) if isinstance(result, dict) else None
             accepted = self.client.complete_task(
-                stage_id, task["task_id"], result, self.worker_id
+                stage_id, task["task_id"], result, self.worker_id, map_output
             )
         except StaleAttemptError as e:
             logger.warning("worker %s: %s — attempt abandoned", self.worker_id, e)

@@ -123,20 +123,6 @@ class MapOutputWriter:
         checksums = self._checksum_values if self._checksums_enabled else None
         return MapOutputCommitMessage(self._lengths, checksums)
 
-    def disown(self) -> None:
-        """Close the data stream WITHOUT committing (no checksums, no index
-        — readers never see this output) and WITHOUT deleting the object
-        path: used by attempts refused at the commit fence
-        (metadata.service.TaskQueue.can_commit), whose path a replacement
-        attempt may already own. Buffered bytes may still flush on close —
-        the residual data-plane window the fence documents."""
-        self._committed = True
-        if self._stream is not None:
-            try:
-                self._stream.close()
-            except OSError:
-                pass
-
     def abort(self, error: Exception | None = None) -> None:
         if self._stream is not None:
             try:

@@ -203,16 +203,6 @@ class ShuffleMapWriter:
         finally:
             self._cleanup_spill()
 
-    def disown(self) -> None:
-        """Abandon this attempt WITHOUT committing and WITHOUT deleting the
-        shared output path (a replacement attempt may own it) — the commit-
-        fence refusal path. Local spill temp files are still cleaned."""
-        if self._stopped:
-            return
-        self._stopped = True
-        self.output_writer.disown()
-        self._cleanup_spill()
-
     def _commit(self) -> MapOutputCommitMessage:
         for pid, pipeline in enumerate(self._pipelines):
             final = pipeline.finalize()

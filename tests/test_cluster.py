@@ -289,43 +289,38 @@ def test_task_queue_refuses_zombie_reports():
     assert q.reap_expired("s3", lease_s=10.0) == 0
 
 
-def test_commit_fence_and_disown(tmp_path):
+def test_commit_fence_and_atomic_registration(tmp_path):
     """can_commit (OutputCommitCoordinator analog): only the current lease
-    holder is authorized; a refused attempt disowns — closing its stream
-    without publishing an index (readers never see it) and without deleting
-    the shared path."""
-    import os
-
-    from s3shuffle_tpu.dependency import HashPartitioner, ShuffleDependency
-    from s3shuffle_tpu.manager import ShuffleManager
+    holder is authorized; and map-output registration rides completion
+    ATOMICALLY — a refused (zombie) completion registers nothing, so
+    reducers can never see two attempts of one logical map."""
+    from s3shuffle_tpu.metadata.map_output import MapOutputTracker, MapStatus, STORE_LOCATION
     from s3shuffle_tpu.metadata.service import TaskQueue
-    from s3shuffle_tpu.storage.dispatcher import Dispatcher
 
     q = TaskQueue()
-    q.submit_stage("s", [{"task_id": 0, "kind": "map"}])
-    q.take_task("zombie")
+    q.submit_stage("s", [{"task_id": 0, "kind": "map", "map_id": 0}])
+    t1 = q.take_task("zombie")
+    assert t1["task"]["_attempt"] == 1
     q.reap_expired("s", lease_s=0.0)
-    q.take_task("live")
+    t2 = q.take_task("live")
+    assert t2["task"]["_attempt"] == 2
     assert q.can_commit("s", 0, "zombie") is False
     assert q.can_commit("s", 0, "live") is True
     assert q.can_commit("dropped-stage", 0, "live") is False
 
-    Dispatcher.reset()
-    m = ShuffleManager(
-        ShuffleConfig(root_dir=f"file://{tmp_path}/fence", app_id="fence", codec="zlib")
-    )
-    handle = m.register_shuffle(0, ShuffleDependency(0, HashPartitioner(2)))
-    w = m.get_writer(handle, 0)
-    w.write([(b"k1", b"v1"), (b"k2", b"v2")])
-    w.disown()
-    files = [
-        f for _d, _s, fs in os.walk(f"{tmp_path}/fence") for f in fs
-    ]
-    assert not any(f.endswith(".index") for f in files), files  # no commit
-    # idempotent + stop() after disown is a no-op
-    w.disown()
-    assert w.stop(success=True) is None
-    m.stop()
+    # atomic accept+register: the zombie's on_accept must never run
+    tracker = MapOutputTracker()
+    tracker.register_shuffle(9, 2)
+
+    def register(mid):
+        return lambda: tracker.register_map_output(
+            9, MapStatus(map_id=mid, location=STORE_LOCATION, sizes=np.array([1, 2]))
+        )
+
+    assert q.complete_task("s", 0, {}, worker_id="zombie", on_accept=register(0)) is False
+    assert q.complete_task("s", 0, {}, worker_id="live", on_accept=register(1)) is True
+    registered = [m for m, _sizes in tracker.get_map_sizes_by_range(9, 0, None, 0, 2)]
+    assert registered == [1]  # only the winning attempt's output exists
 
 
 def test_distributed_driver_recovers_from_hung_worker(tmp_path):
