@@ -300,6 +300,29 @@ class RecordBatch:
         return order
 
 
+def cut_sorted_head(p: "RecordBatch", bound: bytes, inclusive: bool) -> int:
+    """Rows at the head of key-sorted batch ``p`` with key < ``bound``
+    (``inclusive=False``) or ≤ ``bound`` (``inclusive=True``), exact bytes
+    order. Used by the k-way run merges in :class:`BatchSorter` (exclusive
+    cuts + skew streaming — equal keys must keep run order) and
+    colagg.ColumnarReducer (inclusive cuts — runs have unique keys and
+    commutative ops). Uses the batch's natural-width padded key strings
+    (cached on the batch, so untouched merge chunks don't re-pad every
+    round); the S-compare pad-tie is resolved with klens — pad-tied rows sort
+    short-first within a sorted run. A bound longer than the batch width
+    compares greater than every pad-tied row (each such row is a proper
+    zero-pad prefix of the bound)."""
+    width = max(int(p.klens.max()) if p.n else 0, 1)
+    ks = p.key_strings(width=width)
+    bs = np.array([bound[:width]], dtype=f"S{width}")[0]
+    lo = int(np.searchsorted(ks, bs, side="left"))
+    hi = int(np.searchsorted(ks, bs, side="right"))
+    if len(bound) > width:
+        return hi  # every pad-tied row is a proper prefix of bound → < bound
+    side = "right" if inclusive else "left"
+    return lo + int(np.searchsorted(p.klens[lo:hi], len(bound), side=side))
+
+
 def _segment_ids(boundaries: np.ndarray, total: int) -> np.ndarray:
     """Map output position → segment index given segment ``boundaries``
     (int64, length m+1, boundaries[0]=0, boundaries[-1]=total). Vectorized
@@ -587,25 +610,8 @@ class BatchSorter:
         finally:
             self.cleanup()
 
-    @staticmethod
-    def _cut(p: RecordBatch, bound: bytes, inclusive: bool) -> int:
-        """Rows at the head of sorted batch ``p`` with key < ``bound``
-        (``inclusive=False``) or ≤ ``bound`` (``inclusive=True``), exact bytes
-        order. Uses the batch's natural-width padded key strings (cached on
-        the batch, so untouched merge chunks don't re-pad every round); the
-        S-compare pad-tie is resolved with klens — pad-tied rows sort
-        short-first within a sorted run. A bound longer than the batch width
-        compares greater than every pad-tied row (each such row is a proper
-        zero-pad prefix of the bound)."""
-        width = max(int(p.klens.max()) if p.n else 0, 1)
-        ks = p.key_strings(width=width)
-        bs = np.array([bound[:width]], dtype=f"S{width}")[0]
-        lo = int(np.searchsorted(ks, bs, side="left"))
-        hi = int(np.searchsorted(ks, bs, side="right"))
-        if len(bound) > width:
-            return hi  # every pad-tied row is a proper prefix of bound → < bound
-        side = "right" if inclusive else "left"
-        return lo + int(np.searchsorted(p.klens[lo:hi], len(bound), side=side))
+    # shared with colagg.ColumnarReducer's run merge — see cut_sorted_head
+    _cut = staticmethod(lambda p, bound, inclusive: cut_sorted_head(p, bound, inclusive))
 
     def _merge_spills(self, chunk_records: int) -> Iterator[RecordBatch]:
         """Bounded-memory columnar k-way merge. Bulk rounds emit every loaded

@@ -48,6 +48,144 @@ class HashPartitioner(Partitioner):
         return _stable_key_hash(key) % self.num_partitions
 
 
+_FNV64_PRIME = 1099511628211
+_M64 = (1 << 64) - 1
+# multiplicative inverse of the prime mod 2^64 (prime is odd → invertible):
+# un-does the Horner factor contributed by zero padding columns
+_FNV64_PRIME_INV = pow(_FNV64_PRIME, -1, 1 << 64)
+_LEN_SALT = 0x9E3779B97F4A7C15
+
+
+def _mix64(h: int) -> int:
+    """splitmix64 finalizer (scalar) — must match `_mix64_vec` bit-for-bit."""
+    h &= _M64
+    h ^= h >> 30
+    h = (h * 0xBF58476D1CE4E5B9) & _M64
+    h ^= h >> 27
+    h = (h * 0x94D049BB133111EB) & _M64
+    h ^= h >> 31
+    return h
+
+
+def _mix64_vec(h):
+    import numpy as np
+
+    h = h ^ (h >> np.uint64(30))
+    h = h * np.uint64(0xBF58476D1CE4E5B9)
+    h = h ^ (h >> np.uint64(27))
+    h = h * np.uint64(0x94D049BB133111EB)
+    return h ^ (h >> np.uint64(31))
+
+
+class BytesHashPartitioner(Partitioner):
+    """Hash partitioner over raw key BYTES, vectorized over RecordBatches.
+
+    The structured/columnar plane routes on this instead of
+    :class:`HashPartitioner` because `_stable_key_hash` (zlib.crc32 per key)
+    has no vectorized form — this partitioner's hash is a base-P Horner
+    polynomial over the key bytes, length-salted, splitmix64-finalized, which
+    maps to O(width) numpy column passes over the padded key matrix. Padding
+    zeros contribute a pure ``P^pad`` factor that is cancelled exactly with
+    the precomputed multiplicative inverse, so the scalar ``__call__`` (used
+    by per-record fallback paths) and :meth:`partition_batch` agree
+    bit-for-bit on every key.
+
+    NOTE: deterministic across processes by construction (no PYTHONHASHSEED
+    anywhere), but it is a *different* partition function from
+    HashPartitioner — the two must not be mixed within one shuffle.
+    """
+
+    def __init__(self, num_partitions: int):
+        if num_partitions < 1:
+            raise ValueError("num_partitions must be >= 1")
+        self.num_partitions = num_partitions
+        self._inv_pows = None  # lazily grown [P^-0, P^-1, ...] uint64 table
+
+    def __call__(self, key: Any) -> int:
+        if isinstance(key, str):
+            key = key.encode("utf-8")
+        b = bytes(key)
+        h = 0
+        for x in b:
+            h = (h * _FNV64_PRIME + x) & _M64
+        h ^= (len(b) * _LEN_SALT) & _M64
+        return _mix64(h) % self.num_partitions
+
+    def _inverse_powers(self, upto: int):
+        import numpy as np
+
+        if self._inv_pows is None or len(self._inv_pows) <= upto:
+            pows = [1]
+            for _ in range(upto):
+                pows.append((pows[-1] * _FNV64_PRIME_INV) & _M64)
+            self._inv_pows = np.array(pows, dtype=np.uint64)
+        return self._inv_pows
+
+    def partition_batch(self, batch):
+        import numpy as np
+
+        n = batch.n
+        if n == 0:
+            return np.zeros(0, dtype=np.int64)
+        klens = batch.klens
+        kw = batch._fixed_width(klens, "_kw")
+        prime = np.uint64(_FNV64_PRIME)
+        h = np.zeros(n, dtype=np.uint64)
+        if kw >= 0:
+            mat = (
+                np.ascontiguousarray(batch.keys).reshape(n, kw)
+                if kw
+                else np.zeros((n, 0), dtype=np.uint8)
+            )
+            for c in range(kw):
+                h = h * prime + mat[:, c]
+        elif int(klens.max()) <= 64:
+            # ragged: reuse the cached padded key matrix (key_strings builds
+            # and caches it) and cancel each row's padding factor
+            w = max(int(klens.max()), 1)
+            mat = batch.key_strings(width=w).view(np.uint8).reshape(n, w)
+            for c in range(w):
+                h = h * prime + mat[:, c]
+            pad = (w - klens).astype(np.int64)
+            h = h * self._inverse_powers(w)[pad]
+        else:
+            # one oversized key must not size the padded matrix for the whole
+            # chunk (n × max_klen can be GBs) — rows ≤ 64 B vectorize at a
+            # bounded width, longer keys (rare) hash scalar
+            w = 64
+            small = np.flatnonzero(klens <= w)
+            large = np.flatnonzero(klens > w)
+            if len(small):
+                from s3shuffle_tpu.batch import _ragged_gather, _segment_ids
+
+                lens = klens[small].astype(np.int64)
+                off = np.zeros(len(small) + 1, dtype=np.int64)
+                np.cumsum(lens, out=off[1:])
+                mat = np.zeros((len(small), w), dtype=np.uint8)
+                total = int(off[-1])
+                if total:
+                    rows = _segment_ids(off, total)
+                    cols = np.arange(total, dtype=np.int64) - off[rows]
+                    mat[rows, cols] = _ragged_gather(
+                        batch.keys, batch.koffsets, batch.klens, small
+                    )
+                hs = np.zeros(len(small), dtype=np.uint64)
+                for c in range(w):
+                    hs = hs * prime + mat[:, c]
+                hs = hs * self._inverse_powers(w)[(w - lens)]
+                h[small] = hs
+            if len(large):
+                keys, ko = batch.keys, batch.koffsets
+                for i in large.tolist():
+                    hh = 0
+                    for x in keys[ko[i] : ko[i + 1]].tobytes():
+                        hh = (hh * _FNV64_PRIME + x) & _M64
+                    h[i] = hh
+        h = h ^ (klens.astype(np.uint64) * np.uint64(_LEN_SALT))
+        h = _mix64_vec(h)
+        return (h % np.uint64(self.num_partitions)).astype(np.int64)
+
+
 class RangePartitioner(Partitioner):
     """Key-range partitioner (what sortByKey uses): bounds[i] is the inclusive
     upper key of partition i; computed from a sample by :func:`range_bounds`."""

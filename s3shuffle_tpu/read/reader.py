@@ -153,8 +153,11 @@ class ShuffleReader:
         from s3shuffle_tpu.utils import trace
 
         trace.count("read.tasks")
-        if self.dep.serializer.supports_batches and self.dep.aggregator is None:
-            return self._read_batched()
+        if self.dep.serializer.supports_batches:
+            if self.dep.aggregator is None:
+                return self._read_batched()
+            if getattr(self.dep.aggregator, "supports_columnar", False):
+                return self._read_columnar_agg()
 
         import itertools
 
@@ -268,6 +271,37 @@ class ShuffleReader:
             sorter.insert_all(batch.iter_records())
         yield from sorter.sorted_iterator()
 
+    def _reduced_batches(self):
+        """Columnar combine: stream read batches through the aggregator's
+        ColumnarReducer (sort + reduceat group-by, bounded memory — see
+        s3shuffle_tpu.colagg). Replaces the per-record dict combine the
+        reference delegates to ExternalAppendOnlyMap
+        (S3ShuffleReader.scala:124-138). Output batches arrive key-sorted."""
+        reducer = self.dep.aggregator.new_reducer(
+            spill_bytes=self.dispatcher.config.aggregator_spill_bytes
+        )
+        for batch in self.read_batches():
+            reducer.add(batch)
+        return reducer.results()
+
+    def _read_columnar_agg(self) -> Iterator[Tuple[Any, Any]]:
+        from s3shuffle_tpu.dependency import natural_key
+
+        key_ordering = self.dep.key_ordering
+        if key_ordering is None or key_ordering is natural_key:
+            # reducer output is already in key-byte order — natural ordering
+            # is free
+            for batch in self._reduced_batches():
+                yield from batch.iter_records()
+            return
+        sorter = ExternalSorter(
+            key_func=key_ordering,
+            spill_bytes=self.dispatcher.config.sorter_spill_bytes,
+        )
+        for batch in self._reduced_batches():
+            sorter.insert_all(batch.iter_records())
+        yield from sorter.sorted_iterator()
+
     def _fed_batch_sorter(self):
         """Build the natural-byte-order BatchSorter and feed it every read
         batch — shared by the records and batches terminal paths."""
@@ -299,7 +333,13 @@ class ShuffleReader:
                     )
             return [RecordBatch.from_records(records)]
 
-        if not (self.dep.serializer.supports_batches and self.dep.aggregator is None):
+        if not self.dep.serializer.supports_batches:
+            return fallback()
+        if self.dep.aggregator is not None:
+            if getattr(self.dep.aggregator, "supports_columnar", False) and (
+                self.dep.key_ordering is None or self.dep.key_ordering is natural_key
+            ):
+                return list(self._reduced_batches())
             return fallback()
         if self.dep.key_ordering is None:
             return list(self.read_batches())

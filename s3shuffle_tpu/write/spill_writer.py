@@ -98,6 +98,7 @@ class ShuffleMapWriter:
         ]
         self._spill_file: Optional[str] = None
         self._spill_fd = None
+        self._combine_reducer = None  # columnar map-side combine state
         self._records_written = 0
         self._stopped = False
         self.spill_count = 0
@@ -107,9 +108,27 @@ class ShuffleMapWriter:
         from s3shuffle_tpu.batch import RecordBatch
 
         dep = self.dep
-        if not dep.map_side_combine and dep.serializer.supports_batches:
-            self._write_batched(records)
-            return
+        if dep.serializer.supports_batches:
+            if not dep.map_side_combine:
+                self._write_batched(records)
+                return
+            if getattr(dep.aggregator, "supports_columnar", False):
+                # Vectorized map-side combine: the whole map task's input —
+                # across every write() call (production workers write one
+                # batch per call) — flows through one bounded-memory
+                # ColumnarReducer (sorted unique-key partials, spills at
+                # budget); partition routing happens at commit when the
+                # reducer drains.
+                from s3shuffle_tpu.batch import iter_record_batches
+
+                if self._combine_reducer is None:
+                    self._combine_reducer = dep.aggregator.new_reducer(
+                        spill_bytes=self.output_writer.dispatcher.config.aggregator_spill_bytes
+                    )
+                for chunk in iter_record_batches(records):
+                    self._records_written += chunk.n
+                    self._combine_reducer.add(chunk)
+                return
         if isinstance(records, RecordBatch):
             # Per-record routes (combine, or a non-batch serializer) consume
             # (k, v) tuples — expand columnar input at the boundary.
@@ -144,10 +163,15 @@ class ShuffleMapWriter:
         """Vectorized route: chunk records into columnar RecordBatches,
         vectorized partition assignment + stable grouping, one columnar frame
         per (chunk × partition) through each pipeline."""
-        from s3shuffle_tpu.batch import iter_record_batches, split_by_partition
+        from s3shuffle_tpu.batch import iter_record_batches
+
+        self._write_batches(iter_record_batches(records))
+
+    def _write_batches(self, batches) -> None:
+        from s3shuffle_tpu.batch import split_by_partition
 
         dep = self.dep
-        for batch in iter_record_batches(records):
+        for batch in batches:
             if batch.n == 0:
                 continue
             pids = dep.partitioner.partition_batch(batch)
@@ -187,6 +211,9 @@ class ShuffleMapWriter:
             return None
         self._stopped = True
         if not success:
+            if self._combine_reducer is not None:
+                self._combine_reducer.cleanup()
+                self._combine_reducer = None
             self.output_writer.abort()
             self._cleanup_spill()
             return None
@@ -204,6 +231,11 @@ class ShuffleMapWriter:
             self._cleanup_spill()
 
     def _commit(self) -> MapOutputCommitMessage:
+        if self._combine_reducer is not None:
+            # drain the map-side combine: reduced partials route to partition
+            # pipelines now, so every partition's stream is complete below
+            self._write_batches(self._combine_reducer.results())
+            self._combine_reducer = None
         for pid, pipeline in enumerate(self._pipelines):
             final = pipeline.finalize()
             writer = self.output_writer.get_partition_writer(pid)
