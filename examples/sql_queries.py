@@ -1,38 +1,52 @@
 #!/usr/bin/env python
-"""Real query execution for the TPC-DS-shaped benchmark queries.
+"""Real query execution for the TPC-DS-shaped benchmark queries — columnar.
 
 The reference's SQL harness runs actual TPC-DS queries on Spark
 (``/root/reference/examples/sql/run_benchmark.sh``, ``run_single_query.sh``;
 queries q5/q49/q75/q67 per run_tests.sh:39-42). This is the framework-native
 equivalent: each query is a REAL multi-stage pipeline — joins, aggregations,
-rank — hand-written over the shuffle API, on synthetic tables with
-TPC-DS-like schemas. Every shuffle stage runs through the full write/read
-planes (partitioned object writes, index/checksum sidecars, prefetching
-reads, the configured codec), and the **shuffle-stage wall-clock** — the
-north-star metric's second half (BASELINE.md) — is measured per query as
-the summed wall time of the pipeline's shuffle stages.
+rank — over synthetic tables with TPC-DS-like schemas. Every shuffle stage
+runs through the full write/read planes (partitioned object writes,
+index/checksum sidecars, prefetching reads, the configured codec), and the
+**shuffle-stage wall-clock** — the north-star metric's second half
+(BASELINE.md) — is measured per query as the summed wall time of the
+pipeline's shuffle stages.
+
+Round 4: the pipelines are **fully columnar** (numpy tables → typed
+order-preserving key packing → ColumnarAggregator segmented reductions →
+vectorized operators). The r3 pipelines moved Python tuples per record and
+the SF-100 suite was interpreter-bound (VERDICT r3: 1913 s ≈ 11 K rows/s);
+the columnar rewrite is the TPU-native design — the reference leans on
+Spark's native ExternalAppendOnlyMap loops (storage/S3ShuffleReader.scala:
+124-138), this build leans on numpy/reduceat.
 
 Semantics are verified: ``--verify`` (default at small scale) recomputes
-each query single-process in plain Python and asserts exact equality, so
-the measured pipelines are correct query executions, not shuffle-shaped
-traffic generators (the r1 harness, examples/query_shuffles.py, replayed
-volume profiles only — VERDICT r1 §missing #1).
+each query single-process in plain Python dict/loop form over the same
+tables and asserts exact equality, so the measured pipelines are correct
+query executions, not shuffle-shaped traffic generators.
 
 Queries (simplified schemas, faithful shapes):
-  q5   channel profit rollup: union sales+returns, aggregate by
-       (channel, entity), roll up per channel          — 1 shuffle stage
+  q5   channel profit rollup: union sales+returns, aggregate per store — 1 stage
   q49  worst return ratios: join returns to sales on (item, order),
        per-item ratio aggregate, rank by ratio         — 3 shuffle stages
   q75  year-over-year decline: left-join returns, net by (year, item),
-       self-join years, emit declines                  — 3 shuffle stages
+       cross-year cogroup, emit declines               — 3 shuffle stages
   q67  top items per category: rollup sumsales by (category, item,
-       store, month) with a broadcast item dimension, rank top K
-       within category                                 — 2 shuffle stages
+       store, month), rank top K within category       — 2 shuffle stages
   q64  cross-channel repeat purchases: per-(item,year) and per-item
        aggregates, cogroup join, year self-join, growth sort
        (join-heavy profile)                            — 4 shuffle stages
   q95  returned-order analysis: order-level semi-join, per-store
        aggregate, total rollup (semi-join profile)     — 3 shuffle stages
+
+Codec labels (self-describing artifact rows):
+  ``--codec tpu-hostpath``  codec=tpu with host fallback DISABLED — measures
+                            the host TLZ encode path even without a chip
+                            (~5x slower encodes than SLZ: the documented
+                            no-chip worst case, not a bug);
+  ``--codec tpu``           codec=tpu with fallback ENABLED — the deployment
+                            default: SLZ writes + loud warning when no chip
+                            answers, device path when one does.
 
 Usage:
     python examples/sql_queries.py --query all --sf 0.1 --codec native
@@ -48,7 +62,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import random
 import shutil
 import sys
 import tempfile
@@ -57,351 +70,409 @@ from collections import defaultdict
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import numpy as np  # noqa: E402
+
+from s3shuffle_tpu.structured import (  # noqa: E402
+    KeyCodec,
+    agg_shuffle,
+    make_batch,
+    sort_shuffle_batches,
+    split_batch,
+)
+
 N_MAPS = 4
 N_REDUCERS = 6
 TOP_K = 10
 
+_I64 = np.int64
+
+
+def _zeros(n):
+    return np.zeros(n, dtype=_I64)
+
+
+def _ones(n):
+    return np.ones(n, dtype=_I64)
+
 
 # ---------------------------------------------------------------------------
-# Instrumented context: every shuffle stage's wall time is accumulated so
+# Instrumented stages: every shuffle stage's wall time is accumulated so
 # "shuffle-stage wall-clock" is a first-class measured quantity.
 # ---------------------------------------------------------------------------
 
 
-class TimedShuffles:
+class ColumnarStages:
     def __init__(self, ctx):
         self.ctx = ctx
         self.stage_seconds = 0.0
         self.stages = 0
 
-    def __getattr__(self, name):
-        fn = getattr(self.ctx, name)
-        if name not in ("fold_by_key", "combine_by_key", "group_by_key",
-                        "sort_by_key", "run_shuffle"):
-            return fn
+    def agg(self, codec, batch, ops, num_partitions=N_REDUCERS,
+            map_side_combine=True):
+        t0 = time.perf_counter()
+        out = agg_shuffle(
+            self.ctx, codec, split_batch(batch, N_MAPS), ops,
+            num_partitions=num_partitions, map_side_combine=map_side_combine,
+        )
+        self.stage_seconds += time.perf_counter() - t0
+        self.stages += 1
+        return out
 
-        def timed(*a, **kw):
-            t0 = time.perf_counter()
-            out = fn(*a, **kw)
-            self.stage_seconds += time.perf_counter() - t0
-            self.stages += 1
-            return out
-
-        return timed
-
-
-def _partition(rows, n=N_MAPS):
-    return [rows[i::n] for i in range(n)]
+    def sort(self, codec, batch, val_ncols, num_partitions=N_REDUCERS):
+        t0 = time.perf_counter()
+        out = list(sort_shuffle_batches(
+            self.ctx, codec, split_batch(batch, N_MAPS), val_ncols,
+            num_partitions=num_partitions,
+        ))
+        self.stage_seconds += time.perf_counter() - t0
+        self.stages += 1
+        return out
 
 
 # ---------------------------------------------------------------------------
-# Table generators (seeded, TPC-DS-ish distributions)
+# Table generators (seeded, TPC-DS-ish distributions) — columnar numpy tables
 # ---------------------------------------------------------------------------
 
 
 def gen_tables(sf: float, seed: int = 17):
-    """Synthetic star-schema slice. ``sf`` scales row counts linearly
-    (sf=1 ≈ 200k sales rows — sized so sf=1 runs in seconds; raise it for
-    real measurement runs)."""
-    rng = random.Random(seed)
+    """Synthetic star-schema slice as int64 column arrays. ``sf`` scales row
+    counts linearly (sf=1 ≈ 200k sales rows). Prices are integer cents so
+    sums stay exact and the shuffled pipelines agree with the single-process
+    reference regardless of summation order."""
+    rng = np.random.default_rng(seed)
     n_sales = int(200_000 * sf)
     n_items = max(50, int(2_000 * sf))
     n_stores = max(4, int(40 * sf))
-    items = {i: f"cat-{i % 10}" for i in range(n_items)}  # item_sk -> category
-    sales = []  # (item_sk, store_sk, order, year, month, qty, price)
-    for order in range(n_sales):
-        sales.append((
-            rng.randrange(n_items),
-            rng.randrange(n_stores),
-            order,
-            2001 + (order & 1),
-            1 + rng.randrange(12),
-            1 + rng.randrange(10),
-            rng.randrange(100, 10_000),  # unit price in integer cents:
-            # sums stay exact, so the shuffled pipelines and the
-            # single-process reference agree regardless of summation order
-        ))
+    order = np.arange(n_sales, dtype=_I64)
+    sales = {
+        "item": rng.integers(0, n_items, n_sales, dtype=_I64),
+        "store": rng.integers(0, n_stores, n_sales, dtype=_I64),
+        "order": order,
+        "year": 2001 + (order & 1),
+        "month": 1 + rng.integers(0, 12, n_sales, dtype=_I64),
+        "qty": 1 + rng.integers(0, 10, n_sales, dtype=_I64),
+        "price": rng.integers(100, 10_000, n_sales, dtype=_I64),
+    }
     # ~8% of orders have a return of part of the quantity
-    returns = []  # (item_sk, order, ret_qty, ret_amt)
-    for item_sk, _store, order, _y, _m, qty, price in sales:
-        if rng.random() < 0.08:
-            rq = 1 + rng.randrange(qty)
-            returns.append((item_sk, order, rq, rq * price * 9 // 10))
-    return items, sales, returns
+    mask = rng.random(n_sales) < 0.08
+    rq = 1 + np.floor(rng.random(int(mask.sum())) * sales["qty"][mask]).astype(_I64)
+    returns = {
+        "item": sales["item"][mask],
+        "order": sales["order"][mask],
+        "rq": rq,
+        "ramt": rq * sales["price"][mask] * 9 // 10,
+    }
+    return sales, returns
 
 
 # ---------------------------------------------------------------------------
-# The queries — each returns (result, reference_result_fn)
+# The queries — each returns (result, reference_fn). References are plain
+# Python dict/loop recomputations over the same tables.
 # ---------------------------------------------------------------------------
 
+_K1 = KeyCodec("i64")
+_K2 = KeyCodec("i64", "i64")
 
-def q5(ts, items, sales, returns):
+
+def q5(st, sales, returns):
     """Channel profit rollup: sales minus returns per store, rolled up.
-    Shuffle: one aggregate by (store_sk) over the unioned fact stream."""
-    sale_recs = [(s[1], (s[5] * s[6], 0)) for s in sales]  # (store, (amt, ret))
-    # returns don't carry store_sk in TPC-DS either — join via order parity
-    # is q49/q75 territory; here returns are attributed via their sale order
-    store_of_order = {s[2]: s[1] for s in sales}
-    ret_recs = [(store_of_order[r[1]], (0, r[3])) for r in returns]
-    stream = sale_recs + ret_recs
-    out = ts.fold_by_key(
-        _partition(stream),
-        (0, 0),
-        lambda a, b: (a[0] + b[0], a[1] + b[1]),
-        num_partitions=N_REDUCERS,
+    One aggregate stage over the unioned fact stream."""
+    s_amt = sales["qty"] * sales["price"]
+    r_store = sales["store"][returns["order"]]  # returns join their sale's store
+    nr = len(r_store)
+    batch = make_batch(
+        _K1,
+        (np.concatenate([sales["store"], r_store]),),
+        (np.concatenate([s_amt, _zeros(nr)]),
+         np.concatenate([_zeros(len(s_amt)), returns["ramt"]])),
     )
-    result = sorted(
-        (store, amt, ret, amt - ret) for store, (amt, ret) in out
-    )
+    (store,), vals = st.agg(_K1, batch, ("sum", "sum"))
+    order = np.argsort(store, kind="stable")
+    result = [
+        (int(s), int(a), int(r), int(a - r))
+        for s, a, r in zip(store[order], vals[order, 0], vals[order, 1])
+    ]
 
     def reference():
         acc = defaultdict(lambda: [0, 0])
-        for store, (amt, ret) in sale_recs + ret_recs:
-            acc[store][0] += amt
-            acc[store][1] += ret
-        return sorted(
-            (store, a, r, a - r) for store, (a, r) in acc.items()
-        )
+        for s, a in zip(sales["store"].tolist(), s_amt.tolist()):
+            acc[s][0] += a
+        for s, r in zip(r_store.tolist(), returns["ramt"].tolist()):
+            acc[s][1] += r
+        return sorted((s, a, r, a - r) for s, (a, r) in acc.items())
 
     return result, reference
 
 
-def q49(ts, items, sales, returns):
+def q49(st, sales, returns):
     """Worst return ratios: join returns to sales on (item, order), per-item
-    return ratio, rank worst TOP_K. Three shuffle stages: cogroup join,
-    per-item aggregate, rank sort."""
-    tagged = [((s[0], s[2]), ("s", s[5])) for s in sales] + [
-        ((r[0], r[1]), ("r", r[2])) for r in returns
-    ]
-    joined = ts.group_by_key(_partition(tagged), num_partitions=N_REDUCERS)
-    per_item = []
-    for (item_sk, _order), vals in joined:
-        sold = sum(v for t, v in vals if t == "s")
-        ret = sum(v for t, v in vals if t == "r")
-        if ret:  # inner join: only orders with a return
-            per_item.append((item_sk, (ret, sold)))
-    totals = ts.fold_by_key(
-        _partition(per_item),
-        (0, 0),
-        lambda a, b: (a[0] + b[0], a[1] + b[1]),
-        num_partitions=N_REDUCERS,
+    return ratio, rank worst TOP_K. Three stages: cogroup join (as a
+    two-column sum over the tagged union), per-item aggregate, rank sort."""
+    ns, nr = len(sales["item"]), len(returns["item"])
+    joined = make_batch(
+        _K2,
+        (np.concatenate([sales["item"], returns["item"]]),
+         np.concatenate([sales["order"], returns["order"]])),
+        (np.concatenate([sales["qty"], _zeros(nr)]),      # sold
+         np.concatenate([_zeros(ns), returns["rq"]])),    # returned
     )
-    ranked_in = [
-        ((round(ret / sold, 6), item_sk), None) for item_sk, (ret, sold) in totals
-    ]
-    parts = ts.sort_by_key(_partition(ranked_in), num_partitions=N_REDUCERS)
-    flat = [k for part in parts for k, _ in part]
-    result = [(item, ratio) for ratio, item in flat[-TOP_K:]][::-1]  # worst first
+    (item1, _order1), v1 = st.agg(_K2, joined, ("sum", "sum"))
+    hit = v1[:, 1] > 0  # inner join: only orders with a return
+    per_item = make_batch(_K1, (item1[hit],), (v1[hit, 1], v1[hit, 0]))
+    (item2,), v2 = st.agg(_K1, per_item, ("sum", "sum"))
+    ratio = np.round(v2[:, 0] / v2[:, 1], 6)
+    rank_codec = KeyCodec("f64", "i64")
+    ranked = st.sort(rank_codec, make_batch(rank_codec, (ratio, item2), ()), 0)
+    flat_ratio = np.concatenate([kc[0] for kc, _ in ranked]) if ranked else np.empty(0)
+    flat_item = np.concatenate([kc[1] for kc, _ in ranked]) if ranked else np.empty(0)
+    result = [
+        (int(i), float(r))
+        for r, i in zip(flat_ratio[-TOP_K:], flat_item[-TOP_K:])
+    ][::-1]  # worst first
 
     def reference():
         sold_by = defaultdict(int)
         ret_by = defaultdict(int)
-        sold_of_order = {(s[0], s[2]): s[5] for s in sales}
-        for item_sk, order, rq, _amt in returns:
-            ret_by[item_sk] += rq
-            sold_by[item_sk] += sold_of_order[(item_sk, order)]
+        sold_of_order = {}
+        for i, o, q in zip(sales["item"].tolist(), sales["order"].tolist(),
+                           sales["qty"].tolist()):
+            sold_of_order[(i, o)] = q
+        for i, o, rq in zip(returns["item"].tolist(), returns["order"].tolist(),
+                            returns["rq"].tolist()):
+            ret_by[i] += rq
+            sold_by[i] += sold_of_order[(i, o)]
         ratios = sorted(
-            ((round(r / sold_by[i], 6), i) for i, r in ret_by.items()),
+            (float(np.round(r / sold_by[i], 6)), i) for i, r in ret_by.items()
         )
         return [(i, ratio) for ratio, i in ratios[-TOP_K:]][::-1]
 
     return result, reference
 
 
-def q75(ts, items, sales, returns):
+def q75(st, sales, returns):
     """Year-over-year decline: net quantity per (year, item) after a left
-    join with returns, then a self-join across years reporting items whose
-    net quantity declined. Three shuffle stages."""
-    tagged = [((s[0], s[2]), ("s", s[3], s[5])) for s in sales] + [
-        ((r[0], r[1]), ("r", 0, r[2])) for r in returns
+    join with returns, then a cross-year cogroup reporting items whose net
+    quantity declined. Three stages."""
+    ns, nr = len(sales["item"]), len(returns["item"])
+    joined = make_batch(
+        _K2,
+        (np.concatenate([sales["item"], returns["item"]]),
+         np.concatenate([sales["order"], returns["order"]])),
+        (np.concatenate([sales["year"], _zeros(nr)]),   # year (max: sale's year)
+         np.concatenate([sales["qty"], _zeros(nr)]),    # sold
+         np.concatenate([_zeros(ns), returns["rq"]])),  # returned
+    )
+    (item1, _o), v1 = st.agg(_K2, joined, ("max", "sum", "sum"))
+    net = v1[:, 1] - v1[:, 2]
+    per_year = make_batch(_K2, (v1[:, 0], item1), (net,))
+    (year2, item2), v2 = st.agg(_K2, per_year, ("sum",))
+    is1 = (year2 == 2001).astype(_I64)
+    is2 = (year2 == 2002).astype(_I64)
+    by_item = make_batch(
+        _K1, (item2,), (v2[:, 0] * is1, v2[:, 0] * is2, is1, is2)
+    )
+    (item3,), v3 = st.agg(_K1, by_item, ("sum", "sum", "sum", "sum"))
+    hit = (v3[:, 2] > 0) & (v3[:, 3] > 0) & (v3[:, 1] < v3[:, 0])
+    item_f, q1, q2 = item3[hit], v3[hit, 0], v3[hit, 1]
+    order = np.argsort(item_f, kind="stable")  # items unique → total order
+    result = [
+        (int(i), int(a), int(b)) for i, a, b in zip(item_f[order], q1[order], q2[order])
     ]
-    joined = ts.group_by_key(_partition(tagged), num_partitions=N_REDUCERS)
-    net_recs = []
-    for (item_sk, _order), vals in joined:
-        year = next(y for t, y, _q in vals if t == "s")
-        sold = sum(q for t, _y, q in vals if t == "s")
-        ret = sum(q for t, _y, q in vals if t == "r")
-        net_recs.append(((year, item_sk), sold - ret))
-    per_year = ts.fold_by_key(
-        _partition(net_recs), 0, lambda a, b: a + b, num_partitions=N_REDUCERS
-    )
-    by_item = [(item_sk, (year, qty)) for (year, item_sk), qty in per_year]
-    grouped = ts.group_by_key(_partition(by_item), num_partitions=N_REDUCERS)
-    result = sorted(
-        (item_sk, q1, q2)
-        for item_sk, vals in grouped
-        for q1 in [sum(q for y, q in vals if y == 2001)]
-        for q2 in [sum(q for y, q in vals if y == 2002)]
-        if any(y == 2001 for y, _ in vals)
-        and any(y == 2002 for y, _ in vals)
-        and q2 < q1
-    )
 
     def reference():
-        net = defaultdict(int)
+        net_ref = defaultdict(int)
         ret_of = defaultdict(int)
-        for item_sk, order, rq, _amt in returns:
-            ret_of[(item_sk, order)] += rq
-        for s in sales:
-            net[(s[3], s[0])] += s[5] - ret_of[(s[0], s[2])]
+        for i, o, rq in zip(returns["item"].tolist(), returns["order"].tolist(),
+                            returns["rq"].tolist()):
+            ret_of[(i, o)] += rq
+        for i, o, y, q in zip(sales["item"].tolist(), sales["order"].tolist(),
+                              sales["year"].tolist(), sales["qty"].tolist()):
+            net_ref[(y, i)] += q - ret_of[(i, o)]
         out = []
-        for item_sk in {i for _y, i in net}:
-            q1, q2 = net.get((2001, item_sk)), net.get((2002, item_sk))
-            if q1 is not None and q2 is not None and q2 < q1:
-                out.append((item_sk, q1, q2))
+        for i in {i for _y, i in net_ref}:
+            a, b = net_ref.get((2001, i)), net_ref.get((2002, i))
+            if a is not None and b is not None and b < a:
+                out.append((i, a, b))
         return sorted(out)
 
     return result, reference
 
 
-def q67(ts, items, sales, returns):
+def q67(st, sales, returns):
     """Top items per category: rollup sumsales by (category, item, store,
-    month) — the item dimension is broadcast-joined map-side — then rank
-    within category, keep TOP_K. Two shuffle stages (aggregate + sort)."""
-    recs = [
-        ((items[s[0]], s[0], s[1], s[4]), s[5] * s[6])  # (cat,item,store,month) -> amt
-        for s in sales
-    ]
-    rolled = ts.fold_by_key(
-        _partition(recs), 0, lambda a, b: a + b, num_partitions=N_REDUCERS
+    month) — the item→category dimension is a broadcast map-side join
+    (cat = item % 10) — then rank within category, keep TOP_K. Two stages
+    (aggregate + sort) with a vectorized streaming rank scan."""
+    cat = sales["item"] % 10
+    codec4 = KeyCodec("i64", "i64", "i64", "i64")
+    rolled = make_batch(
+        codec4,
+        (cat, sales["item"], sales["store"], sales["month"]),
+        (sales["qty"] * sales["price"],),
     )
-    # rank within category by sumsales desc: composite sort key
-    sort_in = [((cat, -amt, item, store, month), None)
-               for (cat, item, store, month), amt in rolled]
-    parts = ts.sort_by_key(_partition(sort_in), num_partitions=N_REDUCERS)
+    (cat1, item1, store1, month1), v1 = st.agg(codec4, rolled, ("sum",))
+    codec5 = KeyCodec("i64", "i64", "i64", "i64", "i64")
+    sort_in = make_batch(codec5, (cat1, -v1[:, 0], item1, store1, month1), ())
+    batches = st.sort(codec5, sort_in, 0)
+    # streaming vectorized rank-within-category over globally sorted batches
     result = []
-    rank = 0
     last_cat = None
-    for part in parts:
-        for (cat, neg_amt, item, store, month), _ in part:
-            rank = rank + 1 if cat == last_cat else 1
-            last_cat = cat
-            if rank <= TOP_K:
-                result.append((cat, item, store, month, -neg_amt, rank))
+    carry = 0
+    for (bc, bneg, bitem, bstore, bmonth), _v in batches:
+        n = len(bc)
+        newrun = np.empty(n, dtype=bool)
+        newrun[0] = last_cat is None or bc[0] != last_cat
+        np.not_equal(bc[1:], bc[:-1], out=newrun[1:])
+        run_start = np.zeros(n, dtype=_I64)
+        idx = np.flatnonzero(newrun)
+        run_start[idx] = idx
+        np.maximum.accumulate(run_start, out=run_start)
+        pos = np.arange(n, dtype=_I64) - run_start
+        if not newrun[0]:
+            # rows before the first boundary continue the previous batch's cat
+            first_run_len = int(idx[0]) if len(idx) else n
+            pos[:first_run_len] += carry
+        keep = np.flatnonzero(pos < TOP_K)
+        for i in keep.tolist():
+            result.append((
+                f"cat-{int(bc[i])}", int(bitem[i]), int(bstore[i]),
+                int(bmonth[i]), int(-bneg[i]), int(pos[i]) + 1,
+            ))
+        last_cat = int(bc[-1])
+        carry = int(pos[-1]) + 1
 
     def reference():
         acc = defaultdict(int)
-        for s in sales:
-            acc[(items[s[0]], s[0], s[1], s[4])] += s[5] * s[6]
+        for i, s, m, q, p in zip(sales["item"].tolist(), sales["store"].tolist(),
+                                 sales["month"].tolist(), sales["qty"].tolist(),
+                                 sales["price"].tolist()):
+            acc[(f"cat-{i % 10}", i, s, m)] += q * p
         rows = sorted(
-            (cat, -amt, item, store, month)
-            for (cat, item, store, month), amt in acc.items()
+            (c, -amt, i, s, m) for (c, i, s, m), amt in acc.items()
         )
         out = []
         r, last = 0, None
-        for cat, neg_amt, item, store, month in rows:
-            r = r + 1 if cat == last else 1
-            last = cat
+        for c, neg_amt, i, s, m in rows:
+            r = r + 1 if c == last else 1
+            last = c
             if r <= TOP_K:
-                out.append((cat, item, store, month, -neg_amt, r))
+                out.append((c, i, s, m, -neg_amt, r))
         return out
 
     return result, reference
 
 
-def q64(ts, items, sales, returns):
-    """Cross-channel repeat purchases (q64's join-heavy profile, simplified
-    schema): per (item, year) sales stats, per-item return stats, a cogroup
-    join of the two, then a self-join across years emitting items whose 2002
-    amount grew despite returns. Four shuffle stages — the widest join
-    pipeline in the suite, matching q64's role in the reference benchmark
-    config (BASELINE.json #3; reference examples/sql/run_benchmark.sh)."""
-    by_item_year = ts.fold_by_key(
-        _partition([((s[0], s[3]), (s[5], s[5] * s[6])) for s in sales]),
-        (0, 0),
-        lambda a, b: (a[0] + b[0], a[1] + b[1]),
-        num_partitions=N_REDUCERS,
-    )  # (item, year) -> (qty, amt)
-    ret_by_item = ts.fold_by_key(
-        _partition([(r[0], r[2]) for r in returns]),
-        0,
-        lambda a, b: a + b,
-        num_partitions=N_REDUCERS,
-    )  # item -> returned qty
-    tagged = [(item, ("y", year, qty, amt)) for (item, year), (qty, amt) in by_item_year]
-    tagged += [(item, ("r", 0, rq, 0)) for item, rq in ret_by_item]
-    joined = ts.group_by_key(_partition(tagged), num_partitions=N_REDUCERS)
-    cross = []
-    for item, vals in joined:
-        y1 = next(((q, a) for t, y, q, a in vals if t == "y" and y == 2001), None)
-        y2 = next(((q, a) for t, y, q, a in vals if t == "y" and y == 2002), None)
-        ret = sum(q for t, _y, q, _a in vals if t == "r")
-        if y1 and y2 and y2[1] > y1[1]:
-            cross.append(((y2[1] - y1[1], item), (y1, y2, ret)))
-    parts = ts.sort_by_key(_partition(cross), num_partitions=N_REDUCERS)
+def q64(st, sales, returns):
+    """Cross-channel repeat purchases (q64's join-heavy profile): per
+    (item, year) sales stats, per-item return stats, a cogroup join of the
+    two, then a cross-year self-join emitting items whose 2002 amount grew.
+    Four stages — the widest join pipeline in the suite (BASELINE.json #3)."""
+    by_iy = make_batch(
+        _K2, (sales["item"], sales["year"]),
+        (sales["qty"], sales["qty"] * sales["price"]),
+    )
+    (item1, year1), v1 = st.agg(_K2, by_iy, ("sum", "sum"))
+    ret_b = make_batch(_K1, (returns["item"],), (returns["rq"],))
+    (item_r,), v_r = st.agg(_K1, ret_b, ("sum",))
+    is1 = (year1 == 2001).astype(_I64)
+    is2 = (year1 == 2002).astype(_I64)
+    nj, nr = len(item1), len(item_r)
+    cogroup = make_batch(
+        _K1,
+        (np.concatenate([item1, item_r]),),
+        (np.concatenate([v1[:, 0] * is1, _zeros(nr)]),   # qty 2001
+         np.concatenate([v1[:, 1] * is1, _zeros(nr)]),   # amt 2001
+         np.concatenate([v1[:, 0] * is2, _zeros(nr)]),   # qty 2002
+         np.concatenate([v1[:, 1] * is2, _zeros(nr)]),   # amt 2002
+         np.concatenate([_zeros(nj), v_r[:, 0]]),        # returned qty
+         np.concatenate([is1, _zeros(nr)]),              # has 2001
+         np.concatenate([is2, _zeros(nr)])),             # has 2002
+    )
+    (item3,), m = st.agg(_K1, cogroup, ("sum",) * 7)
+    hit = (m[:, 5] > 0) & (m[:, 6] > 0) & (m[:, 3] > m[:, 1])
+    growth = m[hit, 3] - m[hit, 1]
+    sort_in = make_batch(
+        _K2, (growth, item3[hit]),
+        (m[hit, 0], m[hit, 1], m[hit, 2], m[hit, 3], m[hit, 4]),
+    )
+    batches = st.sort(_K2, sort_in, 5)
     result = [
-        (item, y1, y2, ret)
-        for part in parts
-        for (_growth, item), (y1, y2, ret) in part
+        (int(i), (int(r[0]), int(r[1])), (int(r[2]), int(r[3])), int(r[4]))
+        for (_g, items), vals in batches
+        for i, r in zip(items, vals)
     ]
 
     def reference():
         acc = defaultdict(lambda: [0, 0])
-        for s in sales:
-            acc[(s[0], s[3])][0] += s[5]
-            acc[(s[0], s[3])][1] += s[5] * s[6]
+        for i, y, q, p in zip(sales["item"].tolist(), sales["year"].tolist(),
+                              sales["qty"].tolist(), sales["price"].tolist()):
+            acc[(i, y)][0] += q
+            acc[(i, y)][1] += q * p
         rets = defaultdict(int)
-        for r in returns:
-            rets[r[0]] += r[2]
+        for i, rq in zip(returns["item"].tolist(), returns["rq"].tolist()):
+            rets[i] += rq
         rows = []
-        for item in {i for i, _y in acc}:
-            y1 = acc.get((item, 2001))
-            y2 = acc.get((item, 2002))
+        for i in {i for i, _y in acc}:
+            y1 = acc.get((i, 2001))
+            y2 = acc.get((i, 2002))
             if y1 and y2 and y2[1] > y1[1]:
-                rows.append((y2[1] - y1[1], item, tuple(y1), tuple(y2), rets[item]))
+                rows.append((y2[1] - y1[1], i, tuple(y1), tuple(y2), rets[i]))
         rows.sort()
-        return [(item, y1, y2, ret) for _g, item, y1, y2, ret in rows]
+        return [(i, y1, y2, ret) for _g, i, y1, y2, ret in rows]
 
     return result, reference
 
 
-def q95(ts, items, sales, returns):
-    """Returned-order analysis (q95's semi-join profile, simplified schema):
-    orders that have a matching return (semi-join on order), aggregated per
-    store — distinct order count, total quantity, total returned amount —
-    with a final total rollup row. Three shuffle stages (cogroup semi-join,
-    per-store aggregate, rollup), matching q95's role in the reference
-    benchmark config (BASELINE.json #3)."""
-    tagged = [((s[2],), ("s", s[1], s[5])) for s in sales] + [
-        ((r[1],), ("r", 0, r[3])) for r in returns
+def q95(st, sales, returns):
+    """Returned-order analysis (q95's semi-join profile): orders with a
+    matching return (semi-join on order), aggregated per store — distinct
+    order count, total quantity, total returned amount — plus a total rollup
+    row. Three stages (cogroup semi-join, per-store aggregate, rollup)."""
+    ns, nr = len(sales["order"]), len(returns["order"])
+    joined = make_batch(
+        _K1,
+        (np.concatenate([sales["order"], returns["order"]]),),
+        (np.concatenate([_zeros(ns), returns["ramt"]]),   # returned amount
+         np.concatenate([sales["store"], _zeros(nr)]),    # store (max: sale's)
+         np.concatenate([sales["qty"], _zeros(nr)])),     # qty
+    )
+    (_order1,), v1 = st.agg(_K1, joined, ("sum", "max", "sum"))
+    hit = v1[:, 0] > 0  # semi-join: orders with at least one return
+    per_store = make_batch(
+        _K1, (v1[hit, 1],),
+        (_ones(int(hit.sum())), v1[hit, 2], v1[hit, 0]),
+    )
+    (store2,), v2 = st.agg(_K1, per_store, ("sum", "sum", "sum"))
+    order2 = np.argsort(store2, kind="stable")
+    agg_rows = [
+        (int(s), (int(c), int(q), int(a)))
+        for s, c, q, a in zip(store2[order2], v2[order2, 0], v2[order2, 1],
+                              v2[order2, 2])
     ]
-    joined = ts.group_by_key(_partition(tagged), num_partitions=N_REDUCERS)
-    per_store = []
-    for (_order,), vals in joined:
-        ret_amt = sum(a for t, _st, a in vals if t == "r")
-        if not ret_amt:
-            continue  # semi-join: orders with at least one return
-        store = next(st for t, st, _q in vals if t == "s")
-        qty = sum(q for t, _st, q in vals if t == "s")
-        per_store.append((store, (1, qty, ret_amt)))
-    agg = ts.fold_by_key(
-        _partition(per_store),
-        (0, 0, 0),
-        lambda a, b: (a[0] + b[0], a[1] + b[1], a[2] + b[2]),
-        num_partitions=N_REDUCERS,
+    rollup = make_batch(
+        _K1, (_zeros(len(store2)),), (v2[:, 0], v2[:, 1], v2[:, 2])
     )
-    total = ts.fold_by_key(
-        _partition([("ALL", v) for _s, v in agg]),
-        (0, 0, 0),
-        lambda a, b: (a[0] + b[0], a[1] + b[1], a[2] + b[2]),
-        num_partitions=1,
+    (_z,), vt = st.agg(_K1, rollup, ("sum", "sum", "sum"), num_partitions=1)
+    total_rows = (
+        [("ALL", (int(vt[0, 0]), int(vt[0, 1]), int(vt[0, 2])))] if len(vt) else []
     )
-    result = (sorted(agg), sorted(total))
+    result = (agg_rows, total_rows)
 
     def reference():
         ret_amt_of = defaultdict(int)
-        for r in returns:
-            ret_amt_of[r[1]] += r[3]
+        for o, a in zip(returns["order"].tolist(), returns["ramt"].tolist()):
+            ret_amt_of[o] += a
         acc = defaultdict(lambda: [0, 0, 0])
-        for s in sales:
-            ra = ret_amt_of.get(s[2])
+        for o, s, q in zip(sales["order"].tolist(), sales["store"].tolist(),
+                           sales["qty"].tolist()):
+            ra = ret_amt_of.get(o)
             if ra:
-                acc[s[1]][0] += 1
-                acc[s[1]][1] += s[5]
-                acc[s[1]][2] += ra
-        agg_ref = sorted((st, tuple(v)) for st, v in acc.items())
+                acc[s][0] += 1
+                acc[s][1] += q
+                acc[s][2] += ra
+        agg_ref = sorted((s, tuple(v)) for s, v in acc.items())
         t = [0, 0, 0]
-        for _st, (c, q, a) in agg_ref:
+        for _s, (c, q, a) in agg_ref:
             t[0] += c
             t[1] += q
             t[2] += a
@@ -411,6 +482,15 @@ def q95(ts, items, sales, returns):
 
 
 QUERIES = {"q5": q5, "q49": q49, "q75": q75, "q67": q67, "q64": q64, "q95": q95}
+
+#: CLI codec label → (ShuffleConfig codec, tpu_host_fallback). Labels are
+#: self-describing in artifacts: "tpu-hostpath" pins the no-chip host TLZ
+#: encode path (no fallback — the documented ~5x encode penalty), "tpu" is
+#: the deployment default (loud-warning SLZ fallback without a chip).
+CODEC_MODES = {
+    "tpu-hostpath": ("tpu", False),
+    "tpu": ("tpu", True),
+}
 
 
 def run_query(name: str, sf: float, codec: str, workers: int, verify: bool,
@@ -431,16 +511,15 @@ def run_query(name: str, sf: float, codec: str, workers: int, verify: bool,
         tmp = root or tempfile.mkdtemp(prefix=f"s3shuffle-sql-{name}-")
         root_dir = f"file://{tmp}"
     Dispatcher.reset()
-    # measure the codec named on the CLI: auto-fallback (codec=tpu with no
-    # chip -> SLZ encode) would silently benchmark the wrong codec
-    cfg = ShuffleConfig(root_dir=root_dir, app_id=f"sql-{name}", codec=codec,
-                        tpu_host_fallback=False)
-    items, sales, returns = gen_tables(sf)
+    cfg_codec, fallback = CODEC_MODES.get(codec, (codec, False))
+    cfg = ShuffleConfig(root_dir=root_dir, app_id=f"sql-{name}", codec=cfg_codec,
+                        tpu_host_fallback=fallback)
+    sales, returns = gen_tables(sf)
     try:
         with ShuffleContext(config=cfg, num_workers=workers) as ctx:
-            ts = TimedShuffles(ctx)
+            st = ColumnarStages(ctx)
             t0 = time.perf_counter()
-            result, reference = QUERIES[name](ts, items, sales, returns)
+            result, reference = QUERIES[name](st, sales, returns)
             wall = time.perf_counter() - t0
         if verify:
             expected = reference()
@@ -452,11 +531,11 @@ def run_query(name: str, sf: float, codec: str, workers: int, verify: bool,
             "query": name,
             "codec": codec,
             "sf": sf,
-            "rows_in": len(sales) + len(returns),
+            "rows_in": len(sales["order"]) + len(returns["order"]),
             "rows_out": len(result),
             "wall_s": round(wall, 3),
-            "shuffle_stage_wall_s": round(ts.stage_seconds, 3),
-            "shuffle_stages": ts.stages,
+            "shuffle_stage_wall_s": round(st.stage_seconds, 3),
+            "shuffle_stages": st.stages,
             "verified": bool(verify),
         }
     finally:
@@ -469,7 +548,9 @@ def main(argv=None) -> int:
     ap.add_argument("--query", default="all", choices=["all", *QUERIES])
     ap.add_argument("--sf", type=float, default=0.1,
                     help="scale factor (1 ≈ 200k sales rows)")
-    ap.add_argument("--codec", default="auto")
+    ap.add_argument("--codec", default="auto",
+                    help="codec name, or the labeled modes "
+                         "tpu-hostpath / tpu (see CODEC_MODES)")
     ap.add_argument("--workers", type=int, default=4)
     ap.add_argument("--no-verify", action="store_true",
                     help="skip the single-process reference check "

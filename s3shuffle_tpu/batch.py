@@ -611,7 +611,7 @@ class BatchSorter:
             self.cleanup()
 
     # shared with colagg.ColumnarReducer's run merge — see cut_sorted_head
-    _cut = staticmethod(lambda p, bound, inclusive: cut_sorted_head(p, bound, inclusive))
+    _cut = staticmethod(cut_sorted_head)
 
     def _merge_spills(self, chunk_records: int) -> Iterator[RecordBatch]:
         """Bounded-memory columnar k-way merge. Bulk rounds emit every loaded

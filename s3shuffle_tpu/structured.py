@@ -1,0 +1,228 @@
+"""Typed columnar shuffle layer: order-preserving key packing + decoded
+aggregation/sort results.
+
+The reference's SQL benchmark pipelines ride Spark's row iterators +
+Kryo/Java serialization (SURVEY.md §2.2 TPC-DS bench; the shuffle sees
+opaque serialized rows). The TPU-native equivalent keeps query data columnar
+through the shuffle: typed key columns pack into **fixed-width,
+order-preserving big-endian bytes** (so the byte-sorting data plane —
+``argsort_by_key``, range partitioning, ``BatchSorter`` — IS the typed sort),
+and value columns pack into fixed-width little-endian int64 rows (the shape
+:mod:`s3shuffle_tpu.colagg` reduces with ``ufunc.reduceat``).
+
+Encodings (all order-preserving under bytes comparison):
+- ``i64``: sign-bit-flipped uint64, big-endian;
+- ``f64``: IEEE-754 total order — negative floats bit-inverted, positive
+  floats sign-bit-set, big-endian (NaNs order after +inf; -0.0 < +0.0);
+- ``("bytes", w)``: raw bytes right-padded with NULs to width ``w``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from s3shuffle_tpu.batch import RecordBatch
+
+_SIGN = np.uint64(0x8000000000000000)
+
+FieldSpec = Union[str, Tuple[str, int]]
+
+
+def _enc_i64(col: np.ndarray) -> np.ndarray:
+    """int64 column → (n, 8) big-endian order-preserving bytes."""
+    u = np.ascontiguousarray(col, dtype=np.int64).view(np.uint64) ^ _SIGN
+    return u.astype(">u8").view(np.uint8).reshape(-1, 8)
+
+
+def _dec_i64(mat: np.ndarray) -> np.ndarray:
+    u = np.ascontiguousarray(mat).view(">u8").ravel().astype(np.uint64) ^ _SIGN
+    return u.view(np.int64)
+
+
+def _enc_f64(col: np.ndarray) -> np.ndarray:
+    bits = np.ascontiguousarray(col, dtype=np.float64).view(np.uint64)
+    enc = np.where(bits >> np.uint64(63), ~bits, bits | _SIGN)
+    return enc.astype(">u8").view(np.uint8).reshape(-1, 8)
+
+
+def _dec_f64(mat: np.ndarray) -> np.ndarray:
+    enc = np.ascontiguousarray(mat).view(">u8").ravel().astype(np.uint64)
+    bits = np.where(enc & _SIGN, enc ^ _SIGN, ~enc)
+    return bits.view(np.float64)
+
+
+class KeyCodec:
+    """Fixed-width multi-column key packer. ``fields`` are ``"i64"``,
+    ``"f64"``, or ``("bytes", width)``; key bytes order == tuple order of the
+    decoded columns (ints/floats numerically, bytes lexicographically)."""
+
+    def __init__(self, *fields: FieldSpec):
+        if not fields:
+            raise ValueError("KeyCodec needs at least one field")
+        self.fields: Tuple[FieldSpec, ...] = tuple(fields)
+        self.widths: List[int] = []
+        for f in self.fields:
+            if f in ("i64", "f64"):
+                self.widths.append(8)
+            elif isinstance(f, tuple) and f[0] == "bytes" and int(f[1]) > 0:
+                self.widths.append(int(f[1]))
+            else:
+                raise ValueError(f"Unknown key field spec: {f!r}")
+        self.width = sum(self.widths)
+
+    # ------------------------------------------------------------------
+    def pack(self, *cols) -> np.ndarray:
+        """Columns → flat uint8 key buffer (n × width)."""
+        if len(cols) != len(self.fields):
+            raise ValueError(f"expected {len(self.fields)} key columns, got {len(cols)}")
+        n = len(cols[0])
+        mat = np.empty((n, self.width), dtype=np.uint8)
+        off = 0
+        for f, w, col in zip(self.fields, self.widths, cols):
+            if f == "i64":
+                mat[:, off : off + 8] = _enc_i64(col)
+            elif f == "f64":
+                mat[:, off : off + 8] = _enc_f64(col)
+            else:
+                part = np.zeros((n, w), dtype=np.uint8)
+                if isinstance(col, np.ndarray) and col.dtype.kind == "S":
+                    if col.dtype.itemsize > w and (np.char.str_len(col) > w).any():
+                        raise ValueError(
+                            f"bytes key longer than declared width {w}"
+                        )
+                    raw = np.ascontiguousarray(col.astype(f"S{w}")).view(np.uint8)
+                    part[:, :] = raw.reshape(n, w)
+                else:
+                    for i, b in enumerate(col):
+                        bb = bytes(b)
+                        if len(bb) > w:
+                            raise ValueError(
+                                f"bytes key {bb[:16]!r}... longer than declared "
+                                f"width {w}"
+                            )
+                        part[i, : len(bb)] = np.frombuffer(bb, dtype=np.uint8)
+                mat[:, off : off + w] = part
+            off += w
+        return mat.ravel()
+
+    def unpack(self, keys: np.ndarray, n: int) -> List[np.ndarray]:
+        """Flat key buffer (n × width) → decoded columns."""
+        mat = np.ascontiguousarray(keys).reshape(n, self.width)
+        out: List[np.ndarray] = []
+        off = 0
+        for f, w in zip(self.fields, self.widths):
+            sub = mat[:, off : off + w]
+            if f == "i64":
+                out.append(_dec_i64(sub))
+            elif f == "f64":
+                out.append(_dec_f64(sub))
+            else:
+                out.append(np.ascontiguousarray(sub).view(f"S{w}").ravel())
+            off += w
+        return out
+
+
+def pack_values(*cols) -> np.ndarray:
+    """int64 columns → flat uint8 value buffer of (n × 8·k) LE rows — the
+    fixed-width layout ColumnarAggregator reduces."""
+    stacked = np.column_stack([np.asarray(c, dtype="<i8") for c in cols])
+    return np.ascontiguousarray(stacked).view(np.uint8).ravel()
+
+
+def values_matrix(batch: RecordBatch, ncols: int) -> np.ndarray:
+    """A reduced batch's values as an (n, ncols) int64 matrix."""
+    return np.ascontiguousarray(batch.values).reshape(batch.n, 8 * ncols).view("<i8")
+
+
+def make_batch(codec: KeyCodec, key_cols: Sequence, val_cols: Sequence) -> RecordBatch:
+    """Pack typed columns into a RecordBatch (fixed-width keys AND values —
+    every downstream fast path engages)."""
+    n = len(key_cols[0])
+    keys = codec.pack(*key_cols)
+    if val_cols:
+        values = pack_values(*val_cols)
+        vw = 8 * len(val_cols)
+    else:
+        values = np.empty(0, dtype=np.uint8)
+        vw = 0
+    return RecordBatch(
+        np.full(n, codec.width, dtype=np.int32),
+        np.full(n, vw, dtype=np.int32),
+        keys,
+        values,
+    )
+
+
+def split_batch(batch: RecordBatch, n_parts: int) -> List[RecordBatch]:
+    """Contiguous row split into ``n_parts`` map partitions (zero-copy)."""
+    n = batch.n
+    bounds = [n * i // n_parts for i in range(n_parts + 1)]
+    return [batch.slice_rows(bounds[i], bounds[i + 1]) for i in range(n_parts)]
+
+
+# ----------------------------------------------------------------------------
+# Context-level typed operations
+# ----------------------------------------------------------------------------
+
+
+def agg_shuffle(
+    ctx,
+    codec: KeyCodec,
+    parts: Sequence[RecordBatch],
+    ops: Sequence[str],
+    num_partitions: int,
+    map_side_combine: bool = True,
+) -> Tuple[List[np.ndarray], np.ndarray]:
+    """Hash-shuffle + columnar aggregation; returns (key_columns, value
+    matrix) concatenated over all output partitions (each partition's rows
+    are key-sorted; cross-partition order is by hash, i.e. unspecified)."""
+    from s3shuffle_tpu.colagg import ColumnarAggregator
+    from s3shuffle_tpu.dependency import BytesHashPartitioner
+    from s3shuffle_tpu.serializer import ColumnarKVSerializer
+
+    out = ctx.run_shuffle(
+        list(parts),
+        partitioner=BytesHashPartitioner(num_partitions),
+        aggregator=ColumnarAggregator(ops),
+        serializer=ColumnarKVSerializer(),
+        map_side_combine=map_side_combine,
+        materialize="batches",
+    )
+    batches = [b for part in out for b in part if b.n]
+    if not batches:
+        empty_cols = [
+            np.empty(0, dtype=np.float64)
+            if f == "f64"
+            else np.empty(0, dtype=f"S{w}")
+            if isinstance(f, tuple)
+            else np.empty(0, dtype=np.int64)
+            for f, w in zip(codec.fields, codec.widths)
+        ]
+        return empty_cols, np.empty((0, len(ops)), dtype=np.int64)
+    merged = RecordBatch.concat(batches)
+    return codec.unpack(merged.keys, merged.n), values_matrix(merged, len(ops))
+
+
+def sort_shuffle_batches(
+    ctx,
+    codec: KeyCodec,
+    parts: Sequence[RecordBatch],
+    val_ncols: int,
+    num_partitions: int,
+) -> Iterator[Tuple[List[np.ndarray], np.ndarray]]:
+    """Range-partitioned global sort; yields decoded (key_columns, value
+    matrix) per output batch in GLOBAL key order."""
+    from s3shuffle_tpu.serializer import ColumnarKVSerializer
+
+    out = ctx.sort_by_key(
+        list(parts),
+        num_partitions=num_partitions,
+        serializer=ColumnarKVSerializer(),
+        materialize="batches",
+    )
+    for part in out:
+        for b in part:
+            if b.n:
+                yield codec.unpack(b.keys, b.n), values_matrix(b, val_ncols) if val_ncols else np.empty((b.n, 0), dtype=np.int64)

@@ -125,8 +125,9 @@ class ShuffleMapWriter:
                     self._combine_reducer = dep.aggregator.new_reducer(
                         spill_bytes=self.output_writer.dispatcher.config.aggregator_spill_bytes
                     )
+                # _records_written counts at the commit drain (post-combine
+                # rows, matching the per-record combine route's semantics)
                 for chunk in iter_record_batches(records):
-                    self._records_written += chunk.n
                     self._combine_reducer.add(chunk)
                 return
         if isinstance(records, RecordBatch):

@@ -1,0 +1,111 @@
+"""Typed columnar shuffle layer: order-preserving packing roundtrips and
+end-to-end typed aggregation/sort."""
+
+import random
+
+import numpy as np
+import pytest
+
+from s3shuffle_tpu.batch import RecordBatch
+from s3shuffle_tpu.config import ShuffleConfig
+from s3shuffle_tpu.shuffle import ShuffleContext
+from s3shuffle_tpu.storage.dispatcher import Dispatcher
+from s3shuffle_tpu.structured import (
+    KeyCodec,
+    agg_shuffle,
+    make_batch,
+    pack_values,
+    sort_shuffle_batches,
+    split_batch,
+    values_matrix,
+)
+
+
+def test_i64_roundtrip_and_order():
+    vals = np.array(
+        [0, 1, -1, 2**62, -(2**62), 2**63 - 1, -(2**63), 7, -7], dtype=np.int64
+    )
+    codec = KeyCodec("i64")
+    keys = codec.pack(vals)
+    assert codec.unpack(keys, len(vals))[0].tolist() == vals.tolist()
+    rows = [bytes(keys[i * 8 : (i + 1) * 8]) for i in range(len(vals))]
+    assert [v for _, v in sorted(zip(rows, vals.tolist()))] == sorted(vals.tolist())
+
+
+def test_f64_roundtrip_and_order():
+    vals = np.array(
+        [0.0, -0.0, 1.5, -1.5, 3.14e300, -3.14e300, 1e-308, -1e-308], dtype=np.float64
+    )
+    codec = KeyCodec("f64")
+    keys = codec.pack(vals)
+    got = codec.unpack(keys, len(vals))[0]
+    assert got.tolist() == vals.tolist()
+    rows = [bytes(keys[i * 8 : (i + 1) * 8]) for i in range(len(vals))]
+    order = [v for _, v in sorted(zip(rows, vals.tolist()))]
+    assert order == sorted(vals.tolist())
+
+
+def test_mixed_key_order_matches_tuple_order():
+    rng = random.Random(5)
+    a = np.array([rng.randrange(-50, 50) for _ in range(500)], dtype=np.int64)
+    b = np.array([rng.randrange(-50, 50) for _ in range(500)], dtype=np.int64)
+    codec = KeyCodec("i64", "i64")
+    keys = codec.pack(a, b)
+    rows = [bytes(keys[i * 16 : (i + 1) * 16]) for i in range(500)]
+    by_bytes = sorted(range(500), key=lambda i: rows[i])
+    by_tuple = sorted(range(500), key=lambda i: (a[i], b[i]))
+    assert [(a[i], b[i]) for i in by_bytes] == [(a[i], b[i]) for i in by_tuple]
+
+
+def test_bytes_field_and_values_roundtrip():
+    codec = KeyCodec(("bytes", 6), "i64")
+    cats = [b"cat-1", b"cat-22", b"x"]
+    ids = np.array([9, -3, 0], dtype=np.int64)
+    keys = codec.pack(cats, ids)
+    dc, di = codec.unpack(keys, 3)
+    assert [c.rstrip(b"\x00") for c in dc.tolist()] == [b"cat-1", b"cat-22", b"x"]
+    assert di.tolist() == [9, -3, 0]
+    vals = pack_values(np.arange(3), np.arange(3) * 10)
+    batch = RecordBatch(
+        np.full(3, codec.width, np.int32), np.full(3, 16, np.int32), keys, vals
+    )
+    assert values_matrix(batch, 2).tolist() == [[0, 0], [1, 10], [2, 20]]
+
+
+def _ctx(tmp_path):
+    Dispatcher.reset()
+    cfg = ShuffleConfig(root_dir=f"file://{tmp_path}/shuffle", app_id="structured")
+    return ShuffleContext(config=cfg, num_workers=2)
+
+
+def test_agg_shuffle_end_to_end(tmp_path):
+    rng = np.random.default_rng(3)
+    k1 = rng.integers(-20, 20, 10000)
+    k2 = rng.integers(0, 5, 10000)
+    v = rng.integers(0, 100, 10000)
+    codec = KeyCodec("i64", "i64")
+    batch = make_batch(codec, (k1, k2), (v, np.ones(10000, dtype=np.int64)))
+    with _ctx(tmp_path) as ctx:
+        (ka, kb), vals = agg_shuffle(
+            ctx, codec, split_batch(batch, 4), ("sum", "sum"), num_partitions=3
+        )
+    got = {(int(a), int(b)): (int(s), int(c)) for a, b, s, c in zip(ka, kb, vals[:, 0], vals[:, 1])}
+    ref = {}
+    for a, b, x in zip(k1.tolist(), k2.tolist(), v.tolist()):
+        s, c = ref.get((a, b), (0, 0))
+        ref[(a, b)] = (s + x, c + 1)
+    assert got == ref
+
+
+def test_sort_shuffle_global_order(tmp_path):
+    rng = np.random.default_rng(11)
+    k = rng.integers(-(2**40), 2**40, 20000)
+    v = np.arange(20000, dtype=np.int64)
+    codec = KeyCodec("i64")
+    batch = make_batch(codec, (k,), (v,))
+    with _ctx(tmp_path) as ctx:
+        out = list(sort_shuffle_batches(ctx, codec, split_batch(batch, 4), 1, num_partitions=5))
+    flat = np.concatenate([kc[0] for kc, _ in out])
+    assert len(flat) == 20000
+    assert (np.diff(flat) >= 0).all()
+    assert np.array_equal(np.sort(k), flat)
