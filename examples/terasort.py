@@ -119,6 +119,7 @@ def main() -> int:
                 checksum_algorithm=args.checksum if args.checksum.lower() != "off" else "ADLER32",
             )
             ctx = ShuffleContext(config=cfg, num_workers=args.workers)
+            cpu0 = time.process_time()
             t0 = time.perf_counter()
             out = ctx.sort_by_key(
                 parts,
@@ -127,6 +128,7 @@ def main() -> int:
                 materialize="batches",
             )
             dt = time.perf_counter() - t0
+            cpu = time.process_time() - cpu0
             teravalidate(out, n_records)
             ctx.stop()
             raw = n_records * (KEY_BYTES + VALUE_BYTES)
@@ -134,22 +136,35 @@ def main() -> int:
                 "rep": rep,
                 "wall_s": round(dt, 3),
                 "records": n_records,
+                "records_per_s": round(n_records / dt),
                 "mb": round(raw / 1e6, 1),
                 "mb_per_s": round(raw / 1e6 / dt, 1),
+                # worker pool is threads in THIS process → process CPU time
+                # covers all workers; cpu_utilization = cpu / wall (≤ cores)
+                "process_cpu_s": round(cpu, 3),
+                "cpu_utilization": round(cpu / dt, 2),
             })
             print(json.dumps(results[-1]), file=sys.stderr)
     finally:
         if tmp:
             shutil.rmtree(tmp, ignore_errors=True)
 
-    best = max(r["mb_per_s"] for r in results)
+    rates = sorted(r["mb_per_s"] for r in results)
+    median = rates[len(rates) // 2] if len(rates) % 2 else round(
+        (rates[len(rates) // 2 - 1] + rates[len(rates) // 2]) / 2, 1
+    )
     print(json.dumps({
         "bench": "terasort",
         "size": args.size,
         "codec": args.codec,
         "checksum": args.checksum,
         "workers": args.workers,
-        "best_mb_per_s": best,
+        # median is the headline (VERDICT r3 weak #6: best-of-2 with 65%
+        # swing is weak evidence); best/min/max show the spread
+        "median_mb_per_s": median,
+        "best_mb_per_s": rates[-1],
+        "min_mb_per_s": rates[0],
+        "host_cores": os.cpu_count() or 1,
         "runs": results,
     }))
     return 0

@@ -63,6 +63,12 @@ class ShuffleConfig:
     aggregator_spill_bytes: int = 256 * MiB
     use_block_manager: bool = True
     force_batch_fetch: bool = False
+    # attempt-unique map-id convention (0 = map_ids ARE logical indices, the
+    # local-mode default). Distributed workers set this to their
+    # ATTEMPT_STRIDE so LISTING-mode enumeration can recover the logical map
+    # index (map_id // stride) for range filtering and dedupe committed
+    # duplicate attempts — the tracker path carries map_index explicitly.
+    map_id_attempt_stride: int = 0
     # --- caches ---
     cache_partition_lengths: bool = True
     cache_checksums: bool = True

@@ -270,6 +270,16 @@ def _stable_key_hash(key: Any) -> int:
     """Deterministic across processes (PYTHONHASHSEED-independent) so map and
     reduce tasks in different processes agree on partition assignment.
 
+    COMPATIBILITY: this is part of the shuffle wire contract — all workers
+    and the driver of one job MUST run the same framework version. The r3
+    fast-path rewrite changed the mapping for common key types (int:
+    key&mask → hash(key)&mask; str/bytes: blake2b → crc32), so mixed-version
+    workers in a rolling upgrade, or shuffle data re-read by a different
+    version with cleanup=False, would route the same key to different
+    partitions with no error. ``version.SHUFFLE_FORMAT_VERSION`` names this
+    contract (bumped on any partition-function or wire-format change; logged
+    with BUILD_INFO at manager startup): deploy ONE version per job.
+
     Per-record hot path of every hash shuffle: common key types avoid the
     generic pickle+blake2b route (which cost ~3.5 µs/record and dominated
     the group-heavy TPC-DS stages) — ints fold directly, bytes/str go

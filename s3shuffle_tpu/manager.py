@@ -6,10 +6,12 @@ Parity: ``S3ShuffleManager`` (sort/S3ShuffleManager.scala:38-201):
   Spark's SortShuffleManager (:52-71): bypass-merge when the dependency has no
   map-side combine and ≤ ``bypass_merge_threshold`` partitions; serialized
   ("unsafe") when the serializer is relocatable, there is no aggregator, and
-  the partition count fits; base sort otherwise. In this framework all three
-  converge on the same partitioned writer, but the handle kind is preserved —
-  it selects the map-side strategy (buffer-per-partition vs sort-by-partition)
-  and is part of the capability surface;
+  the partition count fits; base sort otherwise. The handle kind selects the
+  map-side strategy in ``get_writer``: serialized handles with a columnar
+  serializer take :class:`SerializedSortMapWriter` (ONE buffer + partition-id
+  radix sort at spill — the UnsafeShuffleWriter analog, the win on wide
+  shuffles); bypass-merge and base handles take the buffer-per-partition
+  :class:`ShuffleMapWriter` (few live pipelines / aggregating deps);
 - ``get_writer`` vends a map-task writer whose committed MapStatus always
   points at the object store — the ``S3ShuffleWriter`` FALLBACK_BLOCK_MANAGER_ID
   rebranding trick (S3ShuffleWriter.scala:7-21) that makes output
@@ -125,7 +127,12 @@ class ShuffleManager:
         return handle
 
     # ------------------------------------------------------------------
-    def get_writer(self, handle: ShuffleHandle, map_id: int) -> "ShuffleMapWriter":
+    def get_writer(
+        self, handle: ShuffleHandle, map_id: int, map_index: Optional[int] = None
+    ):
+        """``map_id`` names the store objects (attempt-unique in distributed
+        mode); ``map_index`` is the logical map partition index used by
+        range reads (defaults to map_id — correct in local mode)."""
         output_writer = MapOutputWriter(
             self.dispatcher,
             self.helper,
@@ -133,19 +140,31 @@ class ShuffleManager:
             map_id,
             handle.dependency.num_partitions,
         )
-        return ShuffleMapWriter(
+        cls = ShuffleMapWriter
+        if handle.kind == "serialized" and handle.dependency.serializer.supports_batches:
+            from s3shuffle_tpu.write.serialized_writer import SerializedSortMapWriter
+
+            cls = SerializedSortMapWriter
+        return cls(
             handle=handle,
             map_id=map_id,
             output_writer=output_writer,
             codec=self._codec,
             on_commit=self._commit_map_output,
+            map_index=map_index,
         )
 
-    def _commit_map_output(self, shuffle_id: int, map_id: int, lengths: np.ndarray) -> None:
+    def _commit_map_output(
+        self, shuffle_id: int, map_id: int, lengths: np.ndarray, map_index: int
+    ) -> None:
         # MapStatus location rebranding (S3ShuffleWriter.scala:10-18): the
         # output's address is the store, never a worker.
         self.tracker.register_map_output(
-            shuffle_id, MapStatus(map_id=map_id, location=STORE_LOCATION, sizes=lengths)
+            shuffle_id,
+            MapStatus(
+                map_id=map_id, location=STORE_LOCATION, sizes=lengths,
+                map_index=map_index,
+            ),
         )
 
     # ------------------------------------------------------------------

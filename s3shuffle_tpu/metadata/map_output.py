@@ -29,9 +29,20 @@ STORE_LOCATION = "object-store"
 
 @dataclasses.dataclass
 class MapStatus:
+    """Spark 3 keeps the *logical* map index (partition position) and the
+    *attempt-unique* mapId as separate fields on MapStatus; distributed
+    workers here register attempt-strided map_ids (worker.ATTEMPT_STRIDE), so
+    range queries MUST filter on ``map_index``, never ``map_id`` — filtering
+    on strided ids silently excludes/misselects outputs."""
+
     map_id: int
     location: str
     sizes: np.ndarray  # per reduce partition, stored (compressed) bytes
+    map_index: int = -1  # logical map partition index; defaults to map_id
+
+    def __post_init__(self) -> None:
+        if self.map_index < 0:
+            self.map_index = self.map_id
 
 
 class MapOutputTrackerLike(Protocol):
@@ -94,23 +105,32 @@ class MapOutputTracker:
     ) -> List[Tuple[int, List[Tuple[int, int]]]]:
         """[(map_id, [(reduce_id, size), ...]), ...] for the requested map and
         partition ranges — the shape MapOutputTracker.getMapSizesByExecutorId
-        returns, minus executor locations (everything is STORE_LOCATION)."""
+        returns, minus executor locations (everything is STORE_LOCATION).
+        The range filters on the LOGICAL ``map_index`` (Spark's mapIndex);
+        the returned ``map_id`` stays attempt-unique — it names the store
+        objects."""
         with self._lock:
             if shuffle_id not in self._shuffles:
                 raise KeyError(f"Shuffle {shuffle_id} not registered")
-            statuses = self._shuffles[shuffle_id]
+            # one winner per logical index (the commit fence enforces it);
+            # defensively keep the latest-registered attempt if ever two
+            by_index: Dict[int, MapStatus] = {}
+            for status in self._shuffles[shuffle_id].values():
+                prev = by_index.get(status.map_index)
+                if prev is None or status.map_id > prev.map_id:
+                    by_index[status.map_index] = status
             out = []
-            for map_id in sorted(statuses):
-                if map_id < start_map_index:
+            for map_index in sorted(by_index):
+                if map_index < start_map_index:
                     continue
-                if end_map_index is not None and map_id >= end_map_index:
+                if end_map_index is not None and map_index >= end_map_index:
                     continue
-                status = statuses[map_id]
+                status = by_index[map_index]
                 sizes = [
                     (rid, int(status.sizes[rid]))
                     for rid in range(start_partition, end_partition)
                 ]
-                out.append((map_id, sizes))
+                out.append((status.map_id, sizes))
             return out
 
     def unregister_shuffle(self, shuffle_id: int) -> None:

@@ -113,11 +113,29 @@ class ShuffleReader:
         # Listing mode: enumerate committed indices from the store
         # (S3ShuffleReader.scala:181-196), filtered by map range.
         indices = self.dispatcher.list_shuffle_indices(sid)
+        stride = cfg.map_id_attempt_stride
+        if stride:
+            # attempt-strided ids (distributed workers): the logical map
+            # index is map_id // stride. Dedupe duplicate committed attempts
+            # (attempt 1 committed but its lease was reaped → attempt 2 also
+            # committed) keeping the latest attempt, and range-filter on the
+            # LOGICAL index — the listing-mode counterpart of the tracker's
+            # map_index filtering (MapStatus docstring).
+            by_logical: dict = {}
+            for idx in indices:
+                lg = idx.map_id // stride
+                prev = by_logical.get(lg)
+                if prev is None or idx.map_id > prev.map_id:
+                    by_logical[lg] = idx
+            indices = [by_logical[lg] for lg in sorted(by_logical)]
+            logical = lambda idx: idx.map_id // stride  # noqa: E731
+        else:
+            logical = lambda idx: idx.map_id  # noqa: E731
         blocks = []
         for idx in indices:
-            if idx.map_id < self.start_map_index:
+            if logical(idx) < self.start_map_index:
                 continue
-            if self.end_map_index is not None and idx.map_id >= self.end_map_index:
+            if self.end_map_index is not None and logical(idx) >= self.end_map_index:
                 continue
             if self.do_batch_fetch:
                 blocks.append(

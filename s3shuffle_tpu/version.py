@@ -7,8 +7,18 @@ startup (sort/S3ShuffleManager.scala:39-41).
 
 __version__ = "0.1.0"
 
+#: Shuffle wire-contract version: partition functions (_stable_key_hash,
+#: BytesHashPartitioner), codec framing, index/checksum sidecar layout, and
+#: serializer frames. Bumped on ANY change that would make a different
+#: framework version route or parse shuffle data differently (e.g. r3's
+#: _stable_key_hash fast-path rewrite → 2). Driver and all workers of one
+#: job must run the same value; re-reading kept shuffle data
+#: (cleanup=False) across versions is unsupported.
+SHUFFLE_FORMAT_VERSION = 2
+
 BUILD_INFO = {
     "name": "s3shuffle_tpu",
     "version": __version__,
+    "shuffle_format": SHUFFLE_FORMAT_VERSION,
     "target": "tpu (jax/xla/pallas) + cpu fallback",
 }

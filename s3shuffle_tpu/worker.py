@@ -123,11 +123,22 @@ class WorkerAgent:
     ):
         from s3shuffle_tpu.manager import ShuffleManager
 
+        import dataclasses
+
         self.client = RemoteMapOutputTracker(coordinator)
         self.config = config or ShuffleConfig.from_env()
+        if self.config.map_id_attempt_stride != self.ATTEMPT_STRIDE:
+            # announce the attempt-id convention to the read plane (listing-
+            # mode range filtering / duplicate-attempt dedupe)
+            self.config = dataclasses.replace(
+                self.config, map_id_attempt_stride=self.ATTEMPT_STRIDE
+            )
         self.worker_id = worker_id or f"{socket.gethostname()}-{os.getpid()}"
         self.manager = ShuffleManager(config=self.config, tracker=self.client)
         self.tasks_run = 0
+        # refuse to join a coordinator speaking a different shuffle wire
+        # format — mixed versions mis-partition silently (see version.py)
+        self.client.check_format()
 
     # -- task kinds ----------------------------------------------------
     def _commit_allowed(self, stage_id: str, task: dict) -> bool:
@@ -159,15 +170,19 @@ class WorkerAgent:
 
         batches = read_input_batches(self.manager.dispatcher.backend, task["input_path"])
         attempt = int(task.get("_attempt", 1))
-        map_id = int(task["map_id"]) * self.ATTEMPT_STRIDE + (attempt - 1)
-        writer = self.manager.get_writer(handle, map_id)
+        logical_index = int(task["map_id"])
+        map_id = logical_index * self.ATTEMPT_STRIDE + (attempt - 1)
+        # map_index rides separately from the attempt-unique map_id so range
+        # reads filter on logical position (Spark's MapStatus mapIndex/mapId
+        # split) — strided ids must never leak into range filtering
+        writer = self.manager.get_writer(handle, map_id, map_index=logical_index)
         # defer MapStatus registration: it rides the complete_task RPC and is
         # registered ATOMICALLY with acceptance (TaskQueue.complete_task), so
         # a stalled attempt that passed the pre-write fence still cannot
         # register outputs after being reaped
         captured: dict = {}
-        writer.on_commit = lambda sid, mid, lengths: captured.update(
-            map_output=[sid, mid, STORE_LOCATION, np.asarray(lengths).tolist()]
+        writer.on_commit = lambda sid, mid, lengths, midx: captured.update(
+            map_output=[sid, mid, STORE_LOCATION, np.asarray(lengths).tolist(), midx]
         )
         try:
             for b in batches:
@@ -229,6 +244,8 @@ class WorkerAgent:
                 self.worker_id,
             )
             return "run"
+        map_output = None
+        result = None
         try:
             result = fn(self, task, stage_id)
             map_output = result.pop("_map_output", None) if isinstance(result, dict) else None
@@ -247,13 +264,50 @@ class WorkerAgent:
         if accepted is False:
             # our lease was reaped while we ran (coordinator thought us dead
             # — e.g. a long GC or network partition); the attempt was stale
-            # and the report was ignored. Keep serving.
+            # and the report was ignored. Keep serving — but first delete
+            # this attempt's store objects: refused attempts never register,
+            # so their attempt-unique objects would otherwise leak until
+            # unregister_shuffle sweeps the whole prefix.
             logger.warning(
                 "worker %s: stale attempt for task %s ignored by coordinator",
                 self.worker_id, task.get("task_id"),
             )
+            self._delete_refused_attempt_objects(kind, map_output, result)
         self.tasks_run += 1
         return "run"
+
+    def _delete_refused_attempt_objects(self, kind, map_output, result) -> None:
+        """Best-effort removal of a refused (zombie/stale) attempt's
+        attempt-unique store objects — safe precisely because the naming is
+        attempt-unique (the winner's objects have different names). Any
+        object that slips through (e.g. worker death right here) is swept by
+        unregister_shuffle's prefix delete."""
+        from s3shuffle_tpu.block_ids import (
+            ShuffleChecksumBlockId,
+            ShuffleDataBlockId,
+            ShuffleIndexBlockId,
+        )
+
+        dispatcher = self.manager.dispatcher
+        try:
+            if kind == "map" and map_output:
+                sid, mid = int(map_output[0]), int(map_output[1])
+                blocks = [
+                    ShuffleDataBlockId(sid, mid),
+                    ShuffleIndexBlockId(sid, mid),
+                    ShuffleChecksumBlockId(
+                        sid, mid, algorithm=dispatcher.config.checksum_algorithm
+                    ),
+                ]
+                for block in blocks:
+                    dispatcher.backend.delete(dispatcher.get_path(block))
+            elif kind == "reduce" and isinstance(result, dict) and result.get("path"):
+                dispatcher.backend.delete(result["path"])
+        except Exception:
+            logger.warning(
+                "worker %s: could not delete refused-attempt objects",
+                self.worker_id, exc_info=True,
+            )
 
     def _start_heartbeat(self, interval_s: float) -> None:
         """Daemon thread: liveness signal while a (long) task runs — the

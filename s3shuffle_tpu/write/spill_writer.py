@@ -74,34 +74,112 @@ class _PartitionPipeline:
         return self.sink.getvalue()
 
 
-class ShuffleMapWriter:
+class MapWriterBase:
+    """Shared writer state + the stop()/commit/abort/cleanup protocol —
+    subclasses implement the buffering strategy (`write`, `_commit`).
+    Extracted so the buffer-per-partition and serialized-sort strategies
+    cannot drift on the commit protocol (they once duplicated it)."""
+
     def __init__(
         self,
         handle,
         map_id: int,
         output_writer: MapOutputWriter,
         codec: Optional[FrameCodec],
-        on_commit: Callable[[int, int, np.ndarray], None],
+        on_commit: Callable[[int, int, np.ndarray, int], None],
         spill_memory_budget: Optional[int] = None,
+        map_index: Optional[int] = None,
     ):
         self.handle = handle
         self.dep = handle.dependency
         self.map_id = map_id
+        self.map_index = map_id if map_index is None else map_index
         self.output_writer = output_writer
         self.codec = codec
         self.on_commit = on_commit
         cfg = output_writer.dispatcher.config
         self.spill_memory_budget = spill_memory_budget or cfg.max_buffer_size_task
-        self._pipelines = [
-            _PartitionPipeline(self.dep.serializer, codec)
-            for _ in range(self.dep.num_partitions)
-        ]
         self._spill_file: Optional[str] = None
         self._spill_fd = None
-        self._combine_reducer = None  # columnar map-side combine state
         self._records_written = 0
         self._stopped = False
         self.spill_count = 0
+
+    def write(self, records) -> None:
+        raise NotImplementedError
+
+    def _commit(self) -> MapOutputCommitMessage:
+        raise NotImplementedError
+
+    def _on_abort(self) -> None:
+        """Strategy-specific state release on unsuccessful stop."""
+
+    # ------------------------------------------------------------------
+    def stop(self, success: bool) -> Optional[MapOutputCommitMessage]:
+        if self._stopped:
+            return None
+        self._stopped = True
+        if not success:
+            self._on_abort()
+            self.output_writer.abort()
+            self._cleanup_spill()
+            return None
+        from s3shuffle_tpu.utils import trace
+
+        try:
+            with trace.span(
+                "write.commit", map_id=self.map_id, records=self._records_written
+            ):
+                return self._commit()
+        except BaseException as e:
+            self.output_writer.abort(e if isinstance(e, Exception) else None)
+            raise
+        finally:
+            self._cleanup_spill()
+
+    def _register_commit(self) -> MapOutputCommitMessage:
+        """Shared commit tail: seal the data object, write index/checksum
+        sidecars, register the MapStatus."""
+        message = self.output_writer.commit_all_partitions()
+        self.on_commit(
+            self.handle.shuffle_id, self.map_id, message.partition_lengths,
+            self.map_index,
+        )
+        return message
+
+    def _cleanup_spill(self) -> None:
+        if self._spill_fd is not None:
+            self._spill_fd.close()
+            self._spill_fd = None
+        if self._spill_file is not None:
+            try:
+                os.remove(self._spill_file)
+            except OSError:
+                pass
+            self._spill_file = None
+
+    def _copy_spill_range(self, writer, lo: int, hi: int) -> None:
+        """Stream spill-file bytes [lo, hi) into a partition writer."""
+        assert self._spill_fd is not None
+        self._spill_fd.seek(lo)
+        remaining = hi - lo
+        while remaining > 0:
+            chunk = self._spill_fd.read(min(remaining, 1 << 20))
+            if not chunk:
+                raise IOError("Truncated spill file")
+            writer.write(chunk)
+            remaining -= len(chunk)
+
+
+class ShuffleMapWriter(MapWriterBase):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._pipelines = [
+            _PartitionPipeline(self.dep.serializer, self.codec)
+            for _ in range(self.dep.num_partitions)
+        ]
+        self._combine_reducer = None  # columnar map-side combine state
+        self._since_budget_check = 0
 
     # ------------------------------------------------------------------
     def write(self, records: Iterable[Tuple[Any, Any]]) -> None:
@@ -140,6 +218,8 @@ class ShuffleMapWriter:
                 records,
                 spill_bytes=self.output_writer.dispatcher.config.aggregator_spill_bytes,
             )
+        import itertools
+
         from s3shuffle_tpu.utils import gc_paused
 
         partitioner = dep.partitioner
@@ -148,16 +228,28 @@ class ShuffleMapWriter:
         # Running total across write() calls — incremental callers writing
         # small batches must still hit the budget check.
         n = self._records_written
-        # The pause also covers the upstream iterator (user compute); the
-        # periodic tick bounds any reference cycles it creates.
-        with gc_paused:
-            for k, v in records:
-                pipelines[partitioner(k)].record_writer.write(k, v)
-                n += 1
-                if n % check_every == 0:
-                    gc_paused.tick()
-                    if self._buffered_total() > self.spill_memory_budget:
-                        self._spill()
+        it = iter(records)
+        while True:
+            # Pull each chunk with the collector LIVE: `records` may run
+            # arbitrary user compute (combine functions, lazy sources), and a
+            # process-wide gc pause across it would let reference cycles pile
+            # up for the whole task (ADVICE r3). The pause below covers only
+            # writer-internal routing + serialization.
+            chunk = list(itertools.islice(it, check_every))
+            if not chunk:
+                break
+            with gc_paused:
+                for k, v in chunk:
+                    pipelines[partitioner(k)].record_writer.write(k, v)
+            n += len(chunk)
+            # amortize the O(num_partitions) budget scan across write()
+            # calls: incremental callers writing tiny batches must not pay
+            # a full-pipeline scan per call
+            self._since_budget_check += len(chunk)
+            if self._since_budget_check >= check_every:
+                self._since_budget_check = 0
+                if self._buffered_total() > self.spill_memory_budget:
+                    self._spill()
         self._records_written = n
 
     def _write_batched(self, records: Iterable[Tuple[Any, Any]]) -> None:
@@ -207,29 +299,10 @@ class ShuffleMapWriter:
         )
 
     # ------------------------------------------------------------------
-    def stop(self, success: bool) -> Optional[MapOutputCommitMessage]:
-        if self._stopped:
-            return None
-        self._stopped = True
-        if not success:
-            if self._combine_reducer is not None:
-                self._combine_reducer.cleanup()
-                self._combine_reducer = None
-            self.output_writer.abort()
-            self._cleanup_spill()
-            return None
-        from s3shuffle_tpu.utils import trace
-
-        try:
-            with trace.span(
-                "write.commit", map_id=self.map_id, records=self._records_written
-            ):
-                return self._commit()
-        except BaseException as e:
-            self.output_writer.abort(e if isinstance(e, Exception) else None)
-            raise
-        finally:
-            self._cleanup_spill()
+    def _on_abort(self) -> None:
+        if self._combine_reducer is not None:
+            self._combine_reducer.cleanup()
+            self._combine_reducer = None
 
     def _commit(self) -> MapOutputCommitMessage:
         if self._combine_reducer is not None:
@@ -241,29 +314,8 @@ class ShuffleMapWriter:
             final = pipeline.finalize()
             writer = self.output_writer.get_partition_writer(pid)
             for offset, length in pipeline.spill_segments:
-                assert self._spill_fd is not None
-                self._spill_fd.seek(offset)
-                remaining = length
-                while remaining > 0:
-                    chunk = self._spill_fd.read(min(remaining, 1 << 20))
-                    if not chunk:
-                        raise IOError("Truncated spill file")
-                    writer.write(chunk)
-                    remaining -= len(chunk)
+                self._copy_spill_range(writer, offset, offset + length)
             if final:
                 writer.write(final)
             writer.close()
-        message = self.output_writer.commit_all_partitions()
-        self.on_commit(self.handle.shuffle_id, self.map_id, message.partition_lengths)
-        return message
-
-    def _cleanup_spill(self) -> None:
-        if self._spill_fd is not None:
-            self._spill_fd.close()
-            self._spill_fd = None
-        if self._spill_file is not None:
-            try:
-                os.remove(self._spill_file)
-            except OSError:
-                pass
-            self._spill_file = None
+        return self._register_commit()

@@ -140,3 +140,38 @@ def test_stable_key_hash_subclasses_hash_like_their_builtins():
     assert _stable_key_hash(True) == _stable_key_hash(1)
     # deep tuples recurse; results stay in the 31-bit range
     assert 0 <= _stable_key_hash((1, ("a", b"b", (2, 3)))) < 2**31
+
+
+def test_map_range_reads_filter_on_logical_index():
+    """ADVICE r3 (medium): distributed workers register attempt-strided
+    map_ids (logical*1000 + attempt-1); range queries must filter on the
+    LOGICAL map_index or they silently exclude/misselect outputs."""
+    import numpy as np
+
+    from s3shuffle_tpu.metadata.map_output import (
+        STORE_LOCATION,
+        MapOutputTracker,
+        MapStatus,
+    )
+
+    tracker = MapOutputTracker()
+    tracker.register_shuffle(0, 2)
+    for logical, mid in [(0, 0), (1, 1000), (2, 2001)]:  # 2001 = attempt 2
+        tracker.register_map_output(
+            0,
+            MapStatus(
+                map_id=mid,
+                location=STORE_LOCATION,
+                sizes=np.array([5, 7]),
+                map_index=logical,
+            ),
+        )
+    # logical range [1, 3) → the strided ids 1000 and 2001, nothing else
+    out = tracker.get_map_sizes_by_range(0, 1, 3, 0, 2)
+    assert [m for m, _ in out] == [1000, 2001]
+    assert all(sizes == [(0, 5), (1, 7)] for _m, sizes in out)
+    # full range returns everything in logical order
+    out_all = tracker.get_map_sizes_by_range(0, 0, None, 0, 2)
+    assert [m for m, _ in out_all] == [0, 1000, 2001]
+    # map_index defaults to map_id (local mode back-compat)
+    assert MapStatus(map_id=4, location=STORE_LOCATION, sizes=np.array([1])).map_index == 4

@@ -1,0 +1,170 @@
+"""SerializedSortMapWriter: handle-kind strategy selection and wide-shuffle
+correctness (the UnsafeShuffleWriter-analog map-side fast path)."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from s3shuffle_tpu.batch import RecordBatch
+from s3shuffle_tpu.config import ShuffleConfig
+from s3shuffle_tpu.dependency import BytesHashPartitioner, ShuffleDependency
+from s3shuffle_tpu.manager import ShuffleManager
+from s3shuffle_tpu.serializer import ColumnarKVSerializer, PickleBatchSerializer
+from s3shuffle_tpu.storage.dispatcher import Dispatcher
+from s3shuffle_tpu.write.serialized_writer import SerializedSortMapWriter
+from s3shuffle_tpu.write.spill_writer import ShuffleMapWriter
+
+
+def _mgr(tmp_path, **over):
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/shuffle", app_id="sersort", **over
+    )
+    return ShuffleManager(cfg)
+
+
+def _dep(n_parts, serializer=None, aggregator=None, map_side_combine=False):
+    return ShuffleDependency(
+        shuffle_id=0,
+        partitioner=BytesHashPartitioner(n_parts),
+        serializer=serializer or ColumnarKVSerializer(),
+        aggregator=aggregator,
+        map_side_combine=map_side_combine,
+    )
+
+
+def test_handle_kind_selects_writer_strategy(tmp_path):
+    mgr = _mgr(tmp_path)
+    # wide + relocatable + no aggregator → serialized handle → sort writer
+    dep = _dep(2500)
+    h = mgr.register_shuffle(0, dep)
+    assert h.kind == "serialized"
+    assert isinstance(mgr.get_writer(h, 0), SerializedSortMapWriter)
+    # narrow (≤ bypass threshold) → bypass-merge → buffer-per-partition
+    dep2 = _dep(10)
+    h2 = mgr.register_shuffle(1, dep2)
+    assert h2.kind == "bypass-merge"
+    assert isinstance(mgr.get_writer(h2, 0), ShuffleMapWriter)
+    # serialized handle but non-columnar serializer → buffer-per-partition
+    dep3 = _dep(2500, serializer=PickleBatchSerializer())
+    h3 = mgr.register_shuffle(2, dep3)
+    assert h3.kind == "serialized"
+    assert isinstance(mgr.get_writer(h3, 0), ShuffleMapWriter)
+    mgr.stop()
+
+
+def _write_and_read_all(mgr, handle, batches, n_parts, spill_budget=None):
+    writer = mgr.get_writer(handle, map_id=0)
+    if spill_budget:
+        writer.spill_memory_budget = spill_budget
+    for b in batches:
+        writer.write(b)
+    assert writer.stop(success=True) is not None
+    got = []
+    for pid in range(n_parts):
+        reader = mgr.get_reader(handle, pid, pid + 1)
+        got.append(list(reader.read()))
+    return writer, got
+
+
+@pytest.mark.parametrize("codec", ["none", "native"])
+def test_wide_shuffle_roundtrip_with_spills(tmp_path, codec):
+    n_parts = 2500
+    mgr = _mgr(tmp_path, codec=codec)
+    dep = _dep(n_parts)
+    handle = mgr.register_shuffle(0, dep)
+    rng = np.random.default_rng(7)
+    batches = []
+    expected = {}
+    part = BytesHashPartitioner(n_parts)
+    for bi in range(4):
+        recs = [
+            (struct.pack(">q", int(k)), struct.pack("<q", bi * 10000 + i))
+            for i, k in enumerate(rng.integers(0, 100000, 3000))
+        ]
+        batches.append(RecordBatch.from_records(recs))
+        for k, v in recs:
+            expected.setdefault(part(k), []).append((k, v))
+    writer, got = _write_and_read_all(
+        mgr, handle, batches, n_parts, spill_budget=64 * 1024
+    )
+    assert isinstance(writer, SerializedSortMapWriter)
+    assert writer.spill_count > 0
+    for pid in range(n_parts):
+        # single map task → per-partition record order is insertion order
+        # (stable radix sort by pid)
+        assert got[pid] == expected.get(pid, [])
+    mgr.stop()
+
+
+def test_serialized_writer_abort_cleans_spill(tmp_path):
+    mgr = _mgr(tmp_path)
+    dep = _dep(300)
+    handle = mgr.register_shuffle(0, dep)
+    writer = mgr.get_writer(handle, map_id=0)
+    writer.spill_memory_budget = 1024
+    recs = [(struct.pack(">q", i), b"v" * 50) for i in range(2000)]
+    writer.write(RecordBatch.from_records(recs))
+    spill_file = writer._spill_file
+    assert writer.spill_count > 0 and spill_file is not None
+    import os
+
+    assert writer.stop(success=False) is None
+    assert not os.path.exists(spill_file)
+    mgr.stop()
+
+
+def test_sort_by_key_runs_through_serialized_path(tmp_path):
+    """sort_by_key with a columnar serializer and >threshold partitions picks
+    the serialized handle — the terasort shape exercises the new writer end
+    to end (range partitioner + global order)."""
+    from s3shuffle_tpu.shuffle import ShuffleContext
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(root_dir=f"file://{tmp_path}/shuffle", app_id="sersort-e2e")
+    rng = np.random.default_rng(3)
+    keys = rng.integers(0, 2**48, 20000)
+    recs = [(struct.pack(">q", int(k)), b"x" * 10) for k in keys]
+    batch = RecordBatch.from_records(recs)
+    with ShuffleContext(config=cfg, num_workers=2) as ctx:
+        out = ctx.sort_by_key(
+            [batch], num_partitions=250, serializer=ColumnarKVSerializer()
+        )
+    flat = [k for part in out for k, _v in part]
+    assert flat == sorted(struct.pack(">q", int(k)) for k in keys)
+
+
+def test_listing_mode_dedupes_strided_attempts(tmp_path):
+    """Listing-mode enumeration must recover the LOGICAL map index from
+    attempt-strided ids (config.map_id_attempt_stride): duplicate committed
+    attempts dedupe to the latest, and map ranges filter logically."""
+    import numpy as np
+
+    from s3shuffle_tpu.colagg import ColumnarAggregator  # noqa: F401 (import check)
+
+    STRIDE = 1000
+    mgr = _mgr(tmp_path, use_block_manager=False, map_id_attempt_stride=STRIDE)
+    dep = _dep(4)
+    handle = mgr.register_shuffle(0, dep)
+
+    def write_map(map_id, tag):
+        w = mgr.get_writer(handle, map_id, map_index=map_id // STRIDE)
+        recs = [(struct.pack(">q", k), tag) for k in range(40)]
+        w.write(RecordBatch.from_records(recs))
+        assert w.stop(success=True) is not None
+
+    # logical 0 → two committed attempts (ids 0 and 1); logical 1 → id 1000
+    write_map(0, b"old")
+    write_map(1, b"new")   # attempt 2 of logical 0
+    write_map(1000, b"one")
+    reader = mgr.get_reader(handle, 0, 4)
+    vals = [v for _k, v in reader.read()]
+    # 40 records from logical 0 (latest attempt only) + 40 from logical 1
+    assert len(vals) == 80
+    assert vals.count(b"old") == 0 and vals.count(b"new") == 40
+    # logical map range [1, 2) → only logical 1's output
+    reader2 = mgr.get_reader(handle, 0, 4, start_map_index=1, end_map_index=2)
+    vals2 = [v for _k, v in reader2.read()]
+    assert len(vals2) == 40 and vals2.count(b"one") == 40
+    mgr.stop()
