@@ -45,6 +45,21 @@ class MapStatus:
             self.map_index = self.map_id
 
 
+def dedupe_latest_attempt(items, logical_of, map_id_of):
+    """One winner per LOGICAL map index: keep the item with the largest
+    attempt-unique map_id, returned in sorted logical order. Shared by the
+    tracker range query and the listing-mode reader so the two enumeration
+    paths can never diverge on which duplicate committed attempt they
+    serve."""
+    by_logical: Dict[int, object] = {}
+    for item in items:
+        lg = logical_of(item)
+        prev = by_logical.get(lg)
+        if prev is None or map_id_of(item) > map_id_of(prev):
+            by_logical[lg] = item
+    return [(lg, by_logical[lg]) for lg in sorted(by_logical)]
+
+
 class MapOutputTrackerLike(Protocol):
     """The tracker contract the manager/reader depend on — satisfied by the
     in-process :class:`MapOutputTracker` and the TCP
@@ -114,18 +129,17 @@ class MapOutputTracker:
                 raise KeyError(f"Shuffle {shuffle_id} not registered")
             # one winner per logical index (the commit fence enforces it);
             # defensively keep the latest-registered attempt if ever two
-            by_index: Dict[int, MapStatus] = {}
-            for status in self._shuffles[shuffle_id].values():
-                prev = by_index.get(status.map_index)
-                if prev is None or status.map_id > prev.map_id:
-                    by_index[status.map_index] = status
+            deduped = dedupe_latest_attempt(
+                self._shuffles[shuffle_id].values(),
+                logical_of=lambda s: s.map_index,
+                map_id_of=lambda s: s.map_id,
+            )
             out = []
-            for map_index in sorted(by_index):
+            for map_index, status in deduped:
                 if map_index < start_map_index:
                     continue
                 if end_map_index is not None and map_index >= end_map_index:
                     continue
-                status = by_index[map_index]
                 sizes = [
                     (rid, int(status.sizes[rid]))
                     for rid in range(start_partition, end_partition)

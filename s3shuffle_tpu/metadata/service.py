@@ -328,10 +328,17 @@ class _Handler(socketserver.BaseRequestHandler):
             if len(a) > 4 and a[4] is not None:
                 # map-output registration rides the completion atomically:
                 # accepted ⇒ registered; refused (zombie) ⇒ never registered
-                m_shuffle, m_map, m_loc, m_sizes = a[4][:4]
-                # 5th element: logical map_index (attempt-strided map_ids
-                # must not leak into range filtering — see MapStatus)
-                m_idx = int(a[4][4]) if len(a[4]) > 4 else int(m_map)
+                if len(a[4]) < 5:
+                    # pre-format-2 client: its strided map_ids would default
+                    # map_index wrong and silently mis-filter range reads —
+                    # the exact failure SHUFFLE_FORMAT_VERSION exists to stop
+                    raise RuntimeError(
+                        "map_output registration without map_index: client "
+                        "speaks an older shuffle format; deploy one version "
+                        "per job (see version.SHUFFLE_FORMAT_VERSION)"
+                    )
+                m_shuffle, m_map, m_loc, m_sizes, m_idx = a[4][:5]
+                m_idx = int(m_idx)
                 tracker = self.server.tracker  # type: ignore[attr-defined]
                 status = MapStatus(
                     map_id=int(m_map),
@@ -381,12 +388,18 @@ class _Handler(socketserver.BaseRequestHandler):
         if method == "register_shuffle":
             return tracker.register_shuffle(int(a[0]), int(a[1]))
         if method == "register_map_output":
-            shuffle_id, map_id, location, sizes = a[:4]
+            if len(a) < 5:
+                raise RuntimeError(
+                    "register_map_output without map_index: client speaks an "
+                    "older shuffle format; deploy one version per job "
+                    "(see version.SHUFFLE_FORMAT_VERSION)"
+                )
+            shuffle_id, map_id, location, sizes, map_index = a[:5]
             status = MapStatus(
                 map_id=int(map_id),
                 location=str(location),
                 sizes=np.asarray(sizes, dtype=np.int64),
-                map_index=int(a[4]) if len(a) > 4 else int(map_id),
+                map_index=int(map_index),
             )
             return tracker.register_map_output(int(shuffle_id), status)
         if method == "get_map_sizes_by_range":

@@ -208,6 +208,30 @@ size_t slz_compress(const uint8_t* src, size_t n, uint8_t* dst, size_t cap) {
             if ((size_t)(ip - cp) <= 0xFFFF && load32(cp) == load32(ip)) {
                 size_t mlen = MIN_MATCH + match_length(ip + MIN_MATCH, cp + MIN_MATCH,
                                                       (size_t)(iend - ip) - MIN_MATCH);
+                // Lazy lookahead (cost-checked): a short greedy match often
+                // shadows a longer one starting a byte later. Probe ip+1
+                // while the current match is short; defer only when the
+                // later match nets bytes after paying the extra literal
+                // (mlen2 > mlen + 1). Long matches (≥64) skip the probe —
+                // the gain is negligible and the probe isn't free.
+                while (mlen < 64 && ip + 1 < mflimit &&
+                       (size_t)(iend - (ip + 1)) > MIN_MATCH) {
+                    uint32_t h2 = hash4(load32(ip + 1));
+                    uint32_t cand2 = table[h2];
+                    table[h2] = (uint32_t)(ip + 1 - src);
+                    if (cand2 == 0xFFFFFFFFu) break;
+                    const uint8_t* cp2 = src + cand2;
+                    if ((size_t)(ip + 1 - cp2) > 0xFFFF ||
+                        load32(cp2) != load32(ip + 1))
+                        break;
+                    size_t mlen2 =
+                        MIN_MATCH + match_length(ip + 1 + MIN_MATCH, cp2 + MIN_MATCH,
+                                                 (size_t)(iend - (ip + 1)) - MIN_MATCH);
+                    if (mlen2 <= mlen + 1) break;
+                    ip += 1;  // the skipped byte joins the literal run
+                    cp = cp2;
+                    mlen = mlen2;
+                }
                 size_t llen = (size_t)(ip - anchor);
                 // emit: varint L, literals, u16 offset, varint (M - MIN_MATCH)
                 if (op + llen + 12 > oend) return 0;

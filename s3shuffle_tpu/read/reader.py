@@ -120,14 +120,16 @@ class ShuffleReader:
             # (attempt 1 committed but its lease was reaped → attempt 2 also
             # committed) keeping the latest attempt, and range-filter on the
             # LOGICAL index — the listing-mode counterpart of the tracker's
-            # map_index filtering (MapStatus docstring).
-            by_logical: dict = {}
-            for idx in indices:
-                lg = idx.map_id // stride
-                prev = by_logical.get(lg)
-                if prev is None or idx.map_id > prev.map_id:
-                    by_logical[lg] = idx
-            indices = [by_logical[lg] for lg in sorted(by_logical)]
+            # map_index filtering (same shared helper, so the two paths
+            # cannot diverge on which attempt they serve).
+            from s3shuffle_tpu.metadata.map_output import dedupe_latest_attempt
+
+            deduped = dedupe_latest_attempt(
+                indices,
+                logical_of=lambda idx: idx.map_id // stride,
+                map_id_of=lambda idx: idx.map_id,
+            )
+            indices = [idx for _lg, idx in deduped]
             logical = lambda idx: idx.map_id // stride  # noqa: E731
         else:
             logical = lambda idx: idx.map_id  # noqa: E731
