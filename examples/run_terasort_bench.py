@@ -27,7 +27,12 @@ def run_terasort(size: str, codec: str, repeat: int, workers: int) -> dict:
         "--size", size, "--codec", codec, "--repeat", str(repeat),
         "--workers", str(workers),
     ]
-    out = subprocess.run(cmd, capture_output=True, text=True, check=True)
+    out = subprocess.run(cmd, capture_output=True, text=True)
+    if out.returncode != 0:
+        # surface the child's traceback — a rep can die hours into a 10 GB
+        # sweep and "non-zero exit status" alone is undebuggable
+        sys.stderr.write(out.stderr)
+        raise RuntimeError(f"terasort rep failed ({size}, {codec}): exit {out.returncode}")
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 

@@ -483,14 +483,8 @@ def q95(st, sales, returns):
 
 QUERIES = {"q5": q5, "q49": q49, "q75": q75, "q67": q67, "q64": q64, "q95": q95}
 
-#: CLI codec label → (ShuffleConfig codec, tpu_host_fallback). Labels are
-#: self-describing in artifacts: "tpu-hostpath" pins the no-chip host TLZ
-#: encode path (no fallback — the documented ~5x encode penalty), "tpu" is
-#: the deployment default (loud-warning SLZ fallback without a chip).
-CODEC_MODES = {
-    "tpu-hostpath": ("tpu", False),
-    "tpu": ("tpu", True),
-}
+from s3shuffle_tpu.config import CODEC_LABEL_MODES as CODEC_MODES  # noqa: E402
+# (shared with examples/terasort.py so both harnesses label modes identically)
 
 
 def run_query(name: str, sf: float, codec: str, workers: int, verify: bool,

@@ -113,13 +113,9 @@ def main() -> int:
     try:
         for rep in range(args.repeat):
             Dispatcher.reset()
-            # self-describing codec labels (same convention as sql_queries):
-            # tpu-hostpath pins the no-chip host TLZ path, tpu = deployment
-            # default (SLZ fallback + warning without a chip)
-            cfg_codec, fallback = {
-                "tpu-hostpath": ("tpu", False),
-                "tpu": ("tpu", True),
-            }.get(args.codec, (args.codec, True))
+            from s3shuffle_tpu.config import CODEC_LABEL_MODES
+
+            cfg_codec, fallback = CODEC_LABEL_MODES.get(args.codec, (args.codec, True))
             cfg = ShuffleConfig(
                 root_dir=root,
                 app_id=f"terasort-{rep}",

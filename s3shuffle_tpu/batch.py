@@ -251,23 +251,54 @@ class RecordBatch:
         kw = self._fixed_width(klens, "_kw")
         skip = 0
         prefix_covers_key = 0 <= kw <= 8
+        prefix = None
+        second_cols = None
         if kw > 8:
-            # start the prefix at the first column that actually differs:
-            # constant leading bytes (zero-padded decimals, shared date/URL
-            # heads) don't affect batch-local ordering. Column-by-column with
-            # early exit — high-entropy keys stop at column 0, and only the
-            # first kw-8 columns can matter (skip is capped there; all-equal
-            # keys then refine to identity through the packed index sort).
+            # Constant columns never affect batch-local ordering (zero-padded
+            # decimals, low-cardinality leading columns, zero high bytes of
+            # small ints — the structured-shuffle common case). Scan for the
+            # VARYING columns: ≤8 of them pack into one u64 whose order
+            # equals key order (→ single unstable argsort, identity
+            # refinement); ≤16 pack into two words (one stable two-key
+            # lexsort). Only beyond that fall back to the first-varying-
+            # column prefix + padded-string tie refinement. Packing by
+            # varying columns (not a contiguous window) is what keeps e.g.
+            # (small-int, small-int) 16-byte keys out of the string path —
+            # their 6 varying bytes straddle both words.
             mat = np.ascontiguousarray(self.keys).reshape(n, kw)
-            limit = kw - 8
-            skip = limit
-            for c in range(limit):
+            varying = []
+            for c in range(kw):
                 col = mat[:, c]
                 if (col != col[0]).any():
-                    skip = c
-                    break
-            prefix_covers_key = skip >= limit
-        prefix = self._key_prefix_u64(skip)
+                    varying.append(c)
+                    if len(varying) > 16:
+                        break
+            if not varying:
+                return np.arange(n, dtype=np.int64)  # all keys identical
+            second_cols = None
+            if len(varying) <= 8:
+                pre = np.zeros((n, 8), dtype=np.uint8)
+                pre[:, : len(varying)] = mat[:, varying]
+                prefix = pre.view(">u8").ravel().astype(np.uint64)
+                prefix_covers_key = True
+            elif len(varying) <= 16:
+                # first word = first 8 varying columns → the fast unstable
+                # argsort below; ties refine with the remaining columns
+                # (numeric, never the padded-string path) — see the
+                # second_cols refinement branch
+                pre = np.zeros((n, 8), dtype=np.uint8)
+                pre[:, :8] = mat[:, varying[:8]]
+                prefix = pre.view(">u8").ravel().astype(np.uint64)
+                second_cols = varying[8:]
+            else:
+                # >16 varying columns: first-varying-column prefix + the
+                # padded-string tie refinement. varying[0] IS the first
+                # differing column (< kw-16 here, so never past kw-8) —
+                # no rescan needed, and the prefix can't cover the key.
+                skip = varying[0]
+                prefix_covers_key = False
+        if prefix is None:
+            prefix = self._key_prefix_u64(skip)
         # UNSTABLE introsort: ~5x faster than numpy's stable radix on uint64.
         # Stability is restored below — within every equal-prefix group the
         # refinement key ends with the original row index.
@@ -289,6 +320,25 @@ class RecordBatch:
             # deterministic and exact.
             refined = np.argsort(
                 (gid[pos].astype(np.uint64) << 32) | sub.astype(np.uint64)
+            )
+        elif second_cols is not None:
+            if len(pos) > (n >> 2):
+                # heavy ties (low-entropy first word — e.g. a small-int
+                # leading column): per-tie refinement would re-sort most of
+                # the batch with three keys; ONE stable two-word lexsort over
+                # everything is cheaper. Ordering = (word0, word1) = the
+                # varying key bytes in order; lexsort stability gives
+                # insertion order on full ties.
+                w1 = np.zeros((n, 8), dtype=np.uint8)
+                w1[:, : len(second_cols)] = mat[:, second_cols]
+                return np.lexsort(
+                    (w1.view(">u8").ravel().astype(np.uint64), prefix)
+                )
+            # sparse ties: numeric second word over just the tied rows
+            w1s = np.zeros((len(pos), 8), dtype=np.uint8)
+            w1s[:, : len(second_cols)] = mat[np.ix_(sub, second_cols)]
+            refined = np.lexsort(
+                (sub, w1s.view(">u8").ravel().astype(np.uint64), gid[pos])
             )
         elif kmax <= 8:
             # equal prefix + ragged lens: shorter (zero-pad-prefix) key first,

@@ -22,6 +22,17 @@ logger = logging.getLogger("s3shuffle_tpu.config")
 
 MiB = 1024 * 1024
 
+#: Self-describing benchmark codec labels → (ShuffleConfig.codec,
+#: tpu_host_fallback). Shared by the terasort and SQL harnesses so their
+#: artifacts label identical modes identically: "tpu-hostpath" pins the
+#: no-chip host TLZ encode path (fallback disabled — the documented ~5x
+#: encode penalty, not a bug); "tpu" is the deployment default (loud-warning
+#: SLZ fallback without a chip, device path with one).
+CODEC_LABEL_MODES = {
+    "tpu-hostpath": ("tpu", False),
+    "tpu": ("tpu", True),
+}
+
 # Mapping from reference flag names (README.md:31-85) to our field names, kept
 # so configs written for the reference translate one-for-one.
 _REFERENCE_KEYS = {

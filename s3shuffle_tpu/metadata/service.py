@@ -567,8 +567,10 @@ class RemoteMapOutputTracker:
     def complete_task(
         self, stage_id: str, task_id, result, worker_id=None, map_output=None
     ) -> bool:
-        """``map_output``: optional ``[shuffle_id, map_id, location, sizes]``
-        registered atomically with acceptance (see TaskQueue.complete_task)."""
+        """``map_output``: optional ``[shuffle_id, map_id, location, sizes,
+        map_index]`` registered atomically with acceptance (see
+        TaskQueue.complete_task). All five elements are required — the
+        server rejects 4-element payloads (pre-format-2 clients)."""
         return self._call(
             "q_complete_task", stage_id, task_id, result, worker_id, map_output
         )
