@@ -78,6 +78,7 @@ from s3shuffle_tpu.structured import (  # noqa: E402
     make_batch,
     sort_shuffle_batches,
     split_batch,
+    window_group_limit,
 )
 
 N_MAPS = 4
@@ -216,11 +217,18 @@ def q49(st, sales, returns):
         (np.concatenate([sales["qty"], _zeros(nr)]),      # sold
          np.concatenate([_zeros(ns), returns["rq"]])),    # returned
     )
-    (item1, _order1), v1 = st.agg(_K2, joined, ("sum", "sum"))
+    # (item, order) groups have ≤ 2 rows (order is unique per sale) — the
+    # cogroup join key is ~unique, so map-side combine is skipped (r5)
+    (item1, _order1), v1 = st.agg(_K2, joined, ("sum", "sum"),
+                                  map_side_combine=False)
     hit = v1[:, 1] > 0  # inner join: only orders with a return
     per_item = make_batch(_K1, (item1[hit],), (v1[hit, 1], v1[hit, 0]))
     (item2,), v2 = st.agg(_K1, per_item, ("sum", "sum"))
     ratio = np.round(v2[:, 0] / v2[:, 1], 6)
+    # ORDER BY ratio LIMIT TOP_K → TakeOrderedAndProject-style prune (r5):
+    # only rows that can reach the worst-TOP_K tail survive the rank sort
+    keep = window_group_limit(_zeros(len(ratio)), ratio, TOP_K)
+    ratio, item2 = ratio[keep], item2[keep]
     rank_codec = KeyCodec("f64", "i64")
     ranked = st.sort(rank_codec, make_batch(rank_codec, (ratio, item2), ()), 0)
     flat_ratio = np.concatenate([kc[0] for kc, _ in ranked]) if ranked else np.empty(0)
@@ -262,7 +270,9 @@ def q75(st, sales, returns):
          np.concatenate([sales["qty"], _zeros(nr)]),    # sold
          np.concatenate([_zeros(ns), returns["rq"]])),  # returned
     )
-    (item1, _o), v1 = st.agg(_K2, joined, ("max", "sum", "sum"))
+    # ~unique (item, order) join key → no map-side combine (see q49)
+    (item1, _o), v1 = st.agg(_K2, joined, ("max", "sum", "sum"),
+                             map_side_combine=False)
     net = v1[:, 1] - v1[:, 2]
     per_year = make_batch(_K2, (v1[:, 0], item1), (net,))
     (year2, item2), v2 = st.agg(_K2, per_year, ("sum",))
@@ -302,15 +312,35 @@ def q67(st, sales, returns):
     """Top items per category: rollup sumsales by (category, item, store,
     month) — the item→category dimension is a broadcast map-side join
     (cat = item % 10) — then rank within category, keep TOP_K. Two stages
-    (aggregate + sort) with a vectorized streaming rank scan."""
-    cat = sales["item"] % 10
-    codec4 = KeyCodec("i64", "i64", "i64", "i64")
+    (aggregate + sort) with a vectorized streaming rank scan.
+
+    Plan optimizations (r5, semantics unchanged — ``--verify`` still checks
+    exact equality against the plain-Python reference):
+    - the category column is derivable (item % 10), so the rollup shuffles a
+      3-column key and re-derives cat post-aggregation (-20% key bytes);
+    - rollup groups are ~unique at scale (items × stores × months ≫ rows),
+      so map-side combine is skipped — an argsort per map task that merges
+      almost nothing (the planner-knows-cardinality call Spark makes when it
+      picks obj-hash aggregation over sort-agg);
+    - rank pushdown via :func:`window_group_limit` (Spark 3.5's
+      WindowGroupLimitExec): only rows that can reach rank ≤ TOP_K within
+      their category survive to the rank sort, collapsing the second shuffle
+      from every rolled-up group to ~TOP_K·n_categories rows."""
+    codec3 = KeyCodec("i64", "i64", "i64")
     rolled = make_batch(
-        codec4,
-        (cat, sales["item"], sales["store"], sales["month"]),
+        codec3,
+        (sales["item"], sales["store"], sales["month"]),
         (sales["qty"] * sales["price"],),
     )
-    (cat1, item1, store1, month1), v1 = st.agg(codec4, rolled, ("sum",))
+    (item1, store1, month1), v1 = st.agg(
+        codec3, rolled, ("sum",), map_side_combine=False
+    )
+    cat1 = item1 % 10
+    keep = window_group_limit(cat1, v1[:, 0], TOP_K)
+    cat1, item1, store1, month1 = (
+        cat1[keep], item1[keep], store1[keep], month1[keep],
+    )
+    v1 = v1[keep]
     codec5 = KeyCodec("i64", "i64", "i64", "i64", "i64")
     sort_in = make_batch(codec5, (cat1, -v1[:, 0], item1, store1, month1), ())
     batches = st.sort(codec5, sort_in, 0)
@@ -436,7 +466,9 @@ def q95(st, sales, returns):
          np.concatenate([sales["store"], _zeros(nr)]),    # store (max: sale's)
          np.concatenate([sales["qty"], _zeros(nr)])),     # qty
     )
-    (_order1,), v1 = st.agg(_K1, joined, ("sum", "max", "sum"))
+    # ~unique order semi-join key → no map-side combine (see q49)
+    (_order1,), v1 = st.agg(_K1, joined, ("sum", "max", "sum"),
+                            map_side_combine=False)
     hit = v1[:, 0] > 0  # semi-join: orders with at least one return
     per_store = make_batch(
         _K1, (v1[hit, 1],),

@@ -44,55 +44,81 @@ logger = logging.getLogger("s3shuffle_tpu.codec.tpu")
 
 
 #: process-wide backend-probe verdict (None = not probed yet). One probe
-#: per process: each TpuCodec instance re-paying the timeout — and leaking
-#: another thread parked on jax's init lock — would multiply the stall.
+#: thread per process: each TpuCodec instance re-probing — and leaking
+#: another thread parked on jax's init lock — would multiply the cost.
 #: Guarded by _PROBE_LOCK: all task-pool threads hit the first batch at
 #: once, and each would otherwise spawn its own probe thread.
 _BACKEND_VERDICT: bool | None = None
 _PROBE_LOCK = threading.Lock()
+_PROBE_RESULT: dict = {}
+_PROBE_THREAD: threading.Thread | None = None
+_PROBE_WAITED = False
 
 
-def _probe_device_backend() -> bool:
-    global _BACKEND_VERDICT
+def _probe_state() -> tuple:
+    """(device_now: bool, resolved: bool) — NON-BLOCKING chip discovery.
+
+    r4's q49 ``tpu-hostpath`` "80x outlier" was, on measurement, ~100% THIS
+    probe: the old implementation blocked the first compress batch for up to
+    20 s (S3SHUFFLE_BACKEND_PROBE_S) waiting on jax backend init, which
+    hangs outright when the TPU tunnel is down — the actual C TLZ encode at
+    SF1 is sub-second. The probe now never blocks the data plane: while the
+    verdict is pending the codec host-encodes (readers dispatch per frame's
+    codec_id, so frames legally mix), and batches switch to the device as
+    soon as the parked probe thread resolves — including mid-shuffle when a
+    flaky tunnel comes back. S3SHUFFLE_BACKEND_PROBE_S (default 0) remains
+    as an opt-in FIRST-call wait for runs that want device framing from
+    frame 0 (e.g. device micro-benches)."""
+    global _BACKEND_VERDICT, _PROBE_THREAD, _PROBE_WAITED
     import os
 
     # the env var is an explicit operator override — always honored, never
     # shadowed by an earlier probe's cached verdict
     env = os.environ.get("S3SHUFFLE_TPU_CODEC_DEVICE")
     if env is not None:
-        return env.strip().lower() in ("1", "true", "yes", "on")
+        return env.strip().lower() in ("1", "true", "yes", "on"), True
     if _BACKEND_VERDICT is not None:
-        return _BACKEND_VERDICT
+        return _BACKEND_VERDICT, True
+    # lock-free peek while pending: the write path polls this every batch
+    # during a tunnel hang, and must not serialize on _PROBE_LOCK to learn
+    # "still pending" (GIL-atomic dict read; the lock below only guards
+    # thread start / verdict publication)
+    if _PROBE_THREAD is not None and "backend" not in _PROBE_RESULT:
+        return False, False
     with _PROBE_LOCK:
         if _BACKEND_VERDICT is not None:  # double-checked under the lock
-            return _BACKEND_VERDICT
-        return _probe_device_backend_locked()
+            return _BACKEND_VERDICT, True
+        if _PROBE_THREAD is None:
+
+            def probe() -> None:
+                try:
+                    import jax
+
+                    _PROBE_RESULT["backend"] = jax.default_backend()
+                except Exception:
+                    _PROBE_RESULT["backend"] = None
+
+            _PROBE_THREAD = threading.Thread(
+                target=probe, name="s3shuffle-backend-probe", daemon=True
+            )
+            _PROBE_THREAD.start()
+        if not _PROBE_WAITED:
+            _PROBE_WAITED = True
+            try:
+                grace = float(os.environ.get("S3SHUFFLE_BACKEND_PROBE_S", "0"))
+            except ValueError:
+                grace = 0.0
+            if grace > 0:
+                _PROBE_THREAD.join(timeout=grace)
+        if "backend" in _PROBE_RESULT:
+            backend = _PROBE_RESULT["backend"]
+            _BACKEND_VERDICT = backend is not None and backend != "cpu"
+            return _BACKEND_VERDICT, True
+        return False, False  # still pending: host path for now
 
 
-def _probe_device_backend_locked() -> bool:
-    global _BACKEND_VERDICT
-    import os
-
-    try:
-        timeout = float(os.environ.get("S3SHUFFLE_BACKEND_PROBE_S", "20"))
-    except ValueError:
-        timeout = 20.0
-    result: dict = {}
-
-    def probe() -> None:
-        try:
-            import jax
-
-            result["backend"] = jax.default_backend()
-        except Exception:
-            result["backend"] = None
-
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    t.join(timeout=timeout)
-    backend = result.get("backend")  # None: failed OR still hung
-    _BACKEND_VERDICT = backend is not None and backend != "cpu"
-    return _BACKEND_VERDICT
+def _probe_device_backend() -> bool:
+    return _probe_state()[0]
 
 
 class TpuCodec(FrameCodec):
@@ -125,7 +151,13 @@ class TpuCodec(FrameCodec):
         #: directly testable.
         self.host_encode_fallback = host_encode_fallback
         self._fallback_codec = None
+        self._pending_delegate = None
         self._fallback_lock = threading.Lock()
+        #: per-thread record of the delegate the LAST compress call on this
+        #: thread routed through (None = TLZ). frame_from must stamp the
+        #: codec_id of the payload it is actually framing, and with the
+        #: probe-pending non-sticky delegation that can differ call-to-call.
+        self._tls = threading.local()
 
     def _device_path(self) -> bool:
         """Batch work goes to the device only when an accelerator backend is
@@ -134,43 +166,77 @@ class TpuCodec(FrameCodec):
         tpu-lz data are often plain CPU hosts. Overridable per instance
         (``use_device=``) or via S3SHUFFLE_TPU_CODEC_DEVICE=0/1.
 
-        The backend probe runs ONCE PER PROCESS in a daemon thread with a
-        timeout: on this rig the TPU sits behind a tunnel whose PJRT init
-        HANGS outright when the tunnel is down, and a shuffle must degrade
-        to the (fast) host C paths rather than block forever at the first
-        batch. A timed-out probe leaves that one thread parked inside
-        backend init — callers that import jax themselves afterwards (the
-        device-only helpers like :func:`fused_compress_and_checksum`) can
-        still block on jax's init lock; the shuffle data plane never does
-        once the verdict is host."""
-        if self._use_device is None:
-            self._use_device = _probe_device_backend()
-        return self._use_device
+        The backend probe runs ONCE PER PROCESS in a daemon thread and is
+        NON-BLOCKING (see :func:`_probe_state`): on this rig the TPU sits
+        behind a tunnel whose PJRT init HANGS outright when the tunnel is
+        down, and a shuffle must keep moving on the (fast) host C paths
+        rather than stall at the first batch. While the probe is pending
+        this returns False WITHOUT caching, so batches flip to the device
+        the moment the parked thread resolves. A hung probe leaves that one
+        thread parked inside backend init — callers that import jax
+        themselves afterwards (the device-only helpers like
+        :func:`fused_compress_and_checksum`) can still block on jax's init
+        lock; the shuffle data plane never does."""
+        if self._use_device is not None:
+            return self._use_device
+        verdict, resolved = _probe_state()
+        if resolved:
+            self._use_device = verdict
+        return verdict
 
     def _encode_delegate(self):
         """The SLZ codec encode should reroute to, or None to encode TLZ.
 
-        Decided once, stickily, at the first compress call: enabled fallback +
-        host probe verdict activates the delegate forever (readers dispatch on
-        each frame's codec_id, so a stream legally mixes SLZ frames after TLZ
-        ones — but a stable choice keeps ratios predictable)."""
+        Decided stickily at the first compress call AFTER the backend probe
+        resolves: enabled fallback + host verdict activates the delegate
+        forever (readers dispatch on each frame's codec_id, so a stream
+        legally mixes SLZ frames after TLZ ones — but a stable choice keeps
+        ratios predictable). While the probe is still PENDING the delegate
+        is used non-stickily, so a chip that answers mid-shuffle takes over
+        encode without this process being locked to SLZ."""
+        delegate = self._encode_delegate_inner()
+        self._tls.delegate = delegate
+        return delegate
+
+    def _encode_delegate_inner(self):
         if not self.host_encode_fallback:
             return None
-        if self._fallback_codec is None:
+        if self._fallback_codec is not None:  # sticky choice already made
+            return self._fallback_codec
+        verdict, resolved = (
+            (self._use_device, True)
+            if self._use_device is not None
+            else _probe_state()
+        )
+        if verdict:
+            self.host_encode_fallback = False  # chip attached: TLZ on device
+            return None
+        delegate = self._pending_delegate
+        if delegate is None:
             with self._fallback_lock:
-                if self._fallback_codec is not None or not self.host_encode_fallback:
+                if self._fallback_codec is not None:
                     return self._fallback_codec
-                if self._device_path():
-                    self.host_encode_fallback = False  # chip attached: TLZ on device
-                    return None
-                try:
-                    from s3shuffle_tpu.codec.native import NativeLZCodec
+                delegate = self._pending_delegate
+                if delegate is None:
+                    try:
+                        from s3shuffle_tpu.codec.native import NativeLZCodec
 
-                    self._fallback_codec = NativeLZCodec(block_size=self.block_size)
-                except Exception:
-                    # no native lib either — host TLZ encode is all we have
-                    self.host_encode_fallback = False
-                    return None
+                        delegate = NativeLZCodec(block_size=self.block_size)
+                    except Exception:
+                        # no native lib either — host TLZ is all we have
+                        self.host_encode_fallback = False
+                        return None
+                    self._pending_delegate = delegate
+                    if not resolved:
+                        logger.info(
+                            "codec=tpu: accelerator probe still pending — "
+                            "rerouting writes to SLZ frames until it resolves"
+                        )
+        if not resolved:
+            return delegate  # reroute THIS batch, leave the decision open
+        with self._fallback_lock:
+            if self._fallback_codec is None:
+                self._fallback_codec = delegate
                 logger.warning(
                     "codec=tpu selected but no accelerator backend is attached "
                     "(tunnel down or CPU-only host): rerouting shuffle WRITES to "
@@ -182,10 +248,16 @@ class TpuCodec(FrameCodec):
         return self._fallback_codec
 
     def frame_from(self, raw: bytes, compressed: bytes) -> bytes:
-        if self._fallback_codec is not None and self.host_encode_fallback:
-            # frames must carry the codec_id of the payloads the delegate
-            # produced (compress_* always ran first, so the choice is made)
-            return self._fallback_codec.frame_from(raw, compressed)
+        # frames must carry the codec_id of the payloads the compress call
+        # on THIS thread actually produced (compress_* always runs first and
+        # records its routing; see _tls in __init__)
+        delegate = getattr(self._tls, "delegate", None)
+        if delegate is not None:
+            # trust the thread-local record alone: shared flags (e.g.
+            # host_encode_fallback flipped by a concurrent probe resolution)
+            # must not re-route framing of payloads this thread already
+            # compressed through the delegate
+            return delegate.frame_from(raw, compressed)
         return super().frame_from(raw, compressed)
 
     # --- single block (host path: C encoder, numpy fallback/oracle) ---

@@ -162,6 +162,39 @@ def split_batch(batch: RecordBatch, n_parts: int) -> List[RecordBatch]:
     return [batch.slice_rows(bounds[i], bounds[i + 1]) for i in range(n_parts)]
 
 
+def window_group_limit(
+    group: np.ndarray, order: np.ndarray, k: int, largest: bool = True
+) -> np.ndarray:
+    """Boolean mask of rows that can reach rank ≤ ``k`` within their group
+    when rows are ranked by ``order`` (descending when ``largest``).
+
+    This is the rank-pushdown filter Spark 3.5 applies before the window
+    shuffle (``WindowGroupLimitExec``): any row whose order value is strictly
+    beyond the group's k-th best cannot rank ≤ k regardless of tie-breaking,
+    so it is pruned before the expensive sort. Rows tied AT the k-th value
+    are all kept — the downstream full-tiebreak sort resolves them — so the
+    surviving rows' ranks equal their ranks in the unpruned input.
+    """
+    group = np.asarray(group)
+    n = len(group)
+    if k <= 0:
+        return np.zeros(n, dtype=bool)
+    if n == 0:
+        return np.zeros(0, dtype=bool)
+    vals = np.asarray(order) if largest else -np.asarray(order)
+    # one lexsort pass: rows grouped, each group's values descending — the
+    # k-th best per group is then a direct index, O(n log n) regardless of
+    # group cardinality (a per-group scan would be O(groups * n))
+    idx = np.lexsort((-vals, group))
+    gs, vs = group[idx], vals[idx]
+    starts = np.flatnonzero(np.r_[True, gs[1:] != gs[:-1]])
+    sizes = np.diff(np.r_[starts, n])
+    kth = vs[starts + np.minimum(k, sizes) - 1]  # per-group k-th best value
+    keep = np.empty(n, dtype=bool)
+    keep[idx] = vs >= np.repeat(kth, sizes)
+    return keep
+
+
 # ----------------------------------------------------------------------------
 # Context-level typed operations
 # ----------------------------------------------------------------------------

@@ -109,3 +109,36 @@ def test_sort_shuffle_global_order(tmp_path):
     assert len(flat) == 20000
     assert (np.diff(flat) >= 0).all()
     assert np.array_equal(np.sort(k), flat)
+
+
+def test_window_group_limit_matches_full_rank():
+    """Pruned-set ranks must equal full-set ranks: every row with true rank
+    <= k survives, no surviving row's rank changes (the WindowGroupLimit
+    contract), and ties at the k-th value are all kept."""
+    from s3shuffle_tpu.structured import window_group_limit
+
+    rng = np.random.default_rng(5)
+    group = rng.integers(0, 7, 5000)
+    order = rng.integers(0, 40, 5000)  # few distinct values -> heavy ties
+    k = 3
+    keep = window_group_limit(group, order, k)
+    for g in np.unique(group):
+        m = group == g
+        vals = order[m]
+        kept_vals = order[m & keep]
+        thresh = np.sort(vals)[::-1][k - 1] if len(vals) > k else vals.min()
+        # all rows at-or-above the k-th value kept, all below dropped
+        assert (kept_vals >= thresh).all()
+        assert set(kept_vals.tolist()) == set(
+            v for v in vals.tolist() if v >= thresh
+        )
+    # smallest=True mirror
+    keep_s = window_group_limit(group, order, k, largest=False)
+    for g in np.unique(group):
+        m = group == g
+        vals = order[m]
+        thresh = np.sort(vals)[k - 1] if len(vals) > k else vals.max()
+        assert (order[m & keep_s] <= thresh).all()
+    # degenerate cases
+    assert not window_group_limit(group, order, 0).any()
+    assert window_group_limit(np.array([1, 1]), np.array([5, 5]), 10).all()
