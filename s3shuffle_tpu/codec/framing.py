@@ -143,19 +143,23 @@ class CodecOutputStream(io.RawIOBase):
         return True
 
     def write(self, b) -> int:
-        data = bytes(b)
-        self._buf.extend(data)
+        # buffer-protocol append, NOT bytes(b): serializers hand memoryviews
+        # of whole columns here, and an eager bytes() copy was a full extra
+        # pass over every shuffled byte (r5 profile)
+        before = len(self._buf)
+        self._buf += b if isinstance(b, (bytes, bytearray, memoryview)) else memoryview(b)
+        written = len(self._buf) - before
         bs = self._codec.block_size
         if self._framed is not None:
             if len(self._buf) >= bs * self._batch_blocks:
                 self._emit_framed(len(self._buf) // bs)
-            return len(data)
+            return written
         while len(self._buf) >= bs:
             self._pending.append(bytes(self._buf[:bs]))
             del self._buf[:bs]
             if len(self._pending) >= self._batch_blocks:
                 self._emit_pending()
-        return len(data)
+        return written
 
     def _emit_framed(self, n_blocks: int) -> None:
         bs = self._codec.block_size

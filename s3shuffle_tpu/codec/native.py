@@ -78,6 +78,11 @@ def _load() -> ctypes.CDLL:
         lib.slz_gather_fixed.argtypes = [
             u8p, ctypes.c_size_t, ctypes.c_int64, i64p, ctypes.c_int64, u8p,
         ]
+        lib.slz_gather_fixed_segmented.restype = None
+        lib.slz_gather_fixed_segmented.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), i32p, i64p,
+            ctypes.c_int64, ctypes.c_int64, u8p,
+        ]
         lib.slz_compress_framed.restype = ctypes.c_int64
         lib.slz_compress_framed.argtypes = [
             u8p, ctypes.c_int64, ctypes.c_int64, ctypes.c_uint8, u8p,
@@ -119,12 +124,16 @@ def native_available() -> bool:
         return False
 
 
-def native_crc32c(data: bytes, value: int = 0) -> int:
+def native_crc32c(data, value: int = 0) -> int:
+    """``data`` is any C-contiguous buffer (bytes, memoryview, ndarray) —
+    the write path hands zero-copy views here."""
     lib = _load()
-    if not data:
+    arr = np.frombuffer(data, dtype=np.uint8)
+    if not len(arr):
         return value
-    buf = ctypes.cast(ctypes.c_char_p(data), ctypes.POINTER(ctypes.c_uint8))
-    return lib.slz_crc32c(buf, len(data), value)
+    return lib.slz_crc32c(
+        arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(arr), value
+    )
 
 
 def native_ragged_gather(
@@ -170,6 +179,32 @@ def native_gather_fixed(buf: np.ndarray, row_len: int, idx: np.ndarray) -> np.nd
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
     )
     return out[:total]
+
+
+def native_gather_fixed_segmented(
+    srcs, row_len: int, seg: np.ndarray, local: np.ndarray
+) -> np.ndarray:
+    """Gather fixed-width rows from MANY contiguous uint8 source buffers in
+    one pass: output row i = srcs[seg[i]][local[i]*row_len :][:row_len].
+    Every source must be C-contiguous uint8 (decoded frames and batch
+    columns are). Unlike :func:`native_gather_fixed` the output is exactly
+    sized (the segmented kernel never overshoots)."""
+    lib = _load()
+    seg = np.ascontiguousarray(seg, dtype=np.int32)
+    local = np.ascontiguousarray(local, dtype=np.int64)
+    ptrs = (ctypes.c_void_p * len(srcs))(
+        *(a.ctypes.data for a in srcs)
+    )
+    out = np.empty(len(seg) * row_len, dtype=np.uint8)
+    lib.slz_gather_fixed_segmented(
+        ptrs,
+        seg.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        local.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        row_len,
+        len(seg),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    return out
 
 
 def native_adler32(data: bytes, value: int = 1) -> int:

@@ -150,14 +150,16 @@ class PartitionWriter(io.RawIOBase):
         return True
 
     def write(self, b) -> int:
-        data = bytes(b)
-        if data:
+        # no bytes(b) copy: every partition byte flows through here once at
+        # commit, and checksum/stream layers all take buffer-protocol input
+        n = b.nbytes if isinstance(b, memoryview) else len(b)
+        if n:
             stream = self._parent._init_stream()
-            stream.write(data)
+            stream.write(b)
             if self._checksum is not None:
-                self._checksum.update(data)
-            self._count += len(data)
-        return len(data)
+                self._checksum.update(b)
+            self._count += n
+        return n
 
     @property
     def bytes_written(self) -> int:

@@ -67,6 +67,34 @@ class _PartitionPipeline:
         self.sink.truncate(0)
         return data
 
+    def spill_into(self, f) -> int:
+        """Flush to a frame boundary and append the buffered bytes to ``f``
+        WITHOUT materializing them (getbuffer, not getvalue — the spill path
+        moves every over-budget byte, and the getvalue copy was a full pass;
+        r5 profile). Returns the byte count written."""
+        self.record_writer.flush()
+        if self.codec_stream is not None:
+            self.codec_stream.flush_block()
+        view = self.sink.getbuffer()
+        n = len(view)
+        if n:
+            f.write(view)
+        view.release()  # BytesIO refuses truncate while a buffer is exported
+        self.sink.seek(0)
+        self.sink.truncate(0)
+        return n
+
+    def finalize_into(self, writer) -> None:
+        """Close the pipeline and stream its remaining bytes into ``writer``
+        (same zero-materialization contract as :meth:`spill_into`)."""
+        self.record_writer.close()
+        if self.codec_stream is not None:
+            self.codec_stream.close()
+        view = self.sink.getbuffer()
+        if len(view):
+            writer.write(view)
+        view.release()
+
     def finalize(self) -> bytes:
         self.record_writer.close()
         if self.codec_stream is not None:
@@ -288,11 +316,10 @@ class ShuffleMapWriter(MapWriterBase):
             self._spill_fd = os.fdopen(fd, "wb+")
         f = self._spill_fd
         for pipeline in self._pipelines:
-            data = pipeline.flush_to_frame_boundary()
-            if data:
-                offset = f.tell()
-                f.write(data)
-                pipeline.spill_segments.append((offset, len(data)))
+            offset = f.tell()
+            n = pipeline.spill_into(f)
+            if n:
+                pipeline.spill_segments.append((offset, n))
         self.spill_count += 1
         logger.info(
             "Map %d spilled to %s (spill #%d)", self.map_id, self._spill_file, self.spill_count
@@ -311,11 +338,9 @@ class ShuffleMapWriter(MapWriterBase):
             self._write_batches(self._combine_reducer.results())
             self._combine_reducer = None
         for pid, pipeline in enumerate(self._pipelines):
-            final = pipeline.finalize()
             writer = self.output_writer.get_partition_writer(pid)
             for offset, length in pipeline.spill_segments:
                 self._copy_spill_range(writer, offset, offset + length)
-            if final:
-                writer.write(final)
+            pipeline.finalize_into(writer)
             writer.close()
         return self._register_commit()

@@ -148,7 +148,7 @@ def test_batch_sorter_spills_and_merges():
     out = list(sorter.sorted_records())
     assert [k for k, _ in out] == sorted(k for k, _ in records)
     assert sorted(out) == sorted(records)
-    assert sorter._spills == []  # cleaned up
+    assert sorter._files == [] and sorter._tmp_runs == []  # cleaned up
 
 
 def test_columnar_serializer_stream_roundtrip():
