@@ -460,24 +460,48 @@ def write_cpu_comparison(parts):
     for p in parts:
         write_frame(buf, p)
     payload = buf.getvalue()
-    out = {}
-    times = {}
-    for name in ("native", "lz4", "zlib"):
+    names = ("native", "lz4", "zlib")
+    codecs = {}
+    for name in names:
         try:
-            codec = get_codec(name)
+            codecs[name] = get_codec(name)
         except Exception:
             return {}  # no native toolchain: omit the gate extras, keep benching
-        best = float("inf")
-        compressed = b""
-        for _ in range(3):
+    # Parity methodology (VERDICT r4 ask #8): the r4 artifact's 0.92-1.0
+    # drift was host load hitting codecs measured seconds apart. Reps are
+    # INTERLEAVED (each rep times every codec back to back) and the reported
+    # speedups are the MEDIAN of the per-rep ratios — ratios taken within a
+    # rep share the same instantaneous load, so drift cancels pairwise
+    # instead of penalizing whichever codec ran during the spike.
+    reps = 5
+    times: dict = {name: [] for name in names}
+    sizes: dict = {}
+    for _rep in range(reps):
+        for name in names:
             t0 = time.perf_counter()
-            compressed = codec.compress_bytes(payload)
-            best = min(best, time.perf_counter() - t0)
-        times[name] = best
-        out[f"{name}_compress_mb_s"] = round(len(payload) / 1e6 / best, 1)
-        out[f"{name}_payload_ratio"] = round(len(payload) / len(compressed), 3)
-    out["write_cpu_speedup_vs_zlib"] = round(times["zlib"] / times["native"], 2)
-    out["write_cpu_speedup_vs_lz4"] = round(times["lz4"] / times["native"], 2)
+            compressed = codecs[name].compress_bytes(payload)
+            times[name].append(time.perf_counter() - t0)
+            sizes[name] = len(compressed)
+    import statistics
+
+    out = {}
+    for name in names:
+        out[f"{name}_compress_mb_s"] = round(
+            len(payload) / 1e6 / statistics.median(times[name]), 1
+        )
+        out[f"{name}_payload_ratio"] = round(len(payload) / sizes[name], 3)
+    for other in ("zlib", "lz4"):
+        ratios = sorted(
+            t_o / t_n for t_o, t_n in zip(times[other], times["native"])
+        )
+        out[f"write_cpu_speedup_vs_{other}"] = round(statistics.median(ratios), 2)
+        out[f"write_cpu_speedup_vs_{other}_spread"] = [
+            round(ratios[0], 2), round(ratios[-1], 2)
+        ]
+    out["parity_method"] = (
+        f"median of {reps} interleaved per-rep ratios (same-instant pairs "
+        "cancel host load drift)"
+    )
     return out
 
 
@@ -840,6 +864,74 @@ def _device_kernel_rates_impl():
     return out
 
 
+def prefetch_adaptive_gain(n_blocks: int = 120, delay_s: float = 0.02):
+    """Does the adaptive prefetcher actually adapt? (VERDICT r4 ask #5.)
+
+    A many-block shuffle is read twice through the REAL read plane against a
+    store with ``delay_s`` injected per GET (storage.fault.LatencyRule — the
+    S3-shaped case the hill-climb exists for): once pinned to 1 thread, once
+    with the ThreadPredictor free to climb. Reports the wall ratio and the
+    thread count the climb reached. Runs in ~4s; latency dominates CPU, so
+    the ratio is stable even on a loaded host."""
+    import random as _random
+    import tempfile as _tempfile
+
+    from s3shuffle_tpu.config import ShuffleConfig
+    from s3shuffle_tpu.dependency import HashPartitioner, ShuffleDependency
+    from s3shuffle_tpu.shuffle import ShuffleContext
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+    from s3shuffle_tpu.storage.fault import FlakyBackend, LatencyRule
+
+    root = None
+    ctx = None
+    try:
+        root = _tempfile.mkdtemp(prefix="s3shuffle-bench-prefetch-")
+        Dispatcher.reset()
+        ctx = ShuffleContext(
+            config=ShuffleConfig(
+                root_dir=f"file://{root}", app_id="bench-prefetch", cleanup=False
+            ),
+            num_workers=2,
+        )
+        sid = next(ctx._next_shuffle_id)
+        dep = ShuffleDependency(sid, HashPartitioner(1))
+        handle = ctx.manager.register_shuffle(sid, dep)
+        rng = _random.Random(7)
+        for m in range(n_blocks):
+            w = ctx.manager.get_writer(handle, m)
+            w.write([(rng.randbytes(8), rng.randbytes(48)) for _ in range(20)])
+            w.stop(success=True)
+        disp = ctx.manager.dispatcher
+        disp.backend = FlakyBackend(
+            disp.backend, latency=[LatencyRule("read", match=".data", delay_s=delay_s)]
+        )
+
+        def drain(max_threads: int):
+            disp.config.max_concurrency_task = max_threads
+            pf = ctx.manager.get_reader(handle, 0, 1)._make_prefetcher()
+            t0 = time.perf_counter()
+            for item in pf:
+                item.readall()
+                item.close()
+            return time.perf_counter() - t0, pf.stats["threads"]
+
+        wall_1t, _ = drain(1)
+        wall_ad, threads = drain(6)
+        return {
+            "prefetch_adaptive_speedup": round(wall_1t / wall_ad, 2),
+            "prefetch_adaptive_threads": threads,
+            "prefetch_adaptive_latency_ms": delay_s * 1e3,
+            "prefetch_adaptive_blocks": n_blocks,
+        }
+    except Exception as e:  # never fail the bench over this row
+        return {"prefetch_adaptive_error": str(e)[:120]}
+    finally:
+        if ctx is not None:
+            ctx.stop()
+        if root is not None:
+            shutil.rmtree(root, ignore_errors=True)
+
+
 def main():
     parts = gen_partitions()
     bps, walls, ratios = run_comparison(parts)
@@ -853,6 +945,7 @@ def main():
         ),
         **aggregate_multiworker(parts),
         **wide_shuffle_comparison(),
+        **prefetch_adaptive_gain(),
         **load_calibration(),
         **device_kernel_rates(),
     }

@@ -102,6 +102,17 @@ class ShuffleChecksumBlockId(BlockId):
 
 
 _INDEX_RE = re.compile(r"^shuffle_(\d+)_(\d+)_(\d+)\.index$")
+_ANY_RE = re.compile(r"^shuffle_(\d+)_(\d+)_(\d+)\.(data|index|checksum\..+)$")
+
+
+def parse_shuffle_object_name(name: str):
+    """Parse ANY shuffle object name (data/index/checksum) back to
+    ``(shuffle_id, map_id)``, or None for non-shuffle objects — the orphan
+    sweep classifies every listed object by its attempt-unique map_id."""
+    m = _ANY_RE.match(name.rsplit("/", 1)[-1])
+    if m is None:
+        return None
+    return int(m.group(1)), int(m.group(2))
 
 
 def parse_index_name(name: str) -> ShuffleIndexBlockId | None:

@@ -242,6 +242,17 @@ class DistributedDriver:
             ],
         )
         self._wait_stage(map_stage)
+        # Orphan sweep (VERDICT r4 ask #7): a map worker that died mid-write
+        # never registered, so its attempt-unique objects are invisible to
+        # the tracker but still occupy the store; reclaim them as soon as
+        # the winner set is final instead of waiting for unregister_shuffle.
+        try:
+            self.dispatcher.sweep_orphan_attempts(
+                shuffle_id, self.server.tracker.registered_map_ids(shuffle_id)
+            )
+        except Exception:
+            logger.warning("orphan sweep failed for shuffle %d", shuffle_id,
+                           exc_info=True)
 
         out_paths = [self._scratch(shuffle_id, f"output_{r}") for r in range(dep.num_partitions)]
         reduce_stage = f"shuffle{shuffle_id}-reduce"

@@ -84,6 +84,8 @@ class MapOutputTrackerLike(Protocol):
 
     def unregister_shuffle(self, shuffle_id: int) -> None: ...
 
+    def registered_map_ids(self, shuffle_id: int) -> List[int]: ...
+
     def shuffle_ids(self) -> List[int]: ...
 
 
@@ -146,6 +148,15 @@ class MapOutputTracker:
                 ]
                 out.append((status.map_id, sizes))
             return out
+
+    def registered_map_ids(self, shuffle_id: int) -> List[int]:
+        """The attempt-unique map_ids of every REGISTERED (committed) map
+        output — the winner set the orphan sweep keeps (any same-shuffle
+        object with a different map_id is a dead attempt's leak)."""
+        with self._lock:
+            if shuffle_id not in self._shuffles:
+                raise KeyError(f"Shuffle {shuffle_id} not registered")
+            return sorted(self._shuffles[shuffle_id].keys())
 
     def unregister_shuffle(self, shuffle_id: int) -> None:
         with self._lock:

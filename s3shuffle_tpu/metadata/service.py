@@ -413,6 +413,8 @@ class _Handler(socketserver.BaseRequestHandler):
             return tracker.num_partitions(int(a[0]))
         if method == "unregister_shuffle":
             return tracker.unregister_shuffle(int(a[0]))
+        if method == "registered_map_ids":
+            return tracker.registered_map_ids(int(a[0]))
         if method == "shuffle_ids":
             return tracker.shuffle_ids()
         raise RuntimeError(f"Unknown method: {method}")
@@ -553,6 +555,9 @@ class RemoteMapOutputTracker:
 
     def unregister_shuffle(self, shuffle_id: int) -> None:
         self._call("unregister_shuffle", shuffle_id)
+
+    def registered_map_ids(self, shuffle_id: int) -> List[int]:
+        return [int(x) for x in self._call("registered_map_ids", shuffle_id)]
 
     def shuffle_ids(self) -> List[int]:
         return [int(x) for x in self._call("shuffle_ids")]

@@ -63,6 +63,41 @@ class FaultRule:
             raise self.exc(path)
 
 
+class LatencyRule:
+    """Delay operations of ``op`` whose path contains ``match`` by
+    ``delay_s`` seconds per call — models a high-RTT object store (S3
+    cross-region GETs) so the ADAPTIVE behaviors (prefetch hill-climb)
+    can be exercised, not just failure paths. ``times`` bounds how many
+    calls are delayed (None = every matching call)."""
+
+    def __init__(
+        self,
+        op: str,
+        match: str = "",
+        delay_s: float = 0.01,
+        times: Optional[int] = None,
+    ):
+        if op not in OPS:
+            raise ValueError(f"unknown op {op!r}; one of {OPS}")
+        self.op = op
+        self.match = match
+        self.delay_s = delay_s
+        self.times = times
+        self.hits = 0
+        self._lock = threading.Lock()
+
+    def maybe_delay(self, op: str, path: str) -> None:
+        if op != self.op or self.match not in path:
+            return
+        with self._lock:
+            if self.times is not None and self.hits >= self.times:
+                return
+            self.hits += 1
+        import time
+
+        time.sleep(self.delay_s)
+
+
 class _FlakyReader(RangedReader):
     def __init__(self, inner: RangedReader, path: str, check: Callable[[str, str], None]):
         self._inner = inner
@@ -108,9 +143,15 @@ class _FlakyWriteStream(io.RawIOBase):
 class FlakyBackend(StorageBackend):
     """Wraps ``inner``, raising per :class:`FaultRule` before delegating."""
 
-    def __init__(self, inner: StorageBackend, rules: Optional[List[FaultRule]] = None):
+    def __init__(
+        self,
+        inner: StorageBackend,
+        rules: Optional[List[FaultRule]] = None,
+        latency: Optional[List[LatencyRule]] = None,
+    ):
         self.inner = inner
         self.rules: List[FaultRule] = list(rules or [])
+        self.latency: List[LatencyRule] = list(latency or [])
         self.calls: Dict[str, int] = {op: 0 for op in OPS}
         self.scheme = inner.scheme
         self.supports_rename = inner.supports_rename
@@ -119,10 +160,16 @@ class FlakyBackend(StorageBackend):
         self.rules.append(rule)
         return rule
 
+    def add_latency(self, rule: LatencyRule) -> LatencyRule:
+        self.latency.append(rule)
+        return rule
+
     def _check(self, op: str, path: str) -> None:
         self.calls[op] = self.calls.get(op, 0) + 1
         for rule in self.rules:
             rule.maybe_raise(op, path)
+        for lat in self.latency:
+            lat.maybe_delay(op, path)
 
     # ------------------------------------------------------------------
     def create(self, path: str) -> BinaryIO:

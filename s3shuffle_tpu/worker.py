@@ -136,9 +136,26 @@ class WorkerAgent:
         self.worker_id = worker_id or f"{socket.gethostname()}-{os.getpid()}"
         self.manager = ShuffleManager(config=self.config, tracker=self.client)
         self.tasks_run = 0
-        # refuse to join a coordinator speaking a different shuffle wire
-        # format — mixed versions mis-partition silently (see version.py)
-        self.client.check_format()
+        # Refuse to join a coordinator speaking a different shuffle wire
+        # format — mixed versions mis-partition silently (see version.py).
+        # The initial connect RETRIES with backoff: worker pods routinely
+        # come up before the coordinator binds (the deploy dry-run exposed
+        # exactly this crash-loop), and dying on a transient refusal defeats
+        # the pull-based fleet design. A format MISMATCH still raises
+        # immediately — that is a deployment error, not a race.
+        deadline = time.monotonic() + float(
+            os.environ.get("S3SHUFFLE_WORKER_CONNECT_TIMEOUT_S", "60")
+        )
+        delay = 0.2
+        while True:
+            try:
+                self.client.check_format()
+                break
+            except OSError:  # incl. ConnectionError/TimeoutError subclasses
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(delay)
+                delay = min(delay * 2, 2.0)
 
     # -- task kinds ----------------------------------------------------
     def _commit_allowed(self, stage_id: str, task: dict) -> bool:

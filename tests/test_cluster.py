@@ -454,3 +454,95 @@ def test_worker_metrics_colliding_counter_names_dedup(tmp_path):
         assert 's3shuffle_dedup_check{worker="w-dedup"} 7.0' in body
     finally:
         svc.stop()
+
+
+def test_orphan_sweep_reclaims_dead_attempt_objects(tmp_path):
+    """VERDICT r4 ask #7: a map worker that dies MID-WRITE leaks its
+    attempt-unique store objects (it never registers, so only the final
+    prefix delete would reclaim them). The driver's post-map-stage orphan
+    sweep must remove every non-winner object while the stage's winners'
+    objects stay intact — asserted BEFORE unregister/shutdown."""
+    import dataclasses
+    import multiprocessing as mp
+
+    from s3shuffle_tpu.batch import RecordBatch
+    from s3shuffle_tpu.block_ids import parse_shuffle_object_name
+    from s3shuffle_tpu.cluster import DistributedDriver
+    from s3shuffle_tpu.metadata.service import RemoteMapOutputTracker
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+    from s3shuffle_tpu.worker import WorkerAgent
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/store", app_id="orphan-test", codec="zlib"
+    )
+    rng = random.Random(6)
+    recs = [(rng.randbytes(8), rng.randbytes(24)) for _ in range(2000)]
+    batches = [RecordBatch.from_records(recs[i::2]) for i in range(2)]
+
+    driver = DistributedDriver(cfg)
+    driver.task_lease_s = 3.0
+    sid = driver._next_shuffle_id
+    thief = RemoteMapOutputTracker(driver.coordinator_address)
+    leaked = {}
+
+    def die_mid_write():
+        import time as _t
+
+        for _ in range(200):
+            t = thief.take_task("doomed-worker")
+            if t["action"] == "run":
+                task = t["task"]
+                # the attempt's data object lands in the store, then the
+                # worker "dies": no index, no commit, no fail report
+                map_id = (
+                    int(task["map_id"]) * WorkerAgent.ATTEMPT_STRIDE
+                    + int(task.get("_attempt", 1)) - 1
+                )
+                from s3shuffle_tpu.block_ids import ShuffleDataBlockId
+
+                path = driver.dispatcher.get_path(ShuffleDataBlockId(sid, map_id))
+                with driver.dispatcher.backend.create(path) as sink:
+                    sink.write(b"partial bytes of a dead attempt")
+                leaked["map_id"] = map_id
+                leaked["path"] = path
+                return
+            _t.sleep(0.02)
+
+    import threading
+
+    t = threading.Thread(target=die_mid_write, daemon=True)
+    t.start()
+
+    ctx = mp.get_context("spawn")
+    worker = ctx.Process(
+        target=_agent_main,
+        args=(list(driver.coordinator_address), dataclasses.asdict(cfg), "live", 0.5),
+        daemon=True,
+    )
+    worker.start()
+    try:
+        out = driver.run_sort_shuffle(batches, num_partitions=3)
+        assert sum(b.n for b in out) == 2000
+        t.join(timeout=5)
+        assert "map_id" in leaked, "the doomed worker never got a task"
+        # the sweep ran inside run_sort_shuffle after the map stage: only
+        # winner objects may remain in the store
+        winners = set(driver.server.tracker.registered_map_ids(sid))
+        assert leaked["map_id"] not in winners
+        assert not driver.dispatcher.backend.exists(leaked["path"])
+        survivors = []
+        for prefix in driver.dispatcher.root_prefixes():
+            for st in driver.dispatcher.backend.list_prefix(
+                f"{prefix}/{driver.dispatcher.app_id}/{sid}"
+            ):
+                parsed = parse_shuffle_object_name(st.path)
+                if parsed is not None and parsed[0] == sid:
+                    survivors.append(parsed[1])
+        assert survivors and set(survivors) <= winners
+    finally:
+        thief.close()
+        driver.shutdown()
+        worker.join(timeout=10)
+        if worker.is_alive():
+            worker.terminate()
