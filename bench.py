@@ -174,6 +174,10 @@ def tpu_codec_ratio_run(parts):
         Dispatcher.reset()
         ctx, root = _make_ctx("tpu", min(4, os.cpu_count() or 1))
         try:
+            # warmup first: the native-codec walls this is read against are
+            # best-of-5 after warmup (run_comparison), so a cold single run
+            # here overstated the hostpath cost ~2x (codec/dispatcher init)
+            _timed_shuffle(ctx, parts, cleanup=True)
             wall, out = _timed_shuffle(ctx, parts, cleanup=False)
             _validate(out)
             stored = _tree_bytes(root)

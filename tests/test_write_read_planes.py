@@ -496,16 +496,32 @@ def test_adaptive_prefetch_scales_up_on_slow_store(tmp_path):
         ctx.stop()
 
 
-def test_adaptive_prefetch_stays_low_on_fast_store(tmp_path):
+def test_adaptive_prefetch_converges_to_one_on_flat_landscape():
+    """The fast-store half of the adaptive claim, at the layer where it is
+    DETERMINISTIC: when every thread count measures the same wait (a fast /
+    near-zero-latency store), the climb explores each count once, walks back
+    down (ties prefer fewer threads), and then HOLDS 1 thread — it does not
+    park at the ceiling. (An integration endpoint assertion here is
+    inherently flaky: a finite drain can end mid-exploration; the reference
+    predictor has the same walk, S3BufferedPrefetchIterator.scala:32-69.)"""
+    p = ThreadPredictor(max_threads=6)
+    endpoints = []
+    for i in range(RING_SIZE * 40):
+        t = p.add_measurement_and_predict(1_000)
+        if i % RING_SIZE == RING_SIZE - 1:
+            endpoints.append(t)
+    # explored the range once, then settled
+    assert max(endpoints) == 6
+    assert endpoints[-20:] == [1] * 20
+
+
+def test_adaptive_prefetch_fast_store_drain_is_correct(tmp_path):
     ctx, handle, n_maps = _many_map_shuffle(tmp_path)
     disp = ctx.manager.dispatcher
     try:
         disp.config.max_concurrency_task = 6
         _wall, pf, n = _timed_drain(ctx, handle)
-        assert n == n_maps
-        # a near-zero-latency store gives the climb no gradient to ride to
-        # the ceiling and hold it there: the final TARGET must be off the
-        # max even though exploration may have touched it transiently
-        assert pf._predictor.current < 6
+        assert n == n_maps  # the climb never loses or duplicates blocks
+        assert 1 <= pf.stats["threads"] <= 6
     finally:
         ctx.stop()
