@@ -177,14 +177,43 @@ def window_group_limit(
     """
     group = np.asarray(group)
     n = len(group)
-    if k <= 0:
+    if k <= 0 or n == 0:
         return np.zeros(n, dtype=bool)
-    if n == 0:
-        return np.zeros(0, dtype=bool)
     vals = np.asarray(order) if largest else -np.asarray(order)
-    # one lexsort pass: rows grouped, each group's values descending — the
-    # k-th best per group is then a direct index, O(n log n) regardless of
-    # group cardinality (a per-group scan would be O(groups * n))
+    # Dense small-range groups (the broadcast-dimension case — q67's ~10
+    # categories over tens of millions of rows): a counting pass + one
+    # np.partition per group finds each threshold in O(n) with ~4 cheap
+    # passes. The generic path below lexsorts (group, -val) — robust for
+    # arbitrary high-cardinality groups but ~10x the passes, and at SF-200
+    # it was the single largest cost in the q67 pipeline.
+    dense_ok = group.dtype.kind in "iu" and (
+        vals.dtype.kind != "f" or not np.isnan(vals).any()
+    )  # NaN order values: np.partition ranks NaN largest, which would make
+    # a group's threshold NaN and prune the WHOLE group — the lexsort path
+    # below drops only the NaN rows, so NaN inputs take that path
+    if dense_ok:
+        gmin = int(group.min())
+        grange = int(group.max()) - gmin + 1
+        if grange <= 4096:
+            # uint16 cast: numpy's stable argsort radixes per BYTE of the
+            # dtype, so sorting the int64 group column directly pays 8
+            # passes for a value that fits in 2 (subtract in int64 first:
+            # small signed dtypes can overflow on the span)
+            bucket = (group.astype(np.int64) - gmin).astype(np.uint16)
+            counts = np.bincount(bucket, minlength=grange)
+            idx = np.argsort(bucket, kind="stable")  # radix: rows by group
+            vs = vals[idx]
+            bounds = np.zeros(grange + 1, dtype=np.int64)
+            np.cumsum(counts, out=bounds[1:])
+            kth = np.empty(grange, dtype=vals.dtype)
+            for g in range(grange):
+                lo, hi = int(bounds[g]), int(bounds[g + 1])
+                if hi == lo:
+                    continue
+                size = hi - lo
+                kk = min(k, size)
+                kth[g] = np.partition(vs[lo:hi], size - kk)[size - kk]
+            return vals >= kth[bucket]
     idx = np.lexsort((-vals, group))
     gs, vs = group[idx], vals[idx]
     starts = np.flatnonzero(np.r_[True, gs[1:] != gs[:-1]])
