@@ -13,8 +13,9 @@ the same capability: records stay columnar end to end —
 - combine = ``ufunc.reduceat`` segmented reductions over fixed-width int64
   value columns (sum/min/max — the shapes TPC-DS aggregations need; counts
   are sums over a ones column the producer adds);
-- bounded memory = pending batches consolidate (concat + sort + reduceat)
-  at a byte budget and spill as sorted unique-key runs; runs merge with the
+- bounded memory = pending batches consolidate (keys-only argsort +
+  segmented gather + reduceat — no concat pass) at a byte budget and spill
+  as sorted unique-key runs; runs merge with the
   frontier invariant of :class:`s3shuffle_tpu.batch.BatchSorter` — inclusive
   frontier cuts are safe here because every run has unique keys (no key can
   recur in an unloaded chunk) and the ops are commutative.
@@ -38,6 +39,7 @@ from s3shuffle_tpu.batch import (
     _ragged_gather,
     iter_record_batches,
     read_frames,
+    sort_batches,
     write_frame,
 )
 
@@ -98,7 +100,7 @@ class ColumnarReducer:
         self._pending.append(batch)
         self._pending_bytes += batch.nbytes
         if self._pending_bytes >= self._spill_bytes:
-            merged = self._reduce(RecordBatch.concat(self._pending))
+            merged = self._reduce_pending(self._pending)
             self._pending = [merged]
             self._pending_bytes = merged.nbytes
             # High-cardinality keys barely shrink under reduction — without
@@ -117,13 +119,19 @@ class ColumnarReducer:
             .view("<i8")
         )
 
-    def _reduce(self, batch: RecordBatch) -> RecordBatch:
+    def _reduce_pending(self, batches: List[RecordBatch]) -> RecordBatch:
+        """Reduce a batch LIST without materializing its concatenation —
+        sort_batches' keys-only argsort + segmented gather (the concat here
+        was ~9% of a spilling SF-300 aggregation's wall, r5 profile)."""
+        return self._reduce(sort_batches(batches), presorted=True)
+
+    def _reduce(self, batch: RecordBatch, presorted: bool = False) -> RecordBatch:
         """Sort ``batch`` by key and collapse equal-key runs with the column
         ops. Output keys are sorted and unique."""
         n = batch.n
         if n == 0:
             return batch
-        sb = batch.take(batch.argsort_by_key())
+        sb = batch if presorted else batch.take(batch.argsort_by_key())
         klens = sb.klens
         ks = sb.key_strings()
         neq = np.empty(n, dtype=bool)
@@ -162,11 +170,7 @@ class ColumnarReducer:
     def results(self) -> Iterator[RecordBatch]:
         """Drain the reduction. Streams sorted unique-key batches; cleans up
         spill files on exhaustion (or error)."""
-        final = (
-            self._reduce(RecordBatch.concat(self._pending))
-            if self._pending
-            else RecordBatch.empty()
-        )
+        final = self._reduce_pending(self._pending)
         self._pending = []
         self._pending_bytes = 0
         if not self._spills:
@@ -202,9 +206,9 @@ class ColumnarReducer:
                 refill(r)
             live = [r for r in range(len(iters)) if iters[r] is not None]
             if not live:
-                rest = RecordBatch.concat([p for p in pending if p.n])
+                rest = self._reduce_pending([p for p in pending if p.n])
                 if rest.n:
-                    yield from iter_record_batches(self._reduce(rest))
+                    yield from iter_record_batches(rest)
                 return
             # frontier = smallest LAST-loaded key over undrained runs. Keys
             # are unique within a run, so unloaded chunks hold keys strictly
@@ -217,16 +221,16 @@ class ColumnarReducer:
                 cut_sorted_head(p, frontier, inclusive=True) if p.n else 0
                 for p in pending
             ]
-            emit = RecordBatch.concat(
-                [p.slice_rows(0, c) for p, c in zip(pending, cuts) if c]
-            )
+            spans = [p.slice_rows(0, c) for p, c in zip(pending, cuts) if c]
             for r, c in enumerate(cuts):
                 if c:
                     pending[r] = pending[r].slice_rows(c, pending[r].n)
             # progress is guaranteed: the run attaining the frontier cuts its
             # whole loaded chunk
-            if emit.n:
-                yield from iter_record_batches(self._reduce(emit))
+            if spans:
+                out = self._reduce_pending(spans)
+                if out.n:
+                    yield from iter_record_batches(out)
 
     def cleanup(self) -> None:
         for path in self._spills:
