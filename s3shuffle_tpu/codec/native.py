@@ -41,7 +41,17 @@ def _load() -> ctypes.CDLL:
         if _lib_error is not None:
             raise _lib_error
         try:
-            if not os.path.exists(_SO_PATH):
+            src = os.path.join(os.path.abspath(_NATIVE_DIR), "src",
+                               "s3shuffle_native.cpp")
+            stale = not os.path.exists(_SO_PATH) or (
+                os.path.exists(src)
+                and os.path.getmtime(src) > os.path.getmtime(_SO_PATH)
+            )
+            # Rebuild on STALENESS, not just absence: the .so is untracked
+            # and survives `git pull`, and loading an old binary across a C
+            # ABI change (e.g. the r5 src_sizes parameter) would misread
+            # every argument after the changed position.
+            if stale:
                 subprocess.run(
                     ["make", "-C", os.path.abspath(_NATIVE_DIR)],
                     check=True,
@@ -80,7 +90,8 @@ def _load() -> ctypes.CDLL:
         ]
         lib.slz_gather_fixed_segmented.restype = None
         lib.slz_gather_fixed_segmented.argtypes = [
-            ctypes.POINTER(ctypes.c_void_p), i32p, i64p,
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_size_t), i32p, i64p,
             ctypes.c_int64, ctypes.c_int64, u8p,
         ]
         lib.slz_compress_framed.restype = ctypes.c_int64
@@ -187,24 +198,28 @@ def native_gather_fixed_segmented(
     """Gather fixed-width rows from MANY contiguous uint8 source buffers in
     one pass: output row i = srcs[seg[i]][local[i]*row_len :][:row_len].
     Every source must be C-contiguous uint8 (decoded frames and batch
-    columns are). Unlike :func:`native_gather_fixed` the output is exactly
-    sized (the segmented kernel never overshoots)."""
+    columns are). The output is over-allocated by 16 bytes (the kernel's
+    branchless short-row copy may write past the last row when the source
+    read fits) and returned as a trimmed view."""
     lib = _load()
     seg = np.ascontiguousarray(seg, dtype=np.int32)
     local = np.ascontiguousarray(local, dtype=np.int64)
     ptrs = (ctypes.c_void_p * len(srcs))(
         *(a.ctypes.data for a in srcs)
     )
-    out = np.empty(len(seg) * row_len, dtype=np.uint8)
+    sizes = (ctypes.c_size_t * len(srcs))(*(a.nbytes for a in srcs))
+    total = len(seg) * row_len
+    out = np.empty(total + 16, dtype=np.uint8)
     lib.slz_gather_fixed_segmented(
         ptrs,
+        sizes,
         seg.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         local.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         row_len,
         len(seg),
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
     )
-    return out
+    return out[:total]
 
 
 def native_adler32(data: bytes, value: int = 1) -> int:

@@ -864,13 +864,40 @@ void slz_gather_fixed(const uint8_t* src, size_t src_size, int64_t row_len,
 // local[i]*row_len. One call gathers a sorted permutation straight out of
 // MANY source buffers (decoded frames, pending batches) into one contiguous
 // output — replacing the concat-then-gather two-pass (the concat pass was a
-// top-3 CPU cost in the r5 terasort profile). Copies are exact (no
-// overshoot): segment buffers are independently sized, so the 16-byte
-// branchless trick of slz_gather_fixed is not safe here.
-void slz_gather_fixed_segmented(const uint8_t* const* srcs, const int32_t* seg,
+// top-3 CPU cost in the r5 terasort profile). src_sizes[s] is the byte size
+// of srcs[s]: short rows take the branchless two-load copy whenever the
+// 16-byte read stays inside the SOURCE buffer (checked per row — segment
+// buffers are independently sized, unlike slz_gather_fixed's single src);
+// rows near a segment's end fall back to an exact memcpy of the SOURCE
+// read, but the branchless path still STORES 16 bytes — dst MUST be
+// allocated with >= n*row_len + 16 bytes whenever row_len <= 16 (the
+// Python wrapper over-allocates and trims). A per-row memcpy call for
+// 10-16 byte rows measured ~20% slower than concat+contiguous-gather,
+// defeating the pass saving.
+void slz_gather_fixed_segmented(const uint8_t* const* srcs,
+                                const size_t* src_sizes, const int32_t* seg,
                                 const int64_t* local, int64_t row_len,
                                 int64_t n, uint8_t* dst) {
     uint8_t* op = dst;
+    if (row_len <= 16) {
+        for (int64_t i = 0; i < n; i++) {
+            if (i + GATHER_PF < n)
+                __builtin_prefetch(
+                    srcs[seg[i + GATHER_PF]] + local[i + GATHER_PF] * row_len);
+            int32_t s = seg[i];
+            size_t off = (size_t)local[i] * (size_t)row_len;
+            const uint8_t* p = srcs[s] + off;
+            if (off + 16 <= src_sizes[s]) {
+                uint64_t a = load64(p), b = load64(p + 8);
+                memcpy(op, &a, 8);
+                memcpy(op + 8, &b, 8);
+            } else {
+                memcpy(op, p, (size_t)row_len);
+            }
+            op += row_len;
+        }
+        return;
+    }
     for (int64_t i = 0; i < n; i++) {
         if (i + GATHER_PF < n) {
             const uint8_t* f =
