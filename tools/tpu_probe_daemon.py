@@ -14,6 +14,9 @@ kernel-rate measurement to ``bench_tpu_last_good.json`` on success.
 
 Run detached:  nohup python tools/tpu_probe_daemon.py >/tmp/probe_daemon.out 2>&1 &
 Stop:          touch tools/.probe_stop
+Pause:         touch tools/.probe_pause   (benchmarks own the single CPU;
+               remove the file to resume — paused cycles don't count as
+               attempts)
 
 Parity note: the reference has no equivalent (its benchmarks run on always-
 attached clusters, /root/reference/examples/run_tests.sh); this is rig
@@ -32,6 +35,7 @@ sys.path.insert(0, REPO)
 LOG_PATH = os.path.join(REPO, "TPU_PROBE_LOG.jsonl")
 E2E_PATH = os.path.join(REPO, "bench_tpu_e2e.json")
 STOP_PATH = os.path.join(REPO, "tools", ".probe_stop")
+PAUSE_PATH = os.path.join(REPO, "tools", ".probe_pause")
 PROBE_INTERVAL_S = int(os.environ.get("S3SHUFFLE_PROBE_INTERVAL_S", "600"))
 MAX_RUNTIME_S = float(os.environ.get("S3SHUFFLE_PROBE_MAX_RUNTIME_S", 11.5 * 3600))
 PROBE_TIMEOUT_S = int(os.environ.get("S3SHUFFLE_PROBE_TIMEOUT_S", "150"))
@@ -103,6 +107,12 @@ def main() -> None:
         if os.path.exists(STOP_PATH):
             log_line({"event": "daemon_stop", "reason": "stop file"})
             return
+        if os.path.exists(PAUSE_PATH):
+            # A bench run owns the (single) CPU: skip this cycle without
+            # burning an attempt, and re-check every few seconds so probing
+            # resumes promptly when the bench removes the pause file.
+            time.sleep(5)
+            continue
         attempt_n += 1
         t0 = time.time()
         out = run_probe()
