@@ -109,11 +109,12 @@ class ColumnarStages:
         self.stages = 0
 
     def agg(self, codec, batch, ops, num_partitions=N_REDUCERS,
-            map_side_combine=True):
+            map_side_combine=True, val_dtypes=None):
         t0 = time.perf_counter()
         out = agg_shuffle(
             self.ctx, codec, split_batch(batch, N_MAPS), ops,
             num_partitions=num_partitions, map_side_combine=map_side_combine,
+            val_dtypes=val_dtypes,
         )
         self.stage_seconds += time.perf_counter() - t0
         self.stages += 1
@@ -173,6 +174,13 @@ def gen_tables(sf: float, seed: int = 17):
 
 _K1 = KeyCodec("i64")
 _K2 = KeyCodec("i64", "i64")
+# Narrow typed-plane codecs (r5): item/order/store/year/month all fit i32 at
+# every benchmarked SF (pack range-checks and raises rather than wrap), and
+# per-row value columns declare i1/i2/i4 wire widths — the reduce side widens
+# to i64 before reducing, so only row inputs must fit. q75's stage-1 shuffle
+# drops from 40 to 12 bytes/row.
+_K1_32 = KeyCodec("i32")
+_K2_32 = KeyCodec("i32", "i32")
 
 
 def q5(st, sales, returns):
@@ -182,12 +190,14 @@ def q5(st, sales, returns):
     r_store = sales["store"][returns["order"]]  # returns join their sale's store
     nr = len(r_store)
     batch = make_batch(
-        _K1,
+        _K1_32,
         (np.concatenate([sales["store"], r_store]),),
         (np.concatenate([s_amt, _zeros(nr)]),
          np.concatenate([_zeros(len(s_amt)), returns["ramt"]])),
+        val_dtypes=("i4", "i4"),  # per-row amounts ≤ 100 000
     )
-    (store,), vals = st.agg(_K1, batch, ("sum", "sum"))
+    (store,), vals = st.agg(_K1_32, batch, ("sum", "sum"),
+                            val_dtypes=("i4", "i4"))
     order = np.argsort(store, kind="stable")
     result = [
         (int(s), int(a), int(r), int(a - r))
@@ -211,19 +221,23 @@ def q49(st, sales, returns):
     two-column sum over the tagged union), per-item aggregate, rank sort."""
     ns, nr = len(sales["item"]), len(returns["item"])
     joined = make_batch(
-        _K2,
+        _K2_32,
         (np.concatenate([sales["item"], returns["item"]]),
          np.concatenate([sales["order"], returns["order"]])),
         (np.concatenate([sales["qty"], _zeros(nr)]),      # sold
          np.concatenate([_zeros(ns), returns["rq"]])),    # returned
+        val_dtypes=("i1", "i1"),  # per-row qty/rq ≤ 10
     )
     # (item, order) groups have ≤ 2 rows (order is unique per sale) — the
     # cogroup join key is ~unique, so map-side combine is skipped (r5)
-    (item1, _order1), v1 = st.agg(_K2, joined, ("sum", "sum"),
-                                  map_side_combine=False)
+    (item1, _order1), v1 = st.agg(_K2_32, joined, ("sum", "sum"),
+                                  map_side_combine=False,
+                                  val_dtypes=("i1", "i1"))
     hit = v1[:, 1] > 0  # inner join: only orders with a return
-    per_item = make_batch(_K1, (item1[hit],), (v1[hit, 1], v1[hit, 0]))
-    (item2,), v2 = st.agg(_K1, per_item, ("sum", "sum"))
+    per_item = make_batch(_K1_32, (item1[hit],), (v1[hit, 1], v1[hit, 0]),
+                          val_dtypes=("i2", "i2"))  # per-(item,order) sums ≤ 20
+    (item2,), v2 = st.agg(_K1_32, per_item, ("sum", "sum"),
+                          val_dtypes=("i2", "i2"))
     ratio = np.round(v2[:, 0] / v2[:, 1], 6)
     # ORDER BY ratio LIMIT TOP_K → TakeOrderedAndProject-style prune (r5):
     # only rows that can reach the worst-TOP_K tail survive the rank sort
@@ -263,25 +277,31 @@ def q75(st, sales, returns):
     quantity declined. Three stages."""
     ns, nr = len(sales["item"]), len(returns["item"])
     joined = make_batch(
-        _K2,
+        _K2_32,
         (np.concatenate([sales["item"], returns["item"]]),
          np.concatenate([sales["order"], returns["order"]])),
         (np.concatenate([sales["year"], _zeros(nr)]),   # year (max: sale's year)
          np.concatenate([sales["qty"], _zeros(nr)]),    # sold
          np.concatenate([_zeros(ns), returns["rq"]])),  # returned
+        val_dtypes=("i2", "i1", "i1"),  # year ≤ 2002; per-row qty/rq ≤ 10
     )
     # ~unique (item, order) join key → no map-side combine (see q49)
-    (item1, _o), v1 = st.agg(_K2, joined, ("max", "sum", "sum"),
-                             map_side_combine=False)
+    (item1, _o), v1 = st.agg(_K2_32, joined, ("max", "sum", "sum"),
+                             map_side_combine=False,
+                             val_dtypes=("i2", "i1", "i1"))
     net = v1[:, 1] - v1[:, 2]
-    per_year = make_batch(_K2, (v1[:, 0], item1), (net,))
-    (year2, item2), v2 = st.agg(_K2, per_year, ("sum",))
+    per_year = make_batch(_K2_32, (v1[:, 0], item1), (net,),
+                          val_dtypes=("i2",))  # |net| ≤ 20 per (item,order)
+    (year2, item2), v2 = st.agg(_K2_32, per_year, ("sum",),
+                                val_dtypes=("i2",))
     is1 = (year2 == 2001).astype(_I64)
     is2 = (year2 == 2002).astype(_I64)
     by_item = make_batch(
-        _K1, (item2,), (v2[:, 0] * is1, v2[:, 0] * is2, is1, is2)
+        _K1_32, (item2,), (v2[:, 0] * is1, v2[:, 0] * is2, is1, is2),
+        val_dtypes=("i4", "i4", "i1", "i1"),
     )
-    (item3,), v3 = st.agg(_K1, by_item, ("sum", "sum", "sum", "sum"))
+    (item3,), v3 = st.agg(_K1_32, by_item, ("sum", "sum", "sum", "sum"),
+                          val_dtypes=("i4", "i4", "i1", "i1"))
     hit = (v3[:, 2] > 0) & (v3[:, 3] > 0) & (v3[:, 1] < v3[:, 0])
     item_f, q1, q2 = item3[hit], v3[hit, 0], v3[hit, 1]
     order = np.argsort(item_f, kind="stable")  # items unique → total order
@@ -326,14 +346,16 @@ def q67(st, sales, returns):
       WindowGroupLimitExec): only rows that can reach rank ≤ TOP_K within
       their category survive to the rank sort, collapsing the second shuffle
       from every rolled-up group to ~TOP_K·n_categories rows."""
-    codec3 = KeyCodec("i64", "i64", "i64")
+    codec3 = KeyCodec("i32", "i32", "i32")
     rolled = make_batch(
         codec3,
         (sales["item"], sales["store"], sales["month"]),
         (sales["qty"] * sales["price"],),
+        val_dtypes=("i4",),  # per-row amt = qty·price ≤ 100 000
     )
     (item1, store1, month1), v1 = st.agg(
-        codec3, rolled, ("sum",), map_side_combine=False
+        codec3, rolled, ("sum",), map_side_combine=False,
+        val_dtypes=("i4",),
     )
     cat1 = item1 % 10
     keep = window_group_limit(cat1, v1[:, 0], TOP_K)
@@ -398,17 +420,20 @@ def q64(st, sales, returns):
     two, then a cross-year self-join emitting items whose 2002 amount grew.
     Four stages — the widest join pipeline in the suite (BASELINE.json #3)."""
     by_iy = make_batch(
-        _K2, (sales["item"], sales["year"]),
+        _K2_32, (sales["item"], sales["year"]),
         (sales["qty"], sales["qty"] * sales["price"]),
+        val_dtypes=("i1", "i4"),  # per-row qty ≤ 10, amt ≤ 100 000
     )
-    (item1, year1), v1 = st.agg(_K2, by_iy, ("sum", "sum"))
-    ret_b = make_batch(_K1, (returns["item"],), (returns["rq"],))
-    (item_r,), v_r = st.agg(_K1, ret_b, ("sum",))
+    (item1, year1), v1 = st.agg(_K2_32, by_iy, ("sum", "sum"),
+                                val_dtypes=("i1", "i4"))
+    ret_b = make_batch(_K1_32, (returns["item"],), (returns["rq"],),
+                       val_dtypes=("i1",))
+    (item_r,), v_r = st.agg(_K1_32, ret_b, ("sum",), val_dtypes=("i1",))
     is1 = (year1 == 2001).astype(_I64)
     is2 = (year1 == 2002).astype(_I64)
     nj, nr = len(item1), len(item_r)
     cogroup = make_batch(
-        _K1,
+        _K1_32,
         (np.concatenate([item1, item_r]),),
         (np.concatenate([v1[:, 0] * is1, _zeros(nr)]),   # qty 2001
          np.concatenate([v1[:, 1] * is1, _zeros(nr)]),   # amt 2001
@@ -418,7 +443,7 @@ def q64(st, sales, returns):
          np.concatenate([is1, _zeros(nr)]),              # has 2001
          np.concatenate([is2, _zeros(nr)])),             # has 2002
     )
-    (item3,), m = st.agg(_K1, cogroup, ("sum",) * 7)
+    (item3,), m = st.agg(_K1_32, cogroup, ("sum",) * 7)
     hit = (m[:, 5] > 0) & (m[:, 6] > 0) & (m[:, 3] > m[:, 1])
     growth = m[hit, 3] - m[hit, 1]
     sort_in = make_batch(
@@ -460,21 +485,25 @@ def q95(st, sales, returns):
     row. Three stages (cogroup semi-join, per-store aggregate, rollup)."""
     ns, nr = len(sales["order"]), len(returns["order"])
     joined = make_batch(
-        _K1,
+        _K1_32,
         (np.concatenate([sales["order"], returns["order"]]),),
         (np.concatenate([_zeros(ns), returns["ramt"]]),   # returned amount
          np.concatenate([sales["store"], _zeros(nr)]),    # store (max: sale's)
          np.concatenate([sales["qty"], _zeros(nr)])),     # qty
+        val_dtypes=("i4", "i4", "i1"),  # ramt ≤ 90 000; qty ≤ 10
     )
     # ~unique order semi-join key → no map-side combine (see q49)
-    (_order1,), v1 = st.agg(_K1, joined, ("sum", "max", "sum"),
-                            map_side_combine=False)
+    (_order1,), v1 = st.agg(_K1_32, joined, ("sum", "max", "sum"),
+                            map_side_combine=False,
+                            val_dtypes=("i4", "i4", "i1"))
     hit = v1[:, 0] > 0  # semi-join: orders with at least one return
     per_store = make_batch(
-        _K1, (v1[hit, 1],),
+        _K1_32, (v1[hit, 1],),
         (_ones(int(hit.sum())), v1[hit, 2], v1[hit, 0]),
+        val_dtypes=("i1", "i2", "i4"),  # per-order count/qty/ramt
     )
-    (store2,), v2 = st.agg(_K1, per_store, ("sum", "sum", "sum"))
+    (store2,), v2 = st.agg(_K1_32, per_store, ("sum", "sum", "sum"),
+                           val_dtypes=("i1", "i2", "i4"))
     order2 = np.argsort(store2, kind="stable")
     agg_rows = [
         (int(s), (int(c), int(q), int(a)))
@@ -482,9 +511,9 @@ def q95(st, sales, returns):
                               v2[order2, 2])
     ]
     rollup = make_batch(
-        _K1, (_zeros(len(store2)),), (v2[:, 0], v2[:, 1], v2[:, 2])
+        _K1_32, (_zeros(len(store2)),), (v2[:, 0], v2[:, 1], v2[:, 2])
     )
-    (_z,), vt = st.agg(_K1, rollup, ("sum", "sum", "sum"), num_partitions=1)
+    (_z,), vt = st.agg(_K1_32, rollup, ("sum", "sum", "sum"), num_partitions=1)
     total_rows = (
         [("ALL", (int(vt[0, 0]), int(vt[0, 1]), int(vt[0, 2])))] if len(vt) else []
     )

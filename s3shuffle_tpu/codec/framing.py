@@ -163,9 +163,18 @@ class CodecOutputStream(io.RawIOBase):
 
     def _emit_framed(self, n_blocks: int) -> None:
         bs = self._codec.block_size
-        out = self._framed(memoryview(self._buf)[: n_blocks * bs], n_blocks, bs)
+        cut = n_blocks * bs
+        out = self._framed(memoryview(self._buf)[:cut], n_blocks, bs)
         self._sink.write(out)
-        del self._buf[: n_blocks * bs]
+        try:
+            del self._buf[:cut]
+        except BufferError:
+            # The device encode path stages H2D transfers asynchronously and
+            # may still hold an export of the buffer after returning (jax
+            # owns the view until the transfer lands). A pinned bytearray
+            # cannot be resized — start a fresh buffer with the tail bytes
+            # and let the old one die when the device releases it.
+            self._buf = bytearray(memoryview(self._buf)[cut:])
 
     def _emit_pending(self) -> None:
         if not self._pending:

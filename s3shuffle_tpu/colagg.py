@@ -76,10 +76,30 @@ class ColumnarReducer:
         ops: Sequence[str],
         spill_bytes: int = 256 * 1024 * 1024,
         spill_dir: Optional[str] = None,
+        val_dtypes: Optional[Sequence[str]] = None,
     ):
         self.ops = _validate_ops(ops)
         self.ncols = len(self.ops)
         self.value_width = 8 * self.ncols
+        # Narrow wire schema (structured.pack_values dtypes): incoming raw
+        # batches carry packed narrow rows; they widen to int64 here BEFORE
+        # any reduction, so only per-row inputs — never aggregates — must
+        # fit the narrow widths. Already-wide batches (map-side-combined
+        # partials, re-added reduced runs) pass through untouched; the two
+        # are told apart by row width, which is unambiguous whenever the
+        # schema is actually narrow.
+        self._val_dtypes = tuple(val_dtypes) if val_dtypes else None
+        if self._val_dtypes is not None:
+            from s3shuffle_tpu.structured import val_schema_width
+
+            if len(self._val_dtypes) != self.ncols:
+                raise ValueError(
+                    f"val_dtypes has {len(self._val_dtypes)} columns, "
+                    f"ops has {self.ncols}"
+                )
+            self._narrow_width = val_schema_width(self._val_dtypes)
+            if self._narrow_width == self.value_width:
+                self._val_dtypes = None  # all-i8 schema: already wide
         self._spill_bytes = max(1, spill_bytes)
         self._spill_dir = spill_dir
         self._pending: List[RecordBatch] = []
@@ -89,14 +109,39 @@ class ColumnarReducer:
         self._all_sum = all(op == "sum" for op in self.ops)
 
     # ------------------------------------------------------------------
+    def _widen(self, batch: RecordBatch) -> RecordBatch:
+        from s3shuffle_tpu.structured import widen_values
+
+        out = RecordBatch(
+            batch.klens,
+            np.full(batch.n, self.value_width, dtype=np.int32),
+            batch.keys,
+            widen_values(batch.values, batch.n, self._val_dtypes),
+        )
+        out._kw, out._vw = batch._kw, self.value_width
+        return out
+
     def add(self, batch: RecordBatch) -> None:
         if batch.n == 0:
             return
         if batch.vlens.size and not (batch.vlens == self.value_width).all():
-            raise ValueError(
-                f"columnar aggregation requires fixed {self.value_width}-byte "
-                f"values ({self.ncols} int64 columns); got ragged/mismatched vlens"
-            )
+            if (
+                self._val_dtypes is not None
+                and (batch.vlens == self._narrow_width).all()
+            ):
+                batch = self._widen(batch)
+            else:
+                raise ValueError(
+                    f"columnar aggregation requires fixed {self.value_width}-byte "
+                    f"values ({self.ncols} int64 columns"
+                    + (
+                        f") or the declared {self._narrow_width}-byte narrow "
+                        f"schema {self._val_dtypes}"
+                        if self._val_dtypes is not None
+                        else ""
+                    )
+                    + "; got ragged/mismatched vlens"
+                )
         self._pending.append(batch)
         self._pending_bytes += batch.nbytes
         if self._pending_bytes >= self._spill_bytes:
@@ -249,9 +294,12 @@ class ColumnarAggregator(Aggregator):
 
     Values are fixed-width rows of ``len(ops)`` little-endian int64 columns;
     ``ops[c]`` ∈ {"sum", "min", "max"} reduces column ``c`` over equal keys.
-    ``create_combiner`` is identity (a value row IS a combiner row), so
-    map-side partials and reduce-side finals share one representation and
-    ``combine_values_by_key`` ≡ ``combine_combiners_by_key``.
+    Combiner rows are ALWAYS wide int64; without ``val_dtypes`` a value row
+    IS a combiner row (``create_combiner`` is identity and
+    ``combine_values_by_key`` ≡ ``combine_combiners_by_key``). With a narrow
+    ``val_dtypes`` wire schema, incoming rows may be either narrow (raw map
+    output) or wide (partials) — told apart by row length — and widen on
+    entry, so the equivalence still holds on the wide representation.
 
     The per-record fallback (non-columnar serializer, custom read paths)
     stays correct via the inherited dict machinery with numpy row merges.
@@ -264,17 +312,41 @@ class ColumnarAggregator(Aggregator):
         ops: Sequence[str],
         spill_bytes: int = 256 * 1024 * 1024,
         spill_dir: Optional[str] = None,
+        val_dtypes: Optional[Sequence[str]] = None,
     ):
         self.ops = _validate_ops(ops)
         self.ncols = len(self.ops)
         self.value_width = 8 * self.ncols
+        self.val_dtypes = tuple(val_dtypes) if val_dtypes else None
         super().__init__(
-            create_combiner=lambda v: v,
-            merge_value=self._merge_rows,
+            # per-record fallback: combiners are ALWAYS wide int64 rows;
+            # narrow wire values widen in create_combiner / merge_value, so
+            # the dict loop agrees with the columnar plane bit-for-bit
+            create_combiner=self._widen_row,
+            merge_value=lambda c, v: self._merge_rows(c, self._widen_row(v)),
             merge_combiners=self._merge_rows,
             spill_bytes=spill_bytes,
             spill_dir=spill_dir,
         )
+
+    def _widen_row(self, v):
+        if self.val_dtypes is None:
+            return v
+        b = bytes(v)
+        if len(b) == self.value_width:
+            return b  # already-wide row (e.g. a map-side-combined partial)
+        from s3shuffle_tpu.structured import val_schema_width, val_struct_dtype
+
+        if len(b) != val_schema_width(self.val_dtypes):
+            raise ValueError(
+                f"value row is {len(b)} bytes; expected the declared narrow "
+                f"schema {self.val_dtypes} ({val_schema_width(self.val_dtypes)} "
+                f"bytes) or wide int64 rows ({self.value_width} bytes)"
+            )
+        row = np.frombuffer(b, dtype=val_struct_dtype(self.val_dtypes))
+        return np.array(
+            [int(row[f"c{j}"][0]) for j in range(self.ncols)], dtype="<i8"
+        ).tobytes()
 
     def _merge_rows(self, a, b):
         av = np.frombuffer(bytes(a), dtype="<i8")
@@ -296,6 +368,7 @@ class ColumnarAggregator(Aggregator):
             self.ops,
             spill_bytes=self.spill_bytes if spill_bytes is None else spill_bytes,
             spill_dir=spill_dir if spill_dir is not None else self.spill_dir,
+            val_dtypes=self.val_dtypes,
         )
 
     # ------------------------------------------------------------------

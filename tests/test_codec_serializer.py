@@ -131,3 +131,44 @@ def test_pickle_batch_overflow_regression():
     records = [(i, i * 2) for i in range(50)]
     data = s.dumps(records)
     assert list(s.loads(data)) == records
+
+
+def test_codec_output_stream_survives_retained_view():
+    """Async device encoders (jax H2D staging) may still hold an export of
+    the accumulation buffer when ``compress_framed`` returns; the stream
+    must swap to a fresh buffer instead of dying on the bytearray resize
+    (regression: BufferError mid-shuffle the moment the chip probe resolved
+    to the device path)."""
+    import io
+
+    from s3shuffle_tpu.codec import get_codec
+    from s3shuffle_tpu.codec.framing import CodecInputStream, CodecOutputStream
+
+    inner = get_codec("zlib")
+    retained = []
+
+    class RetainingCodec:
+        block_size = inner.block_size
+        batch_blocks = 4
+
+        def compress_framed(self, buf, n_blocks, block_size):
+            retained.append(buf)  # never released, like an in-flight H2D
+            return b"".join(
+                inner.frame_block(bytes(buf[i * block_size:(i + 1) * block_size]))
+                for i in range(n_blocks)
+            )
+
+        def frame_block(self, raw):
+            return inner.frame_block(raw)
+
+    data = bytes(range(256)) * 2000  # several blocks across several writes
+    sink = io.BytesIO()
+    out = CodecOutputStream(RetainingCodec(), sink, close_sink=False)
+    step = 64 * 1024 + 17
+    for i in range(0, len(data), step):
+        out.write(data[i : i + step])  # appends after the pinned emit
+    out.close()
+    assert retained, "fast path never engaged"
+    sink.seek(0)
+    back = CodecInputStream(None, sink).read()
+    assert back == data

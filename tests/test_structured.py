@@ -142,3 +142,176 @@ def test_window_group_limit_matches_full_rank():
     # degenerate cases
     assert not window_group_limit(group, order, 0).any()
     assert window_group_limit(np.array([1, 1]), np.array([5, 5]), 10).all()
+
+
+def test_i32_roundtrip_and_order():
+    vals = np.array(
+        [0, 1, -1, 2**31 - 1, -(2**31), 7, -7, 123456789], dtype=np.int64
+    )
+    codec = KeyCodec("i32")
+    assert codec.width == 4
+    keys = codec.pack(vals)
+    got = codec.unpack(keys, len(vals))[0]
+    assert got.dtype == np.int64 and got.tolist() == vals.tolist()
+    rows = [bytes(keys[i * 4 : (i + 1) * 4]) for i in range(len(vals))]
+    assert [v for _, v in sorted(zip(rows, vals.tolist()))] == sorted(vals.tolist())
+
+
+def test_i32_range_check_raises():
+    codec = KeyCodec("i32")
+    with pytest.raises(ValueError, match="int32 range"):
+        codec.pack(np.array([2**31], dtype=np.int64))
+    with pytest.raises(ValueError, match="int32 range"):
+        codec.pack(np.array([-(2**31) - 1], dtype=np.int64))
+
+
+def test_i32_mixed_with_i64_generic_path():
+    a = np.array([3, -3, 0], dtype=np.int64)
+    b = np.array([-(2**40), 2**40, 5], dtype=np.int64)
+    codec = KeyCodec("i32", "i64")
+    assert codec.width == 12
+    da, db = codec.unpack(codec.pack(a, b), 3)
+    assert da.tolist() == a.tolist() and db.tolist() == b.tolist()
+    rows = codec.pack(a, b)
+    rb = [bytes(rows[i * 12 : (i + 1) * 12]) for i in range(3)]
+    by_bytes = sorted(range(3), key=lambda i: rb[i])
+    by_tuple = sorted(range(3), key=lambda i: (a[i], b[i]))
+    assert by_bytes == by_tuple
+
+
+def test_narrow_values_pack_widen_roundtrip():
+    from s3shuffle_tpu.structured import val_schema_width, widen_values
+
+    c0 = np.array([-128, 127, 0, 5], dtype=np.int64)
+    c1 = np.array([-32768, 32767, 9, -9], dtype=np.int64)
+    c2 = np.array([-(2**31), 2**31 - 1, 1, -1], dtype=np.int64)
+    dt = ("i1", "i2", "i4")
+    assert val_schema_width(dt) == 7
+    packed = pack_values(c0, c1, c2, dtypes=dt)
+    assert len(packed) == 4 * 7
+    wide = widen_values(packed, 4, dt).view("<i8").reshape(4, 3)
+    assert wide[:, 0].tolist() == c0.tolist()
+    assert wide[:, 1].tolist() == c1.tolist()
+    assert wide[:, 2].tolist() == c2.tolist()
+
+
+def test_narrow_values_range_check():
+    with pytest.raises(ValueError, match="i1 range"):
+        pack_values(np.array([128]), dtypes=("i1",))
+    with pytest.raises(ValueError, match="i2 range"):
+        pack_values(np.array([40000]), dtypes=("i2",))
+
+
+def test_narrow_agg_shuffle_no_overflow(tmp_path):
+    """i1 wire values summing far past 127: the reduce side widens BEFORE
+    reducing, so aggregates never overflow the wire width."""
+    n = 20000
+    k = np.zeros(n, dtype=np.int64)  # one giant group
+    v = np.full(n, 100, dtype=np.int64)  # sum = 2,000,000 >> int8
+    codec = KeyCodec("i32")
+    batch = make_batch(codec, (k,), (v,), val_dtypes=("i1",))
+    assert batch.vlens[0] == 1
+    with _ctx(tmp_path) as ctx:
+        (ka,), vals = agg_shuffle(
+            ctx, codec, split_batch(batch, 4), ("sum",), num_partitions=3,
+            map_side_combine=False, val_dtypes=("i1",),
+        )
+    assert ka.tolist() == [0] and int(vals[0, 0]) == 100 * n
+
+
+def test_narrow_agg_with_map_side_combine(tmp_path):
+    """Narrow wire + map-side columnar combine: partials widen at the map
+    side and stay exact."""
+    rng = np.random.default_rng(9)
+    n = 30000
+    k = rng.integers(-50, 50, n)
+    v = rng.integers(-10, 10, n)
+    codec = KeyCodec("i32")
+    batch = make_batch(codec, (k,), (v, np.ones(n, dtype=np.int64)),
+                       val_dtypes=("i1", "i1"))
+    with _ctx(tmp_path) as ctx:
+        (ka,), vals = agg_shuffle(
+            ctx, codec, split_batch(batch, 4), ("sum", "sum"),
+            num_partitions=3, map_side_combine=True, val_dtypes=("i1", "i1"),
+        )
+    got = {int(a): (int(s), int(c)) for a, s, c in zip(ka, vals[:, 0], vals[:, 1])}
+    ref = {}
+    for a, x in zip(k.tolist(), v.tolist()):
+        s, c = ref.get(a, (0, 0))
+        ref[a] = (s + x, c + 1)
+    assert got == ref
+
+
+def test_narrow_min_max_ops(tmp_path):
+    rng = np.random.default_rng(21)
+    n = 5000
+    k = rng.integers(0, 7, n)
+    v = rng.integers(-100, 100, n)
+    codec = KeyCodec("i32")
+    batch = make_batch(codec, (k,), (v, v), val_dtypes=("i1", "i1"))
+    with _ctx(tmp_path) as ctx:
+        (ka,), vals = agg_shuffle(
+            ctx, codec, split_batch(batch, 3), ("min", "max"),
+            num_partitions=2, map_side_combine=False, val_dtypes=("i1", "i1"),
+        )
+    for a, lo, hi in zip(ka.tolist(), vals[:, 0].tolist(), vals[:, 1].tolist()):
+        sel = v[k == a]
+        assert lo == int(sel.min()) and hi == int(sel.max())
+
+
+def test_columnar_reducer_mixes_narrow_and_wide():
+    from s3shuffle_tpu.colagg import ColumnarReducer
+
+    k = np.array([1, 2, 3], dtype=np.int64)
+    codec = KeyCodec("i32")
+    narrow = make_batch(codec, (k,), (np.array([5, 6, 7]),), val_dtypes=("i2",))
+    wide = make_batch(codec, (k,), (np.array([10, 20, 30]),))
+    red = ColumnarReducer(("sum",), val_dtypes=("i2",))
+    red.add(narrow)
+    red.add(wide)  # already-reduced shape mixes in untouched
+    out = RecordBatch.concat(list(red.results()))
+    got = dict(zip(codec.unpack(out.keys, out.n)[0].tolist(),
+                   values_matrix(out, 1)[:, 0].tolist()))
+    assert got == {1: 15, 2: 26, 3: 37}
+
+
+def test_columnar_reducer_rejects_undeclared_width():
+    from s3shuffle_tpu.colagg import ColumnarReducer
+
+    codec = KeyCodec("i32")
+    narrow = make_batch(codec, (np.array([1]),), (np.array([5]),),
+                        val_dtypes=("i2",))
+    red = ColumnarReducer(("sum",))  # no narrow schema declared
+    with pytest.raises(ValueError, match="vlens"):
+        red.add(narrow)
+
+
+def test_per_record_fallback_widens_narrow_values():
+    from s3shuffle_tpu.colagg import ColumnarAggregator
+
+    agg = ColumnarAggregator(("sum", "max"), val_dtypes=("i1", "i2"))
+    rows = [
+        (b"k", pack_values(np.array([3]), np.array([100]),
+                           dtypes=("i1", "i2")).tobytes()),
+        (b"k", pack_values(np.array([4]), np.array([-5]),
+                           dtypes=("i1", "i2")).tobytes()),
+    ]
+    out = dict(agg.combine_values_by_key(iter(rows)))
+    vals = np.frombuffer(out[b"k"], dtype="<i8")
+    assert vals.tolist() == [7, 100]
+
+
+def test_per_record_fallback_accepts_wide_rows_with_narrow_schema():
+    """combine_values/combiners equivalence on the wide representation: an
+    already-wide partial through the per-record path must pass untouched
+    (regression: it was silently truncated through the narrow struct), and
+    an undeclared width must raise."""
+    from s3shuffle_tpu.colagg import ColumnarAggregator
+
+    agg = ColumnarAggregator(("sum",), val_dtypes=("i4",))
+    wide = np.array([2**33 + 5], dtype="<i8").tobytes()
+    narrow = pack_values(np.array([7]), dtypes=("i4",)).tobytes()
+    out = dict(agg.combine_values_by_key(iter([(b"k", wide), (b"k", narrow)])))
+    assert np.frombuffer(out[b"k"], dtype="<i8").tolist() == [2**33 + 12]
+    with pytest.raises(ValueError, match="value row is"):
+        list(agg.combine_values_by_key(iter([(b"k", b"xyz")])))
