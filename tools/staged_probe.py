@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Staged chip probe child: one JSON line per completed step, flushed.
+
+The axon tunnel's quality varies from "answers `jax.devices()` in seconds"
+to "hangs backend init for an hour" within minutes (TPU_PROBE_LOG.jsonl,
+2026-07-31 04:12Z window). A monolithic probe with a hard timeout loses ALL
+evidence from a marginal window; this child emits each step's measurement
+the moment it lands, so the daemon can log partial chip evidence (device
+contact, H2D rate, kernel rates) even when the window closes mid-probe.
+
+Steps, cheapest first: backend init → 1 KiB first touch → 2 MiB H2D rate →
+device CRC32C (compile + warm rate + host cross-check) → device TLZ encode
+(compile + warm rate + ratio + decode roundtrip check).
+
+Run standalone:  python tools/staged_probe.py
+Driven by:       tools/tpu_probe_daemon.py (logs every step line).
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, __import__("os").path.dirname(
+    __import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+
+
+def emit(**kw):
+    print(json.dumps({"ts_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()), **kw}),
+          flush=True)
+
+
+def main() -> int:
+    t0 = time.time()
+    import os
+
+    import numpy as np
+
+    import jax
+
+    if os.environ.get("S3SHUFFLE_STAGED_PROBE_CPU"):
+        # CPU self-test mode: the machine env pins the axon TPU plugin and a
+        # plain JAX_PLATFORMS=cpu env var does NOT override it — only a
+        # post-import config.update does (same dance as tests/conftest.py).
+        jax.config.update("jax_platforms", "cpu")
+
+    backend = jax.default_backend()
+    devices = [str(d) for d in jax.devices()]
+    emit(step="backend_init", backend=backend, devices=devices,
+         wall_s=round(time.time() - t0, 1))
+    if backend == "cpu" and not os.environ.get("S3SHUFFLE_STAGED_PROBE_CPU"):
+        emit(step="abort", reason="cpu backend (no chip)")
+        return 1
+
+    t0 = time.time()
+    jax.device_put(np.zeros(1024, np.uint8)).block_until_ready()
+    emit(step="first_touch_1k", wall_s=round(time.time() - t0, 3))
+
+    batch = np.arange(2 * 1024 * 1024, dtype=np.uint8).reshape(8, -1)
+    t0 = time.time()
+    dev = jax.device_put(batch)
+    dev.block_until_ready()
+    dt = time.time() - t0
+    emit(step="h2d_2m", wall_s=round(dt, 3), h2d_mb_s=round(batch.nbytes / 1e6 / dt, 2))
+
+    from s3shuffle_tpu.ops.checksum import POLY_CRC32C, _crc_raw_bytes, crc32_batch
+
+    lengths = np.full(batch.shape[0], batch.shape[1], dtype=np.int64)
+    t0 = time.time()
+    crcs = crc32_batch(batch, lengths)
+    emit(step="crc_compile_and_run", wall_s=round(time.time() - t0, 1))
+    t0 = time.time()
+    crcs2 = crc32_batch(batch, lengths)
+    dt = time.time() - t0
+    final_xor = 0xFFFFFFFF
+    host = [(_crc_raw_bytes(bytes(r), POLY_CRC32C, final_xor) ^ final_xor) & 0xFFFFFFFF
+            for r in batch]
+    host_ok = [int(c) for c in crcs] == host
+    emit(step="crc_warm", wall_s=round(dt, 3),
+         crc_mb_s=round(batch.nbytes / 1e6 / max(dt, 1e-9), 1),
+         device_matches_host_crc=bool(host_ok and np.array_equal(crcs, crcs2)))
+
+    from s3shuffle_tpu.ops import tlz
+
+    bs = 128 * 1024
+    raw = np.frombuffer((b"the quick brown fox jumps over the lazy dog " * 4000)[:bs],
+                        dtype=np.uint8)
+    t0 = time.time()
+    payloads = tlz.encode_buffer_device(memoryview(raw.tobytes()), 1, bs)
+    emit(step="tlz_encode_compile_and_run", wall_s=round(time.time() - t0, 1),
+         payload_len=len(payloads[0]))
+    t0 = time.time()
+    payloads = tlz.encode_buffer_device(memoryview(raw.tobytes()), 1, bs)
+    dt = time.time() - t0
+    dec = tlz.decode_payload_numpy(bytes(payloads[0]), bs)
+    emit(step="tlz_encode_warm", wall_s=round(dt, 3),
+         tlz_dev_encode_mb_s=round(len(raw) / 1e6 / max(dt, 1e-9), 2),
+         ratio=round(len(raw) / len(payloads[0]), 3),
+         roundtrip_ok=bool(bytes(dec) == raw.tobytes()))
+    emit(step="done")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

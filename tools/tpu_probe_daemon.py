@@ -39,6 +39,7 @@ PAUSE_PATH = os.path.join(REPO, "tools", ".probe_pause")
 PROBE_INTERVAL_S = int(os.environ.get("S3SHUFFLE_PROBE_INTERVAL_S", "600"))
 MAX_RUNTIME_S = float(os.environ.get("S3SHUFFLE_PROBE_MAX_RUNTIME_S", 11.5 * 3600))
 PROBE_TIMEOUT_S = int(os.environ.get("S3SHUFFLE_PROBE_TIMEOUT_S", "150"))
+STAGED_TIMEOUT_S = int(os.environ.get("S3SHUFFLE_STAGED_PROBE_TIMEOUT_S", "420"))
 E2E_TIMEOUT_S = int(os.environ.get("S3SHUFFLE_PROBE_E2E_TIMEOUT_S", "900"))
 
 # Child script for the end-to-end chip shuffle: the headline terasort-shaped
@@ -74,9 +75,52 @@ def log_line(rec: dict) -> None:
         f.write(json.dumps(rec) + "\n")
 
 
+def run_staged_probe() -> tuple:
+    """One STAGED probe attempt (tools/staged_probe.py): the child emits one
+    JSON line per completed step, so a marginal tunnel window still yields
+    partial chip evidence (device contact, H2D rate, kernel rates) instead
+    of an all-or-nothing timeout — the 2026-07-31 04:12Z window answered
+    ``jax.devices()`` in seconds but closed before a monolithic probe could
+    finish, and rounds 1-4 never logged even that much. Returns
+    (chip_contact: bool, steps: list of parsed step dicts)."""
+    steps = []
+    stderr_tail = ""
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "staged_probe.py")],
+            capture_output=True, text=True, timeout=STAGED_TIMEOUT_S,
+        )
+        raw = r.stdout
+        if r.returncode != 0:
+            # a crash is NOT a tunnel hang — keep the traceback tail so the
+            # log distinguishes a deterministic code bug from a down tunnel
+            stderr_tail = (r.stderr or "").strip()[-300:]
+            steps.append({"step": "child_exit", "returncode": r.returncode,
+                          "stderr_tail": stderr_tail})
+    except subprocess.TimeoutExpired as e:
+        raw = e.stdout.decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
+        steps.append({"step": "timeout", "after_s": STAGED_TIMEOUT_S})
+    except Exception as e:  # never kill the daemon
+        return False, [{"step": "error", "error": str(e)[:200]}]
+    parsed = []
+    for line in raw.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed.append(json.loads(line))
+            except ValueError:
+                pass
+    parsed.extend(steps)
+    contact = any(
+        s.get("step") == "backend_init" and s.get("backend") not in (None, "cpu")
+        for s in parsed
+    )
+    return contact, parsed
+
+
 def run_probe() -> dict:
-    """One probe attempt via bench.device_kernel_rates (itself subprocess-
-    isolated with a hard timeout, per the tunnel lessons)."""
+    """Full kernel-rate probe via bench.device_kernel_rates (itself
+    subprocess-isolated with a hard timeout, per the tunnel lessons)."""
     import bench
 
     return bench.device_kernel_rates(timeout_s=PROBE_TIMEOUT_S, attempts=1)
@@ -101,6 +145,7 @@ def main() -> None:
     t_start = time.time()
     attempt_n = 0
     e2e_done = os.path.exists(E2E_PATH)
+    full_ok = os.path.exists(os.path.join(REPO, "bench_tpu_last_good.json"))
     log_line({"event": "daemon_start", "pid": os.getpid(),
               "interval_s": PROBE_INTERVAL_S, "e2e_already_captured": e2e_done})
     while time.time() - t_start < MAX_RUNTIME_S:
@@ -115,17 +160,53 @@ def main() -> None:
             continue
         attempt_n += 1
         t0 = time.time()
-        out = run_probe()
-        ok = "tpu_probe_error" not in out
+        contact, steps = run_staged_probe()
+        done_steps = [s.get("step") for s in steps]
+        ok = contact and "done" in done_steps  # all staged kernels measured
         rec = {"event": "probe", "attempt": attempt_n, "ok": ok,
-               "probe_wall_s": round(time.time() - t0, 1)}
-        if ok:
-            # keep the log line compact: headline kernel rates only
-            rec["summary"] = {k: out[k] for k in sorted(out)
-                             if isinstance(out.get(k), (int, float))}
-        else:
-            rec["error"] = out["tpu_probe_error"][:200]
+               "chip_contact": contact,
+               "probe_wall_s": round(time.time() - t0, 1),
+               "staged": True, "steps": done_steps}
+        if contact:
+            # every completed step's measurement is chip evidence — log them
+            rec["measurements"] = [
+                {k: v for k, v in s.items() if k != "ts_utc"} for s in steps
+            ]
+        if not ok:
+            crash = next((s for s in steps if s.get("step") == "child_exit"), None)
+            if crash is not None:
+                rec["error"] = (
+                    f"staged child exited rc={crash['returncode']}: "
+                    f"{crash.get('stderr_tail', '')}"
+                )[:300]
+            elif "timeout" in done_steps and len(done_steps) == 1:
+                rec["error"] = (
+                    f"staged probe produced no step within {STAGED_TIMEOUT_S}s "
+                    "(axon backend init hang — tunnel down?)"
+                )
+            elif "timeout" in done_steps:
+                rec["error"] = (
+                    f"window closed mid-probe after {done_steps[-2]} "
+                    f"(timeout at {STAGED_TIMEOUT_S}s)"
+                )
+            elif steps:
+                rec["error"] = "; ".join(
+                    str(s.get("reason") or s.get("error") or s.get("step"))
+                    for s in steps[-2:]
+                )[:200]
         log_line(rec)
+        if ok and not full_ok:
+            # window is good: capture the full kernel-rate probe too (writes
+            # bench_tpu_last_good.json via bench.device_kernel_rates)
+            full = run_probe()
+            if "tpu_probe_error" not in full:
+                full_ok = True
+                log_line({"event": "full_kernel_probe", "summary": {
+                    k: full[k] for k in sorted(full)
+                    if isinstance(full.get(k), (int, float))}})
+            else:
+                log_line({"event": "full_kernel_probe_failed",
+                          "error": full["tpu_probe_error"][:200]})
         if ok and not e2e_done:
             log_line({"event": "e2e_start"})
             e2e = run_e2e()
@@ -135,8 +216,15 @@ def main() -> None:
                     json.dump({"measured_at_utc": time.strftime(
                         "%Y-%m-%dT%H:%M:%SZ", time.gmtime()), **e2e}, f)
                 e2e_done = True
+        # adaptive cadence: device contact means a window is open RIGHT NOW —
+        # windows last minutes (probe log, 04:12Z) — so retry fast while it
+        # lasts AND something remains to capture; once the full kernel probe
+        # and the e2e shuffle have both landed, drop back to the slow cycle
+        interval = (
+            60 if contact and not (full_ok and e2e_done) else PROBE_INTERVAL_S
+        )
         # sleep in small steps so the stop file is honored promptly
-        deadline = time.time() + PROBE_INTERVAL_S
+        deadline = time.time() + interval
         while time.time() < deadline:
             if os.path.exists(STOP_PATH):
                 log_line({"event": "daemon_stop", "reason": "stop file"})
