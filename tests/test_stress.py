@@ -229,3 +229,51 @@ def test_tpu_fallback_delegate_race_free_under_concurrent_writers(tmp_path, monk
         t.join()
     ctx.stop()
     assert not errors, errors
+
+
+def test_concurrent_narrow_schema_aggregations(tmp_path):
+    """8 threads x independent narrow-schema typed aggregations through ONE
+    context: the i32-key/i1-value wire plane (widen-before-reduce) must stay
+    exact under the shared manager/dispatcher/codec caches."""
+    import numpy as np
+
+    from s3shuffle_tpu.structured import KeyCodec, agg_shuffle, make_batch, split_batch
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(root_dir=f"file://{tmp_path}", app_id="stress-narrow",
+                        codec="auto")
+    ctx = ShuffleContext(config=cfg, num_workers=4)
+    codec = KeyCodec("i32")
+    errors = []
+
+    def one_agg(seed):
+        try:
+            rng = np.random.default_rng(seed)
+            n = 20_000
+            k = rng.integers(seed * 1000, seed * 1000 + 50, n)
+            v = rng.integers(-10, 11, n)
+            batch = make_batch(codec, (k,), (v, np.ones(n, dtype=np.int64)),
+                               val_dtypes=("i1", "i1"))
+            (ka,), vals = agg_shuffle(
+                ctx, codec, split_batch(batch, 2), ("sum", "sum"),
+                num_partitions=3, map_side_combine=bool(seed % 2),
+                val_dtypes=("i1", "i1"),
+            )
+            ref = {}
+            for key, val in zip(k.tolist(), v.tolist()):
+                s, c = ref.get(key, (0, 0))
+                ref[key] = (s + val, c + 1)
+            assert len(ka) == len(ref), f"seed {seed}: duplicate/missing keys"
+            got = {int(a): (int(s), int(c))
+                   for a, s, c in zip(ka, vals[:, 0], vals[:, 1])}
+            assert got == ref, f"seed {seed}: wrong aggregation"
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=one_agg, args=(s,)) for s in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    ctx.stop()
+    assert not errors, errors
