@@ -36,10 +36,16 @@ LOG_PATH = os.path.join(REPO, "TPU_PROBE_LOG.jsonl")
 E2E_PATH = os.path.join(REPO, "bench_tpu_e2e.json")
 STOP_PATH = os.path.join(REPO, "tools", ".probe_stop")
 PAUSE_PATH = os.path.join(REPO, "tools", ".probe_pause")
-PROBE_INTERVAL_S = int(os.environ.get("S3SHUFFLE_PROBE_INTERVAL_S", "600"))
+#: 240s attempt box + 240s sleep ≈ one fresh attempt every 8 minutes while
+#: the tunnel is down (a hung attempt costs ~no CPU — the child blocks in
+#: axon backend init). Windows observed so far last minutes and answer
+#: backend init in <60s when healthy, so a window ≥ one cycle is near-
+#: guaranteed to catch an attempt that STARTS inside it; the old
+#: 420s box + 600s sleep could sleep straight through one.
+PROBE_INTERVAL_S = int(os.environ.get("S3SHUFFLE_PROBE_INTERVAL_S", "240"))
 MAX_RUNTIME_S = float(os.environ.get("S3SHUFFLE_PROBE_MAX_RUNTIME_S", 11.5 * 3600))
 PROBE_TIMEOUT_S = int(os.environ.get("S3SHUFFLE_PROBE_TIMEOUT_S", "150"))
-STAGED_TIMEOUT_S = int(os.environ.get("S3SHUFFLE_STAGED_PROBE_TIMEOUT_S", "420"))
+STAGED_TIMEOUT_S = int(os.environ.get("S3SHUFFLE_STAGED_PROBE_TIMEOUT_S", "240"))
 E2E_TIMEOUT_S = int(os.environ.get("S3SHUFFLE_PROBE_E2E_TIMEOUT_S", "900"))
 
 # Child script for the end-to-end chip shuffle: the headline terasort-shaped
