@@ -173,9 +173,23 @@ class Aggregator:
                     return
 
 
+def _fold_create(zero: Any, fn: Callable[[Any, Any], Any], v: Any) -> Any:
+    return fn(zero, v)
+
+
+def _singleton_list(v: Any) -> list:
+    return [v]
+
+
 def fold_by_key_aggregator(zero: Any, fn: Callable[[Any, Any], Any]) -> Aggregator:
+    # functools.partial of a module-level function, NOT a closure lambda: the
+    # cluster path pickles the whole dependency (aggregator included) to its
+    # worker processes (cluster.py), and lambdas don't pickle. The aggregator
+    # remains picklable whenever the caller's ``fn``/``zero`` are.
+    import functools
+
     return Aggregator(
-        create_combiner=lambda v: fn(zero, v),
+        create_combiner=functools.partial(_fold_create, zero, fn),
         merge_value=fn,
         merge_combiners=fn,
     )
@@ -197,7 +211,7 @@ class GroupingAggregator(Aggregator):
     def __init__(self, spill_bytes: int = 256 * 1024 * 1024,
                  spill_dir: Optional[str] = None):
         super().__init__(
-            create_combiner=lambda v: [v],
+            create_combiner=_singleton_list,  # module-level: must pickle
             merge_value=_append_value,
             merge_combiners=_concat_lists,
             spill_bytes=spill_bytes,

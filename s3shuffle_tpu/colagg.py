@@ -321,13 +321,19 @@ class ColumnarAggregator(Aggregator):
         super().__init__(
             # per-record fallback: combiners are ALWAYS wide int64 rows;
             # narrow wire values widen in create_combiner / merge_value, so
-            # the dict loop agrees with the columnar plane bit-for-bit
+            # the dict loop agrees with the columnar plane bit-for-bit.
+            # Bound methods, NOT lambdas: the cluster path pickles the whole
+            # dependency (aggregator included) to map/reduce worker
+            # processes (cluster.py), and lambdas don't pickle.
             create_combiner=self._widen_row,
-            merge_value=lambda c, v: self._merge_rows(c, self._widen_row(v)),
+            merge_value=self._merge_value,
             merge_combiners=self._merge_rows,
             spill_bytes=spill_bytes,
             spill_dir=spill_dir,
         )
+
+    def _merge_value(self, c, v):
+        return self._merge_rows(c, self._widen_row(v))
 
     def _widen_row(self, v):
         if self.val_dtypes is None:

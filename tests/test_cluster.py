@@ -546,3 +546,61 @@ def test_orphan_sweep_reclaims_dead_attempt_objects(tmp_path):
         worker.join(timeout=10)
         if worker.is_alive():
             worker.terminate()
+
+
+def _make_narrow_agg_dep(shuffle_id: int):
+    # module-level so the whole dependency (aggregator included) pickles to
+    # the spawn workers — the regression this guards: ColumnarAggregator
+    # once built its combine hooks from __init__ lambdas, which don't pickle
+    from s3shuffle_tpu.colagg import ColumnarAggregator
+    from s3shuffle_tpu.dependency import HashPartitioner, ShuffleDependency
+    from s3shuffle_tpu.serializer import BytesKVSerializer
+
+    return ShuffleDependency(
+        shuffle_id=shuffle_id,
+        partitioner=HashPartitioner(4),
+        serializer=BytesKVSerializer(),
+        aggregator=ColumnarAggregator(("sum", "sum"), val_dtypes=("i2", "i1")),
+        map_side_combine=True,
+    )
+
+
+def test_multiprocess_narrow_schema_aggregation(tmp_path):
+    """Narrow-schema typed aggregation ACROSS PROCESS BOUNDARIES: the
+    dependency (with its widen-before-reduce aggregator) pickles to spawn
+    workers, map-side combine runs in the worker processes, and the reduce
+    output is exact."""
+    from s3shuffle_tpu.cluster import LocalCluster
+    from s3shuffle_tpu.structured import pack_values
+
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/store", app_id="cluster-narrow", codec="zlib"
+    )
+    rng = np.random.default_rng(3)
+    ref = {}
+    parts = []
+    for _p in range(3):
+        recs = []
+        keys = rng.integers(0, 40, 400)
+        vals = rng.integers(-100, 101, 400)
+        for k, v in zip(keys.tolist(), vals.tolist()):
+            kb = int(k).to_bytes(2, "big")
+            recs.append(
+                (kb, pack_values(np.array([v]), np.array([1]),
+                                 dtypes=("i2", "i1")).tobytes())
+            )
+            s, c = ref.get(kb, (0, 0))
+            ref[kb] = (s + v, c + 1)
+        parts.append(recs)
+    cluster = LocalCluster(cfg, num_workers=2)
+    try:
+        out = cluster.run_shuffle(parts, _make_narrow_agg_dep)
+    finally:
+        cluster.shutdown()
+    got = {}
+    for p in out:
+        for k, v in p:
+            assert k not in got, f"duplicate key {k!r} across partitions"
+            w = np.frombuffer(v, dtype="<i8")
+            got[k] = (int(w[0]), int(w[1]))
+    assert got == ref
