@@ -136,19 +136,33 @@ class ColumnarStages:
 # ---------------------------------------------------------------------------
 
 
-def gen_tables(sf: float, seed: int = 17):
+def gen_tables(sf: float, seed: int = 17, skew: float = 0.0):
     """Synthetic star-schema slice as int64 column arrays. ``sf`` scales row
     counts linearly (sf=1 ≈ 200k sales rows). Prices are integer cents so
     sums stay exact and the shuffled pipelines agree with the single-process
-    reference regardless of summation order."""
+    reference regardless of summation order.
+
+    ``skew`` > 1 draws item/store ids from a Zipf(``skew``) law instead of
+    uniform — the hot-key shape real TPC-DS data has (a few items dominate
+    sales). The shuffled pipelines see heavy partition imbalance and long
+    equal-key runs; semantics are unchanged (the ``--verify`` reference
+    recomputes over the same skewed tables)."""
     rng = np.random.default_rng(seed)
     n_sales = int(200_000 * sf)
     n_items = max(50, int(2_000 * sf))
     n_stores = max(4, int(40 * sf))
+
+    def _ids(n, domain):
+        if skew > 1.0:
+            # zipf is unbounded: fold the tail back into the domain (keeps
+            # the head hot, preserves the domain size)
+            return (rng.zipf(skew, n).astype(_I64) - 1) % domain
+        return rng.integers(0, domain, n, dtype=_I64)
+
     order = np.arange(n_sales, dtype=_I64)
     sales = {
-        "item": rng.integers(0, n_items, n_sales, dtype=_I64),
-        "store": rng.integers(0, n_stores, n_sales, dtype=_I64),
+        "item": _ids(n_sales, n_items),
+        "store": _ids(n_sales, n_stores),
         "order": order,
         "year": 2001 + (order & 1),
         "month": 1 + rng.integers(0, 12, n_sales, dtype=_I64),
@@ -571,7 +585,8 @@ def _host_calibration() -> dict:
 
 
 def run_query(name: str, sf: float, codec: str, workers: int, verify: bool,
-              root: str | None = None, root_uri: str | None = None) -> dict:
+              root: str | None = None, root_uri: str | None = None,
+              skew: float = 0.0) -> dict:
     """``root`` is a caller-owned local directory (tests); ``root_uri`` a
     storage root URI (file://, memory://, s3://, ...) so the sweep can point
     the query pipelines at a real object store like its sibling workloads."""
@@ -591,7 +606,7 @@ def run_query(name: str, sf: float, codec: str, workers: int, verify: bool,
     cfg_codec, fallback = CODEC_MODES.get(codec, (codec, False))
     cfg = ShuffleConfig(root_dir=root_dir, app_id=f"sql-{name}", codec=cfg_codec,
                         tpu_host_fallback=fallback)
-    sales, returns = gen_tables(sf)
+    sales, returns = gen_tables(sf, skew=skew)
     try:
         with ShuffleContext(config=cfg, num_workers=workers) as ctx:
             st = ColumnarStages(ctx)
@@ -614,6 +629,7 @@ def run_query(name: str, sf: float, codec: str, workers: int, verify: bool,
             "shuffle_stage_wall_s": round(st.stage_seconds, 3),
             "shuffle_stages": st.stages,
             "verified": bool(verify),
+            **({"skew": skew} if skew else {}),
             **_host_calibration(),
         }
     finally:
@@ -633,6 +649,16 @@ def main(argv=None) -> int:
     ap.add_argument("--no-verify", action="store_true",
                     help="skip the single-process reference check "
                          "(use at large --sf)")
+    def _skew(v):
+        v = float(v)
+        if 0.0 < v <= 1.0:
+            raise argparse.ArgumentTypeError(
+                "skew must be 0 (uniform) or > 1 (Zipf exponent)")
+        return v
+
+    ap.add_argument("--skew", type=_skew, default=0.0,
+                    help="item/store id distribution: 0 = uniform, >1 = "
+                         "Zipf(skew) hot-key law")
     ap.add_argument("--root", default=None,
                     help="storage root URI (file://, s3://, ...; "
                          "default: local temp dir)")
@@ -641,7 +667,7 @@ def main(argv=None) -> int:
     for name in names:
         out = run_query(
             name, args.sf, args.codec, args.workers,
-            verify=not args.no_verify, root_uri=args.root,
+            verify=not args.no_verify, root_uri=args.root, skew=args.skew,
         )
         print(json.dumps(out))
     return 0

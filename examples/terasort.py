@@ -160,6 +160,11 @@ def main() -> int:
     median = rates[len(rates) // 2] if len(rates) % 2 else round(
         (rates[len(rates) // 2 - 1] + rates[len(rates) // 2]) / 2, 1
     )
+    # host condition stamp: on the shared 1-core rig identical code swings
+    # ~2x with background load (QUERYBENCH_r05 host_drift_ab) — rows without
+    # a calibration cannot be compared across runs
+    import bench
+
     print(json.dumps({
         "bench": "terasort",
         "size": args.size,
@@ -172,6 +177,7 @@ def main() -> int:
         "best_mb_per_s": rates[-1],
         "min_mb_per_s": rates[0],
         "host_cores": os.cpu_count() or 1,
+        **bench.load_calibration(),
         "runs": results,
     }))
     return 0

@@ -17,6 +17,7 @@ from __future__ import annotations
 import heapq
 import itertools
 import os
+import functools
 import pickle
 import sys
 import tempfile
@@ -173,23 +174,17 @@ class Aggregator:
                     return
 
 
-def _fold_create(zero: Any, fn: Callable[[Any, Any], Any], v: Any) -> Any:
-    return fn(zero, v)
-
-
 def _singleton_list(v: Any) -> list:
     return [v]
 
 
 def fold_by_key_aggregator(zero: Any, fn: Callable[[Any, Any], Any]) -> Aggregator:
-    # functools.partial of a module-level function, NOT a closure lambda: the
-    # cluster path pickles the whole dependency (aggregator included) to its
-    # worker processes (cluster.py), and lambdas don't pickle. The aggregator
-    # remains picklable whenever the caller's ``fn``/``zero`` are.
-    import functools
-
+    # functools.partial, NOT a closure lambda: the cluster path pickles the
+    # whole dependency (aggregator included) to its worker processes
+    # (cluster.py), and lambdas don't pickle. The aggregator remains
+    # picklable whenever the caller's ``fn``/``zero`` are.
     return Aggregator(
-        create_combiner=functools.partial(_fold_create, zero, fn),
+        create_combiner=functools.partial(fn, zero),
         merge_value=fn,
         merge_combiners=fn,
     )
