@@ -275,3 +275,76 @@ def test_bucket_sorter_randomized_vs_sorted_oracle():
         # stable by key: equal keys keep insertion order
         expected = sorted(recs, key=lambda kv: kv[0])
         assert out == expected, (case, n, len(key_pool))
+
+
+def test_narrow_schema_agg_shuffle_randomized_matrix(tmp_path):
+    """Seeded sweep over the typed-plane combinatorics no single example
+    hits: random narrow value schemas x ops x map-side combine x codec x
+    tiny spill budgets, each asserted exactly against a plain-dict
+    reference. Values are drawn to the full declared range, so widen-
+    before-reduce (and nothing else) must be what keeps aggregates exact."""
+    import random as pyrandom
+
+    from s3shuffle_tpu.config import ShuffleConfig
+    from s3shuffle_tpu.shuffle import ShuffleContext
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+    from s3shuffle_tpu.structured import (
+        KeyCodec,
+        _VAL_DTYPES,
+        agg_shuffle,
+        make_batch,
+        split_batch,
+    )
+
+    rng = pyrandom.Random(2024)
+    nrng = np.random.default_rng(2024)
+    for case in range(12):
+        ncols = rng.randint(1, 4)
+        dtypes = tuple(rng.choice(list(_VAL_DTYPES)) for _ in range(ncols))
+        ops = tuple(rng.choice(["sum", "min", "max"]) for _ in range(ncols))
+        key_fields = tuple(
+            rng.choice(["i32", "i64"]) for _ in range(rng.randint(1, 2))
+        )
+        codec_name = rng.choice(["native", "zlib", "lz4"])
+        combine = rng.random() < 0.5
+        n = rng.randint(500, 4000)
+        nkeys = rng.choice([3, 50, n])  # giant groups / mixed / ~unique
+        key_cols = [
+            nrng.integers(-nkeys, nkeys, n) for _ in key_fields
+        ]
+        val_cols = []
+        for d in dtypes:
+            info = np.iinfo(_VAL_DTYPES[d][0])
+            # full declared range for narrow columns; i8 capped so a sum of
+            # n rows stays inside int64 (the plane's aggregation dtype —
+            # same wrap semantics as Spark's long sum)
+            lo, hi = max(info.min, -(1 << 40)), min(int(info.max), 1 << 40)
+            val_cols.append(nrng.integers(lo, hi + 1, n, dtype=np.int64))
+        kc = KeyCodec(*key_fields)
+        Dispatcher.reset()
+        cfg = ShuffleConfig(
+            root_dir=f"file://{tmp_path}/m{case}", app_id=f"mx{case}",
+            codec=codec_name, aggregator_spill_bytes=64 * 1024,
+            sorter_spill_bytes=64 * 1024,
+        )
+        with ShuffleContext(config=cfg, num_workers=2) as ctx:
+            b = make_batch(kc, key_cols, val_cols, val_dtypes=dtypes)
+            out_keys, out_vals = agg_shuffle(
+                ctx, kc, split_batch(b, 3), ops, num_partitions=4,
+                map_side_combine=combine, val_dtypes=dtypes,
+            )
+        ref = {}
+        merge = {"sum": lambda a, b: a + b, "min": min, "max": max}
+        for i in range(n):
+            k = tuple(int(c[i]) for c in key_cols)
+            vs = [int(c[i]) for c in val_cols]
+            if k in ref:
+                ref[k] = [merge[op](a, v) for op, a, v in zip(ops, ref[k], vs)]
+            else:
+                ref[k] = vs
+        got = {
+            tuple(int(c[i]) for c in out_keys): [int(x) for x in out_vals[i]]
+            for i in range(len(out_vals))
+        }
+        assert len(got) == len(ref), (case, dtypes, ops, key_fields)
+        assert got == ref, (case, dtypes, ops, key_fields, codec_name, combine)
