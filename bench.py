@@ -694,7 +694,53 @@ def device_kernel_rates(timeout_s: int = 150, attempts: int = 3):
             result["tpu_last_good"] = json.load(f)
     except (OSError, ValueError):
         pass
+    contact = _latest_probe_log_contact()
+    if contact:
+        result["tpu_probe_log_last_contact"] = contact
     return result
+
+
+def _latest_probe_log_contact():
+    """Most recent chip-contact evidence from the round-long probe log
+    (tools/tpu_probe_daemon.py): the bench must carry what the daemon saw
+    even when the tunnel is down at artifact time — the whole reason the
+    daemon exists. Returns a compact dict or None."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "TPU_PROBE_LOG.jsonl")
+    latest = None
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if not isinstance(rec, dict):
+                    continue
+                if (
+                    rec.get("chip_contact")
+                    or rec.get("ok")
+                    or rec.get("event") in (
+                        "manual_device_contact",
+                        "full_kernel_probe",  # these two carry the strongest
+                        "e2e_result",  # evidence (kernel rates / e2e shuffle)
+                    )
+                ):
+                    latest = rec
+    except OSError:
+        return None
+    if latest is None:
+        return None
+    out = {"ts_utc": latest.get("ts_utc"), "event": latest.get("event")}
+    for k in ("steps", "measurements", "summary"):
+        if k in latest:
+            out[k] = latest[k]
+    if latest.get("event") == "manual_device_contact":
+        out["note"] = (latest.get("note") or "")[:200]
+    if latest.get("event") == "e2e_result":
+        out.update({k: v for k, v in latest.items()
+                    if k.startswith("tpu_e2e_") or k == "e2e_error"})
+    return out
 
 
 def _device_kernel_rates_impl():
