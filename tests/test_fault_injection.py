@@ -153,3 +153,47 @@ def test_rule_matching_and_counters():
     assert flaky.calls["open"] == 3
     with pytest.raises(ValueError):
         FaultRule("frobnicate")
+
+
+def test_query_pipeline_loud_failure_then_retry_heals(tmp_path):
+    """End-to-end resilience contract for a REAL multi-stage query (q75,
+    3 shuffle stages through the typed narrow plane) over a store with
+    TRANSIENT faults (S3 503-style, exhausted after N hits):
+
+    1. the poisoned attempt fails LOUDLY — ChecksumError naming the exact
+       block — never a silent wrong answer (reads surface as logged EOF per
+       the reference's S3ShuffleBlockStream semantics; the checksum layer
+       catches the truncation);
+    2. the retry (the task-level recovery Spark and this framework's
+       cluster TaskQueue perform) runs the identical query over the healed
+       store and produces the exact verified answer.
+    """
+    import os as _os
+    import sys as _sys
+
+    _sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))), "examples"))
+    import sql_queries as q
+
+    from s3shuffle_tpu.read.checksum_stream import ChecksumError
+    from s3shuffle_tpu.shuffle import ShuffleContext
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/store", app_id="fault-query", codec="native"
+    )
+    sales, returns = q.gen_tables(1)
+    with ShuffleContext(config=cfg, num_workers=2) as ctx:
+        disp = ctx.manager.dispatcher
+        flaky = FlakyBackend(disp.backend)
+        flaky.add_rule(FaultRule("read", match="data", times=3))
+        disp.backend = flaky
+        st = q.ColumnarStages(ctx)
+        with pytest.raises(ChecksumError, match="shuffle_"):
+            q.QUERIES["q75"](st, sales, returns)
+        assert flaky.rules[0].hits > 0
+        # transient rule exhausted -> the retry sees a healthy store
+        st2 = q.ColumnarStages(ctx)
+        result, reference = q.QUERIES["q75"](st2, sales, returns)
+    assert st2.stages == 3
+    assert result == reference(), "retry after transient faults diverged"
