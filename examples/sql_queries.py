@@ -107,6 +107,35 @@ class ColumnarStages:
         self.ctx = ctx
         self.stage_seconds = 0.0
         self.stages = 0
+        self.narrow_fallbacks = 0
+
+    def agg_typed(self, codec, key_cols, val_cols, ops,
+                  num_partitions=N_REDUCERS, map_side_combine=True,
+                  val_dtypes=None):
+        """Pack + aggregate with the declared narrow wire dtypes. The typed
+        pack paths range-check (and dtype-check) every column and raise
+        ``ValueError`` rather than silently wrap/truncate — correct, but a
+        single out-of-range value at an unusual --sf/--skew must not abort a
+        whole benchmark sweep: on pack failure the STAGE retries with wide
+        int64 rows (and i64 keys), which cannot overflow, and the fallback is
+        counted so the emitted row shows the narrow plane was bypassed."""
+        try:
+            batch = make_batch(codec, key_cols, val_cols, val_dtypes=val_dtypes)
+        except ValueError as e:
+            # Only RANGE overflow is recoverable by widening; dtype/arity
+            # errors are caller bugs (and a float column would truncate just
+            # as silently through the wide i64 path) — re-raise those.
+            if "range" not in str(e):
+                raise
+            wide = KeyCodec(*("i64" if f == "i32" else f for f in codec.fields))
+            print(f"narrow typed pack failed ({e}); retrying stage with wide "
+                  "int64 rows", file=sys.stderr)
+            self.narrow_fallbacks += 1
+            batch = make_batch(wide, key_cols, val_cols)
+            codec, val_dtypes = wide, None
+        return self.agg(codec, batch, ops, num_partitions=num_partitions,
+                        map_side_combine=map_side_combine,
+                        val_dtypes=val_dtypes)
 
     def agg(self, codec, batch, ops, num_partitions=N_REDUCERS,
             map_side_combine=True, val_dtypes=None):
@@ -203,15 +232,14 @@ def q5(st, sales, returns):
     s_amt = sales["qty"] * sales["price"]
     r_store = sales["store"][returns["order"]]  # returns join their sale's store
     nr = len(r_store)
-    batch = make_batch(
+    (store,), vals = st.agg_typed(
         _K1_32,
         (np.concatenate([sales["store"], r_store]),),
         (np.concatenate([s_amt, _zeros(nr)]),
          np.concatenate([_zeros(len(s_amt)), returns["ramt"]])),
+        ("sum", "sum"),
         val_dtypes=("i4", "i4"),  # per-row amounts ≤ 100 000
     )
-    (store,), vals = st.agg(_K1_32, batch, ("sum", "sum"),
-                            val_dtypes=("i4", "i4"))
     order = np.argsort(store, kind="stable")
     result = [
         (int(s), int(a), int(r), int(a - r))
@@ -234,24 +262,23 @@ def q49(st, sales, returns):
     return ratio, rank worst TOP_K. Three stages: cogroup join (as a
     two-column sum over the tagged union), per-item aggregate, rank sort."""
     ns, nr = len(sales["item"]), len(returns["item"])
-    joined = make_batch(
+    # (item, order) groups have ≤ 2 rows (order is unique per sale) — the
+    # cogroup join key is ~unique, so map-side combine is skipped (r5)
+    (item1, _order1), v1 = st.agg_typed(
         _K2_32,
         (np.concatenate([sales["item"], returns["item"]]),
          np.concatenate([sales["order"], returns["order"]])),
         (np.concatenate([sales["qty"], _zeros(nr)]),      # sold
          np.concatenate([_zeros(ns), returns["rq"]])),    # returned
+        ("sum", "sum"),
+        map_side_combine=False,
         val_dtypes=("i1", "i1"),  # per-row qty/rq ≤ 10
     )
-    # (item, order) groups have ≤ 2 rows (order is unique per sale) — the
-    # cogroup join key is ~unique, so map-side combine is skipped (r5)
-    (item1, _order1), v1 = st.agg(_K2_32, joined, ("sum", "sum"),
-                                  map_side_combine=False,
-                                  val_dtypes=("i1", "i1"))
     hit = v1[:, 1] > 0  # inner join: only orders with a return
-    per_item = make_batch(_K1_32, (item1[hit],), (v1[hit, 1], v1[hit, 0]),
-                          val_dtypes=("i2", "i2"))  # per-(item,order) sums ≤ 20
-    (item2,), v2 = st.agg(_K1_32, per_item, ("sum", "sum"),
-                          val_dtypes=("i2", "i2"))
+    (item2,), v2 = st.agg_typed(
+        _K1_32, (item1[hit],), (v1[hit, 1], v1[hit, 0]), ("sum", "sum"),
+        val_dtypes=("i2", "i2"),  # per-(item,order) sums ≤ 20
+    )
     ratio = np.round(v2[:, 0] / v2[:, 1], 6)
     # ORDER BY ratio LIMIT TOP_K → TakeOrderedAndProject-style prune (r5):
     # only rows that can reach the worst-TOP_K tail survive the rank sort
@@ -290,32 +317,30 @@ def q75(st, sales, returns):
     join with returns, then a cross-year cogroup reporting items whose net
     quantity declined. Three stages."""
     ns, nr = len(sales["item"]), len(returns["item"])
-    joined = make_batch(
+    # ~unique (item, order) join key → no map-side combine (see q49)
+    (item1, _o), v1 = st.agg_typed(
         _K2_32,
         (np.concatenate([sales["item"], returns["item"]]),
          np.concatenate([sales["order"], returns["order"]])),
         (np.concatenate([sales["year"], _zeros(nr)]),   # year (max: sale's year)
          np.concatenate([sales["qty"], _zeros(nr)]),    # sold
          np.concatenate([_zeros(ns), returns["rq"]])),  # returned
+        ("max", "sum", "sum"),
+        map_side_combine=False,
         val_dtypes=("i2", "i1", "i1"),  # year ≤ 2002; per-row qty/rq ≤ 10
     )
-    # ~unique (item, order) join key → no map-side combine (see q49)
-    (item1, _o), v1 = st.agg(_K2_32, joined, ("max", "sum", "sum"),
-                             map_side_combine=False,
-                             val_dtypes=("i2", "i1", "i1"))
     net = v1[:, 1] - v1[:, 2]
-    per_year = make_batch(_K2_32, (v1[:, 0], item1), (net,),
-                          val_dtypes=("i2",))  # |net| ≤ 20 per (item,order)
-    (year2, item2), v2 = st.agg(_K2_32, per_year, ("sum",),
-                                val_dtypes=("i2",))
+    (year2, item2), v2 = st.agg_typed(
+        _K2_32, (v1[:, 0], item1), (net,), ("sum",),
+        val_dtypes=("i2",),  # |net| ≤ 20 per (item,order)
+    )
     is1 = (year2 == 2001).astype(_I64)
     is2 = (year2 == 2002).astype(_I64)
-    by_item = make_batch(
+    (item3,), v3 = st.agg_typed(
         _K1_32, (item2,), (v2[:, 0] * is1, v2[:, 0] * is2, is1, is2),
+        ("sum", "sum", "sum", "sum"),
         val_dtypes=("i4", "i4", "i1", "i1"),
     )
-    (item3,), v3 = st.agg(_K1_32, by_item, ("sum", "sum", "sum", "sum"),
-                          val_dtypes=("i4", "i4", "i1", "i1"))
     hit = (v3[:, 2] > 0) & (v3[:, 3] > 0) & (v3[:, 1] < v3[:, 0])
     item_f, q1, q2 = item3[hit], v3[hit, 0], v3[hit, 1]
     order = np.argsort(item_f, kind="stable")  # items unique → total order
@@ -361,15 +386,12 @@ def q67(st, sales, returns):
       their category survive to the rank sort, collapsing the second shuffle
       from every rolled-up group to ~TOP_K·n_categories rows."""
     codec3 = KeyCodec("i32", "i32", "i32")
-    rolled = make_batch(
+    (item1, store1, month1), v1 = st.agg_typed(
         codec3,
         (sales["item"], sales["store"], sales["month"]),
         (sales["qty"] * sales["price"],),
+        ("sum",), map_side_combine=False,
         val_dtypes=("i4",),  # per-row amt = qty·price ≤ 100 000
-    )
-    (item1, store1, month1), v1 = st.agg(
-        codec3, rolled, ("sum",), map_side_combine=False,
-        val_dtypes=("i4",),
     )
     cat1 = item1 % 10
     keep = window_group_limit(cat1, v1[:, 0], TOP_K)
@@ -433,16 +455,16 @@ def q64(st, sales, returns):
     (item, year) sales stats, per-item return stats, a cogroup join of the
     two, then a cross-year self-join emitting items whose 2002 amount grew.
     Four stages — the widest join pipeline in the suite (BASELINE.json #3)."""
-    by_iy = make_batch(
+    (item1, year1), v1 = st.agg_typed(
         _K2_32, (sales["item"], sales["year"]),
         (sales["qty"], sales["qty"] * sales["price"]),
+        ("sum", "sum"),
         val_dtypes=("i1", "i4"),  # per-row qty ≤ 10, amt ≤ 100 000
     )
-    (item1, year1), v1 = st.agg(_K2_32, by_iy, ("sum", "sum"),
-                                val_dtypes=("i1", "i4"))
-    ret_b = make_batch(_K1_32, (returns["item"],), (returns["rq"],),
-                       val_dtypes=("i1",))
-    (item_r,), v_r = st.agg(_K1_32, ret_b, ("sum",), val_dtypes=("i1",))
+    (item_r,), v_r = st.agg_typed(
+        _K1_32, (returns["item"],), (returns["rq"],), ("sum",),
+        val_dtypes=("i1",),
+    )
     is1 = (year1 == 2001).astype(_I64)
     is2 = (year1 == 2002).astype(_I64)
     nj, nr = len(item1), len(item_r)
@@ -498,26 +520,24 @@ def q95(st, sales, returns):
     order count, total quantity, total returned amount — plus a total rollup
     row. Three stages (cogroup semi-join, per-store aggregate, rollup)."""
     ns, nr = len(sales["order"]), len(returns["order"])
-    joined = make_batch(
+    # ~unique order semi-join key → no map-side combine (see q49)
+    (_order1,), v1 = st.agg_typed(
         _K1_32,
         (np.concatenate([sales["order"], returns["order"]]),),
         (np.concatenate([_zeros(ns), returns["ramt"]]),   # returned amount
          np.concatenate([sales["store"], _zeros(nr)]),    # store (max: sale's)
          np.concatenate([sales["qty"], _zeros(nr)])),     # qty
+        ("sum", "max", "sum"),
+        map_side_combine=False,
         val_dtypes=("i4", "i4", "i1"),  # ramt ≤ 90 000; qty ≤ 10
     )
-    # ~unique order semi-join key → no map-side combine (see q49)
-    (_order1,), v1 = st.agg(_K1_32, joined, ("sum", "max", "sum"),
-                            map_side_combine=False,
-                            val_dtypes=("i4", "i4", "i1"))
     hit = v1[:, 0] > 0  # semi-join: orders with at least one return
-    per_store = make_batch(
+    (store2,), v2 = st.agg_typed(
         _K1_32, (v1[hit, 1],),
         (_ones(int(hit.sum())), v1[hit, 2], v1[hit, 0]),
+        ("sum", "sum", "sum"),
         val_dtypes=("i1", "i2", "i4"),  # per-order count/qty/ramt
     )
-    (store2,), v2 = st.agg(_K1_32, per_store, ("sum", "sum", "sum"),
-                           val_dtypes=("i1", "i2", "i4"))
     order2 = np.argsort(store2, kind="stable")
     agg_rows = [
         (int(s), (int(c), int(q), int(a)))
@@ -629,6 +649,8 @@ def run_query(name: str, sf: float, codec: str, workers: int, verify: bool,
             "shuffle_stage_wall_s": round(st.stage_seconds, 3),
             "shuffle_stages": st.stages,
             "verified": bool(verify),
+            **({"narrow_fallbacks": st.narrow_fallbacks}
+               if st.narrow_fallbacks else {}),
             **({"skew": skew} if skew else {}),
             **_host_calibration(),
         }

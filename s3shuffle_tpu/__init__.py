@@ -15,7 +15,10 @@ Capability parity map (reference file → this package):
 
 TPU-first additions the reference lacks: batched Pallas/XLA codec kernels
 (:mod:`s3shuffle_tpu.ops`), a C++ native CPU codec (:mod:`s3shuffle_tpu.codec`),
-and an ICI all-to-all repartition fast path (:mod:`s3shuffle_tpu.parallel`).
+an ICI all-to-all repartition fast path (:mod:`s3shuffle_tpu.parallel`), and a
+typed metrics subsystem with per-shuffle stats reports
+(:mod:`s3shuffle_tpu.metrics` — replaces the reference's external
+jvm-profiler → InfluxDB → Grafana stack).
 """
 
 from s3shuffle_tpu.version import BUILD_INFO, __version__

@@ -12,10 +12,23 @@ import ctypes
 import os
 import subprocess
 import threading
+import time
 
 import numpy as np
 
 from s3shuffle_tpu.codec.framing import CODEC_IDS, FrameCodec
+from s3shuffle_tpu.metrics import registry as _metrics
+
+_H_COMPRESS = _metrics.REGISTRY.histogram(
+    "codec_compress_seconds",
+    "Batch compression latency per native-codec crossing",
+    labelnames=("codec",),
+)
+_C_COMPRESS_IN = _metrics.REGISTRY.counter(
+    "codec_compress_bytes_total",
+    "Uncompressed bytes fed to native batch compression",
+    labelnames=("codec",),
+)
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
 _SO_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libs3shuffle_native.so"))
@@ -288,10 +301,23 @@ class NativeLZCodec(FrameCodec):
         header packing in Python."""
         from s3shuffle_tpu.utils import trace
 
+        t0 = time.perf_counter_ns() if _metrics.enabled() else 0
         if trace.enabled():
             with trace.span("codec.compress_batch", blocks=n_blocks):
-                return self._compress_framed_impl(buf, n_blocks, block_size)
-        return self._compress_framed_impl(buf, n_blocks, block_size)
+                out = self._compress_framed_impl(buf, n_blocks, block_size)
+        else:
+            out = self._compress_framed_impl(buf, n_blocks, block_size)
+        self._observe_compress(t0, n_blocks * block_size)
+        return out
+
+    def _observe_compress(self, start_ns: int, src_bytes: int) -> None:
+        """Metrics tail shared by the batch compression entry points
+        (``start_ns`` of 0 means metrics were off at entry)."""
+        if start_ns:
+            _H_COMPRESS.labels(codec=self.name).observe(
+                (time.perf_counter_ns() - start_ns) / 1e9
+            )
+            _C_COMPRESS_IN.labels(codec=self.name).inc(src_bytes)
 
     def _compress_framed_impl(self, buf, n_blocks: int, block_size: int) -> bytes:
         src = np.frombuffer(buf, dtype=np.uint8, count=n_blocks * block_size)
@@ -313,10 +339,14 @@ class NativeLZCodec(FrameCodec):
             return [self.compress_block(b) for b in blocks]
         from s3shuffle_tpu.utils import trace
 
+        t0 = time.perf_counter_ns() if _metrics.enabled() else 0
         if trace.enabled():
             with trace.span("codec.compress_batch", blocks=n):
-                return self._compress_blocks_impl(blocks)
-        return self._compress_blocks_impl(blocks)
+                out = self._compress_blocks_impl(blocks)
+        else:
+            out = self._compress_blocks_impl(blocks)
+        self._observe_compress(t0, sum(len(b) for b in blocks))
+        return out
 
     def _compress_blocks_impl(self, blocks):
         n = len(blocks)

@@ -166,3 +166,25 @@ class MapOutputTracker:
     def shuffle_ids(self) -> List[int]:
         with self._lock:
             return list(self._shuffles.keys())
+
+    # -- per-shuffle stats aggregation (metrics subsystem) -------------
+    # The tracker is the natural aggregation point (it is what every worker
+    # already talks to): task-stats entries recorded at map-commit /
+    # reduce-completion are pushed here and folded into the process
+    # ShuffleStatsCollector — the driver-side task-metrics aggregation role
+    # Spark's DAGScheduler heartbeat path plays.
+    def report_task_stats(self, entries: List[dict]) -> None:
+        """Fold task-stats entries (TaskStats dicts, each carrying its own
+        shuffle_id) into the aggregate."""
+        from s3shuffle_tpu.metrics.stats import COLLECTOR
+
+        for entry in entries:
+            COLLECTOR.merge(entry)
+
+    def get_shuffle_stats(self, shuffle_id: int) -> Optional[dict]:
+        """The aggregated ShuffleStats report (dict; None when nothing was
+        recorded — e.g. metrics disabled)."""
+        from s3shuffle_tpu.metrics.stats import COLLECTOR
+
+        report = COLLECTOR.report(int(shuffle_id))
+        return None if report is None else report.to_dict()

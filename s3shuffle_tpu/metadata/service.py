@@ -417,6 +417,10 @@ class _Handler(socketserver.BaseRequestHandler):
             return tracker.registered_map_ids(int(a[0]))
         if method == "shuffle_ids":
             return tracker.shuffle_ids()
+        if method == "report_task_stats":
+            return tracker.report_task_stats(list(a[0]))
+        if method == "get_shuffle_stats":
+            return tracker.get_shuffle_stats(int(a[0]))
         raise RuntimeError(f"Unknown method: {method}")
 
 
@@ -561,6 +565,15 @@ class RemoteMapOutputTracker:
 
     def shuffle_ids(self) -> List[int]:
         return [int(x) for x in self._call("shuffle_ids")]
+
+    # -- shuffle-stats aggregation (metrics subsystem) -----------------
+    def report_task_stats(self, entries: List[dict]) -> None:
+        """Push task-stats entries (TaskStats dicts) to the coordinator's
+        aggregate — the worker outbox drain path."""
+        self._call("report_task_stats", entries)
+
+    def get_shuffle_stats(self, shuffle_id: int) -> Optional[dict]:
+        return self._call("get_shuffle_stats", shuffle_id)
 
     # -- task-queue interface (coordinator-hosted TaskQueue) -----------
     def submit_stage(self, stage_id: str, tasks: List[dict]) -> None:

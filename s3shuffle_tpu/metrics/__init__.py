@@ -1,0 +1,47 @@
+"""Metrics subsystem: typed registry + per-shuffle stats reports.
+
+Composes with :mod:`s3shuffle_tpu.utils.trace` (spans/timelines) rather than
+replacing it — trace answers "when did what run", this package answers "how
+are the latencies and volumes distributed". See :mod:`.registry` and
+:mod:`.stats` for the full story.
+"""
+
+from s3shuffle_tpu.metrics.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricRegistry,
+    REGISTRY,
+    DEFAULT_BYTES_BUCKETS,
+    DEFAULT_TIME_BUCKETS,
+    disable,
+    enable,
+    enabled,
+    exponential_buckets,
+    render_prometheus,
+)
+from s3shuffle_tpu.metrics.stats import (
+    COLLECTOR,
+    ShuffleStats,
+    ShuffleStatsCollector,
+    TaskStats,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricRegistry",
+    "REGISTRY",
+    "DEFAULT_BYTES_BUCKETS",
+    "DEFAULT_TIME_BUCKETS",
+    "disable",
+    "enable",
+    "enabled",
+    "exponential_buckets",
+    "render_prometheus",
+    "COLLECTOR",
+    "ShuffleStats",
+    "ShuffleStatsCollector",
+    "TaskStats",
+]

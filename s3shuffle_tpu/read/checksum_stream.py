@@ -12,12 +12,22 @@ partitions are validated and skipped immediately (:79-82).
 from __future__ import annotations
 
 import io
+import time
 from typing import BinaryIO
 
 import numpy as np
 
 from s3shuffle_tpu.block_ids import BlockId
+from s3shuffle_tpu.metrics import registry as _metrics
 from s3shuffle_tpu.utils.checksums import create_checksum
+
+_H_VALIDATE = _metrics.REGISTRY.histogram(
+    "read_checksum_validate_seconds",
+    "Checksum update+compare time per validated reduce partition",
+)
+_C_FAILURES = _metrics.REGISTRY.counter(
+    "read_checksum_failures_total", "Reduce partitions that failed validation"
+)
 
 
 class ChecksumError(IOError):
@@ -44,6 +54,7 @@ class ChecksumValidationStream(io.RawIOBase):
         self._algorithm = algorithm
         self._checksum = create_checksum(algorithm)
         self._pos_in_partition = 0
+        self._hash_ns = 0  # checksum work accumulated since the last boundary
         self._skip_empty_and_validate()
 
     def readable(self) -> bool:
@@ -62,7 +73,11 @@ class ChecksumValidationStream(io.RawIOBase):
     def _validate_current(self) -> None:
         expected = int(self._checksums[self._reduce_id]) & 0xFFFFFFFF
         actual = self._checksum.value
+        if _metrics.enabled():
+            _H_VALIDATE.observe(self._hash_ns / 1e9)
+            self._hash_ns = 0
         if actual != expected:
+            _C_FAILURES.inc()
             raise ChecksumError(
                 f"Invalid checksum detected for {self._block.name} reduce partition "
                 f"{self._reduce_id} ({self._algorithm}): "
@@ -80,7 +95,12 @@ class ChecksumValidationStream(io.RawIOBase):
         n = min(size, remaining)
         data = self._source.read(n) if n > 0 else b""
         if data:
-            self._checksum.update(data)
+            if _metrics.enabled():
+                t0 = time.perf_counter_ns()
+                self._checksum.update(data)
+                self._hash_ns += time.perf_counter_ns() - t0
+            else:
+                self._checksum.update(data)
             self._pos_in_partition += len(data)
         if self._pos_in_partition >= self._partition_len():
             self._validate_current()

@@ -31,12 +31,31 @@ import threading
 import time
 from typing import Iterator, List, Optional, Tuple
 
+from s3shuffle_tpu.metrics import registry as _metrics
 from s3shuffle_tpu.read.block_stream import BlockStream
 from s3shuffle_tpu.utils.io import read_up_to as _read_up_to
 
 logger = logging.getLogger("s3shuffle_tpu.read")
 
 RING_SIZE = 20
+
+_H_WAIT = _metrics.REGISTRY.histogram(
+    "read_prefetch_wait_seconds",
+    "Consumer wait for the next prefetched block (the ThreadPredictor's "
+    "control signal)",
+)
+_H_FILL = _metrics.REGISTRY.histogram(
+    "read_prefetch_fill_seconds",
+    "Background prefill latency per block (the actual store GET)",
+)
+_G_THREADS = _metrics.REGISTRY.gauge(
+    "read_prefetch_threads", "Live ThreadPredictor thread-count decision"
+)
+_C_THREAD_MOVES = _metrics.REGISTRY.counter(
+    "read_prefetch_thread_moves_total",
+    "ThreadPredictor decisions that changed the thread count",
+    labelnames=("direction",),
+)
 
 
 class ThreadPredictor:
@@ -233,6 +252,8 @@ class BufferedPrefetchIterator:
                 with trace.span("read.prefetch", block=block.name, budget=bsize):
                     buffer = _read_up_to(stream, bsize)  # ← the actual store GET
                 dt = time.perf_counter_ns() - t0
+                if _metrics.enabled():
+                    _H_FILL.observe(dt / 1e9)
                 prefetched = PrefetchedBlockStream(block, stream, buffer, self._release_budget(len(buffer), bsize))
                 with self._lock:
                     self._stat_prefetch_ns += dt
@@ -279,7 +300,15 @@ class BufferedPrefetchIterator:
             item = self._completed.pop()  # LIFO pop (:146, 209)
             wait_ns = time.perf_counter_ns() - t0
             self._stat_wait_ns += wait_ns
+            previous = self._desired_threads
             self._desired_threads = self._predictor.add_measurement_and_predict(wait_ns)
+        if _metrics.enabled():
+            _H_WAIT.observe(wait_ns / 1e9)
+            _G_THREADS.set(self._desired_threads)
+            if self._desired_threads != previous:
+                _C_THREAD_MOVES.labels(
+                    direction="up" if self._desired_threads > previous else "down"
+                ).inc()
         self._configure_threads()
         return item
 

@@ -204,6 +204,30 @@ class ShuffleReader:
             records = sorter.sorted_iterator()
         return records
 
+    def _finish_read(self, prefetcher: BufferedPrefetchIterator) -> None:
+        """Drain hook: fold prefetcher stats into the task metrics and record
+        the reduce-completion ShuffleStats entry (pushed through the tracker
+        when it aggregates stats — the metadata-service analog of the
+        reference's per-task printStatistics log)."""
+        stats = prefetcher.stats
+        self.metrics.wait_ns += stats["wait_ns"]
+        self.metrics.prefetch_ns += stats["prefetch_ns"]
+        from s3shuffle_tpu.metrics import registry as _metrics_registry
+
+        if not _metrics_registry.enabled():
+            return
+        from s3shuffle_tpu.metrics.stats import COLLECTOR
+
+        COLLECTOR.record_reduce(
+            shuffle_id=self.dep.shuffle_id,
+            partition=self.start_partition,
+            bytes=self.metrics.remote_bytes_read,
+            records=self.metrics.records_read,
+            prefetch_seconds=stats["prefetch_ns"] / 1e9,
+            wait_seconds=stats["wait_ns"] / 1e9,
+            threads=stats["threads"],
+        )
+
     def _wrapped_stream(self, prefetched):
         """checksum validation + codec decompression over one block stream —
         the analog of ``serializerManager.wrapStream`` (:98-110)."""
@@ -244,10 +268,7 @@ class ShuffleReader:
                 stream.close()
                 prefetched.close()
         self.metrics.records_read += pending
-        # fold prefetcher stats into task metrics on drain
-        stats = prefetcher.stats
-        self.metrics.wait_ns += stats["wait_ns"]
-        self.metrics.prefetch_ns += stats["prefetch_ns"]
+        self._finish_read(prefetcher)
 
     # ------------------------------------------------------------------
     # Vectorized plane: columnar serializers stream RecordBatches; ordering
@@ -266,9 +287,7 @@ class ShuffleReader:
             finally:
                 stream.close()
                 prefetched.close()
-        stats = prefetcher.stats
-        self.metrics.wait_ns += stats["wait_ns"]
-        self.metrics.prefetch_ns += stats["prefetch_ns"]
+        self._finish_read(prefetcher)
 
     def _read_batched(self) -> Iterator[Tuple[Any, Any]]:
         from s3shuffle_tpu.batch import BatchSorter

@@ -213,12 +213,15 @@ def get_backend(root_dir: str, storage_options: Dict | None = None) -> StorageBa
     """Pick a backend from the root URI scheme, like the reference's
     ``FileSystem.get(rootDir URI, hadoopConf)`` (S3ShuffleDispatcher.scala:72-76).
     ``storage_options`` are passed to the fsspec driver (credentials,
-    endpoint_url, ... — the Hadoop-FS-config analog)."""
+    endpoint_url, ... — the Hadoop-FS-config analog). With metrics enabled
+    (``S3SHUFFLE_METRICS`` / ``metrics.enable()``) the backend comes wrapped
+    in an :class:`~s3shuffle_tpu.storage.instrumented.InstrumentedBackend`,
+    so every caller records per-op latency/bytes/error metrics for free."""
     scheme = root_dir.split("://", 1)[0] if "://" in root_dir else "file"
     if scheme == "file":
         from s3shuffle_tpu.storage.local import LocalBackend
 
-        return LocalBackend()
+        return _maybe_instrument(LocalBackend())
     if scheme == "memory":
         # One shared store per root so driver/executor components see the same
         # objects within a process.
@@ -227,7 +230,17 @@ def get_backend(root_dir: str, storage_options: Dict | None = None) -> StorageBa
             if backend is None:
                 backend = MemoryBackend()
                 _memory_backends[root_dir] = backend
-            return backend
+        return _maybe_instrument(backend)
     from s3shuffle_tpu.storage.fsspec_backend import FsspecBackend
 
-    return FsspecBackend(scheme, **(storage_options or {}))
+    return _maybe_instrument(FsspecBackend(scheme, **(storage_options or {})))
+
+
+def _maybe_instrument(backend: StorageBackend) -> StorageBackend:
+    from s3shuffle_tpu.metrics import registry as _metrics_registry
+
+    if not _metrics_registry.enabled():
+        return backend
+    from s3shuffle_tpu.storage.instrumented import InstrumentedBackend
+
+    return InstrumentedBackend(backend)

@@ -72,8 +72,16 @@ def _dec_f64_words(u: np.ndarray) -> np.ndarray:
 
 def _enc_i32_words(col) -> np.ndarray:
     """int64-valued column → order-preserving native uint32 words; range-
-    checked (silent wraparound would silently mis-sort and mis-join)."""
-    a = np.ascontiguousarray(col, dtype=np.int64)
+    checked (silent wraparound would silently mis-sort and mis-join), and
+    integer-dtype-checked (a float column cast to int64 would silently
+    TRUNCATE — e.g. 1.9 → 1 — and mis-join just as silently)."""
+    raw = np.asarray(col)
+    if raw.size and raw.dtype.kind not in "iu":
+        raise ValueError(
+            f"i32 key column requires an integer dtype, got {raw.dtype} "
+            "(float values would be silently truncated; use an f64 field)"
+        )
+    a = np.ascontiguousarray(raw, dtype=np.int64)
     if a.size and (
         int(a.min()) < -(1 << 31) or int(a.max()) >= (1 << 31)
     ):
@@ -265,6 +273,12 @@ def pack_values(*cols, dtypes: Optional[Sequence[str]] = None) -> np.ndarray:
     rows = np.empty(n, dtype=st)
     for j, (d, c) in enumerate(zip(dtypes, cols)):
         a = np.asarray(c)
+        if a.size and a.dtype.kind not in "iu":
+            raise ValueError(
+                f"value column {j} requires an integer dtype for {d} "
+                f"packing, got {a.dtype} (float values would be silently "
+                "truncated on the struct assignment)"
+            )
         info = np.iinfo(_VAL_DTYPES[d][0])
         if a.size and (int(a.min()) < info.min or int(a.max()) > info.max):
             raise ValueError(

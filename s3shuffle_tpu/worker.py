@@ -263,6 +263,7 @@ class WorkerAgent:
             return "run"
         map_output = None
         result = None
+        stale = False
         try:
             result = fn(self, task, stage_id)
             map_output = result.pop("_map_output", None) if isinstance(result, dict) else None
@@ -272,6 +273,7 @@ class WorkerAgent:
         except StaleAttemptError as e:
             logger.warning("worker %s: %s — attempt abandoned", self.worker_id, e)
             accepted = True  # nothing to report; the lease moved on
+            stale = True  # ... and any stats it recorded are the retry's to report
         except Exception as e:
             logger.exception("task %s failed", task.get("task_id"))
             accepted = self.client.fail_task(
@@ -290,8 +292,31 @@ class WorkerAgent:
                 self.worker_id, task.get("task_id"),
             )
             self._delete_refused_attempt_objects(kind, map_output, result)
+        self._push_task_stats(discard=stale or accepted is False)
         self.tasks_run += 1
         return "run"
+
+    def _push_task_stats(self, discard: bool = False) -> None:
+        """Drain this process's ShuffleStats outbox (entries recorded at
+        map-commit / reduce-completion) to the coordinator's aggregate.
+        ``discard`` drops the drained entries instead (a REFUSED attempt:
+        the winning retry reports the same task, so pushing the zombie's
+        entries would double-count it — same rationale as the object delete
+        above). Best-effort: stats must never fail a task report."""
+        from s3shuffle_tpu.metrics import registry as metrics_registry
+        from s3shuffle_tpu.metrics.stats import COLLECTOR
+
+        if not metrics_registry.enabled():
+            return
+        entries = COLLECTOR.drain_outbox()
+        if not entries or discard:
+            return
+        try:
+            self.client.report_task_stats(entries)
+        except Exception:
+            logger.warning(
+                "worker %s: could not push task stats", self.worker_id, exc_info=True
+            )
 
     def _delete_refused_attempt_objects(self, kind, map_output, result) -> None:
         """Best-effort removal of a refused (zombie/stale) attempt's
@@ -414,6 +439,7 @@ class MetricsServer:
         return self
 
     def render(self) -> str:
+        from s3shuffle_tpu.metrics import registry as metrics_registry
         from s3shuffle_tpu.utils import trace
 
         # exposition-format label escaping: \\, \" and newline
@@ -434,11 +460,24 @@ class MetricsServer:
                 c if c.isalnum() else "_" for c in name.lower()
             )
             merged[metric] = merged.get(metric, 0) + value
+        # registry instruments render below (with _bucket/_sum/_count series
+        # for histograms); keep the legacy trace counters out of their way
+        registry_names = {
+            "s3shuffle_" + m.name for m in metrics_registry.REGISTRY.metrics()
+        }
         lines = []
         for metric, value in merged.items():
+            if metric in registry_names:
+                continue
             lines.append(f"# TYPE {metric} counter")
             lines.append(f'{metric}{{worker="{wid}"}} {value}')
-        return "\n".join(lines) + "\n"
+        body = "\n".join(lines) + "\n"
+        # typed registry: counters, gauges, and histograms (the metrics
+        # subsystem's latency distributions), labeled with this worker id
+        body += metrics_registry.render_prometheus(
+            metrics_registry.REGISTRY, extra_labels={"worker": wid}
+        )
+        return body
 
     def stop(self) -> None:
         self._server.shutdown()

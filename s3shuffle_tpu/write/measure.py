@@ -13,7 +13,17 @@ import logging
 import time
 from typing import BinaryIO
 
+from s3shuffle_tpu.metrics import registry as _metrics
+
 logger = logging.getLogger("s3shuffle_tpu.write")
+
+_H_UPLOAD = _metrics.REGISTRY.histogram(
+    "write_upload_seconds",
+    "Cumulative sink write/flush/close time per measured output object",
+)
+_C_UPLOAD_BYTES = _metrics.REGISTRY.counter(
+    "write_upload_bytes_total", "Bytes pushed through measured output streams"
+)
 
 
 class MeasuredOutputStream(io.RawIOBase):
@@ -50,6 +60,9 @@ class MeasuredOutputStream(io.RawIOBase):
         self.time_ns += time.perf_counter_ns() - t0
         ms = self.time_ns / 1e6
         mib_s = (self.bytes_written / (1024 * 1024)) / (self.time_ns / 1e9) if self.time_ns else 0.0
+        if _metrics.enabled():
+            _H_UPLOAD.observe(self.time_ns / 1e9)
+            _C_UPLOAD_BYTES.inc(self.bytes_written)
         logger.info(
             "Statistics: Writing %s %d bytes took %.1f ms (%.1f MiB/s)",
             self._label,

@@ -26,14 +26,21 @@ from __future__ import annotations
 import logging
 import os
 import tempfile
+import time
 from typing import Iterable, List, Tuple
 
 import numpy as np
 
+from s3shuffle_tpu.metrics import registry as _metrics
 from s3shuffle_tpu.write.map_output_writer import MapOutputCommitMessage
 from s3shuffle_tpu.write.spill_writer import MapWriterBase
 
 logger = logging.getLogger("s3shuffle_tpu.write")
+
+_H_SERIALIZE = _metrics.REGISTRY.histogram(
+    "write_serialize_seconds",
+    "Per-partition serializer→codec emission latency (serialized-sort path)",
+)
 
 
 class SerializedSortMapWriter(MapWriterBase):
@@ -85,6 +92,7 @@ class SerializedSortMapWriter(MapWriterBase):
         are self-delimiting, so consecutive emissions concatenate."""
         from s3shuffle_tpu.codec.framing import CodecOutputStream
 
+        t0 = time.perf_counter_ns() if _metrics.enabled() else 0
         if self.codec is not None:
             codec_stream = CodecOutputStream(self.codec, sink, close_sink=False)
             target = codec_stream
@@ -96,10 +104,13 @@ class SerializedSortMapWriter(MapWriterBase):
         w.close()
         if codec_stream is not None:
             codec_stream.close()
+        if t0:
+            _H_SERIALIZE.observe((time.perf_counter_ns() - t0) / 1e9)
 
     def _spill(self) -> None:
         if not self._batches:
             return
+        t0 = time.perf_counter_ns()
         grouped, bounds = self._sorted_pending()
         if self._spill_fd is None:
             fd, self._spill_file = tempfile.mkstemp(prefix="s3shuffle-sersort-")
@@ -115,6 +126,7 @@ class SerializedSortMapWriter(MapWriterBase):
                 self._emit_partition(f, grouped.slice_rows(lo, hi))
             offsets[pid + 1] = f.tell()
         self._spill_offsets.append(offsets)
+        self._record_spill(t0, int(offsets[-1] - offsets[0]))
         self.spill_count += 1
         logger.info(
             "Map %d (serialized path) spilled to %s (spill #%d)",

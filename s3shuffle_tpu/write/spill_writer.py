@@ -23,14 +23,27 @@ import io
 import logging
 import os
 import tempfile
+import time
 from typing import Any, Callable, Iterable, List, Optional, Tuple
 
 import numpy as np
 
 from s3shuffle_tpu.codec.framing import FrameCodec
+from s3shuffle_tpu.metrics import registry as _metrics
 from s3shuffle_tpu.write.map_output_writer import MapOutputCommitMessage, MapOutputWriter
 
 logger = logging.getLogger("s3shuffle_tpu.write")
+
+_H_SPILL = _metrics.REGISTRY.histogram(
+    "write_spill_seconds", "Per-spill flush latency (all partitions)"
+)
+_C_SPILL_BYTES = _metrics.REGISTRY.counter(
+    "write_spill_bytes_total", "Bytes moved to local spill files"
+)
+_H_COMMIT = _metrics.REGISTRY.histogram(
+    "write_commit_seconds",
+    "Map-output commit latency (drain + serialize + upload + index)",
+)
 
 
 class _PartitionPipeline:
@@ -155,10 +168,26 @@ class MapWriterBase:
         from s3shuffle_tpu.utils import trace
 
         try:
+            t0 = time.perf_counter_ns()
             with trace.span(
                 "write.commit", map_id=self.map_id, records=self._records_written
             ):
-                return self._commit()
+                message = self._commit()
+            if _metrics.enabled():
+                seconds = (time.perf_counter_ns() - t0) / 1e9
+                _H_COMMIT.observe(seconds)
+                from s3shuffle_tpu.metrics.stats import COLLECTOR
+
+                # map-commit ShuffleStats entry (reduce side reports at drain)
+                COLLECTOR.record_map(
+                    shuffle_id=self.handle.shuffle_id,
+                    map_id=self.map_id,
+                    bytes=int(np.sum(message.partition_lengths)),
+                    records=self._records_written,
+                    seconds=seconds,
+                    spills=self.spill_count,
+                )
+            return message
         except BaseException as e:
             self.output_writer.abort(e if isinstance(e, Exception) else None)
             raise
@@ -174,6 +203,12 @@ class MapWriterBase:
             self.map_index,
         )
         return message
+
+    def _record_spill(self, start_ns: int, nbytes: int) -> None:
+        """Metrics hook shared by both buffering strategies' spill paths."""
+        if _metrics.enabled():
+            _H_SPILL.observe((time.perf_counter_ns() - start_ns) / 1e9)
+            _C_SPILL_BYTES.inc(nbytes)
 
     def _cleanup_spill(self) -> None:
         if self._spill_fd is not None:
@@ -311,15 +346,19 @@ class ShuffleMapWriter(MapWriterBase):
         return sum(p.buffered_bytes() for p in self._pipelines)
 
     def _spill(self) -> None:
+        t0 = time.perf_counter_ns()
         if self._spill_fd is None:
             fd, self._spill_file = tempfile.mkstemp(prefix="s3shuffle-map-spill-")
             self._spill_fd = os.fdopen(fd, "wb+")
         f = self._spill_fd
+        spilled = 0
         for pipeline in self._pipelines:
             offset = f.tell()
             n = pipeline.spill_into(f)
             if n:
                 pipeline.spill_segments.append((offset, n))
+                spilled += n
+        self._record_spill(t0, spilled)
         self.spill_count += 1
         logger.info(
             "Map %d spilled to %s (spill #%d)", self.map_id, self._spill_file, self.spill_count

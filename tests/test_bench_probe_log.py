@@ -1,0 +1,47 @@
+"""bench._latest_probe_log_contact must only surface records with actual
+measurement payload as chip-contact evidence (ADVICE r5: an ``e2e_error``-only
+record is an attempt, not contact)."""
+
+import bench
+
+
+def test_probe_record_measurement_filter():
+    has = bench._probe_record_has_measurement
+    # real evidence
+    assert has({"chip_contact": True})
+    assert has({"event": "e2e_result", "tpu_e2e_mb_s": 4.2})
+    assert has({"event": "full_kernel_probe", "measurements": {"crc_mb_s": 9}})
+    assert has({"event": "probe", "summary": "kernels ran"})
+    assert has({"event": "manual_device_contact", "note": "jax.devices() answered"})
+    # non-evidence: failed attempts, bare heartbeats, empty blobs
+    assert not has({"event": "e2e_result", "e2e_error": "tunnel down"})
+    assert not has({"ok": True, "event": "probe"})
+    assert not has({"event": "full_kernel_probe", "measurements": {}})
+    assert not has({"event": "manual_device_contact", "note": ""})
+    assert not has({"event": "daemon_start"})
+
+
+def test_latest_contact_skips_error_only_records(tmp_path, monkeypatch):
+    import json
+    import os
+
+    log = tmp_path / "TPU_PROBE_LOG.jsonl"
+    records = [
+        {"ts_utc": "t1", "event": "e2e_result", "tpu_e2e_mb_s": 3.3},
+        {"ts_utc": "t2", "event": "e2e_result", "e2e_error": "died early"},
+        {"ts_utc": "t3", "ok": True, "event": "probe"},
+        "not json at all",
+    ]
+    with open(log, "w") as f:
+        for r in records:
+            f.write((json.dumps(r) if isinstance(r, dict) else r) + "\n")
+    real_join = os.path.join
+    monkeypatch.setattr(
+        bench.os.path, "join",
+        lambda *a: str(log) if a[-1] == "TPU_PROBE_LOG.jsonl" else real_join(*a),
+    )
+    contact = bench._latest_probe_log_contact()
+    # the error-only record is newer but carries no measurement: the last
+    # REAL measurement wins
+    assert contact["ts_utc"] == "t1"
+    assert contact["tpu_e2e_mb_s"] == 3.3

@@ -1,0 +1,424 @@
+"""Metrics subsystem: registry semantics, instrumented storage, Prometheus
+rendering, ShuffleStats end-to-end round trips, and the trace_report CLI."""
+
+import json
+import threading
+
+import pytest
+
+from s3shuffle_tpu.metrics import registry as mreg
+from s3shuffle_tpu.metrics.registry import (
+    MetricRegistry,
+    exponential_buckets,
+    render_prometheus,
+)
+from s3shuffle_tpu.metrics.stats import (
+    COLLECTOR,
+    ShuffleStats,
+    ShuffleStatsCollector,
+    TaskStats,
+)
+
+
+@pytest.fixture
+def metrics_on():
+    """Enable metrics with clean registry/collector state; restore the
+    disabled default afterwards (the rest of the suite measures the no-op
+    path)."""
+    mreg.REGISTRY.reset_values()
+    COLLECTOR.reset()
+    mreg.enable()
+    yield mreg.REGISTRY
+    mreg.disable()
+    mreg.REGISTRY.reset_values()
+    COLLECTOR.reset()
+
+
+# ---------------------------------------------------------------------------
+# Registry semantics
+# ---------------------------------------------------------------------------
+
+
+def test_counter_gauge_basics(metrics_on):
+    reg = MetricRegistry()
+    c = reg.counter("c", "help")
+    c.inc()
+    c.inc(2.5)
+    assert reg.snapshot()["c"]["series"][0]["value"] == 3.5
+    g = reg.gauge("g")
+    g.set(7)
+    g.inc(3)
+    g.dec(1)
+    assert reg.snapshot()["g"]["series"][0]["value"] == 9.0
+
+
+def test_labels_create_independent_series(metrics_on):
+    reg = MetricRegistry()
+    c = reg.counter("ops", labelnames=("op",))
+    c.labels(op="read").inc(2)
+    c.labels(op="write").inc(5)
+    series = {
+        s["labels"]["op"]: s["value"] for s in reg.snapshot()["ops"]["series"]
+    }
+    assert series == {"read": 2.0, "write": 5.0}
+    with pytest.raises(ValueError):
+        c.labels(wrong="x")
+    with pytest.raises(ValueError):
+        c.inc()  # unlabeled use of a labeled metric
+
+
+def test_get_or_create_and_kind_conflicts(metrics_on):
+    reg = MetricRegistry()
+    c1 = reg.counter("x")
+    assert reg.counter("x") is c1
+    with pytest.raises(ValueError):
+        reg.gauge("x")
+    with pytest.raises(ValueError):
+        reg.counter("x", labelnames=("op",))
+
+
+def test_histogram_bucketing(metrics_on):
+    reg = MetricRegistry()
+    h = reg.histogram("h", buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.0, 1.5, 3.0, 100.0):
+        h.observe(v)
+    s = reg.snapshot()["h"]["series"][0]
+    # le semantics: 1.0 lands in the le=1.0 bin; 100 overflows to +Inf
+    assert s["buckets"] == [2, 1, 1, 1]
+    assert s["count"] == 5
+    assert s["sum"] == pytest.approx(106.0)
+
+
+def test_exponential_buckets():
+    assert exponential_buckets(1.0, 2.0, 4) == (1.0, 2.0, 4.0, 8.0)
+    with pytest.raises(ValueError):
+        exponential_buckets(0, 2, 3)
+
+
+def test_disabled_is_noop():
+    assert not mreg.enabled()
+    reg = MetricRegistry()
+    c = reg.counter("c")
+    h = reg.histogram("h")
+    c.inc(10)
+    h.observe(1.0)
+    assert reg.snapshot(compact=True) == {}
+
+
+def test_thread_safety_under_concurrent_updates(metrics_on):
+    reg = MetricRegistry()
+    c = reg.counter("hits", labelnames=("t",))
+    h = reg.histogram("lat")
+    n_threads, per_thread = 8, 2000
+
+    def hammer(tid):
+        for i in range(per_thread):
+            c.labels(t=str(tid % 2)).inc()
+            h.observe(i * 1e-6)
+
+    threads = [threading.Thread(target=hammer, args=(t,)) for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = reg.snapshot()
+    assert sum(s["value"] for s in snap["hits"]["series"]) == n_threads * per_thread
+    assert snap["lat"]["series"][0]["count"] == n_threads * per_thread
+    assert sum(snap["lat"]["series"][0]["buckets"]) == n_threads * per_thread
+
+
+# ---------------------------------------------------------------------------
+# Prometheus rendering
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_render_all_kinds(metrics_on):
+    reg = MetricRegistry()
+    reg.counter("bytes_total", labelnames=("scheme",)).labels(scheme="s3").inc(10)
+    reg.gauge("threads").set(4)
+    h = reg.histogram("op_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = render_prometheus(reg, extra_labels={"worker": 'w"1'})
+    assert "# TYPE s3shuffle_bytes_total counter" in text
+    assert 's3shuffle_bytes_total{worker="w\\"1",scheme="s3"} 10' in text
+    assert "# TYPE s3shuffle_threads gauge" in text
+    # histogram: cumulative buckets + sum/count triplet
+    assert 's3shuffle_op_seconds_bucket{worker="w\\"1",le="0.1"} 1' in text
+    assert 's3shuffle_op_seconds_bucket{worker="w\\"1",le="1"} 2' in text
+    assert 's3shuffle_op_seconds_bucket{worker="w\\"1",le="+Inf"} 3' in text
+    assert 's3shuffle_op_seconds_count{worker="w\\"1"} 3' in text
+    assert "s3shuffle_op_seconds_sum" in text
+
+
+def test_worker_metrics_server_renders_registry(metrics_on):
+    from s3shuffle_tpu.worker import MetricsServer
+
+    mreg.REGISTRY.histogram(
+        "test_render_seconds", buckets=(0.5, 1.0)
+    ).observe(0.2)
+
+    class FakeAgent:
+        worker_id = "w-1"
+        tasks_run = 3
+
+    server = MetricsServer.__new__(MetricsServer)
+    server.agent = FakeAgent()
+    text = server.render()
+    assert 's3shuffle_tasks_run_total{worker="w-1"} 3' in text
+    assert 's3shuffle_test_render_seconds_bucket' in text
+    assert 's3shuffle_test_render_seconds_count{worker="w-1"} 1' in text
+
+
+# ---------------------------------------------------------------------------
+# InstrumentedBackend
+# ---------------------------------------------------------------------------
+
+
+def test_instrumented_backend_passthrough_and_counts(metrics_on):
+    from s3shuffle_tpu.storage.backend import MemoryBackend
+    from s3shuffle_tpu.storage.instrumented import InstrumentedBackend
+
+    b = InstrumentedBackend(MemoryBackend())
+    with b.create("memory://x/a/obj") as s:
+        s.write(b"hello world")
+    assert b.status("memory://x/a/obj").size == 11
+    r = b.open_ranged("memory://x/a/obj")
+    assert r.read_fully(0, 5) == b"hello"
+    r.close()
+    assert len(b.list_prefix("memory://x/a")) == 1
+    b.delete("memory://x/a/obj")
+    assert not b.exists("memory://x/a/obj")
+
+    snap = mreg.REGISTRY.snapshot(compact=True)
+    ops = {
+        s["labels"]["op"]: s["count"]
+        for s in snap["storage_op_seconds"]["series"]
+    }
+    for op in ("create", "open", "read", "status", "list", "delete", "write"):
+        assert ops.get(op, 0) >= 1, (op, ops)
+    reads = snap["storage_read_bytes_total"]["series"][0]
+    writes = snap["storage_write_bytes_total"]["series"][0]
+    assert reads["value"] == 5 and writes["value"] == 11
+    # the miss probe (exists → FileNotFoundError) is not an error
+    assert "storage_errors_total" not in snap
+
+
+def test_instrumented_backend_fault_injection_interplay(metrics_on):
+    from s3shuffle_tpu.storage.backend import MemoryBackend
+    from s3shuffle_tpu.storage.fault import FaultRule, FlakyBackend
+    from s3shuffle_tpu.storage.instrumented import InstrumentedBackend
+
+    inner = MemoryBackend()
+    with inner.create("memory://f/obj") as s:
+        s.write(b"payload")
+    flaky = FlakyBackend(inner, rules=[FaultRule("open", match="obj", times=1)])
+    b = InstrumentedBackend(flaky)
+    with pytest.raises(OSError):
+        b.open_ranged("memory://f/obj")
+    # transient rule exhausted → next open heals, and data flows through
+    assert b.read_all("memory://f/obj") == b"payload"
+    snap = mreg.REGISTRY.snapshot(compact=True)
+    errors = {
+        s["labels"]["op"]: s["value"]
+        for s in snap["storage_errors_total"]["series"]
+    }
+    assert errors == {"open": 1}
+
+
+def test_instrumented_backend_forwards_attribute_writes(metrics_on):
+    """Test hooks set through the wrapper must land on the inner backend
+    (MemoryBackend reads self.open_interceptor on ITSELF)."""
+    from s3shuffle_tpu.storage.backend import MemoryBackend
+    from s3shuffle_tpu.storage.instrumented import InstrumentedBackend
+
+    inner = MemoryBackend()
+    with inner.create("memory://h/obj") as s:
+        s.write(b"x")
+    b = InstrumentedBackend(inner)
+
+    def boom(path):
+        raise OSError(f"hooked: {path}")
+
+    b.open_interceptor = boom
+    assert inner.open_interceptor is boom
+    with pytest.raises(OSError, match="hooked"):
+        b.open_ranged("memory://h/obj")
+
+
+def test_get_backend_wraps_only_when_enabled(metrics_on, tmp_path):
+    from s3shuffle_tpu.storage.backend import get_backend
+    from s3shuffle_tpu.storage.instrumented import InstrumentedBackend
+
+    wrapped = get_backend(f"file://{tmp_path}")
+    assert isinstance(wrapped, InstrumentedBackend)
+    mreg.disable()
+    assert not isinstance(get_backend(f"file://{tmp_path}"), InstrumentedBackend)
+    mreg.enable()
+    # memory backends stay shared through the wrapper
+    a = get_backend("memory://metrics-test")
+    b = get_backend("memory://metrics-test")
+    with a.create("memory://metrics-test/k") as s:
+        s.write(b"v")
+    assert b.read_all("memory://metrics-test/k") == b"v"
+
+
+# ---------------------------------------------------------------------------
+# ShuffleStats
+# ---------------------------------------------------------------------------
+
+
+def test_shuffle_stats_collector_and_roundtrip(metrics_on):
+    col = ShuffleStatsCollector()
+    col.record_map(3, map_id=0, bytes=100, records=10, seconds=0.5, spills=1)
+    col.record_map(3, map_id=1, bytes=50, records=5, seconds=0.25)
+    col.record_reduce(
+        3, partition=0, bytes=150, records=15,
+        prefetch_seconds=0.1, wait_seconds=0.05, threads=4,
+    )
+    rep = col.report(3)
+    assert rep.map_tasks == 2 and rep.reduce_tasks == 1
+    assert rep.bytes_written == 150 and rep.bytes_read == 150
+    assert rep.spills == 1 and rep.max_prefetch_threads == 4
+    # dataclass → JSON → dataclass round trip
+    back = ShuffleStats.from_json(rep.to_json())
+    assert back.bytes_written == 150 and back.shuffle_id == 3
+    # outbox drain + coordinator-style merge (no re-enqueue)
+    entries = col.drain_outbox()
+    assert len(entries) == 3 and col.drain_outbox() == []
+    other = ShuffleStatsCollector()
+    for e in entries:
+        other.merge(e)
+    assert other.report(3).bytes_written == 150
+    assert other.drain_outbox() == []
+    # same-process guard: a collector never re-counts entries it recorded
+    # itself (coordinator sharing the worker process)
+    for e in entries:
+        col.merge(e)
+    assert col.report(3).bytes_written == 150 and col.report(3).map_tasks == 2
+
+
+def test_tracker_aggregates_task_stats(metrics_on):
+    from s3shuffle_tpu.metadata.map_output import MapOutputTracker
+
+    tracker = MapOutputTracker()
+    tracker.report_task_stats(
+        [TaskStats("map", 9, 0, bytes=42, records=4, seconds=0.1).to_dict()]
+    )
+    stats = tracker.get_shuffle_stats(9)
+    assert stats["map_tasks"] == 1 and stats["bytes_written"] == 42
+    assert tracker.get_shuffle_stats(999) is None
+
+
+def test_remote_tracker_stats_rpc(metrics_on):
+    from s3shuffle_tpu.metadata.service import MetadataServer, RemoteMapOutputTracker
+
+    server = MetadataServer().start()
+    try:
+        client = RemoteMapOutputTracker(server.address)
+        client.report_task_stats(
+            [TaskStats("reduce", 5, 0, bytes=7, records=2,
+                       seconds=0.01, wait_seconds=0.005, threads=2).to_dict()]
+        )
+        stats = client.get_shuffle_stats(5)
+        assert stats["reduce_tasks"] == 1 and stats["bytes_read"] == 7
+        assert stats["max_prefetch_threads"] == 2
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_shuffle_stats_end_to_end(metrics_on, tmp_path):
+    """Acceptance slice: a metrics-enabled shuffle produces a ShuffleStats
+    report with non-zero storage-op latency buckets, prefetcher wait /
+    thread-count series, and write-plane timings — and trace_report renders
+    a p50/p95/p99 summary from its JSON."""
+    import random
+
+    from s3shuffle_tpu.config import ShuffleConfig
+    from s3shuffle_tpu.shuffle import ShuffleContext
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(root_dir=f"file://{tmp_path}/mx", app_id="metrics-e2e")
+    rng = random.Random(11)
+    parts = [
+        [(rng.randrange(50), rng.randrange(1000)) for _ in range(800)]
+        for _ in range(3)
+    ]
+    with ShuffleContext(config=cfg, num_workers=2) as ctx:
+        result = dict(ctx.fold_by_key(parts, 0, lambda a, b: a + b, num_partitions=4))
+    assert len(result) == 50
+
+    rep = COLLECTOR.report(0)
+    assert rep is not None
+    assert rep.map_tasks == 3 and rep.reduce_tasks == 4
+    assert rep.bytes_written > 0 and rep.bytes_read > 0
+    assert rep.write_seconds > 0
+
+    snap = rep.metrics
+    op_series = snap["storage_op_seconds"]["series"]
+    assert any(s["count"] > 0 and sum(s["buckets"]) == s["count"] for s in op_series)
+    assert snap["read_prefetch_wait_seconds"]["series"][0]["count"] > 0
+    assert snap["read_prefetch_threads"]["series"][0]["value"] >= 1
+    assert snap["write_commit_seconds"]["series"][0]["count"] == 3
+    assert snap["write_upload_seconds"]["series"][0]["count"] == 3
+
+    import tools.trace_report as trace_report
+
+    text = trace_report.render(json.loads(rep.to_json()))
+    assert "p50" in text and "p95" in text and "p99" in text
+    assert "storage_op_seconds" in text
+    assert "throughput" in text
+
+
+# ---------------------------------------------------------------------------
+# trace_report CLI
+# ---------------------------------------------------------------------------
+
+
+def test_trace_report_on_synthetic_trace_file(tmp_path, capsys):
+    import tools.trace_report as trace_report
+
+    doc = {
+        "traceEvents": [
+            {"name": "codec.compress_batch", "ph": "X", "ts": i * 100.0,
+             "dur": 500.0 + 10 * i, "pid": 1, "tid": 1}
+            for i in range(50)
+        ],
+        "otherData": {"counters": {"write.bytes": 10 * (1 << 20)}},
+    }
+    path = tmp_path / "trace.json"
+    path.write_text(json.dumps(doc))
+    assert trace_report.main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "codec.compress_batch" in out
+    assert "p99" in out
+    assert "write.bytes" in out and "MiB" in out
+
+
+def test_trace_report_histogram_quantiles():
+    from tools.trace_report import histogram_quantile
+
+    bounds = [1.0, 2.0, 4.0, 8.0]
+    # 10 obs in (1,2], 10 in (4,8]
+    counts = [0, 10, 0, 10, 0]
+    assert 1.0 <= histogram_quantile(bounds, counts, 0.25) <= 2.0
+    assert 4.0 <= histogram_quantile(bounds, counts, 0.99) <= 8.0
+    assert histogram_quantile(bounds, [0] * 5, 0.5) == 0.0
+
+
+def test_trace_report_selftest_smoke():
+    """The tier-1 wiring for the CLI selftest (CI smoke check)."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.trace_report", "--selftest"],
+        cwd=repo, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "selftest OK" in proc.stdout

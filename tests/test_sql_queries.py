@@ -42,3 +42,61 @@ def test_results_codec_invariant(tmp_path):
         )
         rows[codec] = out["rows_out"]
     assert rows["none"] == rows["zlib"]
+
+
+def test_agg_typed_falls_back_to_wide_rows(tmp_path):
+    """A value overflowing its declared narrow wire dtype must not abort the
+    stage: agg_typed retries with wide int64 rows (and i64 keys) and the row
+    reports the fallback."""
+    import numpy as np
+
+    from s3shuffle_tpu.config import ShuffleConfig
+    from s3shuffle_tpu.shuffle import ShuffleContext
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+    from s3shuffle_tpu.structured import KeyCodec
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(root_dir=f"file://{tmp_path}/agg", app_id="fb")
+    with ShuffleContext(config=cfg, num_workers=2) as ctx:
+        st = sql_queries.ColumnarStages(ctx)
+        keys = np.array([1, 1, 2, 2], dtype=np.int64)
+        vals = np.array([1000, 1000, 5, 5], dtype=np.int64)  # 1000 >> i1
+        (k,), v = st.agg_typed(
+            KeyCodec("i32"), (keys,), (vals,), ("sum",), val_dtypes=("i1",)
+        )
+    order = np.argsort(k)
+    assert k[order].tolist() == [1, 2]
+    assert v[order, 0].tolist() == [2000, 10]
+    assert st.narrow_fallbacks == 1
+    assert st.stages == 1
+
+
+def test_agg_typed_reraises_non_range_errors(tmp_path):
+    """Only range overflow is recoverable by widening: a float column (would
+    truncate just as silently through wide i64) or a dtype-count mismatch is
+    a caller bug and must propagate."""
+    import numpy as np
+    import pytest
+
+    from s3shuffle_tpu.config import ShuffleConfig
+    from s3shuffle_tpu.shuffle import ShuffleContext
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+    from s3shuffle_tpu.structured import KeyCodec
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(root_dir=f"file://{tmp_path}/agg2", app_id="fb2")
+    with ShuffleContext(config=cfg, num_workers=1) as ctx:
+        st = sql_queries.ColumnarStages(ctx)
+        with pytest.raises(ValueError, match="integer dtype"):
+            st.agg_typed(
+                KeyCodec("i32"), (np.array([1.5, 2.5]),),
+                (np.array([1, 2], dtype=np.int64),), ("sum",),
+                val_dtypes=("i1",),
+            )
+        with pytest.raises(ValueError, match="expected"):
+            st.agg_typed(
+                KeyCodec("i32"), (np.array([1, 2], dtype=np.int64),),
+                (np.array([1, 2], dtype=np.int64),), ("sum",),
+                val_dtypes=("i1", "i1"),
+            )
+    assert st.narrow_fallbacks == 0

@@ -202,6 +202,30 @@ def test_narrow_values_range_check():
         pack_values(np.array([40000]), dtypes=("i2",))
 
 
+def test_i32_key_rejects_float_dtype():
+    """A float column through the i32 pack path would silently truncate
+    (1.9 → 1) and mis-join; the typed paths must raise instead."""
+    codec = KeyCodec("i32")
+    with pytest.raises(ValueError, match="integer dtype"):
+        codec.pack(np.array([1.9, 2.5]))
+    with pytest.raises(ValueError, match="integer dtype"):
+        codec.pack([0.5])
+    # empty columns keep working regardless of inferred dtype
+    assert codec.pack(np.array([], dtype=np.float64)).size == 0
+    # integer input (including Python lists) is unaffected
+    assert codec.unpack(codec.pack([1, 2]), 2)[0].tolist() == [1, 2]
+
+
+def test_narrow_pack_values_rejects_float_dtype():
+    with pytest.raises(ValueError, match="integer dtype"):
+        pack_values(np.array([1.5, 2.0]), dtypes=("i4",))
+    with pytest.raises(ValueError, match="integer dtype"):
+        pack_values(np.array([1]), np.array([0.25]), dtypes=("i2", "i2"))
+    # empty and integer columns still pack
+    assert pack_values(np.array([], dtype=np.float64), dtypes=("i4",)).size == 0
+    assert len(pack_values(np.array([3]), dtypes=("i4",))) == 4
+
+
 def test_narrow_agg_shuffle_no_overflow(tmp_path):
     """i1 wire values summing far past 127: the reduce side widens BEFORE
     reducing, so aggregates never overflow the wire width."""

@@ -1,0 +1,1 @@
+"""Analysis/ops CLIs that ship with the framework (probe daemons, trace reports)."""

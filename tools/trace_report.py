@@ -1,0 +1,391 @@
+#!/usr/bin/env python
+"""Trace / ShuffleStats report analyzer.
+
+The reference's answer to "where did the time go" is an external Grafana
+dashboard over jvm-profiler samples (examples/README.md:54-101). This CLI is
+the in-repo equivalent: point it at either
+
+- a **Chrome trace JSON** written by :mod:`s3shuffle_tpu.utils.trace`
+  (``S3SHUFFLE_TRACE=<path>``), or
+- a **ShuffleStats report** written by the metrics subsystem
+  (``S3SHUFFLE_STATS=<path>``, or ``ShuffleStatsCollector.dump``),
+
+and it prints per-span / per-histogram p50/p95/p99 latencies, the top time
+consumers, and bytes/throughput tables.
+
+Usage:
+    python -m tools.trace_report s3shuffle_trace.json
+    python -m tools.trace_report shuffle_stats.json --top 15
+    python -m tools.trace_report --selftest   # fast smoke check (CI tier-1)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+QUANTILES = (0.5, 0.95, 0.99)
+
+
+# ---------------------------------------------------------------------------
+# Shared formatting
+# ---------------------------------------------------------------------------
+
+
+def _fmt_seconds(s: float) -> str:
+    if s >= 1.0:
+        return f"{s:.2f}s"
+    if s >= 1e-3:
+        return f"{s * 1e3:.2f}ms"
+    return f"{s * 1e6:.0f}µs"
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit, scale in (("GiB", 1 << 30), ("MiB", 1 << 20), ("KiB", 1 << 10)):
+        if n >= scale:
+            return f"{n / scale:.2f} {unit}"
+    return f"{int(n)} B"
+
+
+def _table(headers: Sequence[str], rows: List[Sequence[str]]) -> str:
+    widths = [
+        max(len(str(h)), *(len(str(r[i])) for r in rows)) if rows else len(str(h))
+        for i, h in enumerate(headers)
+    ]
+    def line(cells):
+        return "  ".join(str(c).ljust(w) for c, w in zip(cells, widths)).rstrip()
+    sep = "  ".join("-" * w for w in widths)
+    return "\n".join([line(headers), sep, *(line(r) for r in rows)])
+
+
+def _exact_quantile(sorted_values: List[float], q: float) -> float:
+    if not sorted_values:
+        return 0.0
+    idx = min(len(sorted_values) - 1, max(0, round(q * (len(sorted_values) - 1))))
+    return sorted_values[idx]
+
+
+def histogram_quantile(
+    bounds: Sequence[float], counts: Sequence[int], q: float
+) -> float:
+    """Estimate the q-quantile from per-bin counts (``counts`` has one more
+    entry than ``bounds`` — the +Inf overflow bin). Linear interpolation
+    within the winning bin; overflow answers the last finite bound (a lower
+    bound on the true value)."""
+    total = sum(counts)
+    if total == 0:
+        return 0.0
+    target = q * total
+    cum = 0.0
+    for i, n in enumerate(counts):
+        if n == 0:
+            continue
+        if cum + n >= target:
+            if i >= len(bounds):  # overflow bin
+                return float(bounds[-1])
+            lo = float(bounds[i - 1]) if i > 0 else 0.0
+            hi = float(bounds[i])
+            frac = (target - cum) / n
+            return lo + (hi - lo) * min(1.0, max(0.0, frac))
+        cum += n
+    return float(bounds[-1]) if bounds else 0.0
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace rendering
+# ---------------------------------------------------------------------------
+
+
+def render_trace(doc: dict, top: int = 10) -> str:
+    events = [e for e in doc.get("traceEvents", []) if e.get("ph") == "X"]
+    by_name: Dict[str, List[float]] = {}
+    for e in events:
+        by_name.setdefault(e.get("name", "?"), []).append(float(e.get("dur", 0.0)) / 1e6)
+    out: List[str] = []
+    total_all = sum(sum(v) for v in by_name.values())
+    if by_name:
+        rows = []
+        for name, durs in sorted(
+            by_name.items(), key=lambda kv: -sum(kv[1])
+        )[:top]:
+            durs.sort()
+            total = sum(durs)
+            rows.append(
+                (
+                    name,
+                    len(durs),
+                    _fmt_seconds(total),
+                    f"{100.0 * total / total_all:.1f}%" if total_all else "-",
+                    _fmt_seconds(_exact_quantile(durs, 0.5)),
+                    _fmt_seconds(_exact_quantile(durs, 0.95)),
+                    _fmt_seconds(_exact_quantile(durs, 0.99)),
+                )
+            )
+        out.append(f"Spans (top {min(top, len(by_name))} by total time):")
+        out.append(
+            _table(("span", "count", "total", "share", "p50", "p95", "p99"), rows)
+        )
+    else:
+        out.append("No complete ('X') span events in trace.")
+    counters = doc.get("otherData", {}).get("counters", {})
+    if counters:
+        wall_s = 0.0
+        if events:
+            t0 = min(float(e["ts"]) for e in events)
+            t1 = max(float(e["ts"]) + float(e.get("dur", 0.0)) for e in events)
+            wall_s = (t1 - t0) / 1e6
+        rows = []
+        for name, value in sorted(counters.items()):
+            if "bytes" in name.lower():
+                thr = _fmt_bytes(value / wall_s) + "/s" if wall_s else "-"
+                rows.append((name, _fmt_bytes(value), thr))
+            else:
+                rows.append((name, f"{value:g}", "-"))
+        out.append("")
+        out.append(f"Counters (trace wall {_fmt_seconds(wall_s)}):")
+        out.append(_table(("counter", "value", "throughput"), rows))
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# ShuffleStats / registry-snapshot rendering
+# ---------------------------------------------------------------------------
+
+
+def _series_label(name: str, series: dict) -> str:
+    labels = series.get("labels")
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={v}" for k, v in labels.items())
+    return f"{name}{{{inner}}}"
+
+
+def render_metrics_snapshot(snapshot: dict, top: int = 10) -> str:
+    hist_rows: List[Tuple[float, Sequence[str]]] = []
+    counter_rows: List[Sequence[str]] = []
+    gauge_rows: List[Sequence[str]] = []
+    for name, metric in sorted(snapshot.items()):
+        kind = metric.get("kind")
+        for series in metric.get("series", []):
+            label = _series_label(name, series)
+            if kind == "histogram":
+                count = series.get("count", 0)
+                if not count:
+                    continue
+                qs = [
+                    histogram_quantile(series["le"], series["buckets"], q)
+                    for q in QUANTILES
+                ]
+                total = float(series.get("sum", 0.0))
+                is_seconds = name.endswith("_seconds")
+                fmt = _fmt_seconds if is_seconds else (lambda v: f"{v:g}")
+                hist_rows.append(
+                    (
+                        total if is_seconds else 0.0,
+                        (
+                            label,
+                            count,
+                            fmt(total),
+                            fmt(qs[0]),
+                            fmt(qs[1]),
+                            fmt(qs[2]),
+                        ),
+                    )
+                )
+            elif kind == "counter":
+                value = series.get("value", 0)
+                pretty = (
+                    _fmt_bytes(value) if "bytes" in name else f"{value:g}"
+                )
+                counter_rows.append((label, pretty))
+            else:
+                gauge_rows.append((label, f"{series.get('value', 0):g}"))
+    out: List[str] = []
+    if hist_rows:
+        hist_rows.sort(key=lambda r: -r[0])
+        out.append("Latency / size distributions (histograms, by total time):")
+        out.append(
+            _table(
+                ("histogram", "count", "sum", "p50", "p95", "p99"),
+                [r for _total, r in hist_rows],
+            )
+        )
+    if counter_rows:
+        out.append("")
+        out.append("Counters:")
+        out.append(_table(("counter", "value"), counter_rows))
+    if gauge_rows:
+        out.append("")
+        out.append("Gauges:")
+        out.append(_table(("gauge", "value"), gauge_rows))
+    if not out:
+        out.append("Empty metrics snapshot.")
+    return "\n".join(out)
+
+
+def render_shuffle_stats(report: dict, top: int = 10) -> str:
+    out = [f"ShuffleStats: shuffle {report.get('shuffle_id', '?')}"]
+    rows = []
+    bw, br = report.get("bytes_written", 0), report.get("bytes_read", 0)
+    ws = report.get("write_seconds", 0.0)
+    ps = report.get("read_prefetch_seconds", 0.0)
+    rows.append(
+        (
+            "map",
+            report.get("map_tasks", 0),
+            _fmt_bytes(bw),
+            report.get("records_written", 0),
+            _fmt_seconds(ws),
+            _fmt_bytes(bw / ws) + "/s" if ws else "-",
+        )
+    )
+    rows.append(
+        (
+            "reduce",
+            report.get("reduce_tasks", 0),
+            _fmt_bytes(br),
+            report.get("records_read", 0),
+            _fmt_seconds(ps),
+            _fmt_bytes(br / ps) + "/s" if ps else "-",
+        )
+    )
+    out.append(
+        _table(("plane", "tasks", "bytes", "records", "seconds", "throughput"), rows)
+    )
+    extras = []
+    if report.get("spills"):
+        extras.append(f"spills={report['spills']}")
+    if report.get("read_wait_seconds"):
+        extras.append(
+            f"reduce consumer wait={_fmt_seconds(report['read_wait_seconds'])}"
+        )
+    if report.get("max_prefetch_threads"):
+        extras.append(f"max prefetch threads={report['max_prefetch_threads']}")
+    if extras:
+        out.append("  " + ", ".join(extras))
+    metrics = report.get("metrics") or {}
+    if metrics:
+        out.append("")
+        out.append(render_metrics_snapshot(metrics, top=top))
+    return "\n".join(out)
+
+
+def render(doc: dict, top: int = 10) -> str:
+    """Dispatch on document shape: Chrome trace, ShuffleStats dump, a single
+    report, or a bare registry snapshot (the BENCH ``metrics`` field)."""
+    if "traceEvents" in doc:
+        return render_trace(doc, top=top)
+    if "shuffles" in doc:
+        return "\n\n".join(
+            render_shuffle_stats(r, top=top) for r in doc["shuffles"]
+        ) or "No shuffle reports in file."
+    if "shuffle_id" in doc:
+        return render_shuffle_stats(doc, top=top)
+    if "metrics" in doc and isinstance(doc["metrics"], dict):
+        return render_metrics_snapshot(doc["metrics"], top=top)
+    # bare registry snapshot: {name: {kind, series}}
+    if all(isinstance(v, dict) and "series" in v for v in doc.values()) and doc:
+        return render_metrics_snapshot(doc, top=top)
+    raise ValueError(
+        "unrecognized document: expected a Chrome trace (traceEvents), a "
+        "ShuffleStats report/dump, or a metrics registry snapshot"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Selftest (wired into the tier-1 run: python -m tools.trace_report --selftest)
+# ---------------------------------------------------------------------------
+
+
+def _selftest() -> int:
+    trace_doc = {
+        "traceEvents": [
+            {"name": "read.prefetch", "ph": "X", "ts": i * 1000.0, "dur": 1000.0 + i}
+            for i in range(100)
+        ]
+        + [{"name": "write.commit", "ph": "X", "ts": 0.0, "dur": 250000.0}],
+        "otherData": {"counters": {"io.bytes_read": 64 * 1024 * 1024}},
+    }
+    text = render_trace(trace_doc)
+    for needle in ("write.commit", "read.prefetch", "p50", "p95", "p99", "MiB"):
+        assert needle in text, f"trace render missing {needle!r}:\n{text}"
+
+    # synthetic histogram: 90 obs in (0.008, 0.016], 10 in (0.128, 0.256]
+    bounds = [0.001 * 2**i for i in range(10)]
+    buckets = [0] * 11
+    buckets[4] = 90
+    buckets[8] = 10
+    report = {
+        "shuffle_id": 7,
+        "map_tasks": 4,
+        "reduce_tasks": 4,
+        "bytes_written": 1 << 20,
+        "bytes_read": 1 << 20,
+        "records_written": 1000,
+        "records_read": 1000,
+        "write_seconds": 0.5,
+        "read_prefetch_seconds": 0.25,
+        "read_wait_seconds": 0.05,
+        "spills": 2,
+        "max_prefetch_threads": 3,
+        "metrics": {
+            "storage_op_seconds": {
+                "kind": "histogram",
+                "labelnames": ["scheme", "op"],
+                "series": [
+                    {
+                        "labels": {"scheme": "file", "op": "read"},
+                        "le": bounds,
+                        "buckets": buckets,
+                        "sum": 90 * 0.012 + 10 * 0.2,
+                        "count": 100,
+                    }
+                ],
+            },
+            "storage_read_bytes_total": {
+                "kind": "counter",
+                "series": [{"labels": {"scheme": "file"}, "value": 1 << 20}],
+            },
+            "read_prefetch_threads": {
+                "kind": "gauge",
+                "series": [{"value": 3}],
+            },
+        },
+    }
+    text = render_shuffle_stats(report)
+    for needle in ("shuffle 7", "storage_op_seconds", "p95", "throughput"):
+        assert needle in text, f"stats render missing {needle!r}:\n{text}"
+    p50 = histogram_quantile(bounds, buckets, 0.5)
+    assert 0.008 <= p50 <= 0.016, p50
+    p99 = histogram_quantile(bounds, buckets, 0.99)
+    assert 0.128 <= p99 <= 0.256, p99
+    assert histogram_quantile(bounds, [0] * 11, 0.5) == 0.0
+    # overflow-bin quantile answers the last finite bound
+    over = [0] * 11
+    over[10] = 5
+    assert histogram_quantile(bounds, over, 0.5) == bounds[-1]
+    print("trace_report selftest OK")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[1])
+    ap.add_argument("path", nargs="?", help="trace JSON or ShuffleStats report")
+    ap.add_argument("--top", type=int, default=10, help="rows in the span table")
+    ap.add_argument("--selftest", action="store_true",
+                    help="render synthetic inputs and verify the output")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _selftest()
+    if not args.path:
+        ap.error("need a trace/report path (or --selftest)")
+    with open(args.path) as f:
+        doc = json.load(f)
+    print(render(doc, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
