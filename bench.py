@@ -991,6 +991,160 @@ def prefetch_adaptive_gain(n_blocks: int = 120, delay_s: float = 0.02):
             shutil.rmtree(root, ignore_errors=True)
 
 
+def chunked_fetch_gain(block_mib: int = 24, delay_s: float = 0.05, parallelism: int = 6):
+    """Transfer-plane probe (read side): does splitting one LARGE prefill into
+    concurrent ranged sub-reads beat the serial GET? One big single-partition
+    block against a memory store with per-read injected latency
+    (storage.fault.LatencyRule — the prefetch_adaptive_gain methodology). The
+    serial path's ``read_up_to`` chunk_limit and the fetcher's chunk size are
+    the SAME 4 MiB, so both paths issue the identical sequence of delayed
+    GETs and only concurrency differs. Byte equality is asserted, not
+    assumed."""
+    from s3shuffle_tpu.block_ids import ShuffleBlockId, ShuffleDataBlockId
+    from s3shuffle_tpu.config import ShuffleConfig
+    from s3shuffle_tpu.metadata.helper import ShuffleHelper
+    from s3shuffle_tpu.read.block_stream import BlockStream
+    from s3shuffle_tpu.read.chunked_fetch import ChunkedRangeFetcher
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+    from s3shuffle_tpu.storage.fault import FlakyBackend, LatencyRule
+    from s3shuffle_tpu.utils.io import read_up_to
+    from s3shuffle_tpu.write.map_output_writer import MapOutputWriter
+
+    chunk = 4 * 1024 * 1024
+    try:
+        Dispatcher.reset()
+        cfg = ShuffleConfig(root_dir="memory://bench-chunked", app_id="bench-chunked")
+        d = Dispatcher(cfg)
+        helper = ShuffleHelper(d)
+        data = random.Random(3).randbytes(block_mib * 1024 * 1024)
+        w = MapOutputWriter(d, helper, 0, 0, 1)
+        pw = w.get_partition_writer(0)
+        pw.write(data)
+        pw.close()
+        w.commit_all_partitions()
+        d.backend = FlakyBackend(
+            d.backend, latency=[LatencyRule("read", match=".data", delay_s=delay_s)]
+        )
+        d.clear_status_cache()
+
+        def make_stream():
+            offsets = helper.get_partition_lengths(0, 0)
+            block = ShuffleBlockId(0, 0, 0)
+            return BlockStream(d, block, ShuffleDataBlockId(0, 0), 0, int(offsets[1]))
+
+        def timed(fn):
+            best, out = float("inf"), None
+            for _ in range(2):
+                s = make_stream()
+                t0 = time.perf_counter()
+                got = fn(s)
+                best = min(best, time.perf_counter() - t0)
+                s.close()
+                out = got
+            return best, out
+
+        serial_wall, serial_bytes = timed(lambda s: read_up_to(s, len(data), chunk_limit=chunk))
+        fetcher = ChunkedRangeFetcher(chunk, parallelism=parallelism)
+        chunked_wall, chunked_bytes = timed(lambda s: fetcher.prefill(s, len(data)))
+        assert chunked_bytes == serial_bytes == data, "chunked fetch corrupted data"
+    except Exception as e:  # never fail the bench over this row
+        return {"chunked_fetch_error": str(e)[:120]}
+    finally:
+        Dispatcher.reset()
+    return {
+        "chunked_fetch_speedup": round(serial_wall / chunked_wall, 2),
+        "chunked_fetch_serial_wall_s": round(serial_wall, 3),
+        "chunked_fetch_wall_s": round(chunked_wall, 3),
+        "chunked_fetch_block_mib": block_mib,
+        "chunked_fetch_chunk_bytes": chunk,
+        "chunked_fetch_parallelism": parallelism,
+        "chunked_fetch_latency_ms": delay_s * 1e3,
+    }
+
+
+def pipelined_commit_gain(
+    n_partitions: int = 8,
+    part_bytes: int = 256 * 1024,
+    compute_s: float = 0.02,
+    delay_s: float = 0.03,
+):
+    """Transfer-plane probe (write side): pipelined commit wall vs the serial
+    drain+upload sum. Each partition costs ``compute_s`` of producer work (the
+    drain/serialize stand-in) and every 256 KiB store write is delayed
+    ``delay_s`` (LatencyRule). The serial run's buffer_size equals the
+    pipelined run's chunk size, so both issue the same delayed writes; the
+    pipelined run overlaps them with the compute."""
+    from s3shuffle_tpu.config import ShuffleConfig
+    from s3shuffle_tpu.metadata.helper import ShuffleHelper
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+    from s3shuffle_tpu.storage.fault import FlakyBackend, LatencyRule
+    from s3shuffle_tpu.write.map_output_writer import MapOutputWriter
+
+    payloads = [
+        random.Random(10 + i).randbytes(part_bytes) for i in range(n_partitions)
+    ]
+
+    def run(queue_bytes: int) -> float:
+        Dispatcher.reset()
+        cfg = ShuffleConfig(
+            root_dir=f"memory://bench-pipelined-{queue_bytes}",
+            app_id="bench-pipelined",
+            upload_queue_bytes=queue_bytes,
+            buffer_size=part_bytes,  # serial path flushes at the same grain
+        )
+        d = Dispatcher(cfg)
+        helper = ShuffleHelper(d)
+        d.backend = FlakyBackend(
+            d.backend, latency=[LatencyRule("write", match=".data", delay_s=delay_s)]
+        )
+        best = float("inf")
+        for rep in range(2):
+            w = MapOutputWriter(d, helper, rep, 0, n_partitions)
+            t0 = time.perf_counter()
+            for pid, data in enumerate(payloads):
+                time.sleep(compute_s)  # drain/serialize stand-in
+                pw = w.get_partition_writer(pid)
+                pw.write(data)
+                pw.close()
+            w.commit_all_partitions()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    try:
+        serial_wall = run(0)
+        pipelined_wall = run(part_bytes * 4)  # queue: 4 chunks in flight
+    except Exception as e:  # never fail the bench over this row
+        return {"pipelined_commit_error": str(e)[:120]}
+    finally:
+        Dispatcher.reset()
+    return {
+        "pipelined_commit_speedup": round(serial_wall / pipelined_wall, 2),
+        "pipelined_commit_serial_wall_s": round(serial_wall, 3),
+        "pipelined_commit_wall_s": round(pipelined_wall, 3),
+        "pipelined_commit_partitions": n_partitions,
+        "pipelined_commit_part_bytes": part_bytes,
+        "pipelined_commit_compute_ms": compute_s * 1e3,
+        "pipelined_commit_write_latency_ms": delay_s * 1e3,
+        "pipelined_commit_queue_bytes": part_bytes * 4,
+    }
+
+
+def transfer_plane_knobs():
+    """The transfer-plane knobs the headline runs used (ShuffleConfig
+    defaults) — recorded so BENCH rounds stay comparable when a default
+    moves."""
+    from s3shuffle_tpu.config import ShuffleConfig
+
+    cfg = ShuffleConfig()
+    return {
+        "transfer_plane": {
+            "fetch_chunk_size": cfg.fetch_chunk_size,
+            "fetch_parallelism": cfg.fetch_parallelism,
+            "upload_queue_bytes": cfg.upload_queue_bytes,
+        }
+    }
+
+
 def main():
     from s3shuffle_tpu.metrics import registry as _metrics_registry
 
@@ -1014,6 +1168,9 @@ def main():
         **aggregate_multiworker(parts),
         **wide_shuffle_comparison(),
         **prefetch_adaptive_gain(),
+        **chunked_fetch_gain(),
+        **pipelined_commit_gain(),
+        **transfer_plane_knobs(),
         **load_calibration(),
         **device_kernel_rates(),
     }

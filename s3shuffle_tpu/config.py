@@ -66,6 +66,18 @@ class ShuffleConfig:
     # --- read plane ---
     max_buffer_size_task: int = 128 * MiB
     max_concurrency_task: int = 10
+    # --- transfer plane (TPU-first addition; the reference delegates ranged-
+    # GET readahead and multipart upload tuning to Hadoop S3A config,
+    # reference README.md:146-178) ---
+    # prefills larger than this split into concurrent positioned sub-reads on
+    # the shared fetch executor (the S3A readahead / multipart-download analog)
+    fetch_chunk_size: int = 8 * MiB
+    # process-wide ranged-GET executor width; <= 1 disables chunked fetch
+    fetch_parallelism: int = 4
+    # bytes allowed in flight between commit serialization and the background
+    # uploader thread (the S3A fast-upload buffer analog); 0 disables the
+    # pipelined upload path (serial drain -> PUT)
+    upload_queue_bytes: int = 32 * MiB
     # in-memory budget for key-ordered reduce output before the batch sorter
     # spills sorted columnar runs (analog of Spark's ExternalSorter memory)
     sorter_spill_bytes: int = 256 * MiB
@@ -116,6 +128,10 @@ class ShuffleConfig:
     def __post_init__(self) -> None:
         if self.folder_prefixes < 1:
             raise ValueError("folder_prefixes must be >= 1")
+        if self.fetch_chunk_size < 1:
+            raise ValueError("fetch_chunk_size must be >= 1")
+        if self.fetch_parallelism < 0 or self.upload_queue_bytes < 0:
+            raise ValueError("fetch_parallelism / upload_queue_bytes must be >= 0")
         algo = self.checksum_algorithm.upper()
         if algo not in ("ADLER32", "CRC32", "CRC32C"):
             # Parity: reference supports ADLER32 & CRC32 only and raises

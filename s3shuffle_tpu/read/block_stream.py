@@ -47,20 +47,65 @@ class BlockStream(io.RawIOBase):
         self._pos = start_offset
         self._reader: Optional[RangedReader] = None
         self._reader_closed = False
+        self._failed = False
         self._lock = threading.Lock()
 
     def readable(self) -> bool:
         return True
+
+    @property
+    def position(self) -> int:
+        """Absolute cursor position inside the data object."""
+        return self._pos
 
     def _ensure_open(self) -> Optional[RangedReader]:
         if self._reader is None and not self._reader_closed:
             self._reader = self.dispatcher.open_block(self.data_block)
         return self._reader
 
+    def pread(self, position: int, length: int) -> bytes:
+        """Positioned read inside the block range with NO cursor movement.
+
+        The chunked-fetch plane issues several of these concurrently — the
+        :class:`RangedReader` contract is cursor-free and thread-safe. I/O
+        errors follow :meth:`read`'s logged-EOF policy, except the reader is
+        only *marked* failed (not closed): sibling sub-range reads may still
+        be in flight on the same handle, and closing it under them could
+        recycle the descriptor. Every later read on this stream sees EOF; the
+        handle itself closes on the normal close/exhaustion path."""
+        length = min(length, self.end_offset - position)
+        if length <= 0:
+            return b""
+        with self._lock:
+            if self._failed:
+                return b""
+            try:
+                reader = self._ensure_open()
+            except OSError as e:
+                logger.error(
+                    "Error opening %s for range [%d,%d): %s",
+                    self.block.name, position, position + length, e,
+                )
+                self._failed = True
+                self._close_reader()
+                return b""
+            if reader is None:
+                return b""
+        try:
+            return reader.read_fully(position, length)
+        except OSError as e:
+            logger.error(
+                "Error reading %s range [%d,%d): %s",
+                self.block.name, position, position + length, e,
+            )
+            with self._lock:
+                self._failed = True
+            return b""
+
     def read(self, size: int = -1) -> bytes:
         with self._lock:
             remaining = self.end_offset - self._pos
-            if remaining <= 0:
+            if remaining <= 0 or self._failed:
                 self._close_reader()
                 return b""
             if size is None or size < 0:

@@ -1,0 +1,177 @@
+"""Read-side parallel transfer plane: chunked concurrent ranged GETs.
+
+The adaptive prefetcher (:mod:`s3shuffle_tpu.read.prefetch`) hides store
+latency ACROSS blocks — one prefetch thread per in-flight block — but each
+individual prefill is still one serial GET, so a batch-fetch block covering a
+whole map output (hundreds of MiB merged by ``ShuffleBlockBatchId``) moves at
+single-stream speed no matter how many threads the hill-climb grants.
+BlobShuffle-style range splitting (PAPERS.md, arxiv 2606.03364 / 2604.21275)
+is the fix: prefills larger than ``fetch_chunk_size`` split into concurrent
+positioned ``read_fully`` sub-reads on a shared bounded executor and
+reassemble IN ORDER, so the prefetcher's budget accounting, checksum
+validation, and codec streams all see byte-identical input to the serial
+path — short only at EOF or after a logged I/O error, exactly like
+:meth:`BlockStream.read` (SURVEY.md §5.3 read resilience).
+
+The reference delegates this whole axis to Hadoop S3A readahead/multipart
+config (reference README.md:146-178); here it is first-class and metered.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional, Tuple
+
+from s3shuffle_tpu.metrics import registry as _metrics
+from s3shuffle_tpu.read.block_stream import BlockStream
+from s3shuffle_tpu.utils.io import read_up_to as _read_up_to
+
+_H_CHUNK = _metrics.REGISTRY.histogram(
+    "read_chunk_fetch_seconds",
+    "Per-sub-range GET latency inside a chunked prefill",
+)
+_G_INFLIGHT = _metrics.REGISTRY.gauge(
+    "read_chunk_inflight",
+    "Sub-range GETs currently in flight on the shared fetch executor",
+)
+_C_CHUNKED = _metrics.REGISTRY.counter(
+    "read_chunked_prefills_total",
+    "Prefills that took the chunked concurrent path",
+)
+
+# ---------------------------------------------------------------------------
+# Shared bounded I/O executor (process-wide, grow-only)
+# ---------------------------------------------------------------------------
+
+_executor_lock = threading.Lock()
+_executor: Optional[ThreadPoolExecutor] = None
+_executor_width = 0
+
+
+def _submit_fetch(width: int, fn, *args):
+    """Submit onto the process-wide ranged-GET pool, sized to the largest
+    width any caller has asked for (reduce tasks with different configs share
+    one pool, like the dispatcher shares one backend handle). Growing swaps
+    in a wider pool; the old one finishes its already-queued work and drains.
+    Submission happens UNDER the swap lock, so a concurrent grow can never
+    shut the pool down between lookup and submit."""
+    global _executor, _executor_width
+    width = max(1, width)
+    with _executor_lock:
+        if _executor is None or width > _executor_width:
+            old = _executor
+            _executor = ThreadPoolExecutor(
+                max_workers=width, thread_name_prefix="s3shuffle-fetch"
+            )
+            _executor_width = width
+            if old is not None:
+                old.shutdown(wait=False)
+        return _executor.submit(fn, *args)
+
+
+class ChunkedRangeFetcher:
+    """Splits one large prefill into concurrent positioned sub-reads.
+
+    Contract (the serial path's, preserved exactly):
+
+    - the returned buffer is byte-identical to ``read_up_to(stream, n)``;
+    - short only at EOF or after a logged I/O error — the prefix up to the
+      first short/failed sub-range is returned, later sub-ranges are
+      discarded, and the stream is left in its post-error EOF state so
+      checksum validation surfaces the truncation;
+    - the stream cursor advances by exactly the returned length, so the
+      synchronous remainder (blocks larger than the prefetch budget) picks
+      up where the prefill stopped.
+    """
+
+    def __init__(
+        self,
+        chunk_size: int,
+        parallelism: int,
+        max_inflight: Optional[int] = None,
+    ):
+        self.chunk_size = max(1, int(chunk_size))
+        self.parallelism = max(1, int(parallelism))
+        # Bound this fetcher's queued sub-reads so one huge prefill cannot
+        # monopolize the shared executor's queue across tasks.
+        self._inflight = threading.BoundedSemaphore(
+            max_inflight or self.parallelism * 2
+        )
+
+    @classmethod
+    def from_config(cls, cfg) -> Optional["ChunkedRangeFetcher"]:
+        """None when the config disables chunking (``fetch_parallelism <= 1``)
+        — the prefetcher then keeps the plain serial prefill."""
+        if cfg.fetch_parallelism <= 1:
+            return None
+        return cls(cfg.fetch_chunk_size, cfg.fetch_parallelism)
+
+    # ------------------------------------------------------------------
+    def prefill(self, stream, n: int) -> bytes:
+        """Read up to ``n`` bytes from ``stream``'s cursor, chunk-parallel
+        when the request is big enough and the stream supports positioned
+        reads; the plain serial loop otherwise."""
+        if not isinstance(stream, BlockStream) or n <= self.chunk_size:
+            return _read_up_to(stream, n)
+        n = min(n, stream.available())
+        if n <= self.chunk_size:
+            return _read_up_to(stream, n)
+        start = stream.position
+        ranges: List[Tuple[int, int]] = []
+        off = 0
+        while off < n:
+            ln = min(self.chunk_size, n - off)
+            ranges.append((start + off, ln))
+            off += ln
+        from s3shuffle_tpu.utils import trace
+
+        if _metrics.enabled():
+            _C_CHUNKED.inc()
+        with trace.span(
+            "read.chunked_prefill",
+            block=stream.block.name,
+            bytes=n,
+            chunks=len(ranges),
+        ):
+            futures = []
+            for pos, ln in ranges:
+                self._inflight.acquire()
+                try:
+                    futures.append(
+                        _submit_fetch(self.parallelism, self._fetch_one, stream, pos, ln)
+                    )
+                except BaseException:
+                    # _fetch_one never ran: its release won't happen
+                    self._inflight.release()
+                    raise
+            parts: List[bytes] = []
+            short = False
+            for (_pos, ln), fut in zip(ranges, futures):
+                data = fut.result()
+                if short:
+                    continue  # still drain the future (semaphore bookkeeping)
+                parts.append(data)
+                if len(data) < ln:
+                    # EOF or logged I/O error on this sub-range: the serial
+                    # path would have stopped here too — keep the prefix,
+                    # drop everything after.
+                    short = True
+        buffer = b"".join(parts)
+        stream.skip(len(buffer))
+        return buffer
+
+    def _fetch_one(self, stream: BlockStream, pos: int, length: int) -> bytes:
+        try:
+            if _metrics.enabled():
+                _G_INFLIGHT.inc()
+                t0 = time.perf_counter_ns()
+                try:
+                    return stream.pread(pos, length)
+                finally:
+                    _H_CHUNK.observe((time.perf_counter_ns() - t0) / 1e9)
+                    _G_INFLIGHT.dec()
+            return stream.pread(pos, length)
+        finally:
+            self._inflight.release()

@@ -86,10 +86,15 @@ class ThreadPredictor:
             {c: self._totals[c] for c in {down, self.current, up}}.items(),
             key=lambda kv: kv[1],
         )[0]
-        # Re-measure neighbors eventually: forget the losing direction's stale
+        # Re-measure neighbors eventually: forget the LOSING direction's stale
         # total so a drifting backend (S3 vs NFS vs page cache) is re-probed.
+        # (Popping the winner would be a no-op — it becomes `current` and its
+        # total is overwritten at the next full ring; the loser's total is the
+        # one that would otherwise pin every future comparison.)
         if best != self.current:
-            self._totals.pop(best, None)
+            for candidate in (down, up):
+                if candidate not in (best, self.current):
+                    self._totals.pop(candidate, None)
         self.current = best
         return self.current
 
@@ -149,9 +154,14 @@ class BufferedPrefetchIterator:
         source: Iterator[Tuple[object, BlockStream]],
         max_buffer_size: int,
         max_threads: int = 10,
+        fetcher=None,
     ):
         self._source = source
         self._max_buffer_size = max(1, max_buffer_size)
+        # Optional ChunkedRangeFetcher: prefills larger than its chunk size
+        # split into concurrent ranged sub-reads (byte-identical contract —
+        # see read/chunked_fetch.py). None = plain serial prefill.
+        self._fetcher = fetcher
         self._predictor = ThreadPredictor(max_threads)
         self._lock = threading.Condition()
         # Separate lock for pulling source items: next(source) can do store
@@ -250,7 +260,12 @@ class BufferedPrefetchIterator:
 
                 t0 = time.perf_counter_ns()
                 with trace.span("read.prefetch", block=block.name, budget=bsize):
-                    buffer = _read_up_to(stream, bsize)  # ← the actual store GET
+                    # ← the actual store GET (chunk-parallel for big prefills
+                    # when a fetcher is attached; serial otherwise)
+                    if self._fetcher is not None:
+                        buffer = self._fetcher.prefill(stream, bsize)
+                    else:
+                        buffer = _read_up_to(stream, bsize)
                 dt = time.perf_counter_ns() - t0
                 if _metrics.enabled():
                     _H_FILL.observe(dt / 1e9)

@@ -163,10 +163,13 @@ class ShuffleReader:
                 self.metrics.remote_bytes_read += stream.max_bytes
                 yield block, stream
 
+        from s3shuffle_tpu.read.chunked_fetch import ChunkedRangeFetcher
+
         return BufferedPrefetchIterator(
             nonempty_streams(),
             max_buffer_size=cfg.max_buffer_size_task,
             max_threads=cfg.max_concurrency_task,
+            fetcher=ChunkedRangeFetcher.from_config(cfg),
         )
 
     def read(self) -> Iterator[Tuple[Any, Any]]:

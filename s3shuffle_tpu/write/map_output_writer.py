@@ -63,18 +63,41 @@ class MapOutputWriter:
         self._checksums_enabled = cfg.checksum_enabled
         self._lengths = np.zeros(num_partitions, dtype=np.int64)
         self._checksum_values = np.zeros(num_partitions, dtype=np.int64)
-        self._stream: Optional[MeasuredOutputStream] = None
+        # MeasuredOutputStream (serial) or PipelinedUploadStream (default) —
+        # both expose bytes_written (accepted bytes) and a flush-all close().
+        self._stream: Optional[io.RawIOBase] = None
+        self._object_created = False  # create_block ran (even if a later sink
+        # constructor failed) — abort() must delete exactly when this is set
         self._total_bytes = 0
         self._last_partition_id = -1
         self._committed = False
         self._block = ShuffleDataBlockId(shuffle_id, map_id)
 
     # ------------------------------------------------------------------
-    def _init_stream(self) -> MeasuredOutputStream:
+    def _init_stream(self) -> io.RawIOBase:
         if self._stream is None:
+            cfg = self.dispatcher.config
             raw = self.dispatcher.create_block(self._block)
-            buffered = io.BufferedWriter(raw, buffer_size=self.dispatcher.config.buffer_size)  # type: ignore[arg-type]
-            self._stream = MeasuredOutputStream(buffered, self._block.name)
+            self._object_created = True
+            if cfg.upload_queue_bytes > 0:
+                # Pipelined transfer plane: partition serialization enqueues
+                # bounded chunks; a background thread does the store PUT, so
+                # commit drain/codec work overlaps the upload
+                # (write/pipelined_upload.py). close() blocks until every
+                # byte landed, keeping the commit point (index after data)
+                # and the stream-position sanity check intact. The measured
+                # stream sits BENEATH the pipeline so its bandwidth log and
+                # write_upload_seconds keep timing real store writes, not
+                # queue pushes.
+                from s3shuffle_tpu.write.pipelined_upload import PipelinedUploadStream
+
+                measured = MeasuredOutputStream(raw, self._block.name)
+                self._stream = PipelinedUploadStream(
+                    measured, cfg.upload_queue_bytes, label=self._block.name
+                )
+            else:
+                buffered = io.BufferedWriter(raw, buffer_size=cfg.buffer_size)  # type: ignore[arg-type]
+                self._stream = MeasuredOutputStream(buffered, self._block.name)
         return self._stream
 
     def get_partition_writer(self, reduce_partition_id: int) -> "PartitionWriter":
@@ -124,10 +147,21 @@ class MapOutputWriter:
         return MapOutputCommitMessage(self._lengths, checksums)
 
     def abort(self, error: Exception | None = None) -> None:
+        if not self._object_created:
+            # The data object was never created (zero bytes written): there
+            # is no partial object to drop — a delete here would only
+            # generate a spurious store op for every aborted empty map task.
+            logger.warning(
+                "Aborted map output %s (nothing written): %s",
+                self._block.name, error if error else "unknown",
+            )
+            return
         if self._stream is not None:
             try:
                 self._stream.close()
-            except OSError:
+            except Exception:
+                # best effort: the pipelined uploader re-raises its failure
+                # on close, but the object is deleted right below either way
                 pass
         self.dispatcher.backend.delete(self.dispatcher.get_path(self._block))
         logger.warning(

@@ -1,0 +1,225 @@
+"""Chunked concurrent ranged GETs (read/chunked_fetch.py).
+
+The chunked prefill must be BYTE-IDENTICAL to the serial path under every
+chunk-size/block-size relation (property test), and under faults it must
+behave exactly like the serial path: a failed sub-range GET becomes a logged
+EOF that checksum validation surfaces, nothing hangs, and the prefetch budget
+is released."""
+
+import random
+
+import numpy as np
+import pytest
+
+from s3shuffle_tpu.block_ids import ShuffleBlockId, ShuffleDataBlockId
+from s3shuffle_tpu.config import ShuffleConfig
+from s3shuffle_tpu.metadata.helper import ShuffleHelper
+from s3shuffle_tpu.metrics import registry as mreg
+from s3shuffle_tpu.read.block_stream import BlockStream
+from s3shuffle_tpu.read.checksum_stream import ChecksumError, ChecksumValidationStream
+from s3shuffle_tpu.read.chunked_fetch import ChunkedRangeFetcher
+from s3shuffle_tpu.read.prefetch import BufferedPrefetchIterator
+from s3shuffle_tpu.storage.dispatcher import Dispatcher
+from s3shuffle_tpu.storage.fault import FaultRule, FlakyBackend
+from s3shuffle_tpu.utils.io import read_up_to
+from s3shuffle_tpu.write.map_output_writer import MapOutputWriter
+
+
+@pytest.fixture
+def env(tmp_path):
+    cfg = ShuffleConfig(root_dir=f"file://{tmp_path}/root", app_id="cf")
+    d = Dispatcher(cfg)
+    return d, ShuffleHelper(d)
+
+
+def _write_block(d, helper, shuffle_id, map_id, data):
+    w = MapOutputWriter(d, helper, shuffle_id, map_id, 1)
+    pw = w.get_partition_writer(0)
+    pw.write(data)
+    pw.close()
+    w.commit_all_partitions()
+
+
+def _stream(d, helper, shuffle_id, map_id):
+    offsets = helper.get_partition_lengths(shuffle_id, map_id)
+    block = ShuffleBlockId(shuffle_id, map_id, 0)
+    return BlockStream(
+        d, block, ShuffleDataBlockId(shuffle_id, map_id), 0, int(offsets[1])
+    )
+
+
+# ---------------------------------------------------------------------------
+# Byte-identity property (acceptance criterion): random chunk sizes vs block
+# sizes, chunked == serial, and the post-prefill cursor agrees too.
+# ---------------------------------------------------------------------------
+
+
+def test_chunked_prefill_byte_identical_property(env):
+    d, helper = env
+    rng = random.Random(1234)
+    for case in range(25):
+        block_size = rng.randrange(1, 5000)
+        chunk_size = rng.randrange(1, 1500)
+        prefill_n = rng.choice(
+            [
+                rng.randrange(1, block_size + 1),
+                block_size,
+                block_size + rng.randrange(1, 500),  # past EOF: short read
+            ]
+        )
+        data = rng.randbytes(block_size)
+        _write_block(d, helper, 100 + case, 0, data)
+        fetcher = ChunkedRangeFetcher(chunk_size, parallelism=3)
+        chunked = _stream(d, helper, 100 + case, 0)
+        serial = _stream(d, helper, 100 + case, 0)
+        got = fetcher.prefill(chunked, prefill_n)
+        want = read_up_to(serial, prefill_n)
+        assert got == want, (case, block_size, chunk_size, prefill_n)
+        # cursor advanced identically: the synchronous remainder matches
+        assert chunked.read() == serial.read()
+        chunked.close()
+        serial.close()
+
+
+def test_prefill_smaller_than_chunk_uses_serial_path(env):
+    d, helper = env
+    data = bytes(range(256)) * 10
+    _write_block(d, helper, 50, 0, data)
+    fetcher = ChunkedRangeFetcher(chunk_size=1 << 20, parallelism=4)
+    s = _stream(d, helper, 50, 0)
+    assert fetcher.prefill(s, len(data)) == data
+    s.close()
+
+
+def test_chunked_prefill_records_metrics(env):
+    d, helper = env
+    data = random.Random(7).randbytes(4096)
+    _write_block(d, helper, 51, 0, data)
+    mreg.REGISTRY.reset_values()
+    mreg.enable()
+    try:
+        fetcher = ChunkedRangeFetcher(chunk_size=512, parallelism=4)
+        s = _stream(d, helper, 51, 0)
+        assert fetcher.prefill(s, 4096) == data
+        s.close()
+        snap = mreg.REGISTRY.snapshot()
+        assert snap["read_chunked_prefills_total"]["series"][0]["value"] == 1
+        assert snap["read_chunk_fetch_seconds"]["series"][0]["count"] == 8
+    finally:
+        mreg.disable()
+        mreg.REGISTRY.reset_values()
+
+
+# ---------------------------------------------------------------------------
+# Faults: one sub-range GET fails mid-block -> same observable behavior as
+# the serial path (prefix + logged EOF, surfaced by checksum validation).
+# ---------------------------------------------------------------------------
+
+
+def _flaky_env(tmp_path, fail_nth_read):
+    cfg = ShuffleConfig(root_dir=f"file://{tmp_path}/root", app_id="cf")
+    d = Dispatcher(cfg)
+    helper = ShuffleHelper(d)
+    data = random.Random(99).randbytes(8192)
+    _write_block(d, helper, 60, 0, data)
+    flaky = FlakyBackend(d.backend)
+    flaky.add_rule(FaultRule("read", match=".data", times=None, skip=fail_nth_read))
+    d.backend = flaky
+    d.clear_status_cache()
+    return d, helper, data
+
+
+def test_subrange_failure_matches_serial_path(tmp_path):
+    # Serial reference: read_up_to stops at the first errored read; the
+    # chunked path must return the same prefix-of-truth and leave the stream
+    # in the same EOF state.
+    d, helper, data = _flaky_env(tmp_path, fail_nth_read=3)
+    fetcher = ChunkedRangeFetcher(chunk_size=1024, parallelism=4)
+    s = _stream(d, helper, 60, 0)
+    got = fetcher.prefill(s, 8192)
+    # a prefix of the true data (which prefix depends on scheduling), never
+    # corrupt, never the full block
+    assert len(got) < 8192
+    assert data.startswith(got)
+    assert s.read() == b""  # post-error EOF state, like BlockStream.read
+    s.close()
+
+
+def test_subrange_failure_surfaces_as_checksum_error(tmp_path):
+    d, helper, data = _flaky_env(tmp_path, fail_nth_read=2)
+    fetcher = ChunkedRangeFetcher(chunk_size=1024, parallelism=4)
+    s = _stream(d, helper, 60, 0)
+    buffer = fetcher.prefill(s, 8192)
+    assert len(buffer) < 8192
+
+    offsets = np.array([0, 8192], dtype=np.int64)
+    from s3shuffle_tpu.utils.checksums import create_checksum
+
+    c = create_checksum("ADLER32")
+    c.update(data)
+    import io
+
+    stream = ChecksumValidationStream(
+        ShuffleBlockId(60, 0, 0),
+        io.BytesIO(buffer),  # what the prefill handed downstream
+        offsets,
+        np.array([c.value], dtype=np.int64),
+        0,
+        1,
+        "ADLER32",
+    )
+    with pytest.raises(ChecksumError, match="Premature EOF"):
+        while stream.read(1024):
+            pass
+
+
+def test_prefetcher_with_fetcher_no_hang_and_budget_released(tmp_path):
+    d, helper, _data = _flaky_env(tmp_path, fail_nth_read=4)
+    offsets = helper.get_partition_lengths(60, 0)
+    block = ShuffleBlockId(60, 0, 0)
+    stream = BlockStream(
+        d, block, ShuffleDataBlockId(60, 0), 0, int(offsets[1])
+    )
+    it = BufferedPrefetchIterator(
+        iter([(block, stream)]),
+        max_buffer_size=1 << 20,
+        max_threads=2,
+        fetcher=ChunkedRangeFetcher(chunk_size=1024, parallelism=4),
+    )
+    delivered = []
+    for prefetched in it:  # must terminate, not hang
+        delivered.append(prefetched.readall())
+        prefetched.close()
+    assert len(delivered) == 1
+    assert len(delivered[0]) < 8192  # truncated by the injected fault
+    with it._lock:
+        assert it._buffers_in_flight == 0  # budget released on close
+
+
+# ---------------------------------------------------------------------------
+# Full read plane: chunked and serial configs produce identical shuffles.
+# ---------------------------------------------------------------------------
+
+
+def test_full_shuffle_identical_chunked_vs_serial(tmp_path):
+    from s3shuffle_tpu.shuffle import ShuffleContext
+
+    results = []
+    for tag, parallelism in (("chunked", 4), ("serial", 1)):
+        Dispatcher.reset()
+        cfg = ShuffleConfig(
+            root_dir=f"file://{tmp_path}/{tag}",
+            app_id=tag,
+            fetch_parallelism=parallelism,
+            fetch_chunk_size=512,  # force many sub-ranges per block
+            force_batch_fetch=True,
+        )
+        rng = random.Random(42)
+        parts = [
+            [(rng.randbytes(8), rng.randbytes(64)) for _ in range(500)]
+            for _ in range(3)
+        ]
+        with ShuffleContext(config=cfg, num_workers=2) as ctx:
+            out = ctx.sort_by_key(parts, num_partitions=4)
+            results.append([sorted(p) for p in out])
+    assert results[0] == results[1]

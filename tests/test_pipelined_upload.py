@@ -1,0 +1,324 @@
+"""Pipelined commit uploads (write/pipelined_upload.py) and the MapOutputWriter
+wiring: content/order preservation, bounded queue backpressure, uploader
+failure propagation, commit-point invariants (index-written-last, stream-
+position sanity check), and the abort() empty-output delete skip."""
+
+import io
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from s3shuffle_tpu.block_ids import ShuffleDataBlockId, ShuffleIndexBlockId
+from s3shuffle_tpu.config import ShuffleConfig
+from s3shuffle_tpu.metadata.helper import ShuffleHelper
+from s3shuffle_tpu.storage.dispatcher import Dispatcher
+from s3shuffle_tpu.storage.fault import FaultRule, FlakyBackend
+from s3shuffle_tpu.write.map_output_writer import MapOutputWriter
+from s3shuffle_tpu.write.pipelined_upload import PipelinedUploadStream
+
+
+class _RecordingSink(io.RawIOBase):
+    def __init__(self, write_delay_s=0.0):
+        super().__init__()
+        self.chunks = []
+        self.write_delay_s = write_delay_s
+        self.closed_at = None
+
+    def writable(self):
+        return True
+
+    def write(self, b):
+        if self.write_delay_s:
+            time.sleep(self.write_delay_s)
+        self.chunks.append(bytes(b))
+        return len(b)
+
+    def close(self):
+        self.closed_at = time.perf_counter()
+        super().close()
+
+
+# ---------------------------------------------------------------------------
+# PipelinedUploadStream unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_content_and_order_preserved():
+    sink = _RecordingSink()
+    s = PipelinedUploadStream(sink, queue_bytes=4096, chunk_bytes=256, label="t")
+    payload = b"".join(bytes([i % 256]) * 37 for i in range(100))
+    for i in range(0, len(payload), 37):
+        s.write(payload[i : i + 37])
+    s.close()
+    assert b"".join(sink.chunks) == payload
+    assert s.bytes_written == len(payload)
+    assert sink.closed  # sink closed after the last byte
+
+
+def test_memoryview_input_is_copied_before_upload():
+    # finalize_into writes a BytesIO getbuffer view and releases it right
+    # after write() returns — the queue must hold a copy, not the view.
+    sink = _RecordingSink(write_delay_s=0.02)
+    s = PipelinedUploadStream(sink, queue_bytes=1 << 20, chunk_bytes=64, label="t")
+    buf = io.BytesIO(b"A" * 200)
+    view = buf.getbuffer()
+    s.write(view)
+    view.release()
+    buf.seek(0)
+    buf.truncate(0)  # would raise if the view were still exported
+    s.close()
+    assert b"".join(sink.chunks) == b"A" * 200
+
+
+def test_queue_bytes_bounds_producer():
+    depth_seen = []
+
+    class _Slow(_RecordingSink):
+        def write(self, b):
+            time.sleep(0.01)
+            return super().write(b)
+
+    sink = _Slow()
+    s = PipelinedUploadStream(sink, queue_bytes=1024, chunk_bytes=256, label="t")
+
+    def sample():
+        # _queued_bytes includes the chunk being uploaded; the producer must
+        # never stack more than the limit (+ one in-flight chunk boundary)
+        with s._cond:
+            depth_seen.append(s._queued_bytes)
+
+    for _ in range(40):
+        s.write(b"z" * 256)
+        sample()
+    s.close()
+    assert b"".join(sink.chunks) == b"z" * 256 * 40
+    assert max(depth_seen) <= 1024 + 256
+
+
+def test_single_large_write_is_chunked_and_bounded():
+    # One write of a whole finalized partition (10x the queue bound) must
+    # still flow through the queue bound in chunk-sized pieces — not bypass
+    # it as one monolithic PUT.
+    seen = []
+
+    class _Slow(_RecordingSink):
+        def write(self, b):
+            time.sleep(0.002)
+            with s._cond:
+                seen.append(s._queued_bytes)
+            return super().write(b)
+
+    sink = _Slow()
+    s = PipelinedUploadStream(sink, queue_bytes=1024, chunk_bytes=256, label="t")
+    s.write(b"q" * 10240)
+    s.close()
+    assert b"".join(sink.chunks) == b"q" * 10240
+    assert max(len(c) for c in sink.chunks) <= 256
+    assert max(seen) <= 1024  # the documented memory bound held throughout
+
+
+def test_queue_depth_gauge_uses_deltas_across_streams():
+    from s3shuffle_tpu.metrics import registry as mreg
+
+    mreg.REGISTRY.reset_values()
+    mreg.enable()
+    try:
+        s1 = PipelinedUploadStream(
+            _RecordingSink(write_delay_s=0.005), queue_bytes=1 << 20,
+            chunk_bytes=128, label="s1",
+        )
+        s2 = PipelinedUploadStream(
+            _RecordingSink(write_delay_s=0.005), queue_bytes=1 << 20,
+            chunk_bytes=128, label="s2",
+        )
+        s1.write(b"x" * 1024)
+        s2.write(b"y" * 1024)
+        s1.close()
+        s2.close()
+        snap = mreg.REGISTRY.snapshot()
+        # inc/dec deltas: once both streams drained, the shared gauge is back
+        # to zero (a per-stream set() would leave whichever wrote last)
+        assert snap["write_upload_queue_bytes"]["series"][0]["value"] == 0.0
+    finally:
+        mreg.disable()
+        mreg.REGISTRY.reset_values()
+
+
+def test_uploader_failure_surfaces_on_producer():
+    class _Failing(_RecordingSink):
+        def write(self, b):
+            raise OSError("injected store failure")
+
+    s = PipelinedUploadStream(_Failing(), queue_bytes=512, chunk_bytes=64, label="t")
+    with pytest.raises(OSError, match="injected store failure"):
+        for _ in range(100):
+            s.write(b"y" * 64)
+            time.sleep(0.001)
+        s.close()
+    assert s.closed or s._error is not None
+
+
+def test_close_flushes_partial_chunk():
+    sink = _RecordingSink()
+    s = PipelinedUploadStream(sink, queue_bytes=4096, chunk_bytes=1024, label="t")
+    s.write(b"tail")  # below chunk_bytes: queued only at close
+    assert sink.chunks == []
+    s.close()
+    assert b"".join(sink.chunks) == b"tail"
+
+
+# ---------------------------------------------------------------------------
+# MapOutputWriter wiring: commit protocol invariants under pipelining
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def env(tmp_path):
+    cfg = ShuffleConfig(root_dir=f"file://{tmp_path}/root", app_id="pu")
+    d = Dispatcher(cfg)
+    assert cfg.upload_queue_bytes > 0  # pipelined path is the default
+    return d, ShuffleHelper(d)
+
+
+def test_commit_roundtrip_through_pipelined_stream(env):
+    d, helper = env
+    parts = [b"alpha" * 1000, b"", b"beta" * 2000]
+    w = MapOutputWriter(d, helper, 1, 0, len(parts))
+    for pid, data in enumerate(parts):
+        pw = w.get_partition_writer(pid)
+        pw.write(data)
+        pw.close()
+    msg = w.commit_all_partitions()
+    assert msg.partition_lengths.tolist() == [5000, 0, 8000]
+    raw = d.backend.read_all(d.get_path(ShuffleDataBlockId(1, 0)))
+    assert raw == b"".join(parts)
+    assert helper.get_partition_lengths(1, 0).tolist() == [0, 5000, 5000, 13000]
+
+
+def test_index_written_after_data_complete(env):
+    d, helper = env
+    expected_len = 5000 + 8000
+
+    seen = {}
+    orig_create = d.backend.create
+
+    def spying_create(path):
+        if path.endswith(".index"):
+            # the COMMIT POINT: by the time the index object is created the
+            # data object must be fully uploaded and closed
+            data_path = d.get_path(ShuffleDataBlockId(2, 0))
+            seen["data_len_at_index_write"] = len(d.backend.read_all(data_path))
+        return orig_create(path)
+
+    d.backend.create = spying_create
+    w = MapOutputWriter(d, helper, 2, 0, 2)
+    for pid, data in enumerate([b"alpha" * 1000, b"beta" * 2000]):
+        pw = w.get_partition_writer(pid)
+        pw.write(data)
+        pw.close()
+    w.commit_all_partitions()
+    assert seen["data_len_at_index_write"] == expected_len
+
+
+def test_stream_position_sanity_check_intact(env):
+    d, helper = env
+    w = MapOutputWriter(d, helper, 3, 0, 1)
+    pw = w.get_partition_writer(0)
+    pw.write(b"payload")
+    pw.close()
+    w._total_bytes += 1  # simulate a lost byte
+    with pytest.raises(IOError, match="does not match"):
+        w.commit_all_partitions()
+
+
+def test_store_write_failure_fails_commit(tmp_path):
+    cfg = ShuffleConfig(root_dir=f"file://{tmp_path}/root", app_id="pu")
+    d = Dispatcher(cfg)
+    helper = ShuffleHelper(d)
+    flaky = FlakyBackend(d.backend)
+    flaky.add_rule(FaultRule("write", match=".data", times=None))
+    d.backend = flaky
+    w = MapOutputWriter(d, helper, 4, 0, 1)
+    pw = w.get_partition_writer(0)
+    pw.write(b"x" * 100)
+    pw.close()
+    with pytest.raises(OSError, match="injected fault"):
+        w.commit_all_partitions()
+    # no index: the failed output stays invisible to readers
+    with pytest.raises(FileNotFoundError):
+        helper.read_block_as_array(ShuffleIndexBlockId(4, 0))
+
+
+def test_pipelined_vs_serial_streams_byte_identical(tmp_path):
+    outputs = {}
+    for tag, queue_bytes in (("pipelined", 1 << 20), ("serial", 0)):
+        Dispatcher.reset()
+        cfg = ShuffleConfig(
+            root_dir=f"file://{tmp_path}/{tag}", app_id=tag,
+            upload_queue_bytes=queue_bytes,
+        )
+        d = Dispatcher(cfg)
+        helper = ShuffleHelper(d)
+        w = MapOutputWriter(d, helper, 5, 0, 3)
+        for pid, data in enumerate([b"a" * 3000, b"b" * 1, b"c" * 9000]):
+            pw = w.get_partition_writer(pid)
+            pw.write(data)
+            pw.close()
+        w.commit_all_partitions()
+        outputs[tag] = (
+            d.backend.read_all(d.get_path(ShuffleDataBlockId(5, 0))),
+            helper.get_partition_lengths(5, 0).tolist(),
+            helper.get_checksums(5, 0).tolist(),
+        )
+    assert outputs["pipelined"] == outputs["serial"]
+
+
+# ---------------------------------------------------------------------------
+# abort(): no spurious delete for never-opened outputs (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_abort_without_writes_skips_store_delete(env):
+    d, helper = env
+    flaky = FlakyBackend(d.backend)
+    d.backend = flaky
+    w = MapOutputWriter(d, helper, 6, 0, 2)
+    w.abort(RuntimeError("empty task failed"))
+    assert flaky.calls["delete"] == 0
+
+
+def test_abort_deletes_when_create_succeeded_but_sink_failed(env, monkeypatch):
+    # The object can exist with self._stream still None: create_block ran,
+    # then the sink constructor failed (e.g. thread exhaustion). abort() must
+    # still delete the partial object in that window.
+    import s3shuffle_tpu.write.pipelined_upload as pu
+
+    d, helper = env
+
+    def boom(*a, **kw):
+        raise RuntimeError("can't start new thread")
+
+    monkeypatch.setattr(pu.PipelinedUploadStream, "__init__", boom)
+    flaky = FlakyBackend(d.backend)
+    d.backend = flaky
+    w = MapOutputWriter(d, helper, 8, 0, 1)
+    pw = w.get_partition_writer(0)
+    with pytest.raises(RuntimeError, match="thread"):
+        pw.write(b"first byte triggers stream init")
+    w.abort(RuntimeError("sink construction failed"))
+    assert flaky.calls["delete"] == 1
+
+
+def test_abort_after_write_still_deletes(env):
+    d, helper = env
+    flaky = FlakyBackend(d.backend)
+    d.backend = flaky
+    w = MapOutputWriter(d, helper, 7, 0, 1)
+    pw = w.get_partition_writer(0)
+    pw.write(b"partial")
+    pw.close()
+    w.abort(RuntimeError("boom"))
+    assert flaky.calls["delete"] == 1
+    assert not d.backend.exists(d.get_path(ShuffleDataBlockId(7, 0)))

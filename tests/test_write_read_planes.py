@@ -263,6 +263,34 @@ def test_thread_predictor_hill_climb():
     assert 1 <= t <= 4
 
 
+def test_thread_predictor_reprobes_drifting_backend():
+    """When a measured best count drifts slow (S3 vs NFS vs page cache), the
+    hill-climb must not stay pinned by its stale total: moving away pops the
+    LOSING direction's total, so that count is re-explored later."""
+    p = ThreadPredictor(max_threads=3, initial=2)
+
+    def ring(latency_ns):
+        t = p.current
+        for _ in range(RING_SIZE):
+            t = p.add_measurement_and_predict(latency_ns)
+        return t
+
+    assert ring(100) == 3       # measure 2, explore up
+    assert ring(200) == 2       # 3 is worse -> back to 2
+    assert ring(300) == 1       # explore down
+    assert ring(50) == 1        # 1 wins, hold
+    # drift: 1 becomes slow; the climb walks back up
+    assert ring(10_000) == 2
+    assert ring(10_000) == 3    # 3's stale total (200-era) wins the compare
+    # the move 2 -> 3 popped the losing direction (1): its stale slow total
+    # no longer pins the landscape
+    assert 1 not in p._totals
+    # ... so once the climb returns to 2, count 1 is explored AGAIN with a
+    # fresh measurement instead of being skipped as "already measured"
+    assert ring(10_000) == 2    # 3 measures slow too, ties resolve down
+    assert ring(10_000) == 1    # unmeasured neighbor 1 re-probed
+
+
 def test_thread_predictor_bounds():
     p = ThreadPredictor(max_threads=1)
     for _ in range(RING_SIZE * 3):

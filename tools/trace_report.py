@@ -344,6 +344,20 @@ def _selftest() -> int:
                     }
                 ],
             },
+            # transfer-plane histograms (chunked ranged GETs / pipelined
+            # commit uploads) — the names the docs point readers at
+            "read_chunk_fetch_seconds": {
+                "kind": "histogram",
+                "series": [{"le": bounds, "buckets": buckets, "sum": 2.0, "count": 100}],
+            },
+            "write_upload_queue_wait_seconds": {
+                "kind": "histogram",
+                "series": [{"le": bounds, "buckets": buckets, "sum": 0.4, "count": 100}],
+            },
+            "write_upload_chunk_seconds": {
+                "kind": "histogram",
+                "series": [{"le": bounds, "buckets": buckets, "sum": 1.1, "count": 100}],
+            },
             "storage_read_bytes_total": {
                 "kind": "counter",
                 "series": [{"labels": {"scheme": "file"}, "value": 1 << 20}],
@@ -352,10 +366,23 @@ def _selftest() -> int:
                 "kind": "gauge",
                 "series": [{"value": 3}],
             },
+            "read_chunk_inflight": {
+                "kind": "gauge",
+                "series": [{"value": 4}],
+            },
         },
     }
     text = render_shuffle_stats(report)
-    for needle in ("shuffle 7", "storage_op_seconds", "p95", "throughput"):
+    for needle in (
+        "shuffle 7",
+        "storage_op_seconds",
+        "read_chunk_fetch_seconds",
+        "write_upload_queue_wait_seconds",
+        "write_upload_chunk_seconds",
+        "read_chunk_inflight",
+        "p95",
+        "throughput",
+    ):
         assert needle in text, f"stats render missing {needle!r}:\n{text}"
     p50 = histogram_quantile(bounds, buckets, 0.5)
     assert 0.008 <= p50 <= 0.016, p50
