@@ -92,6 +92,16 @@ class ShuffleConfig:
     # index (map_id // stride) for range filtering and dedupe committed
     # duplicate attempts — the tracker path carries map_index explicitly.
     map_id_attempt_stride: int = 0
+    # --- resilient storage plane (the S3A ``fs.s3a.retry.*`` analog; the
+    # reference delegates transient-failure handling to the Hadoop client) ---
+    # re-drives per store op after the first attempt; 0 disables the retry
+    # layer entirely (fail-fast, today's behavior)
+    storage_retries: int = 3
+    # exponential-backoff base; actual sleep is full-jitter
+    # uniform(0, min(cap, base * 2**attempt))
+    storage_retry_base_ms: float = 50.0
+    # wall-clock budget per op including backoff sleeps; 0 = unbounded
+    storage_op_deadline_s: float = 30.0
     # --- caches ---
     cache_partition_lengths: bool = True
     cache_checksums: bool = True
@@ -132,6 +142,12 @@ class ShuffleConfig:
             raise ValueError("fetch_chunk_size must be >= 1")
         if self.fetch_parallelism < 0 or self.upload_queue_bytes < 0:
             raise ValueError("fetch_parallelism / upload_queue_bytes must be >= 0")
+        if (
+            self.storage_retries < 0
+            or self.storage_retry_base_ms < 0
+            or self.storage_op_deadline_s < 0
+        ):
+            raise ValueError("storage retry knobs must be >= 0")
         algo = self.checksum_algorithm.upper()
         if algo not in ("ADLER32", "CRC32", "CRC32C"):
             # Parity: reference supports ADLER32 & CRC32 only and raises
@@ -197,6 +213,8 @@ def _coerce(value: Any, typ: Any) -> Any:
         return None
     if "bool" in typ:
         return value.strip().lower() in ("1", "true", "yes", "on")
+    if "float" in typ:
+        return float(value)
     if "int" in typ:
         from s3shuffle_tpu.utils import parse_size
 

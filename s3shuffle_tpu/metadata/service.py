@@ -20,10 +20,12 @@ from __future__ import annotations
 
 import json
 import logging
+import random
 import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Any, List, Optional, Tuple
 
 import numpy as np
@@ -463,11 +465,48 @@ class MetadataServer:
 
 class RemoteMapOutputTracker:
     """Client with MapOutputTracker's interface; safe for concurrent use
-    (one socket, per-call lock, transparent reconnect)."""
+    (one socket, per-call lock, transparent reconnect).
 
-    def __init__(self, address: Tuple[str, int], timeout: float = 30.0):
+    Transport resilience: a connection-level failure (coordinator restart,
+    reset, refused) gets one FREE immediate reconnect (the legacy behavior),
+    then up to ``retries`` further attempts with full-jitter exponential
+    backoff bounded by ``retry_deadline_s`` — so a brief coordinator outage
+    delays in-flight worker RPCs instead of failing every one of them.
+    ``retries=0`` restores the legacy single-silent-reconnect behavior
+    exactly. Server-REPORTED errors (``ok: false``) are never retried; the
+    resend-on-reconnect idempotency contract is the same one the legacy
+    reconnect already relied on."""
+
+    def __init__(
+        self,
+        address: Tuple[str, int],
+        timeout: float = 30.0,
+        retries: int = 4,
+        retry_base_ms: float = 100.0,
+        retry_deadline_s: float = 10.0,
+    ):
         self.address = (address[0], int(address[1]))
         self.timeout = timeout
+        self.retries = int(retries)
+        self.retry_base_ms = float(retry_base_ms)
+        self.retry_deadline_s = float(retry_deadline_s)
+        # one backoff implementation for the whole framework: the storage
+        # plane's RetryPolicy provides the full-jitter formula; sleep is a
+        # seam so tests don't pay real backoff wall time
+        from s3shuffle_tpu.storage.retrying import RetryPolicy
+
+        self._retry_policy = (
+            RetryPolicy(
+                retries=self.retries,
+                base_ms=self.retry_base_ms,
+                deadline_s=self.retry_deadline_s,
+                max_backoff_s=2.0,
+            )
+            if self.retries > 0
+            else None
+        )
+        self._sleep = time.sleep
+        self._rng = random.Random()
         self._lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
 
@@ -478,8 +517,15 @@ class RemoteMapOutputTracker:
         return sock
 
     def _call(self, method: str, *args):
+        policy = self._retry_policy
         with self._lock:
-            for attempt in (0, 1):  # one transparent reconnect
+            deadline = (
+                time.monotonic() + policy.deadline_s
+                if policy is not None and policy.deadline_s > 0
+                else None
+            )
+            attempt = 0
+            while True:
                 try:
                     if self._sock is None:
                         self._sock = self._connect()
@@ -488,15 +534,28 @@ class RemoteMapOutputTracker:
                     if resp is None:
                         raise IOError("Server closed connection")
                     break
-                except (OSError, IOError):
+                except (OSError, IOError) as e:
                     if self._sock is not None:
                         try:
                             self._sock.close()
                         except OSError:
                             pass
                         self._sock = None
-                    if attempt:
+                    attempt += 1
+                    if attempt == 1:
+                        continue  # free immediate reconnect (legacy behavior)
+                    # attempt 2..retries+1 back off under the deadline
+                    if policy is None or attempt > policy.retries + 1:
                         raise
+                    delay = policy.backoff_s(attempt - 2, self._rng)
+                    if deadline is not None and time.monotonic() + delay > deadline:
+                        raise
+                    logger.warning(
+                        "metadata RPC %s failed (%s); retrying in %.0f ms "
+                        "(attempt %d/%d)",
+                        method, e, delay * 1e3, attempt, policy.retries + 1,
+                    )
+                    self._sleep(delay)
         if not resp["ok"]:
             if resp.get("error_type") == "KeyError":
                 raise KeyError(resp["error"])

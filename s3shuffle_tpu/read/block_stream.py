@@ -11,6 +11,15 @@ Parity: ``S3ShuffleBlockStream`` (S3ShuffleBlockStream.scala:16-111):
 - zero-length ranges never open the object (:38);
 - IO errors are logged and surfaced as EOF (:66-70, 87-92) — the read-side
   resilience behavior (SURVEY.md §5.3).
+
+Resilience extension over the reference: when the resilient storage plane is
+on (``storage_retries > 0``), a RETRIABLE read failure (connection reset,
+timeout, 5xx-shaped — see ``storage/retrying.is_retriable``) gets one more
+chance at THIS layer with a **fresh** ``open_ranged`` reader before the
+failed-EOF marker is set: the storage plane already re-drove the positioned
+read with backoff, so a failure surfacing here usually means the long-lived
+handle itself is poisoned. Terminal errors and ``storage_retries = 0`` keep
+the reference's immediate logged-EOF behavior.
 """
 
 from __future__ import annotations
@@ -46,6 +55,10 @@ class BlockStream(io.RawIOBase):
         self.max_bytes = end_offset - start_offset
         self._pos = start_offset
         self._reader: Optional[RangedReader] = None
+        # Readers abandoned by _recover_reader_locked: NOT closed at swap
+        # time (sibling positioned reads may still be in flight on them —
+        # closing could recycle the descriptor), closed with the stream.
+        self._stale_readers: list = []
         self._reader_closed = False
         self._failed = False
         self._lock = threading.Lock()
@@ -62,6 +75,39 @@ class BlockStream(io.RawIOBase):
         if self._reader is None and not self._reader_closed:
             self._reader = self.dispatcher.open_block(self.data_block)
         return self._reader
+
+    def _recover_reader_locked(
+        self, error: OSError, failed: Optional[RangedReader]
+    ) -> Optional[RangedReader]:
+        """One fresh ``open_block`` after a RETRIABLE read failure (caller
+        holds ``self._lock``; ``failed`` is the reader the failed read
+        used). The storage plane below already re-drove the read with
+        backoff under its deadline, so reaching here usually means the
+        long-lived handle is poisoned — swap it. If a concurrent sub-read
+        already swapped in a fresh reader, that one is returned as-is
+        instead of opening yet another. Returns None when recovery is off
+        (``storage_retries = 0``), the error is terminal, the stream
+        already failed, or the reopen itself fails (the caller then
+        surfaces the failed-EOF marker as today)."""
+        if getattr(self.dispatcher.config, "storage_retries", 0) <= 0:
+            return None
+        from s3shuffle_tpu.storage.retrying import is_retriable
+
+        if not is_retriable(error) or self._failed or self._reader_closed:
+            return None
+        if self._reader is not None and self._reader is not failed:
+            return self._reader  # a sibling pread already recovered
+        try:
+            fresh = self.dispatcher.open_block(self.data_block)
+        except OSError:
+            return None
+        logger.warning(
+            "Reopened %s after retriable read failure: %s", self.block.name, error
+        )
+        if self._reader is not None:
+            self._stale_readers.append(self._reader)
+        self._reader = fresh
+        return fresh
 
     def pread(self, position: int, length: int) -> bytes:
         """Positioned read inside the block range with NO cursor movement.
@@ -94,6 +140,13 @@ class BlockStream(io.RawIOBase):
         try:
             return reader.read_fully(position, length)
         except OSError as e:
+            with self._lock:
+                fresh = self._recover_reader_locked(e, reader)
+            if fresh is not None:
+                try:
+                    return fresh.read_fully(position, length)
+                except OSError as e2:
+                    e = e2
             logger.error(
                 "Error reading %s range [%d,%d): %s",
                 self.block.name, position, position + length, e,
@@ -111,16 +164,25 @@ class BlockStream(io.RawIOBase):
             if size is None or size < 0:
                 size = remaining
             n = min(size, remaining)
+            data = None
+            reader = None
             try:
                 reader = self._ensure_open()
                 if reader is None:
                     return b""
                 data = reader.read_fully(self._pos, n)
             except OSError as e:
-                # Log + EOF, matching S3ShuffleBlockStream.scala:66-70.
-                logger.error("Error reading %s range [%d,%d): %s", self.block.name, self._pos, self.end_offset, e)
-                self._close_reader()
-                return b""
+                fresh = self._recover_reader_locked(e, reader)
+                if fresh is not None:
+                    try:
+                        data = fresh.read_fully(self._pos, n)
+                    except OSError as e2:
+                        e = e2
+                if data is None:
+                    # Log + EOF, matching S3ShuffleBlockStream.scala:66-70.
+                    logger.error("Error reading %s range [%d,%d): %s", self.block.name, self._pos, self.end_offset, e)
+                    self._close_reader()
+                    return b""
             self._pos += len(data)
             if self._pos >= self.end_offset or not data:
                 self._close_reader()
@@ -138,6 +200,12 @@ class BlockStream(io.RawIOBase):
         return self.end_offset - self._pos
 
     def _close_reader(self) -> None:
+        for stale in self._stale_readers:
+            try:
+                stale.close()
+            except OSError:
+                pass
+        self._stale_readers = []
         if self._reader is not None:
             try:
                 self._reader.close()

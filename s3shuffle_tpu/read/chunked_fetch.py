@@ -80,7 +80,12 @@ class ChunkedRangeFetcher:
     - short only at EOF or after a logged I/O error — the prefix up to the
       first short/failed sub-range is returned, later sub-ranges are
       discarded, and the stream is left in its post-error EOF state so
-      checksum validation surfaces the truncation;
+      checksum validation surfaces the truncation. With the resilient
+      storage plane on (``storage_retries > 0``) a sub-range only goes
+      short after the storage layer's backoff retries AND
+      ``BlockStream.pread``'s fresh-reader reopen are both exhausted —
+      transient GET failures heal below this contract, invisibly to the
+      reassembly;
     - the stream cursor advances by exactly the returned length, so the
       synchronous remainder (blocks larger than the prefetch budget) picks
       up where the prefill stopped.

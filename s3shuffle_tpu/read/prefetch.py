@@ -183,7 +183,30 @@ class BufferedPrefetchIterator:
         self._stat_wait_ns = 0
         self._max_observed_threads = 1
         self._stats_printed = False
+        # Backstop-wakeup visibility: the condition waits below carry
+        # timeouts purely as missed-notify insurance — a timeout firing with
+        # the wait condition still unmet means a notify was LOST, which this
+        # rate-limited warning (at most one per interval per iterator) makes
+        # visible in soak runs instead of silently adding latency.
+        self._backstop_warn_interval_s = 30.0
+        self._last_backstop_warn = -float("inf")
         self._configure_threads()
+
+    def _warn_backstop(self, which: str, detail: str) -> None:
+        """Caller holds ``self._lock`` and observed a TIMED-OUT wait whose
+        condition is still unmet (a backstop wakeup, not a notify)."""
+        now = time.monotonic()
+        if now - self._last_backstop_warn < self._backstop_warn_interval_s:
+            return
+        self._last_backstop_warn = now
+        logger.warning(
+            "prefetch %s wait woke on its backstop timeout, not a notify "
+            "(possible missed-notify bug): %s; buffers_in_flight=%d/%d "
+            "active_fetches=%d completed=%d threads=%d source_exhausted=%s",
+            which, detail, self._buffers_in_flight, self._max_buffer_size,
+            self._active_fetches, len(self._completed), len(self._threads),
+            self._source_exhausted,
+        )
 
     # ------------------------------------------------------------------
     # Producer side
@@ -253,7 +276,14 @@ class BufferedPrefetchIterator:
                     # Every transition that can unblock this wait notifies
                     # (budget release on stream close, error) — the timeout
                     # is only a deadlock backstop, not a polling interval.
-                    self._lock.wait(timeout=5.0)
+                    notified = self._lock.wait(timeout=5.0)
+                    if not notified and (
+                        self._buffers_in_flight + bsize > self._max_buffer_size
+                        and self._error is None
+                    ):
+                        self._warn_backstop(
+                            "budget", f"producer needs {bsize} budget bytes"
+                        )
                 self._buffers_in_flight += bsize
             try:
                 from s3shuffle_tpu.utils import trace
@@ -311,7 +341,20 @@ class BufferedPrefetchIterator:
                 # all notify — the timeout is only a backstop against a missed
                 # wakeup, not a polling interval (no latency is added: a push
                 # wakes this wait immediately).
-                self._lock.wait(timeout=2.0)
+                notified = self._lock.wait(timeout=2.0)
+                if (
+                    not notified
+                    and not self._completed
+                    and self._error is None
+                    # mirror the loop-exit condition exactly: a lost
+                    # thread-retirement notify must warn too
+                    and not (
+                        self._source_exhausted
+                        and self._active_fetches == 0
+                        and not self._threads_alive()
+                    )
+                ):
+                    self._warn_backstop("consumer", "no completed block arrived")
             item = self._completed.pop()  # LIFO pop (:146, 209)
             wait_ns = time.perf_counter_ns() - t0
             self._stat_wait_ns += wait_ns

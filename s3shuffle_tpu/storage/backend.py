@@ -209,19 +209,31 @@ _memory_backends: Dict[str, MemoryBackend] = {}
 _registry_lock = threading.Lock()
 
 
-def get_backend(root_dir: str, storage_options: Dict | None = None) -> StorageBackend:
+def get_backend(
+    root_dir: str,
+    storage_options: Dict | None = None,
+    retry_policy=None,
+) -> StorageBackend:
     """Pick a backend from the root URI scheme, like the reference's
     ``FileSystem.get(rootDir URI, hadoopConf)`` (S3ShuffleDispatcher.scala:72-76).
     ``storage_options`` are passed to the fsspec driver (credentials,
-    endpoint_url, ... — the Hadoop-FS-config analog). With metrics enabled
-    (``S3SHUFFLE_METRICS`` / ``metrics.enable()``) the backend comes wrapped
-    in an :class:`~s3shuffle_tpu.storage.instrumented.InstrumentedBackend`,
-    so every caller records per-op latency/bytes/error metrics for free."""
+    endpoint_url, ... — the Hadoop-FS-config analog). When ``retry_policy``
+    (a :class:`~s3shuffle_tpu.storage.retrying.RetryPolicy`, built by the
+    dispatcher from ``storage_retries`` / ``storage_retry_base_ms`` /
+    ``storage_op_deadline_s``) is set, the raw backend is wrapped in a
+    :class:`~s3shuffle_tpu.storage.retrying.RetryingBackend` — the S3A
+    ``fs.s3a.retry.*`` analog — so every scheme absorbs transient store
+    failures transparently. With metrics enabled (``S3SHUFFLE_METRICS`` /
+    ``metrics.enable()``) an
+    :class:`~s3shuffle_tpu.storage.instrumented.InstrumentedBackend` stacks
+    on top, so every caller records per-op latency/bytes/error metrics for
+    free (the instrumented latency covers the whole healed op; the retry
+    layer's own counters expose the re-drives)."""
     scheme = root_dir.split("://", 1)[0] if "://" in root_dir else "file"
     if scheme == "file":
         from s3shuffle_tpu.storage.local import LocalBackend
 
-        return _maybe_instrument(LocalBackend())
+        return _wrap(LocalBackend(), retry_policy)
     if scheme == "memory":
         # One shared store per root so driver/executor components see the same
         # objects within a process.
@@ -230,10 +242,18 @@ def get_backend(root_dir: str, storage_options: Dict | None = None) -> StorageBa
             if backend is None:
                 backend = MemoryBackend()
                 _memory_backends[root_dir] = backend
-        return _maybe_instrument(backend)
+        return _wrap(backend, retry_policy)
     from s3shuffle_tpu.storage.fsspec_backend import FsspecBackend
 
-    return _maybe_instrument(FsspecBackend(scheme, **(storage_options or {})))
+    return _wrap(FsspecBackend(scheme, **(storage_options or {})), retry_policy)
+
+
+def _wrap(backend: StorageBackend, retry_policy) -> StorageBackend:
+    if retry_policy is not None and retry_policy.retries > 0:
+        from s3shuffle_tpu.storage.retrying import RetryingBackend
+
+        backend = RetryingBackend(backend, retry_policy)
+    return _maybe_instrument(backend)
 
 
 def _maybe_instrument(backend: StorageBackend) -> StorageBackend:

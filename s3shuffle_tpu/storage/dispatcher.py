@@ -42,8 +42,12 @@ class Dispatcher:
 
     def __init__(self, config: ShuffleConfig):
         self.config = config
+        from s3shuffle_tpu.storage.retrying import RetryPolicy
+
+        # None when storage_retries == 0 → no retry layer, fail-fast parity
+        self.retry_policy = RetryPolicy.from_config(config)
         self.backend: StorageBackend = get_backend(
-            config.root_dir, config.storage_options
+            config.root_dir, config.storage_options, self.retry_policy
         )
         self.app_id = config.app_id
         self._status_cache: ConcurrentObjectMap[str, FileStatus] = ConcurrentObjectMap()

@@ -13,7 +13,9 @@ Used by tests/test_fault_injection.py; safe to use in soak tooling too.
 
 from __future__ import annotations
 
+import errno as _errno
 import io
+import random
 import threading
 from typing import BinaryIO, Callable, Dict, List, Optional
 
@@ -23,12 +25,54 @@ from s3shuffle_tpu.storage.backend import FileStatus, RangedReader, StorageBacke
 OPS = ("create", "open", "read", "write", "status", "list", "delete", "rename")
 
 
+# ---------------------------------------------------------------------------
+# Preset transient exception factories — shaped so the resilient storage
+# plane (storage/retrying.is_retriable) classifies them RETRIABLE, unlike
+# FaultRule's default generic ``OSError("injected fault: ...")`` which stays
+# terminal-shaped (existing fail-fast tests keep their semantics).
+# ---------------------------------------------------------------------------
+
+
+def transient_connection_reset(path: str) -> Exception:
+    """The S3 connection-reset shape (client-side TCP RST mid-transfer)."""
+    return ConnectionResetError(
+        _errno.ECONNRESET, f"injected transient connection reset: {path}"
+    )
+
+
+def transient_timeout(path: str) -> Exception:
+    """A timeout-shaped OSError (socket read timeout against the store)."""
+    return OSError(_errno.ETIMEDOUT, f"injected transient timed out: {path}")
+
+
+def transient_http_503(path: str) -> Exception:
+    """The throttle shape fsspec drivers surface for S3 503 SlowDown."""
+    return OSError(f"injected transient: HTTP 503 Service Unavailable (SlowDown): {path}")
+
+
+#: name → factory, for parametrized tests / soak configs
+TRANSIENT_FACTORIES: Dict[str, Callable[[str], Exception]] = {
+    "reset": transient_connection_reset,
+    "timeout": transient_timeout,
+    "503": transient_http_503,
+}
+
+
 class FaultRule:
     """Fail operations of ``op`` whose path contains ``match``.
 
-    ``skip`` matching calls pass through before failures start; after
-    ``times`` failures the rule is exhausted (None = fail forever).
-    ``exc`` is the exception factory.
+    Two firing modes:
+
+    - **deterministic** (default): ``skip`` matching calls pass through
+      before failures start; after ``times`` failures the rule is exhausted
+      (None = fail forever).
+    - **seeded probabilistic** (``prob`` set): each matching call (after
+      ``skip``) fails with probability ``prob``, drawn from a private
+      ``random.Random(rng_seed)`` — deterministic S3-weather modelling for
+      the fault-soak test and benches; ``times`` still caps total failures.
+
+    ``exc`` is the exception factory; see the ``transient_*`` presets above
+    for retriable-shaped failures.
     """
 
     def __init__(
@@ -38,14 +82,20 @@ class FaultRule:
         times: Optional[int] = 1,
         skip: int = 0,
         exc: Callable[[str], Exception] = lambda path: OSError(f"injected fault: {path}"),
+        prob: Optional[float] = None,
+        rng_seed: Optional[int] = None,
     ):
         if op not in OPS:
             raise ValueError(f"unknown op {op!r}; one of {OPS}")
+        if prob is not None and not (0.0 <= prob <= 1.0):
+            raise ValueError(f"prob must be in [0, 1], got {prob}")
         self.op = op
         self.match = match
         self.times = times
         self.skip = skip
         self.exc = exc
+        self.prob = prob
+        self._rng = random.Random(rng_seed) if prob is not None else None
         self.hits = 0  # calls that matched (after skip) and raised
         self._seen = 0
         self._lock = threading.Lock()
@@ -59,6 +109,11 @@ class FaultRule:
                 return
             if self.times is not None and self.hits >= self.times:
                 return
+            if self.prob is not None:
+                # one draw per matching call keeps the sequence a pure
+                # function of (rng_seed, call order) — reruns are exact
+                if self._rng.random() >= self.prob:
+                    return
             self.hits += 1
             raise self.exc(path)
 

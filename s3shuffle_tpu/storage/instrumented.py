@@ -142,7 +142,10 @@ class InstrumentedBackend(StorageBackend):
         return getattr(self.inner, name)
 
     def __setattr__(self, name, value):
-        if name in self._OWN_ATTRS:
+        # names defined on the wrapper class (the StorageBackend methods)
+        # set on the WRAPPER: a monkeypatched ``backend.create`` must
+        # replace the outermost behavior, not recurse through delegation
+        if name in self._OWN_ATTRS or hasattr(type(self), name):
             object.__setattr__(self, name, value)
         else:
             setattr(self.inner, name, value)

@@ -1,0 +1,391 @@
+"""Resilient storage plane: classified retries with backoff under deadlines.
+
+The reference delegates transient-failure handling (S3 503s / SlowDown,
+connection resets) entirely to the Hadoop S3A client's built-in retry policy
+(``fs.s3a.retry.*`` — reference README.md points at the Hadoop docs); its own
+fault-tolerance story is architectural only (SURVEY.md §5.3). This module is
+the S3A-retry analog for our port: without it one transient GET turns a
+reduce task into a ``ChecksumError`` and one transient PUT kills a map task,
+amplifying store weather into full task re-runs through the TaskQueue lease
+machinery.
+
+Three pieces, shared by every layer that talks to the store:
+
+- :func:`is_retriable` — exception classification. Retriable: connection
+  resets/aborts, timeouts, HTTP-5xx-shaped ``OSError`` messages (503 /
+  SlowDown / InternalError), and the fault injector's ``injected transient``
+  marker. Terminal (never retried): ``FileNotFoundError`` (a semantic miss —
+  ``exists()`` probes, uncommitted indices), auth/permission failures, and
+  ``ChecksumError`` (retrying cannot fix corrupt bytes; the task-level rerun
+  must re-fetch from scratch).
+- :class:`RetryPolicy` + :func:`retry_call` — exponential backoff with FULL
+  jitter (``uniform(0, min(cap, base * 2**attempt))``, the AWS-recommended
+  variant that decorrelates a thundering herd) under a per-op wall-clock
+  deadline. ``storage_retries = 0`` disables everything, restoring the
+  fail-fast behavior the fault-injection suite pins.
+- :class:`RetryingBackend` — a decorator over any
+  :class:`~s3shuffle_tpu.storage.backend.StorageBackend`, auto-stacked by
+  :func:`~s3shuffle_tpu.storage.backend.get_backend` between the raw backend
+  and ``InstrumentedBackend`` so every scheme (file, fsspec/s3, memory) gets
+  it transparently. Its ranged readers re-drive failed ``read_fully`` calls
+  with a **fresh** ``open_ranged`` handle (a poisoned connection cannot heal
+  itself), which is what lets ``BlockStream.pread`` / ``ChunkedRangeFetcher``
+  sub-reads absorb transient GET failures below the failed-EOF marker.
+
+Metrics (recorded when the registry is enabled): ``storage_retries_total
+{op,scheme}``, ``storage_retry_backoff_seconds``, and
+``storage_deadline_exceeded_total{op,scheme}``.
+"""
+
+from __future__ import annotations
+
+import errno
+import logging
+import random
+import re as _re
+import threading
+import time
+from dataclasses import dataclass
+from typing import BinaryIO, Callable, List, Optional
+
+from s3shuffle_tpu.metrics import registry as _reg
+from s3shuffle_tpu.storage.backend import FileStatus, RangedReader, StorageBackend
+
+logger = logging.getLogger("s3shuffle_tpu.storage.retry")
+
+_C_RETRIES = _reg.REGISTRY.counter(
+    "storage_retries_total",
+    "Store operations re-driven after a retriable failure",
+    labelnames=("op", "scheme"),
+)
+_H_BACKOFF = _reg.REGISTRY.histogram(
+    "storage_retry_backoff_seconds",
+    "Backoff sleeps between retry attempts (full jitter)",
+)
+_C_DEADLINE = _reg.REGISTRY.counter(
+    "storage_deadline_exceeded_total",
+    "Store operations abandoned because the per-op deadline expired",
+    labelnames=("op", "scheme"),
+)
+
+#: errno values that mean "the store or the path to it hiccuped" — the
+#: connection-level slice of what S3A's RetryPolicy treats as retriable.
+RETRIABLE_ERRNOS = frozenset(
+    getattr(errno, name)
+    for name in (
+        "ECONNRESET",
+        "ECONNABORTED",
+        "ECONNREFUSED",
+        "EPIPE",
+        "ETIMEDOUT",
+        "EHOSTUNREACH",
+        "ENETUNREACH",
+        "ENETRESET",
+        "EAGAIN",
+    )
+    if hasattr(errno, name)
+)
+
+#: lower-cased message fragments that mark an OSError as HTTP-5xx-shaped /
+#: throttle-shaped (fsspec drivers stringify the service error) or as the
+#: fault injector's explicit transient marker. Named PHRASES only — bare
+#: status-code digits live in the delimited regexes below, because object
+#: paths routinely embed shuffle/map ids ("shuffle_3_503_0.data") and a
+#: substring match would misclassify in both directions.
+TRANSIENT_MARKERS = (
+    "injected transient",
+    "slowdown",
+    "slow down",
+    "service unavailable",
+    "serviceunavailable",
+    "internalerror",
+    "internal error",
+    "bad gateway",
+    "gateway timeout",
+    "requesttimeout",
+    "request timeout",
+    "too many requests",
+    "connection reset",
+    "connection aborted",
+    "broken pipe",
+    "timed out",
+)
+
+#: auth-shaped fragments: retrying cannot mint credentials — terminal.
+TERMINAL_MARKERS = (
+    "access denied",
+    "accessdenied",
+    "forbidden",
+    "unauthorized",
+    "invalidaccesskey",
+    "signaturedoesnotmatch",
+    "credential",
+)
+
+#: status codes count only when delimited like prose/service errors
+#: ("HTTP 503 ...", "(503)", "error: 503") — never when embedded in a path
+#: or id token ("shuffle_3_403_0.data", "/pytest-503/").
+_TRANSIENT_CODE_RE = _re.compile(r"(?:^|[\s(])(?:50[0234]|429)(?:$|[)\s:,.])")
+_TERMINAL_CODE_RE = _re.compile(r"(?:^|[\s(])40[13](?:$|[)\s:,.])")
+
+
+def is_retriable(exc: BaseException) -> bool:
+    """Classify an exception: True = transient (re-drive the op), False =
+    terminal (surface immediately; a retry can only waste the deadline)."""
+    if isinstance(
+        exc,
+        (
+            FileNotFoundError,
+            PermissionError,
+            IsADirectoryError,
+            NotADirectoryError,
+            FileExistsError,
+        ),
+    ):
+        return False
+    # ChecksumError subclasses IOError but means corrupt bytes, not weather.
+    from s3shuffle_tpu.read.checksum_stream import ChecksumError
+
+    if isinstance(exc, ChecksumError):
+        return False
+    if isinstance(exc, (ConnectionError, TimeoutError)):
+        return True
+    if isinstance(exc, OSError):
+        if exc.errno in RETRIABLE_ERRNOS:
+            return True
+        msg = str(exc).lower()
+        if any(marker in msg for marker in TERMINAL_MARKERS) or _TERMINAL_CODE_RE.search(msg):
+            return False
+        return any(marker in msg for marker in TRANSIENT_MARKERS) or bool(
+            _TRANSIENT_CODE_RE.search(msg)
+        )
+    return False
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """How hard to try: ``retries`` re-drives after the first attempt,
+    exponential backoff base ``base_ms`` with full jitter capped at
+    ``max_backoff_s``, all under a ``deadline_s`` wall-clock budget per op
+    (0 = unbounded)."""
+
+    retries: int = 3
+    base_ms: float = 50.0
+    deadline_s: float = 30.0
+    max_backoff_s: float = 5.0
+
+    @classmethod
+    def from_config(cls, config) -> Optional["RetryPolicy"]:
+        """None when ``storage_retries`` is 0 — the retry layer is then not
+        stacked at all and every path keeps today's fail-fast behavior."""
+        retries = int(getattr(config, "storage_retries", 0) or 0)
+        if retries <= 0:
+            return None
+        return cls(
+            retries=retries,
+            base_ms=float(getattr(config, "storage_retry_base_ms", 50.0)),
+            deadline_s=float(getattr(config, "storage_op_deadline_s", 30.0)),
+        )
+
+    def backoff_s(self, attempt: int, rng: random.Random) -> float:
+        """Full jitter: uniform over [0, min(cap, base * 2**attempt))."""
+        ceiling = min(self.max_backoff_s, (self.base_ms / 1000.0) * (2.0 ** attempt))
+        return rng.uniform(0.0, ceiling)
+
+
+_process_rng = random.Random()
+
+
+def retry_call(
+    fn: Callable,
+    policy: Optional[RetryPolicy],
+    *,
+    op: str = "call",
+    scheme: str = "",
+    sleep: Callable[[float], None] = time.sleep,
+    clock: Callable[[], float] = time.monotonic,
+    rng: Optional[random.Random] = None,
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
+) -> object:
+    """Run ``fn`` re-driving retriable failures per ``policy``.
+
+    ``policy=None`` (or ``retries <= 0``) is a plain call — zero overhead,
+    zero behavior change. ``on_retry(attempt, exc)`` runs before each backoff
+    sleep (the reader wrapper uses it to swap in a fresh handle)."""
+    if policy is None or policy.retries <= 0:
+        return fn()
+    rng = rng or _process_rng
+    deadline = clock() + policy.deadline_s if policy.deadline_s > 0 else None
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except Exception as exc:
+            if not is_retriable(exc) or attempt >= policy.retries:
+                raise
+            delay = policy.backoff_s(attempt, rng)
+            if deadline is not None and clock() + delay > deadline:
+                if _reg.enabled():
+                    _C_DEADLINE.labels(op=op, scheme=scheme).inc()
+                logger.warning(
+                    "storage op %s exceeded its %.1fs deadline after %d attempts: %s",
+                    op, policy.deadline_s, attempt + 1, exc,
+                )
+                raise
+            if _reg.enabled():
+                _C_RETRIES.labels(op=op, scheme=scheme).inc()
+                _H_BACKOFF.observe(delay)
+            logger.debug(
+                "retrying storage op %s after %s (attempt %d/%d, backoff %.0f ms)",
+                op, exc, attempt + 1, policy.retries, delay * 1e3,
+            )
+            if on_retry is not None:
+                try:
+                    on_retry(attempt, exc)
+                except Exception as reopen_exc:  # fresh-handle open failed
+                    if not is_retriable(reopen_exc):
+                        raise
+                    # transient reopen failure: burn this attempt and loop
+            sleep(delay)
+            attempt += 1
+
+
+class _RetryingReader(RangedReader):
+    """Re-drives failed ``read_fully`` calls with a FRESH reader handle.
+
+    A positioned read that failed on a poisoned connection will keep failing
+    on the same handle, so each retry re-opens through the wrapped backend
+    before re-issuing the read. The failed handle is NOT closed immediately —
+    sibling positioned reads (chunked-fetch sub-ranges) may still be in
+    flight on it and closing under them could recycle the descriptor
+    (the same policy as ``BlockStream.pread``); stale handles close with the
+    reader."""
+
+    def __init__(self, backend: "RetryingBackend", path: str,
+                 size_hint: Optional[int], inner: RangedReader):
+        self._backend = backend
+        self._path = path
+        self._hint = size_hint
+        self._inner = inner
+        self._stale: List[RangedReader] = []
+        self._lock = threading.Lock()
+
+    @property
+    def size(self) -> int:
+        return self._inner.size
+
+    def _reopen(self, failed: RangedReader) -> None:
+        """Swap in a fresh handle unless a sibling retry already did."""
+        with self._lock:
+            if self._inner is failed:
+                self._stale.append(failed)
+                self._inner = self._backend.inner.open_ranged(self._path, self._hint)
+
+    def read_fully(self, position: int, length: int) -> bytes:
+        state: dict = {}
+
+        def attempt() -> bytes:
+            # remember which handle this attempt used, so on_retry reopens
+            # exactly the failed one (a sibling retry may have swapped
+            # self._inner already — then _reopen is a no-op and we just
+            # re-read on the sibling's fresh handle)
+            reader = self._inner
+            state["reader"] = reader
+            return reader.read_fully(position, length)
+
+        return retry_call(
+            attempt,
+            self._backend.policy,
+            op="read",
+            scheme=self._backend.scheme,
+            sleep=self._backend._sleep,
+            clock=self._backend._clock,
+            rng=self._backend._rng,
+            on_retry=lambda _attempt, _exc: self._reopen(state["reader"]),
+        )
+
+    def close(self) -> None:
+        with self._lock:
+            for stale in self._stale:
+                try:
+                    stale.close()
+                except OSError:
+                    pass
+            self._stale = []
+            self._inner.close()
+
+
+class RetryingBackend(StorageBackend):
+    """Classified-retry decorator over any :class:`StorageBackend`.
+
+    Stacked by :func:`get_backend` between the raw backend and
+    ``InstrumentedBackend`` (instrumentation times the whole healed op; the
+    retry layer's own counters expose the re-drives). Write STREAMS returned
+    by :meth:`create` are not retried mid-stream — a partially-written object
+    cannot be re-driven at this layer; the write plane retries its small
+    idempotent-by-overwrite commit objects at object granularity instead
+    (``MapOutputWriter.commit_all_partitions``)."""
+
+    _OWN_ATTRS = frozenset(
+        {"inner", "policy", "scheme", "supports_rename", "_sleep", "_clock", "_rng"}
+    )
+
+    def __init__(
+        self,
+        inner: StorageBackend,
+        policy: RetryPolicy,
+        sleep: Callable[[float], None] = time.sleep,
+        clock: Callable[[], float] = time.monotonic,
+        rng: Optional[random.Random] = None,
+    ):
+        object.__setattr__(self, "inner", inner)
+        object.__setattr__(self, "policy", policy)
+        object.__setattr__(self, "scheme", inner.scheme)
+        object.__setattr__(self, "supports_rename", inner.supports_rename)
+        object.__setattr__(self, "_sleep", sleep)
+        object.__setattr__(self, "_clock", clock)
+        object.__setattr__(self, "_rng", rng or _process_rng)
+
+    # unknown attributes delegate BOTH ways so backend-specific test hooks
+    # (``MemoryBackend.open_interceptor``) keep working through the stack,
+    # mirroring InstrumentedBackend. Names defined on the wrapper class
+    # (the StorageBackend methods) set on the WRAPPER instead: a test
+    # monkeypatching ``backend.create`` must replace the outermost behavior,
+    # not split get (wrapper) from set (inner) into infinite recursion.
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def __setattr__(self, name, value):
+        if name in self._OWN_ATTRS or hasattr(type(self), name):
+            object.__setattr__(self, name, value)
+        else:
+            setattr(self.inner, name, value)
+
+    def _retry(self, op: str, fn: Callable):
+        return retry_call(
+            fn, self.policy, op=op, scheme=self.scheme,
+            sleep=self._sleep, clock=self._clock, rng=self._rng,
+        )
+
+    # ------------------------------------------------------------------
+    def create(self, path: str) -> BinaryIO:
+        return self._retry("create", lambda: self.inner.create(path))
+
+    def open_ranged(self, path: str, size_hint: int | None = None) -> RangedReader:
+        reader = self._retry("open", lambda: self.inner.open_ranged(path, size_hint))
+        return _RetryingReader(self, path, size_hint, reader)
+
+    def status(self, path: str) -> FileStatus:
+        return self._retry("status", lambda: self.inner.status(path))
+
+    def list_prefix(self, prefix: str) -> List[FileStatus]:
+        return self._retry("list", lambda: self.inner.list_prefix(prefix))
+
+    def delete(self, path: str) -> None:
+        self._retry("delete", lambda: self.inner.delete(path))
+
+    def delete_prefix(self, prefix: str) -> None:
+        self._retry("delete", lambda: self.inner.delete_prefix(prefix))
+
+    def rename(self, src: str, dst: str) -> bool:
+        return self._retry("rename", lambda: self.inner.rename(src, dst))

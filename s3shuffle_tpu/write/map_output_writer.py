@@ -136,13 +136,32 @@ class MapOutputWriter:
                 )
             self._stream.close()  # final flush to the store, logs bandwidth
         if self._total_bytes > 0 or self.dispatcher.config.always_create_index:
+            from s3shuffle_tpu.storage.retrying import retry_call
+
+            # The sidecars are small idempotent-by-overwrite PUTs: a
+            # transient failure re-drives the WHOLE object write (create +
+            # write + close) at object granularity, so a half-landed attempt
+            # is simply overwritten. The commit protocol is unchanged: the
+            # checksum object fully lands before the index is attempted, and
+            # the index stays the LAST write. policy=None (storage_retries=0)
+            # keeps today's single fail-fast attempt.
+            policy = getattr(self.dispatcher, "retry_policy", None)
+            scheme = self.dispatcher.backend.scheme
             if self._checksums_enabled:
-                self.helper.write_checksums(
-                    self.shuffle_id, self.map_id, self._checksum_values
+                retry_call(
+                    lambda: self.helper.write_checksums(
+                        self.shuffle_id, self.map_id, self._checksum_values
+                    ),
+                    policy, op="commit_checksums", scheme=scheme,
                 )
             # Index written LAST: it is the commit point — a data object with
             # no index is invisible to readers (S3ShuffleBlockIterator.scala:46-53).
-            self.helper.write_partition_lengths(self.shuffle_id, self.map_id, self._lengths)
+            retry_call(
+                lambda: self.helper.write_partition_lengths(
+                    self.shuffle_id, self.map_id, self._lengths
+                ),
+                policy, op="commit_index", scheme=scheme,
+            )
         checksums = self._checksum_values if self._checksums_enabled else None
         return MapOutputCommitMessage(self._lengths, checksums)
 

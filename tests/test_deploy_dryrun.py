@@ -122,8 +122,35 @@ def test_deploy_wiring_executes_end_to_end(tmp_path):
         # the pod annotations promise prometheus counters are served there.
         # Either replica satisfies the contract — trying both halves the
         # chance of losing the race against job completion on a loaded host.
+        # Readiness is DEADLINE-based (not a fixed iteration count): poll
+        # worker liveness + /metrics until the wall-clock budget runs out,
+        # and fail with the dead/silent worker's captured output so a
+        # crash-loop is diagnosable from the assertion message alone.
+        def _worker_outputs() -> str:
+            chunks = []
+            for j, p in enumerate(workers):
+                if p.poll() is None:
+                    p.terminate()
+                try:
+                    out, _ = p.communicate(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    out, _ = p.communicate()
+                chunks.append(
+                    f"--- worker dryrun-{j} (rc={p.returncode}) ---\n{(out or '')[-2000:]}"
+                )
+            return "\n".join(chunks)
+
+        deadline = time.monotonic() + 60.0
         body, scraped = None, None
-        for _ in range(100):
+        while body is None and time.monotonic() < deadline:
+            dead = [(j, p) for j, p in enumerate(workers) if p.poll() is not None]
+            # either replica can satisfy the scrape contract — only give up
+            # early when NO replica is left alive to ever serve it
+            assert len(dead) < len(workers), (
+                f"all workers {[j for j, _ in dead]} died before /metrics came up:\n"
+                + _worker_outputs()
+            )
             for i in range(2):
                 try:
                     body = urllib.request.urlopen(
@@ -133,10 +160,12 @@ def test_deploy_wiring_executes_end_to_end(tmp_path):
                     break
                 except OSError:
                     continue
-            if body is not None:
-                break
-            time.sleep(0.2)
-        assert body is not None, "worker /metrics never came up"
+            if body is None:
+                time.sleep(0.2)
+        assert body is not None, (
+            "worker /metrics never came up within the 60s readiness deadline:\n"
+            + _worker_outputs()
+        )
         assert "s3shuffle_tasks_run_total" in body
         assert f'worker="dryrun-{scraped}"' in body
         out, _ = coord.communicate(timeout=150)

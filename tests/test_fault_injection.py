@@ -26,6 +26,14 @@ def make_flaky_ctx(tmp_path, **overrides):
     return ctx, flaky
 
 
+# The fail-fast tests below run under BOTH storage_retries settings: the
+# rules inject the generic terminal-shaped ``injected fault`` OSError, which
+# the resilient storage plane must never retry — so observable behavior is
+# identical whether the retry layer is stacked (default) or bypassed
+# entirely (storage_retries=0, the exact pre-retry-plane behavior).
+RETRY_SETTINGS = [0, 3]
+
+
 def write_one_shuffle(ctx, n_records=2000, n_parts=3):
     from s3shuffle_tpu.dependency import HashPartitioner, ShuffleDependency
 
@@ -47,11 +55,14 @@ def read_all(ctx, handle, n_parts):
     return out
 
 
-def test_persistent_read_fault_surfaces_as_eof(tmp_path, caplog):
+@pytest.mark.parametrize("storage_retries", RETRY_SETTINGS)
+def test_persistent_read_fault_surfaces_as_eof(tmp_path, caplog, storage_retries):
     # Parity: mid-stream IOErrors are logged and surfaced as EOF, not raised
     # (S3ShuffleBlockStream.scala:66-70, 87-92). With checksums off this
     # truncates silently — the reference's documented behavior.
-    ctx, flaky = make_flaky_ctx(tmp_path, checksum_enabled=False)
+    ctx, flaky = make_flaky_ctx(
+        tmp_path, checksum_enabled=False, storage_retries=storage_retries
+    )
     handle, records, n_parts = write_one_shuffle(ctx)
     flaky.add_rule(FaultRule("read", match=".data", times=None))
     with caplog.at_level("ERROR", logger="s3shuffle_tpu.read"):
@@ -61,13 +72,16 @@ def test_persistent_read_fault_surfaces_as_eof(tmp_path, caplog):
     ctx.stop()
 
 
-def test_read_fault_with_checksum_is_detected(tmp_path):
+@pytest.mark.parametrize("storage_retries", RETRY_SETTINGS)
+def test_read_fault_with_checksum_is_detected(tmp_path, storage_retries):
     # The EOF-swallowing above silently truncates; checksum validation turns
     # the truncation into a hard error (our extension over the reference,
     # which validates streaming checksums the same way).
     from s3shuffle_tpu.read.checksum_stream import ChecksumError
 
-    ctx, flaky = make_flaky_ctx(tmp_path, checksum_enabled=True)
+    ctx, flaky = make_flaky_ctx(
+        tmp_path, checksum_enabled=True, storage_retries=storage_retries
+    )
     handle, records, n_parts = write_one_shuffle(ctx)
     # fail from the second read on: the stream EOFs mid-partition
     flaky.add_rule(FaultRule("read", match=".data", times=None, skip=1))
@@ -76,10 +90,15 @@ def test_read_fault_with_checksum_is_detected(tmp_path):
     ctx.stop()
 
 
-def test_transient_read_fault_only_loses_nothing_when_retried_by_caller(tmp_path):
+@pytest.mark.parametrize("storage_retries", RETRY_SETTINGS)
+def test_transient_read_fault_only_loses_nothing_when_retried_by_caller(
+    tmp_path, storage_retries
+):
     # A fresh reader (the task-retry analog: Spark re-runs the reduce task)
     # sees intact data after a transient fault window closes.
-    ctx, flaky = make_flaky_ctx(tmp_path, checksum_enabled=True)
+    ctx, flaky = make_flaky_ctx(
+        tmp_path, checksum_enabled=True, storage_retries=storage_retries
+    )
     handle, records, n_parts = write_one_shuffle(ctx)
     rule = flaky.add_rule(FaultRule("open", match=".data", times=2))
     with pytest.raises(OSError):
@@ -93,10 +112,11 @@ def test_transient_read_fault_only_loses_nothing_when_retried_by_caller(tmp_path
     ctx.stop()
 
 
-def test_delete_faults_are_swallowed_per_prefix(tmp_path, caplog):
+@pytest.mark.parametrize("storage_retries", RETRY_SETTINGS)
+def test_delete_faults_are_swallowed_per_prefix(tmp_path, caplog, storage_retries):
     # Parity: removeShuffle swallows per-prefix IO errors but logs them
     # (S3ShuffleDispatcher.scala:109-114).
-    ctx, flaky = make_flaky_ctx(tmp_path)
+    ctx, flaky = make_flaky_ctx(tmp_path, storage_retries=storage_retries)
     handle, records, n_parts = write_one_shuffle(ctx)
     flaky.add_rule(FaultRule("delete", times=None))
     with caplog.at_level("WARNING", logger="s3shuffle_tpu.dispatcher"):
@@ -117,10 +137,11 @@ def test_index_fault_fails_enumeration_in_metadata_mode(tmp_path):
     ctx.stop()
 
 
-def test_write_fault_aborts_commit_and_leaves_no_index(tmp_path):
+@pytest.mark.parametrize("storage_retries", RETRY_SETTINGS)
+def test_write_fault_aborts_commit_and_leaves_no_index(tmp_path, storage_retries):
     # The index object is the commit point: a failed write must not publish
     # one (write-data-then-index ordering, SURVEY.md §7.3).
-    ctx, flaky = make_flaky_ctx(tmp_path)
+    ctx, flaky = make_flaky_ctx(tmp_path, storage_retries=storage_retries)
     from s3shuffle_tpu.dependency import HashPartitioner, ShuffleDependency
 
     sid = next(ctx._next_shuffle_id)
@@ -197,3 +218,101 @@ def test_query_pipeline_loud_failure_then_retry_heals(tmp_path):
         result, reference = q.QUERIES["q75"](st2, sales, returns)
     assert st2.stages == 3
     assert result == reference(), "retry after transient faults diverged"
+
+
+def test_retries_zero_fail_fast_even_for_transient_shapes(tmp_path):
+    # storage_retries=0 bypasses EVERY retry path: a transient-SHAPED fault
+    # (connection reset — retriable-classified) still fails fast, exactly
+    # like the pre-retry-plane behavior; caller-level task retry remains the
+    # only recovery. (With retries enabled the same shape heals in place —
+    # tests/test_fault_soak.py proves that side.)
+    from s3shuffle_tpu.storage.fault import transient_connection_reset
+
+    ctx, flaky = make_flaky_ctx(tmp_path, checksum_enabled=True, storage_retries=0)
+    handle, records, n_parts = write_one_shuffle(ctx)
+    rule = flaky.add_rule(
+        FaultRule("open", match=".data", times=2, exc=transient_connection_reset)
+    )
+    with pytest.raises(OSError):
+        read_all(ctx, handle, n_parts)
+    with pytest.raises(OSError):
+        read_all(ctx, handle, n_parts)
+    # exactly two fail-fast failures — nothing retried below the task layer
+    assert rule.hits == 2
+    out = read_all(ctx, handle, n_parts)
+    assert sorted(out) == sorted(records)
+    ctx.stop()
+
+
+# ---------------------------------------------------------------------------
+# Dispatcher warning-and-continue paths (orphan sweep + parallel delete)
+# under injected list/delete faults — previously untested.
+# ---------------------------------------------------------------------------
+
+
+def _dispatcher_with_objects(tmp_path, shuffle_id=3, map_ids=(0, 1, 2)):
+    """A dispatcher over file:// with data+index objects for ``map_ids``
+    and a FlakyBackend interposed (fail-fast config: the swallowed-error
+    contracts below must hold with no retry layer in the way)."""
+    from s3shuffle_tpu.block_ids import ShuffleDataBlockId, ShuffleIndexBlockId
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/store", app_id="sweep-app", storage_retries=0
+    )
+    disp = Dispatcher(cfg)
+    flaky = FlakyBackend(disp.backend)
+    disp.backend = flaky
+    for mid in map_ids:
+        for block in (
+            ShuffleDataBlockId(shuffle_id, mid),
+            ShuffleIndexBlockId(shuffle_id, mid),
+        ):
+            with disp.backend.create(disp.get_path(block)) as s:
+                s.write(b"payload")
+    return disp, flaky
+
+
+def test_sweep_orphan_list_fault_warns_and_continues(tmp_path, caplog):
+    # A failed prefix LISTING must not fail the sweep: that prefix is skipped
+    # with a warning and the other prefixes are still swept
+    # (dispatcher.sweep_orphan_attempts list error path).
+    disp, flaky = _dispatcher_with_objects(tmp_path)
+    # map_id % folder_prefixes shards maps 0/1/2 into prefixes 0/1/2 — fail
+    # the listing of prefix 1 only
+    flaky.add_rule(FaultRule("list", match="/1/sweep-app", times=None))
+    with caplog.at_level("WARNING", logger="s3shuffle_tpu.dispatcher"):
+        removed = disp.sweep_orphan_attempts(3, winner_map_ids=[0])
+    assert any("orphan sweep list of" in r.message for r in caplog.records)
+    # orphan 2 (listable prefix) swept: data + index; orphan 1 survives
+    assert len(removed) == 2
+    assert all("_2_" in p for p in removed)
+    survivors = [st.path for st in flaky.list_prefix(f"file://{tmp_path}/store/1")]
+    assert len(survivors) == 2  # map 1's data+index still there
+
+
+def test_sweep_orphan_delete_fault_warns_and_continues(tmp_path, caplog):
+    # A failed per-object DELETE is swallowed with a warning and the sweep
+    # keeps going (dispatcher.sweep_orphan_attempts delete error path).
+    disp, flaky = _dispatcher_with_objects(tmp_path)
+    flaky.add_rule(FaultRule("delete", match=".data", times=None))
+    with caplog.at_level("WARNING", logger="s3shuffle_tpu.dispatcher"):
+        removed = disp.sweep_orphan_attempts(3, winner_map_ids=[0])
+    assert any("orphan sweep delete of" in r.message for r in caplog.records)
+    # both orphans' INDEX objects were still removed despite the data faults
+    assert sorted(p.rsplit(".", 1)[-1] for p in removed) == ["index", "index"]
+
+
+def test_parallel_delete_fault_warns_and_continues(tmp_path, caplog):
+    # Parity: per-prefix delete errors are swallowed but logged
+    # (S3ShuffleDispatcher.scala:109-114) — exercised directly against
+    # _parallel_delete via remove_shuffle with one poisoned prefix.
+    disp, flaky = _dispatcher_with_objects(tmp_path)
+    flaky.add_rule(FaultRule("delete", match="/1/sweep-app", times=None))
+    with caplog.at_level("WARNING", logger="s3shuffle_tpu.dispatcher"):
+        disp.remove_shuffle(3)  # must not raise
+    assert any("delete of" in r.message and "failed" in r.message
+               for r in caplog.records)
+    # the healthy prefixes were deleted; the poisoned one survives
+    left = [st.path for st in flaky.list_prefix(f"file://{tmp_path}/store")]
+    assert len(left) == 2 and all("/1/sweep-app/" in p for p in left)

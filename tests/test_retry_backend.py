@@ -1,0 +1,401 @@
+"""Resilient storage plane unit tests: exception classification, backoff
+shape, per-op deadlines, fresh-reader read retries, commit-object re-drives,
+tracker RPC retries, and the storage_retries=0 bypass contract."""
+
+import errno
+import random
+import threading
+import time
+
+import pytest
+
+from s3shuffle_tpu.config import ShuffleConfig
+from s3shuffle_tpu.metrics import registry as mreg
+from s3shuffle_tpu.storage.backend import MemoryBackend
+from s3shuffle_tpu.storage.dispatcher import Dispatcher
+from s3shuffle_tpu.storage.fault import (
+    FaultRule,
+    FlakyBackend,
+    transient_connection_reset,
+    transient_http_503,
+    transient_timeout,
+)
+from s3shuffle_tpu.storage.retrying import (
+    RetryingBackend,
+    RetryPolicy,
+    is_retriable,
+    retry_call,
+)
+
+
+def _no_sleep(_s: float) -> None:
+    pass
+
+
+def make_backend(rules, policy=None, **kw):
+    """Retrying over Flaky over Memory — faults land UNDER the retry layer,
+    the stacking the resilient plane is built for."""
+    mem = MemoryBackend()
+    flaky = FlakyBackend(mem, rules=rules)
+    backend = RetryingBackend(
+        flaky, policy or RetryPolicy(retries=3, base_ms=0.01), sleep=_no_sleep, **kw
+    )
+    return backend, flaky
+
+
+# ---------------------------------------------------------------------------
+# Classification
+# ---------------------------------------------------------------------------
+
+
+def test_classification_terminal_vs_retriable():
+    from s3shuffle_tpu.read.checksum_stream import ChecksumError
+
+    # terminal: semantic misses, auth, corrupt bytes
+    assert not is_retriable(FileNotFoundError("gone"))
+    assert not is_retriable(PermissionError("no"))
+    assert not is_retriable(ChecksumError("Invalid checksum for shuffle_1_0_0"))
+    assert not is_retriable(OSError("injected fault: x"))  # generic injector default
+    assert not is_retriable(OSError("403 AccessDenied on GET"))
+    assert not is_retriable(ValueError("not even an OSError"))
+    # retriable: weather
+    assert is_retriable(ConnectionResetError(errno.ECONNRESET, "reset by peer"))
+    assert is_retriable(ConnectionAbortedError(errno.ECONNABORTED, "aborted"))
+    assert is_retriable(TimeoutError("timed out"))
+    assert is_retriable(OSError(errno.ETIMEDOUT, "timed out"))
+    assert is_retriable(OSError("HTTP 503 Service Unavailable (SlowDown)"))
+    assert is_retriable(OSError("500 Internal Server Error"))
+    # the fault module's presets are retriable-shaped by construction
+    for factory in (transient_connection_reset, transient_timeout, transient_http_503):
+        assert is_retriable(factory("some/path")), factory.__name__
+
+
+def test_classification_ignores_codes_embedded_in_paths():
+    # status-code digits count only when DELIMITED like a service error —
+    # object paths routinely embed shuffle/map ids and tmp-dir counters
+    # that must not flip the classification either way
+    assert is_retriable(
+        OSError("HTTP 503 Service Unavailable (SlowDown): s3://b/shuffle_3_403_0.data")
+    )  # a genuine throttle mentioning map_id 403 stays retriable
+    assert is_retriable(OSError("An error occurred (503) on GET"))
+    assert not is_retriable(
+        OSError("injected fault: /tmp/pytest-of-root/pytest-503/x.data")
+    )  # a path-embedded 503 does not make a terminal error retriable
+    assert not is_retriable(OSError("read failed on shuffle_1_500_0.data"))
+    assert not is_retriable(OSError("An error occurred (403): Forbidden"))
+
+
+def test_terminal_error_is_never_retried():
+    # acceptance criterion: exactly ONE backend call for a terminal error
+    backend, flaky = make_backend(
+        [FaultRule("open", times=None, exc=lambda p: FileNotFoundError(p))]
+    )
+    with pytest.raises(FileNotFoundError):
+        backend.open_ranged("memory:///a/missing")
+    assert flaky.calls["open"] == 1
+
+
+def test_retriable_fault_heals_within_budget():
+    backend, flaky = make_backend(
+        [FaultRule("open", times=2, exc=transient_connection_reset)]
+    )
+    with backend.create("memory:///a/x") as s:
+        s.write(b"payload")
+    with backend.open_ranged("memory:///a/x") as r:
+        assert r.read_fully(0, r.size) == b"payload"
+    assert flaky.calls["open"] == 3  # 2 faulted attempts + the healed one
+
+
+def test_retries_exhausted_raises_last_error():
+    backend, flaky = make_backend(
+        [FaultRule("status", times=None, exc=transient_http_503)],
+        policy=RetryPolicy(retries=2, base_ms=0.01),
+    )
+    with pytest.raises(OSError, match="503"):
+        backend.status("memory:///a/x")
+    assert flaky.calls["status"] == 3  # first + 2 re-drives
+
+
+def test_backoff_is_full_jitter_exponential():
+    sleeps = []
+    backend, _ = make_backend(
+        [FaultRule("status", times=None, exc=transient_timeout)],
+        policy=RetryPolicy(retries=4, base_ms=100.0, deadline_s=0, max_backoff_s=60.0),
+    )
+    object.__setattr__(backend, "_sleep", sleeps.append)
+    object.__setattr__(backend, "_rng", random.Random(7))
+    with pytest.raises(OSError):
+        backend.status("memory:///a/x")
+    assert len(sleeps) == 4
+    for attempt, slept in enumerate(sleeps):
+        assert 0.0 <= slept <= 0.1 * (2.0 ** attempt)
+    assert any(s > 0 for s in sleeps)  # jitter draws are not degenerate
+
+
+def test_deadline_bounds_the_op():
+    clock = {"now": 0.0}
+
+    def fake_clock():
+        return clock["now"]
+
+    def fake_sleep(s):
+        clock["now"] += s
+
+    mreg.REGISTRY.reset_values()
+    mreg.enable()
+    try:
+        mem = MemoryBackend()
+        flaky = FlakyBackend(
+            mem, rules=[FaultRule("status", times=None, exc=transient_timeout)]
+        )
+        backend = RetryingBackend(
+            flaky,
+            # generous retry count; the 0.5s deadline is what must stop it
+            RetryPolicy(retries=1000, base_ms=200.0, deadline_s=0.5, max_backoff_s=60.0),
+            sleep=fake_sleep,
+            clock=fake_clock,
+        )
+        with pytest.raises(OSError):
+            backend.status("memory:///a/x")
+        assert clock["now"] <= 0.5
+        snap = mreg.REGISTRY.snapshot(compact=True)
+        deadline_series = snap["storage_deadline_exceeded_total"]["series"]
+        assert sum(s["value"] for s in deadline_series) == 1
+    finally:
+        mreg.disable()
+        mreg.REGISTRY.reset_values()
+
+
+def test_read_retries_with_fresh_reader():
+    # A failed positioned read is re-driven on a FRESH open_ranged handle —
+    # the recovery path BlockStream.pread / chunked-fetch sub-reads ride.
+    backend, flaky = make_backend([])
+    with backend.create("memory:///a/x") as s:
+        s.write(b"0123456789")
+    reader = backend.open_ranged("memory:///a/x")
+    opens_before = flaky.calls["open"]
+    flaky.add_rule(FaultRule("read", times=2, exc=transient_connection_reset))
+    assert reader.read_fully(2, 4) == b"2345"
+    # each faulted read re-opened a fresh handle before re-reading
+    assert flaky.calls["open"] == opens_before + 2
+    reader.close()
+
+
+def test_read_terminal_mid_read_not_retried():
+    backend, flaky = make_backend([])
+    with backend.create("memory:///a/x") as s:
+        s.write(b"0123456789")
+    reader = backend.open_ranged("memory:///a/x")
+    reads_before = flaky.calls["read"]
+    flaky.add_rule(FaultRule("read", times=None, exc=lambda p: OSError(f"injected fault: {p}")))
+    with pytest.raises(OSError, match="injected fault"):
+        reader.read_fully(0, 4)
+    assert flaky.calls["read"] == reads_before + 1
+    reader.close()
+
+
+def test_retry_metrics_recorded():
+    mreg.REGISTRY.reset_values()
+    mreg.enable()
+    try:
+        backend, _ = make_backend(
+            [FaultRule("open", times=2, exc=transient_connection_reset)]
+        )
+        with backend.create("memory:///a/x") as s:
+            s.write(b"d")
+        backend.open_ranged("memory:///a/x").close()
+        snap = mreg.REGISTRY.snapshot(compact=True)
+        series = snap["storage_retries_total"]["series"]
+        by_labels = {tuple(sorted(s["labels"].items())): s["value"] for s in series}
+        assert by_labels[(("op", "open"), ("scheme", "memory"))] == 2
+        assert snap["storage_retry_backoff_seconds"]["series"][0]["count"] == 2
+    finally:
+        mreg.disable()
+        mreg.REGISTRY.reset_values()
+
+
+# ---------------------------------------------------------------------------
+# Stacking / bypass
+# ---------------------------------------------------------------------------
+
+
+def _unwrap_chain(backend):
+    chain = [type(backend).__name__]
+    while hasattr(backend, "inner"):
+        backend = backend.inner
+        chain.append(type(backend).__name__)
+    return chain
+
+
+def test_get_backend_stacks_retry_layer_by_default():
+    Dispatcher.reset()
+    disp = Dispatcher(ShuffleConfig(root_dir="memory://stacked"))
+    assert "RetryingBackend" in _unwrap_chain(disp.backend)
+    assert disp.retry_policy is not None
+    assert disp.retry_policy.retries == 3
+
+
+def test_storage_retries_zero_bypasses_everything():
+    # acceptance criterion: retries=0 → the retry layer is NOT stacked and
+    # policy resolution yields None everywhere (commit re-drives, block
+    # stream recovery, and the backend decorator are all plain calls)
+    Dispatcher.reset()
+    cfg = ShuffleConfig(root_dir="memory://bypass", storage_retries=0)
+    disp = Dispatcher(cfg)
+    assert "RetryingBackend" not in _unwrap_chain(disp.backend)
+    assert disp.retry_policy is None
+    assert RetryPolicy.from_config(cfg) is None
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise ConnectionResetError(errno.ECONNRESET, "reset")
+
+    with pytest.raises(ConnectionResetError):
+        retry_call(boom, None)
+    assert len(calls) == 1  # policy=None is a plain call
+
+
+def test_retry_knobs_parse_from_env():
+    cfg = ShuffleConfig.from_env(
+        {
+            "S3SHUFFLE_STORAGE_RETRIES": "5",
+            "S3SHUFFLE_STORAGE_RETRY_BASE_MS": "12.5",
+            "S3SHUFFLE_STORAGE_OP_DEADLINE_S": "7.5",
+        }
+    )
+    assert cfg.storage_retries == 5
+    assert cfg.storage_retry_base_ms == 12.5
+    assert cfg.storage_op_deadline_s == 7.5
+    with pytest.raises(ValueError):
+        ShuffleConfig(storage_retries=-1)
+
+
+def test_test_hooks_delegate_through_retry_layer():
+    # MemoryBackend.open_interceptor set through the stacked wrapper must
+    # land on the inner backend (both-ways attribute delegation)
+    Dispatcher.reset()
+    disp = Dispatcher(ShuffleConfig(root_dir="memory://hooks"))
+    seen = []
+    disp.backend.open_interceptor = lambda path: seen.append(path)
+    with disp.backend.create("memory://hooks/a") as s:
+        s.write(b"x")
+    disp.backend.open_ranged("memory://hooks/a").close()
+    assert seen == ["memory://hooks/a"]
+
+
+# ---------------------------------------------------------------------------
+# Commit-object re-drives (MapOutputWriter)
+# ---------------------------------------------------------------------------
+
+
+def _write_map_output(ctx, n_parts=2):
+    from s3shuffle_tpu.dependency import HashPartitioner, ShuffleDependency
+
+    sid = next(ctx._next_shuffle_id)
+    dep = ShuffleDependency(sid, HashPartitioner(n_parts))
+    handle = ctx.manager.register_shuffle(sid, dep)
+    w = ctx.manager.get_writer(handle, 0)
+    w.write([(b"k%d" % i, b"v%d" % i) for i in range(200)])
+    w.stop(success=True)
+    return handle
+
+
+def test_commit_retries_transient_index_put(tmp_path):
+    # A transient create on the index object is re-driven at object
+    # granularity by the writer, so the commit point still lands. The flaky
+    # layer sits ABOVE the storage stack here, so the recovery under test is
+    # the WRITER's, not the backend decorator's.
+    from s3shuffle_tpu.shuffle import ShuffleContext
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/store", app_id="commit-retry",
+        storage_retries=3, storage_retry_base_ms=0.01,
+    )
+    with ShuffleContext(config=cfg, num_workers=2) as ctx:
+        disp = ctx.manager.dispatcher
+        flaky = FlakyBackend(disp.backend)
+        disp.backend = flaky
+        rule = flaky.add_rule(
+            FaultRule("create", match=".index", times=1, exc=transient_connection_reset)
+        )
+        handle = _write_map_output(ctx)
+        assert rule.hits == 1
+        indices = [
+            st.path
+            for st in flaky.list_prefix(f"file://{tmp_path}/store")
+            if ".index" in st.path
+        ]
+        assert len(indices) == 1  # commit landed despite the transient PUT
+        out = []
+        for rid in range(2):
+            out.extend(ctx.manager.get_reader(handle, rid, rid + 1).read())
+        assert len(out) == 200
+
+
+def test_commit_fail_fast_with_retries_zero(tmp_path):
+    from s3shuffle_tpu.shuffle import ShuffleContext
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/store", app_id="commit-ff", storage_retries=0
+    )
+    with ShuffleContext(config=cfg, num_workers=2) as ctx:
+        disp = ctx.manager.dispatcher
+        flaky = FlakyBackend(disp.backend)
+        disp.backend = flaky
+        rule = flaky.add_rule(
+            FaultRule("create", match=".index", times=1, exc=transient_connection_reset)
+        )
+        with pytest.raises(ConnectionResetError):
+            _write_map_output(ctx)
+        assert rule.hits == 1  # exactly one attempt — nothing re-driven
+
+
+# ---------------------------------------------------------------------------
+# Tracker RPC retries
+# ---------------------------------------------------------------------------
+
+
+def test_tracker_rpc_survives_coordinator_restart():
+    from s3shuffle_tpu.metadata.service import MetadataServer, RemoteMapOutputTracker
+
+    server = MetadataServer(port=0).start()
+    host, port = server.address
+    client = RemoteMapOutputTracker(
+        (host, port), retries=8, retry_base_ms=20.0, retry_deadline_s=10.0
+    )
+    assert client.ping()
+    server.stop()  # coordinator goes away mid-session
+
+    def restart():
+        time.sleep(0.3)
+        restarted = MetadataServer(host=host, port=port).start()
+        restarts.append(restarted)
+
+    restarts = []
+    t = threading.Thread(target=restart)
+    t.start()
+    try:
+        assert client.ping()  # healed across the restart window
+    finally:
+        t.join()
+        client.close()
+        for s in restarts:
+            s.stop()
+
+
+def test_tracker_rpc_legacy_fail_fast_with_retries_zero():
+    from s3shuffle_tpu.metadata.service import MetadataServer, RemoteMapOutputTracker
+
+    server = MetadataServer(port=0).start()
+    address = server.address
+    server.stop()
+    client = RemoteMapOutputTracker(address, retries=0)
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        client.ping()
+    # legacy behavior: one silent reconnect, no backoff sleeps
+    assert time.monotonic() - t0 < 5.0
+    client.close()

@@ -362,6 +362,25 @@ def _selftest() -> int:
                 "kind": "counter",
                 "series": [{"labels": {"scheme": "file"}, "value": 1 << 20}],
             },
+            # resilient-storage-plane series (classified retries with
+            # backoff) — the names the fault-tolerance docs point readers at
+            "storage_retries_total": {
+                "kind": "counter",
+                "labelnames": ["op", "scheme"],
+                "series": [
+                    {"labels": {"op": "read", "scheme": "file"}, "value": 7},
+                    {"labels": {"op": "open", "scheme": "file"}, "value": 2},
+                ],
+            },
+            "storage_retry_backoff_seconds": {
+                "kind": "histogram",
+                "series": [{"le": bounds, "buckets": buckets, "sum": 0.9, "count": 100}],
+            },
+            "storage_deadline_exceeded_total": {
+                "kind": "counter",
+                "labelnames": ["op", "scheme"],
+                "series": [{"labels": {"op": "read", "scheme": "file"}, "value": 1}],
+            },
             "read_prefetch_threads": {
                 "kind": "gauge",
                 "series": [{"value": 3}],
@@ -380,6 +399,9 @@ def _selftest() -> int:
         "write_upload_queue_wait_seconds",
         "write_upload_chunk_seconds",
         "read_chunk_inflight",
+        "storage_retries_total",
+        "storage_retry_backoff_seconds",
+        "storage_deadline_exceeded_total",
         "p95",
         "throughput",
     ):
