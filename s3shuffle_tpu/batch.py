@@ -17,12 +17,15 @@ kernels take, so batches flow host→TPU with no re-packing.
 
 from __future__ import annotations
 
+import logging
 import os
 import struct
 import tempfile
 from typing import BinaryIO, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+logger = logging.getLogger("s3shuffle_tpu.batch")
 
 _U32 = struct.Struct("<I")
 
@@ -186,7 +189,10 @@ class RecordBatch:
                     out._kw, out._vw = kw, vw
                     return out
             except Exception:  # pragma: no cover - fall back to concat path
-                pass
+                logger.debug(
+                    "fixed-width gather fast path failed; using concat path",
+                    exc_info=True,
+                )
         return RecordBatch.concat(batches).take(perm)
 
     # ------------------------------------------------------------------
@@ -494,6 +500,7 @@ def _load_native_gather():
             _native_gather = native_ragged_gather if ok else False
             _native_gather_fixed = native_gather_fixed if ok else False
         except Exception:
+            logger.debug("native gather unavailable; using numpy", exc_info=True)
             _native_gather = False
             _native_gather_fixed = False
     return _native_gather

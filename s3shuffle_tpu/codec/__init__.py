@@ -14,6 +14,8 @@ for batch reads — S3ShuffleReader.scala:55-75).
 
 from __future__ import annotations
 
+import logging
+
 from s3shuffle_tpu.codec.framing import (
     CODEC_IDS,
     CodecInputStream,
@@ -47,6 +49,9 @@ def get_codec(
 
             return NativeLZCodec(**bs)
         except Exception:
+            logging.getLogger("s3shuffle_tpu.codec").debug(
+                "codec=auto: native unavailable, selecting zlib", exc_info=True
+            )
             name = "zlib"
     if name == "zlib":
         from s3shuffle_tpu.codec.cpu import ZlibCodec

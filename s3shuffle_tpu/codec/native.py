@@ -9,12 +9,15 @@ functional everywhere.
 from __future__ import annotations
 
 import ctypes
+import logging
 import os
 import subprocess
 import threading
 import time
 
 import numpy as np
+
+logger = logging.getLogger("s3shuffle_tpu.codec.native")
 
 from s3shuffle_tpu.codec.framing import CODEC_IDS, FrameCodec
 from s3shuffle_tpu.metrics import registry as _metrics
@@ -145,6 +148,7 @@ def native_available() -> bool:
         _load()
         return True
     except Exception:
+        logger.debug("native library unavailable", exc_info=True)
         return False
 
 

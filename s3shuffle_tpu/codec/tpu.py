@@ -33,7 +33,6 @@ import numpy as np
 from s3shuffle_tpu.codec.framing import CODEC_IDS, FrameCodec
 from s3shuffle_tpu.ops import tlz
 from s3shuffle_tpu.ops.checksum import (
-    POLY_CRC32,
     POLY_CRC32C,
     crc32_batch,
     crc_combine,
@@ -96,6 +95,7 @@ def _probe_state() -> tuple:
 
                     _PROBE_RESULT["backend"] = jax.default_backend()
                 except Exception:
+                    logger.debug("jax backend probe failed", exc_info=True)
                     _PROBE_RESULT["backend"] = None
 
             _PROBE_THREAD = threading.Thread(
@@ -224,6 +224,9 @@ class TpuCodec(FrameCodec):
                         delegate = NativeLZCodec(block_size=self.block_size)
                     except Exception:
                         # no native lib either — host TLZ is all we have
+                        logger.debug(
+                            "codec=tpu: no native fallback codec", exc_info=True
+                        )
                         self.host_encode_fallback = False
                         return None
                     self._pending_delegate = delegate

@@ -1,0 +1,68 @@
+"""Single source of truth for every metric name the data plane emits.
+
+Before this module existed the known-name list lived, hand-maintained, inside
+``tools/trace_report.py --selftest`` — each PR that added an instrument had to
+remember to extend it, and a forgotten entry silently shrank the selftest's
+coverage. Now there is exactly one exported table:
+
+- every instrument-declaration site (``REGISTRY.counter/gauge/histogram``)
+  must use a name declared here — enforced statically by shuffle-lint rule
+  **MET01** (``python -m tools.shuffle_lint``);
+- ``tools/trace_report.py --selftest`` derives its synthetic rendering
+  coverage from this table, so a metric registered anywhere in the package is
+  automatically exercised by the CLI smoke check;
+- ``tests/test_shuffle_lint.py`` closes the loop in the other direction: a
+  name declared here that NO source file registers fails the drift test.
+
+Keep entries sorted by subsystem. The value is ``(kind, labelnames)`` where
+``kind`` is one of ``counter`` / ``gauge`` / ``histogram`` and ``labelnames``
+matches the ``labelnames=`` tuple at the registration site (``()`` for
+unlabeled instruments).
+
+NOTE for shuffle-lint: this file is parsed with ``ast.literal_eval`` — keep
+``KNOWN_METRICS`` a pure literal (no comprehensions, calls, or name
+references) so the linter can read it without importing the package.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+#: metric name -> (kind, labelnames). PURE LITERAL — see module docstring.
+KNOWN_METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
+    # --- storage plane: instrumented backend (storage/instrumented.py) ---
+    "storage_op_seconds": ("histogram", ("scheme", "op")),
+    "storage_errors_total": ("counter", ("scheme", "op")),
+    "storage_read_bytes_total": ("counter", ("scheme",)),
+    "storage_write_bytes_total": ("counter", ("scheme",)),
+    # --- storage plane: classified retries (storage/retrying.py) ---
+    "storage_retries_total": ("counter", ("op", "scheme")),
+    "storage_retry_backoff_seconds": ("histogram", ()),
+    "storage_deadline_exceeded_total": ("counter", ("op", "scheme")),
+    # --- read plane: adaptive prefetch (read/prefetch.py) ---
+    "read_prefetch_wait_seconds": ("histogram", ()),
+    "read_prefetch_fill_seconds": ("histogram", ()),
+    "read_prefetch_threads": ("gauge", ()),
+    "read_prefetch_thread_moves_total": ("counter", ("direction",)),
+    # --- read plane: chunked concurrent ranged GETs (read/chunked_fetch.py) ---
+    "read_chunk_fetch_seconds": ("histogram", ()),
+    "read_chunk_inflight": ("gauge", ()),
+    "read_chunked_prefills_total": ("counter", ()),
+    # --- read plane: checksum validation (read/checksum_stream.py) ---
+    "read_checksum_validate_seconds": ("histogram", ()),
+    "read_checksum_failures_total": ("counter", ()),
+    # --- write plane: spill/commit/serialize (write/*.py) ---
+    "write_spill_seconds": ("histogram", ()),
+    "write_spill_bytes_total": ("counter", ()),
+    "write_commit_seconds": ("histogram", ()),
+    "write_serialize_seconds": ("histogram", ()),
+    "write_upload_seconds": ("histogram", ()),
+    "write_upload_bytes_total": ("counter", ()),
+    # --- write plane: pipelined commit uploads (write/pipelined_upload.py) ---
+    "write_upload_queue_wait_seconds": ("histogram", ()),
+    "write_upload_queue_bytes": ("gauge", ()),
+    "write_upload_chunk_seconds": ("histogram", ()),
+    # --- codec plane (codec/native.py) ---
+    "codec_compress_seconds": ("histogram", ("codec",)),
+    "codec_compress_bytes_total": ("counter", ("codec",)),
+}

@@ -30,7 +30,7 @@ from __future__ import annotations
 import os
 import threading
 from bisect import bisect_left
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 _enabled = False
 

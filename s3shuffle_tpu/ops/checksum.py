@@ -34,10 +34,13 @@ magnitude above the JVM's table-walk.
 from __future__ import annotations
 
 import functools
+import logging
 import threading
 from typing import Dict, Tuple
 
 import numpy as np
+
+logger = logging.getLogger("s3shuffle_tpu.ops.checksum")
 
 POLY_CRC32 = 0xEDB88320  # java.util.zip.CRC32 (the reference's CRC32)
 POLY_CRC32C = 0x82F63B78  # Castagnoli (our extension / native+TPU codec)
@@ -248,6 +251,7 @@ def _use_pallas(b: int, length: int) -> bool:
         if jax.default_backend() not in ("tpu",):
             return False
     except Exception:
+        logger.debug("jax backend probe failed; pallas CRC off", exc_info=True)
         return False
     return crc_pallas.supported(b, length)
 

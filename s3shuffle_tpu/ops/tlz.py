@@ -79,10 +79,13 @@ writers at ~150 MB/s/core.
 from __future__ import annotations
 
 import functools
+import logging
 import threading
 from typing import List, Tuple
 
 import numpy as np
+
+logger = logging.getLogger("s3shuffle_tpu.ops.tlz")
 
 GROUP = 8
 #: v1 used 16-byte groups; kept for decoding legacy payloads.
@@ -896,6 +899,7 @@ def _encode_block_native(data: bytes):
 
         lib = _load()
     except Exception:
+        logger.debug("native tlz encoder unavailable", exc_info=True)
         return None
     n_groups = (len(data) + GROUP - 1) // GROUP
     if n_groups == 0 or n_groups > MAX_BLOCK // GROUP:
@@ -937,6 +941,7 @@ def _decode_block_native_fast(payload: bytes, ulen: int):
 
         lib = _load()
     except Exception:
+        logger.debug("native tlz decoder unavailable", exc_info=True)
         return None
     if len(payload) < 2:
         return None

@@ -170,11 +170,13 @@ class BlockStream(io.RawIOBase):
                 reader = self._ensure_open()
                 if reader is None:
                     return b""
+                # shuffle-lint: disable=LK01 reason=cursor path is single-consumer by contract; the lock exists to serialize cursor reads against concurrent pread siblings, so the GET must sit inside it
                 data = reader.read_fully(self._pos, n)
             except OSError as e:
                 fresh = self._recover_reader_locked(e, reader)
                 if fresh is not None:
                     try:
+                        # shuffle-lint: disable=LK01 reason=recovery re-read on the cursor path; same single-consumer serialization as the primary read above
                         data = fresh.read_fully(self._pos, n)
                     except OSError as e2:
                         e = e2

@@ -62,6 +62,7 @@ def _submit_fetch(width: int, fn, *args):
     with _executor_lock:
         if _executor is None or width > _executor_width:
             old = _executor
+            # shuffle-lint: disable=THR01 reason=process-wide grow-only pool shared across tasks for the process lifetime; a superseded pool is shut down below (old.shutdown) and concurrent.futures joins idle workers at interpreter exit
             _executor = ThreadPoolExecutor(
                 max_workers=width, thread_name_prefix="s3shuffle-fetch"
             )

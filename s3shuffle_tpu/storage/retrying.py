@@ -275,11 +275,23 @@ class _RetryingReader(RangedReader):
         return self._inner.size
 
     def _reopen(self, failed: RangedReader) -> None:
-        """Swap in a fresh handle unless a sibling retry already did."""
+        """Swap in a fresh handle unless a sibling retry already did.
+
+        The open itself happens OUTSIDE the swap lock (shuffle-lint LK01:
+        store-latency I/O under a lock convoys every sibling sub-read
+        blocked on the swap); only the pointer swap is locked. If a sibling
+        won the race while we were opening, our fresh handle joins the
+        stale list and closes with the reader."""
+        with self._lock:
+            if self._inner is not failed:
+                return  # a sibling already swapped in a fresh handle
+        fresh = self._backend.inner.open_ranged(self._path, self._hint)
         with self._lock:
             if self._inner is failed:
                 self._stale.append(failed)
-                self._inner = self._backend.inner.open_ranged(self._path, self._hint)
+                self._inner = fresh
+            else:
+                self._stale.append(fresh)
 
     def read_fully(self, position: int, length: int) -> bytes:
         state: dict = {}

@@ -30,7 +30,7 @@ widths: q75's stage-1 shuffle drops from 40 to 12 bytes/row.
 
 from __future__ import annotations
 
-from typing import Iterable, Iterator, List, Optional, Sequence, Tuple, Union
+from typing import Iterator, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 

@@ -8,7 +8,10 @@ C++ native library when built, else a pure-Python table fallback.
 
 from __future__ import annotations
 
+import logging
 import zlib
+
+logger = logging.getLogger("s3shuffle_tpu.checksums")
 
 
 class Checksum:
@@ -95,7 +98,7 @@ def _crc32c_fn():
         if native_available():
             return native_crc32c
     except Exception:
-        pass
+        logger.debug("native crc32c unavailable; using Python table", exc_info=True)
     return crc32c_py
 
 

@@ -1,0 +1,358 @@
+"""Runtime lock-order witness — the dynamic half of shuffle-lint's LK rules.
+
+The static analyzer (``tools/shuffle_lint``) can prove lexical properties
+(no storage I/O under a lock, predicate-looped waits) but NOT global lock
+*ordering*: an ABBA deadlock needs two call stacks in two modules acquiring
+the same pair of locks in opposite orders, which no per-file pass sees. This
+shim checks it dynamically, the way TSan's deadlock detector or JDK lock
+graphs do:
+
+- :func:`install` replaces ``threading.Lock`` / ``threading.RLock`` /
+  ``threading.Condition`` with witnessed factories. Only locks constructed
+  by *watched code* (by default: files under the ``s3shuffle_tpu`` package;
+  extendable via ``extra_paths``) are wrapped — stdlib machinery
+  (``concurrent.futures``, ``queue``, loggers) keeps the raw primitives, so
+  overhead and noise stay bounded;
+- every witnessed lock is keyed by its **allocation site** (``file:line`` of
+  the constructor call), so all instances of e.g. ``BlockStream._lock``
+  collapse into one graph node and the order graph describes the *design*,
+  not one run's object population;
+- each acquisition that happens while the acquiring thread already holds
+  other witnessed locks records directed edges ``held-site → new-site``;
+- :func:`find_cycles` reports cycles in that graph — a cycle is a lock-order
+  inversion: two threads interleaving those acquisition paths can deadlock,
+  even if this run happened not to. Same-site self-loops are ignored (two
+  instances of the same class's lock are ordered by address, not design).
+
+``Condition.wait`` is modeled correctly: the underlying (witnessed) RLock's
+``_release_save`` / ``_acquire_restore`` hooks pop the lock from the
+holder's stack during the wait and push it back on wakeup, so waiting with
+the condition lock "held" does not fabricate edges.
+
+Opt-in: set ``S3SHUFFLE_LOCK_WITNESS=1`` and run the test suite —
+``tests/conftest.py`` installs the shim before product imports and fails the
+session on cycles. Programmatic use::
+
+    with lockwitness.watching() as w:
+        ... run a workload ...
+    assert w.find_cycles() == []
+
+Overhead when not installed: zero (nothing is patched).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import _thread
+from typing import Dict, List, Optional, Set, Tuple
+
+#: the raw primitive, captured before any patching can occur
+_allocate_lock = _thread.allocate_lock
+
+_THIS_FILE = os.path.abspath(__file__)
+_PKG_ROOT = os.path.dirname(os.path.dirname(_THIS_FILE))
+
+
+class _Holder(threading.local):
+    """Per-thread stack of (lock-object, site) currently held."""
+
+    def __init__(self) -> None:
+        self.stack: List[Tuple[object, str]] = []
+
+
+class LockWitness:
+    """Order-graph recorder shared by every witnessed lock."""
+
+    def __init__(self) -> None:
+        self._mu = _allocate_lock()
+        # site -> set of sites acquired while this one was held
+        self._edges: Dict[str, Set[str]] = {}
+        # (from, to) -> one example (thread name) for diagnostics
+        self._examples: Dict[Tuple[str, str], str] = {}
+        self._holder = _Holder()
+
+    # -- recording -----------------------------------------------------
+    def on_acquired(self, lock: object, site: str) -> None:
+        stack = self._holder.stack
+        if any(obj is lock for obj, _ in stack):
+            # re-entrant acquire of the same object (RLock): no new edges —
+            # mark the reentry so release bookkeeping stays balanced
+            stack.append((lock, site))
+            return
+        if stack:
+            tname = threading.current_thread().name
+            with self._mu:
+                for _obj, held_site in stack:
+                    if held_site == site:
+                        continue  # same-design-site pair: address-ordered
+                    self._edges.setdefault(held_site, set()).add(site)
+                    self._examples.setdefault((held_site, site), tname)
+        stack.append((lock, site))
+
+    def on_released(self, lock: object) -> None:
+        stack = self._holder.stack
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][0] is lock:
+                del stack[i]
+                return
+
+    def on_released_all(self, lock: object) -> int:
+        """Condition.wait released the lock completely (every reentry).
+        Returns how many stack entries were removed so the wakeup can
+        re-push the same number — a reentrantly-held condition lock must
+        not leave the holder's stack short after the wait."""
+        stack = self._holder.stack
+        kept = [(obj, site) for obj, site in stack if obj is not lock]
+        removed = len(stack) - len(kept)
+        self._holder.stack = kept
+        return removed
+
+    # -- reporting -----------------------------------------------------
+    def edges(self) -> Dict[str, Set[str]]:
+        with self._mu:
+            return {k: set(v) for k, v in self._edges.items()}
+
+    def find_cycles(self) -> List[List[str]]:
+        """Cycles in the site order graph (each returned as the site list
+        around the loop). Empty list = every observed acquisition order is
+        consistent with a global partial order = no ABBA deadlock among the
+        exercised paths."""
+        graph = self.edges()
+        color: Dict[str, int] = {}  # 0/absent=white 1=grey 2=black
+        path: List[str] = []
+        cycles: List[List[str]] = []
+
+        def dfs(node: str) -> None:
+            color[node] = 1
+            path.append(node)
+            for nxt in sorted(graph.get(node, ())):
+                c = color.get(nxt, 0)
+                if c == 0:
+                    dfs(nxt)
+                elif c == 1:
+                    cycles.append(path[path.index(nxt):] + [nxt])
+            path.pop()
+            color[node] = 2
+
+        for node in sorted(graph):
+            if color.get(node, 0) == 0:
+                dfs(node)
+        return cycles
+
+    def format_report(self) -> str:
+        cycles = self.find_cycles()
+        if not cycles:
+            return "lock witness: no ordering cycles"
+        lines = [f"lock witness: {len(cycles)} ordering cycle(s) detected:"]
+        with self._mu:
+            for cyc in cycles:
+                lines.append("  " + " -> ".join(cyc))
+                for a, b in zip(cyc, cyc[1:]):
+                    who = self._examples.get((a, b), "?")
+                    lines.append(f"    {a} held while acquiring {b} (thread {who})")
+        return "\n".join(lines)
+
+    def reset(self) -> None:
+        with self._mu:
+            self._edges.clear()
+            self._examples.clear()
+
+
+class _WitnessedLock:
+    """Wrapper over a raw lock that reports to the witness."""
+
+    def __init__(self, witness: LockWitness, inner, site: str):
+        self._witness = witness
+        self._inner = inner
+        self._site = site
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._witness.on_acquired(self, self._site)
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        self._witness.on_released(self)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<witnessed {self._inner!r} from {self._site}>"
+
+
+class _WitnessedRLock(_WitnessedLock):
+    """RLock wrapper exposing the private hooks ``threading.Condition``
+    binds at construction (``_release_save`` / ``_acquire_restore`` /
+    ``_is_owned``), so a Condition built on this wrapper models its wait
+    protocol faithfully in the witness."""
+
+    def locked(self) -> bool:  # RLock in 3.12+; best-effort before
+        locked = getattr(self._inner, "locked", None)
+        return locked() if callable(locked) else self._inner._is_owned()
+
+    def _release_save(self):
+        state = self._inner._release_save()
+        removed = self._witness.on_released_all(self)
+        return (state, removed)
+
+    def _acquire_restore(self, state) -> None:
+        inner_state, removed = state
+        self._inner._acquire_restore(inner_state)
+        # restore the SAME stack depth the wait released (reentrant holds
+        # push one entry per acquire); the first push records edges, the
+        # rest are reentries
+        for _ in range(max(1, removed)):
+            self._witness.on_acquired(self, self._site)
+
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+
+# ---------------------------------------------------------------------------
+# Installation
+# ---------------------------------------------------------------------------
+
+_installed: Optional["_Install"] = None
+
+
+class _Install:
+    def __init__(self, witness: LockWitness, watch_paths: Tuple[str, ...]):
+        self.witness = witness
+        self.watch_paths = watch_paths
+        self.orig_lock = threading.Lock
+        self.orig_rlock = threading.RLock
+        self.orig_condition = threading.Condition
+
+
+def _caller_site(depth: int = 2) -> Optional[str]:
+    """``file:line`` of the first frame outside this module, or None when the
+    constructor ran from unwatched code."""
+    inst = _installed
+    if inst is None:
+        return None
+    frame = sys._getframe(depth)
+    while frame is not None:
+        fn = os.path.abspath(frame.f_code.co_filename)
+        if fn != _THIS_FILE:
+            if any(
+                fn == p or fn.startswith(p + os.sep) for p in inst.watch_paths
+            ):
+                return f"{os.path.relpath(fn, _PKG_ROOT)}:{frame.f_lineno}"
+            return None
+        frame = frame.f_back
+    return None
+
+
+def _make_lock(*args, **kwargs):
+    site = _caller_site()
+    inner = _installed.orig_lock(*args, **kwargs) if _installed else _allocate_lock()
+    if site is None or _installed is None:
+        return inner
+    return _WitnessedLock(_installed.witness, inner, site)
+
+
+def _make_rlock(*args, **kwargs):
+    site = _caller_site()
+    inner = (
+        _installed.orig_rlock(*args, **kwargs)
+        if _installed
+        else threading.RLock(*args, **kwargs)
+    )
+    if site is None or _installed is None:
+        return inner
+    return _WitnessedRLock(_installed.witness, inner, site)
+
+
+def _make_condition(lock=None):
+    orig_condition = _installed.orig_condition if _installed else threading.Condition
+    if lock is None and _installed is not None:
+        site = _caller_site()
+        if site is not None:
+            inner = _installed.orig_rlock()
+            lock = _WitnessedRLock(_installed.witness, inner, site)
+    return orig_condition(lock)
+
+
+def install(extra_paths: Tuple[str, ...] = ()) -> LockWitness:
+    """Patch ``threading.{Lock,RLock,Condition}`` with witnessed factories.
+    Locks constructed by code under ``s3shuffle_tpu`` (plus ``extra_paths``)
+    are recorded; everything else gets the raw primitive. Idempotent — a
+    second install returns the existing witness, EXTENDING its watch set
+    with any new ``extra_paths`` (silently dropping them would make a
+    caller's cycle check vacuous)."""
+    global _installed
+    if _installed is not None:
+        if extra_paths:
+            merged = _installed.watch_paths + tuple(
+                os.path.abspath(p) for p in extra_paths
+            )
+            _installed.watch_paths = tuple(dict.fromkeys(merged))
+        return _installed.witness
+    watch = (_PKG_ROOT,) + tuple(os.path.abspath(p) for p in extra_paths)
+    _installed = _Install(LockWitness(), watch)
+    threading.Lock = _make_lock  # type: ignore[assignment]
+    threading.RLock = _make_rlock  # type: ignore[assignment]
+    threading.Condition = _make_condition  # type: ignore[assignment]
+    return _installed.witness
+
+
+def uninstall() -> None:
+    global _installed
+    if _installed is None:
+        return
+    threading.Lock = _installed.orig_lock  # type: ignore[assignment]
+    threading.RLock = _installed.orig_rlock  # type: ignore[assignment]
+    threading.Condition = _installed.orig_condition  # type: ignore[assignment]
+    _installed = None
+
+
+def active_witness() -> Optional[LockWitness]:
+    return _installed.witness if _installed is not None else None
+
+
+class watching:
+    """Context manager: install on enter, uninstall on exit, expose the
+    witness. Locks created inside keep working after exit (they hold their
+    own inner primitives) — only NEW constructions stop being witnessed."""
+
+    def __init__(self, extra_paths: Tuple[str, ...] = ()):
+        self._extra = extra_paths
+        self.witness: Optional[LockWitness] = None
+        self._preinstalled = False
+        self._saved_watch: Optional[Tuple[str, ...]] = None
+
+    def __enter__(self) -> LockWitness:
+        self._preinstalled = _installed is not None
+        if self._preinstalled:
+            self._saved_watch = _installed.watch_paths
+        self.witness = install(self._extra)
+        return self.witness
+
+    def __exit__(self, *exc) -> None:
+        if not self._preinstalled:  # an env-level install outlives us
+            uninstall()
+        elif self._saved_watch is not None:
+            # restore the session witness's watch scope — our extra_paths
+            # were for this block only
+            _installed.watch_paths = self._saved_watch
+
+
+def install_from_env() -> Optional[LockWitness]:
+    """Install iff ``S3SHUFFLE_LOCK_WITNESS`` is set truthy (how conftest
+    wires the soak/stress runs). ``0`` / ``false`` / ``off`` disable, like
+    every other boolean knob."""
+    value = os.environ.get("S3SHUFFLE_LOCK_WITNESS", "").strip().lower()
+    if value and value not in ("0", "false", "no", "off"):
+        return install()
+    return None

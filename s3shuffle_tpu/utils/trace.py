@@ -21,10 +21,13 @@ from __future__ import annotations
 
 import atexit
 import json
+import logging
 import os
 import threading
 import time
 from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger("s3shuffle_tpu.trace")
 
 _lock = threading.Lock()
 _events: List[dict] = []
@@ -92,6 +95,7 @@ class _Span:
                 self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
                 self._jax_ctx.__enter__()
             except Exception:
+                logger.debug("jax trace annotation unavailable", exc_info=True)
                 self._jax_ctx = None
         return self
 

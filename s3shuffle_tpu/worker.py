@@ -244,6 +244,15 @@ class WorkerAgent:
 
     KINDS = {"map": _run_map, "reduce": _run_reduce}
 
+    # -- lifecycle ------------------------------------------------------
+    def close(self) -> None:
+        """Release the coordinator connection (and stop the heartbeat loop
+        if one is running). In-process/test usage must call this — a leaked
+        tracker socket is exactly what the suite's ResourceWarning
+        strictness turns into a failure."""
+        self._stopped = True
+        self.client.close()
+
     # -- loop ----------------------------------------------------------
     def run_once(self) -> str:
         """Poll for one task. Returns the action taken: run|wait|stop."""
@@ -359,12 +368,19 @@ class WorkerAgent:
 
         def beat():
             hb_client = RemoteMapOutputTracker(self.client.address)
-            while not self._stopped:
-                try:
-                    hb_client.heartbeat(self.worker_id)
-                except Exception:
-                    pass  # coordinator briefly away — take_task also beats
-                time.sleep(interval_s)
+            try:
+                while not self._stopped:
+                    try:
+                        hb_client.heartbeat(self.worker_id)
+                    except Exception as e:
+                        # coordinator briefly away — take_task also beats, so
+                        # a missed heartbeat is recoverable; leave a trace
+                        logger.debug(
+                            "worker %s heartbeat skipped: %s", self.worker_id, e
+                        )
+                    time.sleep(interval_s)
+            finally:
+                hb_client.close()
 
         threading.Thread(target=beat, daemon=True, name="worker-heartbeat").start()
 
@@ -480,7 +496,10 @@ class MetricsServer:
         return body
 
     def stop(self) -> None:
-        self._server.shutdown()
+        if self._thread.is_alive():
+            # shutdown() handshakes with the serve_forever loop — calling it
+            # on a never-started server would block forever
+            self._server.shutdown()
         self._server.server_close()
 
 

@@ -181,7 +181,10 @@ class MapOutputWriter:
             except Exception:
                 # best effort: the pipelined uploader re-raises its failure
                 # on close, but the object is deleted right below either way
-                pass
+                logger.debug(
+                    "close of aborted map output stream %s failed",
+                    self._block.name, exc_info=True,
+                )
         self.dispatcher.backend.delete(self.dispatcher.get_path(self._block))
         logger.warning(
             "Aborted map output %s: %s", self._block.name, error if error else "unknown"

@@ -22,6 +22,33 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# Lock-order witness (S3SHUFFLE_LOCK_WITNESS=1): must install BEFORE any
+# product import — module-level locks (metric registries, gc_paused in
+# utils/__init__, the shared fetch-executor guard, trace state) are
+# constructed at import time and can only be witnessed if threading is
+# already patched. A plain `from s3shuffle_tpu.utils import lockwitness`
+# would run the package __init__s FIRST (constructing gc_paused's lock raw),
+# so the module — deliberately stdlib-only — is loaded straight from its
+# file and pre-registered in sys.modules under its canonical name: the later
+# package import reuses this exact module object (one _installed, one
+# witness). The session fixture at the bottom fails the run on cycles.
+import importlib.util as _ilu  # noqa: E402
+import sys as _sys  # noqa: E402
+
+_LW_NAME = "s3shuffle_tpu.utils.lockwitness"
+_spec = _ilu.spec_from_file_location(
+    _LW_NAME,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "s3shuffle_tpu", "utils", "lockwitness.py",
+    ),
+)
+lockwitness = _ilu.module_from_spec(_spec)
+_sys.modules[_LW_NAME] = lockwitness
+_spec.loader.exec_module(lockwitness)
+
+_WITNESS = lockwitness.install_from_env()
+
 from s3shuffle_tpu.storage.dispatcher import Dispatcher  # noqa: E402
 
 # Mode matrix (the analog of the reference CI's second run with
@@ -51,6 +78,18 @@ if _MODE_OVERRIDES:
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: spawns worker processes / long-running")
+    # Strictness: leaked handles and background-thread deaths become FAILURES
+    # instead of warnings — the dynamic complement to shuffle-lint's EXC01 /
+    # THR01 (a ResourceWarning is a leaked open_ranged/create handle; an
+    # unraisable or thread excepthook error is a helper thread dying silently,
+    # which no static rule can prove).
+    config.addinivalue_line("filterwarnings", "error::ResourceWarning")
+    config.addinivalue_line(
+        "filterwarnings", "error::pytest.PytestUnraisableExceptionWarning"
+    )
+    config.addinivalue_line(
+        "filterwarnings", "error::pytest.PytestUnhandledThreadExceptionWarning"
+    )
 
 
 @pytest.fixture(autouse=True)
@@ -58,3 +97,15 @@ def _reset_dispatcher_singleton():
     Dispatcher.reset()
     yield
     Dispatcher.reset()
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _lock_witness_verdict():
+    """With S3SHUFFLE_LOCK_WITNESS=1: fail the session if the lock-order
+    witness observed an acquisition-order cycle anywhere in the run (the
+    stress + fault-soak tests are the interesting coverage)."""
+    yield
+    if _WITNESS is not None:
+        report = _WITNESS.format_report()
+        print("\n" + report)
+        assert not _WITNESS.find_cycles(), report

@@ -419,15 +419,18 @@ def test_worker_metrics_endpoint(tmp_path):
         agent = WorkerAgent(svc.address, config=cfg, worker_id="w-metrics")
         metrics = MetricsServer(agent, host="127.0.0.1", port=0).start()
         try:
-            body = urllib.request.urlopen(
+            with urllib.request.urlopen(
                 f"http://127.0.0.1:{metrics.port}/metrics", timeout=5
-            ).read().decode()
+            ) as resp:
+                body = resp.read().decode()
             assert 's3shuffle_tasks_run_total{worker="w-metrics"} 0' in body
-            assert urllib.request.urlopen(
+            with urllib.request.urlopen(
                 f"http://127.0.0.1:{metrics.port}/healthz", timeout=5
-            ).status == 200
+            ) as resp:
+                assert resp.status == 200
         finally:
             metrics.stop()
+            agent.close()
     finally:
         svc.stop()
 
@@ -450,6 +453,8 @@ def test_worker_metrics_colliding_counter_names_dedup(tmp_path):
             body = metrics.render()
         finally:
             trace.disable()
+            metrics.stop()  # never started, but its listening socket is bound
+            agent.close()
         assert body.count("# TYPE s3shuffle_dedup_check counter") == 1
         assert 's3shuffle_dedup_check{worker="w-dedup"} 7.0' in body
     finally:

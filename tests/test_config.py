@@ -100,7 +100,8 @@ def test_trace_records_spans_and_counters(tmp_path):
         assert "read.prefetch" in names
         assert trace.counters().get("read.tasks", 0) >= 2
         path = trace.flush()
-        doc = json.load(open(path))
+        with open(path) as f:
+            doc = json.load(f)
         assert doc["traceEvents"] and "counters" in doc["otherData"]
     finally:
         trace.disable()

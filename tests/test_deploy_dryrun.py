@@ -153,9 +153,10 @@ def test_deploy_wiring_executes_end_to_end(tmp_path):
             )
             for i in range(2):
                 try:
-                    body = urllib.request.urlopen(
+                    with urllib.request.urlopen(
                         f"http://127.0.0.1:{metrics_base + i}/metrics", timeout=5
-                    ).read().decode()
+                    ) as resp:
+                        body = resp.read().decode()
                     scraped = i
                     break
                 except OSError:
@@ -181,3 +182,12 @@ def test_deploy_wiring_executes_end_to_end(tmp_path):
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+            if p.stdout:
+                p.stdout.close()
+        try:
+            coord.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            coord.kill()
+            coord.wait()
+        if coord.stdout:
+            coord.stdout.close()

@@ -1,0 +1,459 @@
+"""shuffle-lint: per-rule positive/negative coverage, suppression machinery,
+tree cleanliness (the tier-1 lint gate), CLI contract, and the MET01
+single-source-of-truth drift checks.
+"""
+
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from tools.shuffle_lint import ProjectModel, lint_paths, lint_source, summarize
+from tools.shuffle_lint.rules import ALL_RULES
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO_ROOT, "s3shuffle_tpu")
+
+#: model used by the embedded fixtures (small + independent of the real tree)
+FIXTURE_MODEL = ProjectModel(
+    config_fields={"buffer_size", "root_dir"},
+    config_methods={"log_values", "from_dict", "from_env", "scheme"},
+    metric_names={"read_prefetch_wait_seconds": "histogram"},
+)
+
+
+def _lint(source, model=FIXTURE_MODEL, path="<test>"):
+    return lint_source(source, path, model=model)
+
+
+def _rules_fired(violations):
+    return {v.rule for v in violations if not v.suppressed}
+
+
+# ---------------------------------------------------------------------------
+# Every rule: embedded positive fires, negative stays quiet
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("rule", ALL_RULES, ids=lambda r: r.RULE_ID)
+def test_rule_positive_fixture_fires(rule):
+    violations = _lint(rule.POSITIVE)
+    assert rule.RULE_ID in _rules_fired(violations), (
+        f"{rule.RULE_ID} did not fire on its seeded-violation fixture:\n"
+        + "\n".join(v.format() for v in violations)
+    )
+
+
+@pytest.mark.parametrize("rule", ALL_RULES, ids=lambda r: r.RULE_ID)
+def test_rule_negative_fixture_quiet(rule):
+    violations = [
+        v for v in _lint(rule.NEGATIVE)
+        if v.rule == rule.RULE_ID and not v.suppressed
+    ]
+    assert violations == [], "\n".join(v.format() for v in violations)
+
+
+# ---------------------------------------------------------------------------
+# Rule-specific edge cases
+# ---------------------------------------------------------------------------
+
+
+def test_cw01_wait_in_for_loop_still_flagged():
+    src = """
+import threading
+cond = threading.Condition()
+def f(tries):
+    with cond:
+        for _ in range(tries):      # a for-retry is not a predicate loop
+            cond.wait(timeout=0.1)
+"""
+    assert "CW01" in _rules_fired(_lint(src))
+
+
+def test_cw01_event_wait_not_flagged():
+    src = """
+import threading
+def f():
+    done = threading.Event()
+    done.wait(timeout=1.0)          # Event.wait needs no predicate loop
+"""
+    assert "CW01" not in _rules_fired(_lint(src))
+
+
+def test_cw01_nested_function_resets_loop_scope():
+    src = """
+import threading
+cond = threading.Condition()
+def outer():
+    while True:
+        def inner():
+            with cond:
+                cond.wait()         # the while belongs to OUTER, not inner
+        inner()
+"""
+    assert "CW01" in _rules_fired(_lint(src))
+
+
+def test_lk01_nested_def_under_lock_not_flagged():
+    src = """
+import threading
+_lock = threading.Lock()
+def f(backend, path):
+    with _lock:
+        def later():
+            return backend.read_all(path)   # runs later, not under the lock
+    return later
+"""
+    assert "LK01" not in _rules_fired(_lint(src))
+
+
+def test_lk01_os_path_exists_not_flagged():
+    src = """
+import os
+import threading
+_lock = threading.Lock()
+def f(p):
+    with _lock:
+        return os.path.exists(p)    # local fs check, not a storage backend
+"""
+    assert "LK01" not in _rules_fired(_lint(src))
+
+
+def test_lk01_condition_counts_as_lock():
+    src = """
+import threading
+class W:
+    def __init__(self, backend):
+        self._cond = threading.Condition()
+        self._backend = backend
+    def f(self, path):
+        with self._cond:
+            return self._backend.open_ranged(path)
+"""
+    assert "LK01" in _rules_fired(_lint(src))
+
+
+def test_cfg01_dispatcher_config_chain_checked():
+    src = """
+def f(self):
+    return self.dispatcher.config.bogus_knob
+"""
+    fired = [v for v in _lint(src) if v.rule == "CFG01"]
+    assert fired and "bogus_knob" in fired[0].message
+
+
+def test_cfg01_methods_and_fields_allowed():
+    src = """
+def f(config):
+    config.log_values()
+    return config.root_dir, config.scheme
+"""
+    assert "CFG01" not in _rules_fired(_lint(src))
+
+
+def test_met01_kind_mismatch_flagged():
+    src = """
+from s3shuffle_tpu.metrics import registry as _m
+_x = _m.REGISTRY.counter("read_prefetch_wait_seconds", "wrong kind")
+"""
+    fired = [v for v in _lint(src) if v.rule == "MET01"]
+    assert fired and "histogram" in fired[0].message
+
+
+def test_met01_non_literal_name_flagged():
+    src = """
+from s3shuffle_tpu.metrics import registry as _m
+def make(name):
+    return _m.REGISTRY.gauge(name)
+"""
+    assert "MET01" in _rules_fired(_lint(src))
+
+
+def test_met01_non_registry_receiver_ignored():
+    src = """
+def f(collection):
+    return collection.counter("anything_goes_here")
+"""
+    assert "MET01" not in _rules_fired(_lint(src))
+
+
+def test_exc01_bare_except_flagged():
+    src = """
+def f(x):
+    try:
+        return x()
+    except:
+        return None
+"""
+    assert "EXC01" in _rules_fired(_lint(src))
+
+
+def test_exc01_stored_exception_is_propagation():
+    src = """
+class Sink:
+    def push(self, fn):
+        try:
+            fn()
+        except Exception as e:
+            self.error = e
+"""
+    assert "EXC01" not in _rules_fired(_lint(src))
+
+
+def test_thr01_daemon_false_without_join_flagged():
+    src = """
+import threading
+def f(work):
+    t = threading.Thread(target=work, daemon=False)
+    t.start()
+    return t
+"""
+    assert "THR01" in _rules_fired(_lint(src))
+
+
+def test_imp01_rebound_import_is_unused():
+    """A Store-context rebinding shadows the import — it is not a use."""
+    src = """
+import json
+
+
+def setup(compute):
+    global json
+    json = compute()
+"""
+    assert "IMP01" in _rules_fired(_lint(src))
+
+
+def test_imp01_init_py_exempt():
+    src = "import json\n"
+    assert "IMP01" not in _rules_fired(
+        lint_source(src, "pkg/__init__.py", model=FIXTURE_MODEL)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Suppression machinery
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_with_reason_downgrades():
+    src = """
+def f(x):
+    try:
+        return x()
+    # shuffle-lint: disable=EXC01 reason=probe API contract returns None on any failure
+    except Exception:
+        return None
+"""
+    violations = _lint(src)
+    assert "EXC01" not in _rules_fired(violations)
+    suppressed = [v for v in violations if v.suppressed]
+    assert len(suppressed) == 1 and suppressed[0].rule == "EXC01"
+    assert "probe API contract" in suppressed[0].reason
+    assert summarize(violations) == {
+        "violations": 0, "suppressed": 1, "per_rule": {},
+    }
+
+
+def test_suppression_without_reason_is_violation():
+    src = """
+def f(x):
+    try:
+        return x()
+    # shuffle-lint: disable=EXC01
+    except Exception:
+        return None
+"""
+    assert "SUP00" in _rules_fired(_lint(src))
+
+
+def test_unused_suppression_is_violation():
+    src = """
+# shuffle-lint: disable=LK01 reason=stale comment from a refactor
+x = 1
+"""
+    fired = [v for v in _lint(src) if v.rule == "SUP00"]
+    assert fired and "unused" in fired[0].message
+
+
+def test_skipped_rule_does_not_orphan_its_suppressions(tmp_path):
+    """skip_rules=["EXC01"] must not turn the tree's legitimate inline EXC01
+    suppressions into SUP00 'unused' failures — with the rule off, its
+    findings can never materialize to mark them used."""
+    src = """
+def f(x):
+    try:
+        return x()
+    # shuffle-lint: disable=EXC01 reason=probe contract returns None
+    except Exception:
+        return None
+"""
+    mod = tmp_path / "skipmod.py"
+    mod.write_text(src)
+    violations = lint_paths(
+        [str(mod)], project_root=REPO_ROOT, skip_rules=["EXC01"]
+    )
+    assert [v for v in violations if not v.suppressed] == [], (
+        "\n".join(v.format() for v in violations)
+    )
+
+
+def test_suppression_in_docstring_is_documentation_not_suppression():
+    src = '''
+"""Docs: use `# shuffle-lint: disable=EXC01 reason=...` to suppress."""
+
+def f(x):
+    try:
+        return x()
+    except Exception:
+        return None
+'''
+    fired = _rules_fired(_lint(src))
+    assert "EXC01" in fired   # the docstring text suppressed nothing
+    assert "SUP00" not in fired  # and was not counted as an unused suppression
+
+
+def test_suppression_only_masks_named_rule():
+    src = """
+def f(x):
+    try:
+        return x()
+    # shuffle-lint: disable=LK01 reason=wrong rule id on purpose
+    except Exception:
+        return None
+"""
+    fired = _rules_fired(_lint(src))
+    assert "EXC01" in fired  # the EXC01 finding is NOT masked
+    assert "SUP00" in fired  # and the LK01 suppression is unused
+
+
+# ---------------------------------------------------------------------------
+# The merged tree is clean (the tier-1 gate) and the CLI contract holds
+# ---------------------------------------------------------------------------
+
+
+def test_tree_is_clean():
+    violations = lint_paths(
+        [PKG, os.path.join(REPO_ROOT, "tools")], project_root=REPO_ROOT
+    )
+    open_v = [v for v in violations if not v.suppressed]
+    assert open_v == [], "\n".join(v.format() for v in open_v)
+    # every suppression in the tree carries a reason (SUP00 enforces it, but
+    # pin it explicitly — the budget must stay auditable)
+    for v in violations:
+        if v.suppressed:
+            assert v.reason, f"suppressed finding without reason: {v.format()}"
+
+
+def test_cli_exits_zero_on_tree():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.shuffle_lint", "s3shuffle_tpu"],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 violation(s)" in proc.stdout
+
+
+@pytest.mark.parametrize("rule", ALL_RULES, ids=lambda r: r.RULE_ID)
+def test_cli_exits_nonzero_on_seeded_violation(tmp_path, rule):
+    bad = tmp_path / f"seeded_{rule.RULE_ID.lower()}.py"
+    bad.write_text(rule.POSITIVE)
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "tools.shuffle_lint",
+            "--format", "json", str(bad),
+        ],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    fired = {v["rule"] for v in doc["violations"] if not v["suppressed"]}
+    assert rule.RULE_ID in fired, f"{rule.RULE_ID} missing from {fired}"
+
+
+def test_cli_selftest():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.shuffle_lint", "--selftest"],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "selftest OK" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# MET01 groundwork: names.py is the single source of truth, both directions
+# ---------------------------------------------------------------------------
+
+
+def _iter_package_sources():
+    from tools.shuffle_lint.core import iter_python_files
+
+    # the LINTER's own discovery — the two halves of the MET01 single-source
+    # check must always scan the same file set
+    for path in iter_python_files([PKG]):
+        with open(path, encoding="utf-8") as f:
+            yield path, f.read()
+
+
+def test_every_declared_metric_is_registered_somewhere():
+    """names.py must not rot into declaring metrics nothing emits (the
+    reverse direction of MET01)."""
+    from s3shuffle_tpu.metrics.names import KNOWN_METRICS
+
+    blob = "\n".join(
+        src for path, src in _iter_package_sources()
+        if not path.endswith(os.path.join("metrics", "names.py"))
+    )
+    unregistered = [
+        name for name in KNOWN_METRICS if f'"{name}"' not in blob
+    ]
+    assert unregistered == [], (
+        f"declared in metrics/names.py but never registered: {unregistered}"
+    )
+
+
+def test_model_parses_real_declarations():
+    model = ProjectModel.load(REPO_ROOT)
+    # knobs that shipped across PRs 1-3 — drift here means CFG01 is blind
+    for knob in ("fetch_chunk_size", "upload_queue_bytes", "storage_retries",
+                 "buffer_size", "root_dir"):
+        assert knob in model.config_fields, knob
+    assert "log_values" in model.config_methods
+    from s3shuffle_tpu.metrics.names import KNOWN_METRICS
+
+    assert model.metric_names == {k: v[0] for k, v in KNOWN_METRICS.items()}
+
+
+def test_trace_report_selftest_covers_all_declared_names():
+    from s3shuffle_tpu.metrics.names import KNOWN_METRICS
+    from tools.trace_report import _synthetic_snapshot
+
+    assert set(_synthetic_snapshot()) == set(KNOWN_METRICS)
+
+
+# ---------------------------------------------------------------------------
+# ruff (general hygiene) — runs when the binary exists, skips otherwise
+# ---------------------------------------------------------------------------
+
+
+def test_ruff_clean_when_available():
+    ruff = shutil.which("ruff")
+    if ruff is None:
+        pytest.skip("ruff not installed in this image; IMP01 covers F401")
+    proc = subprocess.run(
+        [ruff, "check", "s3shuffle_tpu", "tools"],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_pyproject_declares_lint_sections():
+    with open(os.path.join(REPO_ROOT, "pyproject.toml"), encoding="utf-8") as f:
+        doc = f.read()
+    assert "[tool.shuffle_lint]" in doc
+    assert "[tool.ruff]" in doc
+    assert re.search(r'paths\s*=\s*\["s3shuffle_tpu", "tools"\]', doc)

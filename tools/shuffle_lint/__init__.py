@@ -1,0 +1,37 @@
+"""shuffle-lint: project-invariant static analyzer for s3shuffle_tpu.
+
+Usage:
+    python -m tools.shuffle_lint [--format json] [paths...]
+    python -m tools.shuffle_lint --selftest
+
+Rules (see README "Static analysis" for the full table):
+
+- **CW01** ``Condition.wait()`` must sit in a ``while`` predicate loop
+- **LK01** no storage-backend I/O while holding a threading lock
+- **CFG01** config-knob references must be declared in ``config.py``
+- **MET01** metric names must be declared in ``metrics/names.py``
+- **EXC01** no silently swallowed broad exceptions
+- **THR01** Thread/ThreadPoolExecutor daemon/join/shutdown discipline
+- **IMP01** no unused imports (pyflakes-F401 subset)
+
+Suppression: ``# shuffle-lint: disable=RULE reason=...`` on (or directly
+above) the flagged line. Reasons are mandatory; unused suppressions and
+missing reasons are violations themselves (SUP00), so the suppression budget
+cannot silently rot.
+"""
+
+from tools.shuffle_lint.core import (
+    ProjectModel,
+    Violation,
+    lint_paths,
+    lint_source,
+    summarize,
+)
+
+__all__ = [
+    "ProjectModel",
+    "Violation",
+    "lint_paths",
+    "lint_source",
+    "summarize",
+]

@@ -1,0 +1,140 @@
+"""CLI for shuffle-lint.
+
+    python -m tools.shuffle_lint                      # lint [tool.shuffle_lint] paths
+    python -m tools.shuffle_lint s3shuffle_tpu        # lint explicit paths
+    python -m tools.shuffle_lint --format json ...    # machine-readable output
+    python -m tools.shuffle_lint --selftest           # rule fixtures smoke check
+
+Exit codes: 0 = clean (suppressed findings allowed), 1 = unsuppressed
+violations, 2 = usage / internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from tools.shuffle_lint.core import (
+    ProjectModel,
+    Violation,
+    find_project_root,
+    lint_paths,
+    lint_source,
+    load_tool_config,
+    summarize,
+)
+
+DEFAULT_PATHS = ["s3shuffle_tpu"]
+
+
+def _selftest() -> int:
+    """Every rule must fire on its POSITIVE fixture and stay quiet on its
+    NEGATIVE one — the same contract tests/test_shuffle_lint.py pins per
+    rule, compressed into one CLI smoke target."""
+    from tools.shuffle_lint.rules import ALL_RULES
+
+    model = ProjectModel(
+        config_fields={"buffer_size", "root_dir"},
+        config_methods={"log_values", "from_dict", "from_env", "scheme"},
+        metric_names={"read_prefetch_wait_seconds": "histogram"},
+    )
+    failures: List[str] = []
+    for rule in ALL_RULES:
+        rid = rule.RULE_ID
+        pos = [
+            v for v in lint_source(rule.POSITIVE, f"<{rid}:positive>", model=model)
+            if v.rule == rid and not v.suppressed
+        ]
+        if not pos:
+            failures.append(f"{rid}: POSITIVE fixture produced no {rid} violation")
+        neg = [
+            v for v in lint_source(rule.NEGATIVE, f"<{rid}:negative>", model=model)
+            if v.rule == rid and not v.suppressed
+        ]
+        if neg:
+            failures.append(
+                f"{rid}: NEGATIVE fixture produced {rid} violations: "
+                + "; ".join(v.format() for v in neg)
+            )
+    if failures:
+        print("shuffle_lint selftest FAILED", file=sys.stderr)
+        for f in failures:
+            print("  " + f, file=sys.stderr)
+        return 1
+    print(f"shuffle_lint selftest OK ({len(ALL_RULES)} rules)")
+    return 0
+
+
+def _render_text(violations: List[Violation]) -> str:
+    lines = [v.format() for v in violations if not v.suppressed]
+    suppressed = [v for v in violations if v.suppressed]
+    summary = summarize(violations)
+    if suppressed:
+        lines.append(
+            f"suppression budget: {len(suppressed)} finding(s) disabled inline:"
+        )
+        for v in suppressed:
+            lines.append(f"  {v.path}:{v.line}: {v.rule} — reason: {v.reason}")
+    lines.append(
+        f"shuffle-lint: {summary['violations']} violation(s), "
+        f"{summary['suppressed']} suppressed"
+    )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.shuffle_lint",
+        description=__doc__.splitlines()[1].strip() if __doc__ else "",
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories to lint (default: [tool.shuffle_lint] "
+                         "paths from pyproject.toml, else s3shuffle_tpu)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--selftest", action="store_true",
+                    help="verify every rule against its embedded fixtures")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _selftest()
+    import os
+
+    root = find_project_root(args.paths[0] if args.paths else ".")
+    if args.paths:
+        paths = args.paths
+    else:
+        # config-sourced paths are relative to the project root, not cwd —
+        # a CI step run from a subdirectory must not silently lint nothing
+        paths = [
+            p if os.path.isabs(p) else os.path.join(root, p)
+            for p in load_tool_config(root).get("paths", DEFAULT_PATHS)
+        ]
+    from tools.shuffle_lint.core import iter_python_files
+
+    files = list(iter_python_files(paths))
+    if not files:
+        print(
+            f"shuffle-lint: no Python files found under {paths!r} — "
+            "wrong directory or a path typo would make this gate vacuous",
+            file=sys.stderr,
+        )
+        return 2
+    violations = lint_paths(files, project_root=root)
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "violations": [v.to_dict() for v in violations],
+                    "summary": summarize(violations),
+                },
+                indent=2,
+            )
+        )
+    else:
+        print(_render_text(violations))
+    return 1 if any(not v.suppressed for v in violations) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

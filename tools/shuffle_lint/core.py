@@ -1,0 +1,376 @@
+"""shuffle-lint engine: project model, suppression parsing, file runner.
+
+The rules themselves live one-per-module under :mod:`tools.shuffle_lint.rules`
+(see that package's ``__init__`` for the registry). This module owns
+everything rule-agnostic:
+
+- :class:`Violation` — one finding (rule id, location, message) plus its
+  suppression state;
+- :class:`ProjectModel` — the project invariants rules check against
+  (declared config knobs parsed from ``s3shuffle_tpu/config.py``, known
+  metric names parsed from ``s3shuffle_tpu/metrics/names.py``), loaded by
+  **AST parsing only** — the linter never imports the code under analysis;
+- suppression comments: ``# shuffle-lint: disable=RULE[,RULE2] reason=...``
+  on the flagged line (or the line directly above it) downgrades matching
+  violations to *suppressed* — still collected, reported in the budget, but
+  not counted toward the exit code. A ``reason=`` is REQUIRED: a suppression
+  without one is itself a violation (rule ``SUP00``);
+- ``[tool.shuffle_lint]`` configuration from ``pyproject.toml`` (paths to
+  scan, rules to skip) via ``tomli``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+#: method names that reach the object store — the LK01 "storage I/O" set.
+#: ``close`` is deliberately absent: closing a stale handle under the swap
+#: lock is the read plane's documented descriptor-recycling policy.
+STORAGE_OPS = frozenset(
+    {
+        "create",
+        "open_ranged",
+        "read_fully",
+        "status",
+        "list_prefix",
+        "delete",
+        "delete_prefix",
+        "rename",
+        "read_all",
+        "exists",
+        # dispatcher-level wrappers (one hop above the backend, same I/O)
+        "open_block",
+        "create_block",
+        "remove_shuffle",
+        "remove_root",
+    }
+)
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*shuffle-lint:\s*disable=(?P<rules>[A-Z0-9,\s]+?)"
+    r"(?:\s+reason=(?P<reason>.*?))?\s*$"
+)
+
+
+@dataclass
+class Violation:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+    reason: str = ""
+
+    def format(self) -> str:
+        tag = " [suppressed]" if self.suppressed else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}{tag}"
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "suppressed": self.suppressed,
+            "reason": self.reason,
+        }
+
+
+@dataclass
+class Suppression:
+    line: int
+    rules: Tuple[str, ...]
+    reason: str
+    used: bool = False
+
+
+@dataclass
+class ProjectModel:
+    """What the project declares — the invariants rules compare code against."""
+
+    config_fields: Set[str] = field(default_factory=set)
+    config_methods: Set[str] = field(default_factory=set)
+    metric_names: Dict[str, str] = field(default_factory=dict)  # name -> kind
+
+    @property
+    def config_attrs(self) -> Set[str]:
+        return self.config_fields | self.config_methods
+
+    @classmethod
+    def load(cls, project_root: str) -> "ProjectModel":
+        model = cls()
+        config_py = os.path.join(project_root, "s3shuffle_tpu", "config.py")
+        names_py = os.path.join(project_root, "s3shuffle_tpu", "metrics", "names.py")
+        if os.path.exists(config_py):
+            model._load_config_fields(config_py)
+        if os.path.exists(names_py):
+            model._load_metric_names(names_py)
+        return model
+
+    def _load_config_fields(self, path: str) -> None:
+        tree = ast.parse(_read(path), filename=path)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef) and node.name == "ShuffleConfig":
+                for stmt in node.body:
+                    if isinstance(stmt, ast.AnnAssign) and isinstance(
+                        stmt.target, ast.Name
+                    ):
+                        self.config_fields.add(stmt.target.id)
+                    elif isinstance(
+                        stmt, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        self.config_methods.add(stmt.name)
+
+    def _load_metric_names(self, path: str) -> None:
+        tree = ast.parse(_read(path), filename=path)
+        for node in ast.walk(tree):
+            targets: List[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets = [node.target]
+            else:
+                continue
+            for target in targets:
+                if isinstance(target, ast.Name) and target.id == "KNOWN_METRICS":
+                    table = ast.literal_eval(node.value)
+                    self.metric_names = {
+                        name: spec[0] for name, spec in table.items()
+                    }
+                    return
+
+
+@dataclass
+class FileContext:
+    """Everything a rule gets about one file."""
+
+    path: str
+    source: str
+    tree: ast.Module
+    model: ProjectModel
+
+    def __post_init__(self) -> None:
+        # parent links let rules walk ancestors (loop/function enclosures)
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._sl_parent = node  # type: ignore[attr-defined]
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        cur = getattr(node, "_sl_parent", None)
+        while cur is not None:
+            yield cur
+            cur = getattr(cur, "_sl_parent", None)
+
+
+def _read(path: str) -> str:
+    with open(path, "r", encoding="utf-8") as f:
+        return f.read()
+
+
+# ---------------------------------------------------------------------------
+# Suppressions
+# ---------------------------------------------------------------------------
+
+
+def parse_suppressions(source: str) -> List[Suppression]:
+    """Real COMMENT tokens only — a ``# shuffle-lint: disable=...`` example
+    quoted inside a docstring is documentation, not a suppression."""
+    import io
+    import tokenize
+
+    out: List[Suppression] = []
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return out
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if m is None:
+            continue
+        rules = tuple(
+            r.strip() for r in m.group("rules").split(",") if r.strip()
+        )
+        out.append(
+            Suppression(tok.start[0], rules, (m.group("reason") or "").strip())
+        )
+    return out
+
+
+def apply_suppressions(
+    violations: List[Violation],
+    suppressions: List[Suppression],
+    path: str,
+    skipped_rules: Iterable[str] = (),
+) -> List[Violation]:
+    """Mark violations covered by a same-line or line-above suppression; emit
+    SUP00 for suppressions that lack a reason or never matched anything. A
+    suppression naming a rule in ``skipped_rules`` counts as used — with the
+    rule disabled globally its finding can never materialize, and failing the
+    tree's legitimate inline suppressions for it would punish the config."""
+    by_line: Dict[int, List[Suppression]] = {}
+    skipped = set(skipped_rules)
+    for sup in suppressions:
+        by_line.setdefault(sup.line, []).append(sup)
+        if skipped.intersection(sup.rules):
+            sup.used = True
+    for v in violations:
+        for line in (v.line, v.line - 1):
+            for sup in by_line.get(line, []):
+                if v.rule in sup.rules:
+                    v.suppressed = True
+                    v.reason = sup.reason
+                    sup.used = True
+                    break
+            if v.suppressed:
+                break
+    for sup in suppressions:
+        if not sup.reason:
+            violations.append(
+                Violation(
+                    "SUP00", path, sup.line, 0,
+                    "suppression without a reason= (every disable must say why)",
+                )
+            )
+        elif not sup.used:
+            violations.append(
+                Violation(
+                    "SUP00", path, sup.line, 0,
+                    f"unused suppression for {','.join(sup.rules)} "
+                    "(nothing on this line violates it — remove the comment)",
+                )
+            )
+    return violations
+
+
+# ---------------------------------------------------------------------------
+# Running
+# ---------------------------------------------------------------------------
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        else:
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+
+def find_project_root(start: str) -> str:
+    """Walk up from ``start`` to the directory holding ``pyproject.toml``."""
+    cur = os.path.abspath(start if os.path.isdir(start) else os.path.dirname(start) or ".")
+    while True:
+        if os.path.exists(os.path.join(cur, "pyproject.toml")):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return os.getcwd()
+        cur = parent
+
+
+def load_tool_config(project_root: str) -> dict:
+    """``[tool.shuffle_lint]`` from pyproject.toml (missing file/section or
+    missing toml parser → defaults)."""
+    path = os.path.join(project_root, "pyproject.toml")
+    if not os.path.exists(path):
+        return {}
+    try:
+        try:
+            import tomllib  # Python >= 3.11
+        except ImportError:
+            import tomli as tomllib  # type: ignore[no-redef]
+    except ImportError:
+        # no parser: the config is silently ignored ONLY with a diagnostic —
+        # a quietly-shrunk lint scope is how gates go vacuous
+        import sys
+
+        print(
+            f"shuffle-lint: warning: {path} exists but no toml parser is "
+            "available (need Python >= 3.11 or the tomli package); "
+            "[tool.shuffle_lint] settings are being IGNORED",
+            file=sys.stderr,
+        )
+        return {}
+    with open(path, "rb") as f:
+        doc = tomllib.load(f)
+    return doc.get("tool", {}).get("shuffle_lint", {})
+
+
+def lint_source(
+    source: str,
+    path: str = "<string>",
+    model: Optional[ProjectModel] = None,
+    rules: Optional[Sequence] = None,
+    skipped_rules: Iterable[str] = (),
+) -> List[Violation]:
+    """Lint one source string (unit tests and fixtures drive this)."""
+    from tools.shuffle_lint.rules import ALL_RULES
+
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [
+            Violation("SYN00", path, e.lineno or 0, e.offset or 0,
+                      f"syntax error: {e.msg}")
+        ]
+    ctx = FileContext(path, source, tree, model or ProjectModel())
+    violations: List[Violation] = []
+    for rule in rules if rules is not None else ALL_RULES:
+        violations.extend(rule.check(ctx))
+    violations = apply_suppressions(
+        violations, parse_suppressions(source), path, skipped_rules
+    )
+    violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return violations
+
+
+def lint_paths(
+    paths: Sequence[str],
+    project_root: Optional[str] = None,
+    rules: Optional[Sequence] = None,
+    skip_rules: Sequence[str] = (),
+) -> List[Violation]:
+    root = project_root or find_project_root(paths[0] if paths else ".")
+    tool_conf = load_tool_config(root)
+    skip = set(skip_rules) | set(tool_conf.get("skip_rules", []))
+    model = ProjectModel.load(root)
+    from tools.shuffle_lint.rules import ALL_RULES
+
+    active = [
+        r for r in (rules if rules is not None else ALL_RULES)
+        if r.RULE_ID not in skip
+    ]
+    out: List[Violation] = []
+    for file_path in iter_python_files(paths):
+        out.extend(
+            lint_source(
+                _read(file_path), file_path, model=model, rules=active,
+                skipped_rules=skip,
+            )
+        )
+    return out
+
+
+def summarize(violations: List[Violation]) -> dict:
+    open_v = [v for v in violations if not v.suppressed]
+    sup_v = [v for v in violations if v.suppressed]
+    per_rule: Dict[str, int] = {}
+    for v in open_v:
+        per_rule[v.rule] = per_rule.get(v.rule, 0) + 1
+    return {
+        "violations": len(open_v),
+        "suppressed": len(sup_v),
+        "per_rule": dict(sorted(per_rule.items())),
+    }

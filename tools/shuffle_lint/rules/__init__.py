@@ -1,0 +1,22 @@
+"""Rule registry — one module per rule, imported in rule-id order.
+
+A rule module exports ``RULE_ID``, ``DESCRIPTION``, ``check(ctx)``, and a
+``POSITIVE``/``NEGATIVE`` fixture pair (the seeded-violation source the
+selftest and unit tests drive). To add a rule: create the module, add it to
+``ALL_RULES``, document it in the README rule table.
+"""
+
+from tools.shuffle_lint.rules import (  # noqa: F401  (registry import)
+    cfg01,
+    cw01,
+    exc01,
+    imp01,
+    lk01,
+    met01,
+    thr01,
+)
+
+#: every active rule, in rule-id order
+ALL_RULES = (cfg01, cw01, exc01, imp01, lk01, met01, thr01)
+
+__all__ = ["ALL_RULES"]

@@ -1,0 +1,103 @@
+"""CFG01 — every config-knob reference must be declared in ``config.py``.
+
+The invariant: ``ShuffleConfig`` is the single registry of knobs (every value
+logged at startup, env/reference-key coercion, README table). Nine-plus knobs
+were added across PRs 1–3; a typo'd or undeclared attribute read
+(``config.fetch_chunksize``) raises ``AttributeError`` only on the code path
+that uses it — or worse, a ``getattr(config, "...", default)`` silently
+ignores the operator's setting forever.
+
+Detection: attribute reads (and string-literal ``getattr``) on config-shaped
+receivers — a bare ``config`` / ``cfg`` name, names ending ``_config`` /
+``_cfg``, or any ``<x>.config`` / ``<x>._config`` chain — are checked against
+the fields and methods parsed from ``s3shuffle_tpu/config.py``'s AST. The
+rule is inert when the project model is absent (fixture runs inject one).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from tools.shuffle_lint.core import FileContext, Violation
+
+RULE_ID = "CFG01"
+DESCRIPTION = "config-knob reference not declared in s3shuffle_tpu/config.py"
+
+#: fixture model: the only declared knobs are buffer_size / root_dir
+POSITIVE = '''
+def writer_size(config):
+    return config.bufer_size          # BUG: typo'd knob, AttributeError at runtime
+
+
+def reader_root(cfg):
+    return getattr(cfg, "root_dirr", "file:///tmp")   # silently wrong default
+'''
+
+NEGATIVE = '''
+def writer_size(config):
+    return config.buffer_size
+
+
+def reader_root(cfg):
+    return getattr(cfg, "root_dir", "file:///tmp")
+
+
+def unrelated(response):
+    return response.status_code       # not a config-shaped receiver
+'''
+
+_BARE_NAMES = {"config", "cfg"}
+_ATTR_NAMES = {"config", "_config"}
+#: module objects that carry their OWN ``.config`` namespace (``jax.config
+#: .update(...)``) — not ShuffleConfig instances
+_FOREIGN_BASES = {"jax", "np", "numpy", "tf", "torch", "matplotlib"}
+
+
+def _is_config_receiver(expr: ast.expr) -> bool:
+    if isinstance(expr, ast.Name):
+        return (
+            expr.id in _BARE_NAMES
+            or expr.id.endswith("_config")
+            or expr.id.endswith("_cfg")
+        )
+    if isinstance(expr, ast.Attribute):
+        if isinstance(expr.value, ast.Name) and expr.value.id in _FOREIGN_BASES:
+            return False
+        return expr.attr in _ATTR_NAMES
+    return False
+
+
+def check(ctx: FileContext) -> List[Violation]:
+    allowed = ctx.model.config_attrs
+    if not allowed:  # no project model (bare fixture run): rule is inert
+        return []
+    if ctx.path.replace("\\", "/").endswith("s3shuffle_tpu/config.py"):
+        return []  # the declaration site itself
+    out: List[Violation] = []
+    for node in ast.walk(ctx.tree):
+        attr: Optional[str] = None
+        if isinstance(node, ast.Attribute) and _is_config_receiver(node.value):
+            attr = node.attr
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "getattr"
+            and len(node.args) >= 2
+            and _is_config_receiver(node.args[0])
+            and isinstance(node.args[1], ast.Constant)
+            and isinstance(node.args[1].value, str)
+        ):
+            attr = node.args[1].value
+        if attr is None or attr.startswith("__"):
+            continue
+        if attr not in allowed:
+            out.append(
+                Violation(
+                    RULE_ID, ctx.path, node.lineno, node.col_offset,
+                    f"config knob {attr!r} is not declared in "
+                    "s3shuffle_tpu/config.py (knob drift — declare the field "
+                    "with a default + comment, or fix the name)",
+                )
+            )
+    return out

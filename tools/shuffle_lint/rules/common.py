@@ -1,0 +1,101 @@
+"""Shared AST helpers for the rule modules."""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, Optional, Set, Tuple
+
+#: terminal names that look like synchronization primitives even without a
+#: visible ``threading.X()`` assignment (conservative fallback).
+LOCKISH_NAME_RE = re.compile(r"(^|_)(lock|locks|cond|condition|mutex)($|_)|_lock_for$")
+
+CONDITIONISH_NAME_RE = re.compile(r"(^|_)(cond|condition)($|_)")
+
+_SYNC_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+_COND_CTORS = {"Condition"}
+
+
+def terminal_name(node: ast.expr) -> Optional[str]:
+    """The rightmost identifier of a Name/Attribute/Call chain:
+    ``self._lock`` -> ``_lock``; ``threading.Condition`` -> ``Condition``;
+    ``self._lock_for(k)`` -> ``_lock_for``."""
+    if isinstance(node, ast.Call):
+        return terminal_name(node.func)
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _ctor_name(value: ast.expr) -> Optional[str]:
+    if isinstance(value, ast.Call):
+        return terminal_name(value.func)
+    return None
+
+
+def collect_sync_assignments(tree: ast.Module) -> Tuple[Set[str], Set[str]]:
+    """Names (vars and attributes alike, by terminal identifier) assigned a
+    ``threading.{Lock,RLock,Condition,Semaphore,BoundedSemaphore}()`` value
+    anywhere in the module: ``(all_sync_names, condition_names)``."""
+    sync: Set[str] = set()
+    conds: Set[str] = set()
+    for node in ast.walk(tree):
+        targets: Iterable[ast.expr]
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        ctor = _ctor_name(value)
+        if ctor not in _SYNC_CTORS:
+            continue
+        for target in targets:
+            name = terminal_name(target)
+            if name is None:
+                continue
+            sync.add(name)
+            if ctor in _COND_CTORS:
+                conds.add(name)
+    return sync, conds
+
+
+def is_lockish(expr: ast.expr, sync_names: Set[str]) -> bool:
+    """Does a ``with <expr>:`` item look like it acquires a lock?"""
+    name = terminal_name(expr)
+    if name is None:
+        return False
+    return name in sync_names or bool(LOCKISH_NAME_RE.search(name))
+
+
+def walk_same_scope(stmts: Iterable[ast.stmt]) -> Iterable[ast.AST]:
+    """Walk statements without descending into nested function/class bodies
+    (code in a nested def runs later, not under the enclosing lock)."""
+    stack = list(stmts)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node,
+            (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda),
+        ):
+            continue  # nested scope: its body runs later, not here
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def call_attr(node: ast.AST) -> Optional[str]:
+    """``x.y(...)`` -> ``y``; None for anything else."""
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    return None
+
+
+def names_used(nodes: Iterable[ast.AST]) -> Set[str]:
+    out: Set[str] = set()
+    for node in nodes:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name):
+                out.add(sub.id)
+    return out

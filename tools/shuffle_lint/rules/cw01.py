@@ -1,0 +1,103 @@
+"""CW01 — ``Condition.wait()`` must sit inside a ``while`` predicate loop.
+
+The invariant: a condition wait can wake spuriously or on a notify meant for
+a different waiter, so the ONLY safe shape is
+
+    with cond:
+        while not predicate():
+            cond.wait(timeout=...)
+
+An ``if``-guarded or bare wait is the missed-notify bug class PR 3 patched by
+hand in the prefetch plane (the budget/consumer backstop warnings exist
+because exactly this kept happening). ``wait_for`` is exempt — it loops
+internally.
+
+Detection: receivers assigned ``threading.Condition()`` anywhere in the
+module (variables and ``self.<attr>`` alike, matched by terminal name), plus
+any receiver whose name says condition (``cond`` / ``condition``). A
+``.wait(...)`` call on such a receiver must have an enclosing ``while`` loop
+*within the same function*.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.shuffle_lint.core import FileContext, Violation
+from tools.shuffle_lint.rules.common import (
+    CONDITIONISH_NAME_RE,
+    collect_sync_assignments,
+    terminal_name,
+)
+
+RULE_ID = "CW01"
+DESCRIPTION = "Condition.wait() not guarded by a while-predicate loop"
+
+POSITIVE = '''
+import threading
+
+class Worker:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._ready = False
+
+    def consume(self):
+        with self._cond:
+            if not self._ready:      # BUG: single-shot guard, missed-notify
+                self._cond.wait(timeout=1.0)
+            return self._ready
+'''
+
+NEGATIVE = '''
+import threading
+
+class Worker:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._ready = False
+
+    def consume(self):
+        with self._cond:
+            while not self._ready:   # predicate re-checked on every wake
+                self._cond.wait(timeout=1.0)
+            return self._ready
+
+    def consume_wait_for(self):
+        with self._cond:
+            self._cond.wait_for(lambda: self._ready)  # loops internally
+'''
+
+
+def check(ctx: FileContext) -> List[Violation]:
+    _sync, cond_names = collect_sync_assignments(ctx.tree)
+    out: List[Violation] = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+            continue
+        if node.func.attr != "wait":
+            continue
+        receiver = terminal_name(node.func.value)
+        if receiver is None:
+            continue
+        if receiver not in cond_names and not CONDITIONISH_NAME_RE.search(receiver):
+            continue
+        if not _inside_while(ctx, node):
+            out.append(
+                Violation(
+                    RULE_ID, ctx.path, node.lineno, node.col_offset,
+                    f"{receiver}.wait() outside a while-predicate loop "
+                    "(spurious wakeups / missed notifies re-check nothing; "
+                    "wrap in `while not <predicate>:` or use wait_for)",
+                )
+            )
+    return out
+
+
+def _inside_while(ctx: FileContext, node: ast.AST) -> bool:
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, ast.While):
+            return True
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return False
+    return False

@@ -1,0 +1,112 @@
+"""EXC01 — no silently swallowed broad exceptions.
+
+The invariant: the resilient storage plane classifies every failure
+(``retrying.is_retriable``) into transient-heal vs terminal-surface. A
+``except Exception: pass`` (or bare ``except:``) upstream of that machinery
+eats BOTH classes — a terminal auth error looks exactly like success, and a
+transient error never reaches the retry layer's backoff/metrics. Narrow
+handlers (``except OSError: pass`` around a best-effort delete) stay legal:
+they document exactly which failure is acceptable.
+
+Detection: a handler catching ``Exception`` / ``BaseException`` / bare
+``except:`` is a violation unless its body does at least one of: re-raise,
+call a logger (``debug``/``info``/``warning``/``error``/``exception``/
+``critical``/``log``/``print_exc``), or *use the bound exception* (``except
+Exception as e`` where ``e`` is referenced — storing it for a consumer to
+re-raise, as the prefetch loop does, is propagation, not swallowing).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.shuffle_lint.core import FileContext, Violation
+from tools.shuffle_lint.rules.common import call_attr
+
+RULE_ID = "EXC01"
+DESCRIPTION = "broad exception handler swallows the failure"
+
+POSITIVE = '''
+def cleanup(backend, path):
+    try:
+        backend.delete(path)
+    except Exception:      # BUG: auth failure and transient reset look identical
+        pass
+'''
+
+NEGATIVE = '''
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def cleanup(backend, path):
+    try:
+        backend.delete(path)
+    except FileNotFoundError:      # narrow: documents the acceptable failure
+        pass
+    except Exception:
+        logger.warning("cleanup of %s failed", path, exc_info=True)
+
+
+def propagate(source, sink):
+    try:
+        sink.push(next(source))
+    except Exception as e:
+        sink.error = e             # bound exc stored for the consumer: not a swallow
+'''
+
+_BROAD = {"Exception", "BaseException"}
+_HANDLING_CALLS = {
+    "debug", "info", "warning", "error", "exception", "critical", "log",
+    "print_exc",
+}
+
+
+def _is_broad(type_node) -> bool:
+    if type_node is None:  # bare except:
+        return True
+    if isinstance(type_node, ast.Name):
+        return type_node.id in _BROAD
+    if isinstance(type_node, ast.Attribute):
+        return type_node.attr in _BROAD
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad(el) for el in type_node.elts)
+    return False
+
+
+def check(ctx: FileContext) -> List[Violation]:
+    out: List[Violation] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_broad(node.type):
+            continue
+        handled = False
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Raise):
+                handled = True
+                break
+            if call_attr(sub) in _HANDLING_CALLS:
+                handled = True
+                break
+            if (
+                node.name is not None
+                and isinstance(sub, ast.Name)
+                and sub.id == node.name
+            ):
+                handled = True
+                break
+        if not handled:
+            caught = "bare except" if node.type is None else ast.unparse(node.type)
+            out.append(
+                Violation(
+                    RULE_ID, ctx.path, node.lineno, node.col_offset,
+                    f"broad handler ({caught}) swallows the failure without "
+                    "re-raise/log/propagation — terminal errors (auth, "
+                    "checksum) become silent no-ops and transients never "
+                    "reach retrying.is_retriable",
+                )
+            )
+    return out

@@ -1,0 +1,90 @@
+"""IMP01 — unused imports (the pyflakes-F401 subset, in-repo).
+
+The container has no ruff/pyflakes binary, so the tree-hygiene slice of that
+toolchain this project actually depends on lives here: an import that binds a
+name no code uses is dead weight that rots into real confusion (readers hunt
+for the usage, reviewers assume a dependency exists). ``__init__.py`` files
+are exempt — re-exporting is their job — as are ``from __future__`` imports
+and explicit re-exports listed in ``__all__``.
+
+When ruff IS available (``[tool.ruff]`` in pyproject.toml configures it),
+its F401 supersedes this rule; both agreeing is fine — the suppression
+syntax differs and this one is wired into tier-1 unconditionally.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.shuffle_lint.core import FileContext, Violation
+
+RULE_ID = "IMP01"
+DESCRIPTION = "imported name is never used"
+
+POSITIVE = '''
+import io
+import json          # BUG: never referenced
+from typing import Optional, List   # BUG: List never referenced
+
+
+def load(stream: io.RawIOBase) -> Optional[bytes]:
+    return stream.read()
+'''
+
+NEGATIVE = '''
+import io
+import json
+from typing import Optional
+
+__all__ = ["load", "json"]          # json re-exported explicitly
+
+
+def load(stream: io.RawIOBase) -> Optional[bytes]:
+    return stream.read()
+'''
+
+
+def check(ctx: FileContext) -> List[Violation]:
+    norm = ctx.path.replace("\\", "/")
+    if norm.endswith("__init__.py"):
+        return []
+    bound: List[tuple] = []  # (name, node)
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                bound.append((alias.asname or alias.name.split(".")[0], node))
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                bound.append((alias.asname or alias.name, node))
+    if not bound:
+        return []
+    used = set()
+    exported = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            # Load only: a Store-context rebinding (`json = compute()`)
+            # SHADOWS the import rather than using it (pyflakes semantics)
+            used.add(node.id)
+        elif isinstance(node, ast.Assign):
+            for target in node.targets:
+                if isinstance(target, ast.Name) and target.id == "__all__":
+                    try:
+                        exported.update(ast.literal_eval(node.value))
+                    except (ValueError, SyntaxError):
+                        pass
+    out: List[Violation] = []
+    for name, node in bound:
+        if name in used or name in exported:
+            continue
+        out.append(
+            Violation(
+                RULE_ID, ctx.path, node.lineno, node.col_offset,
+                f"{name!r} is imported but never used",
+            )
+        )
+    return out

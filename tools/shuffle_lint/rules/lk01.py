@@ -1,0 +1,114 @@
+"""LK01 — no storage I/O while holding a lock.
+
+The invariant: object-store calls (``read_fully`` / ``create`` /
+``open_ranged`` / ``delete`` / ``list_prefix`` ...) take network-scale time
+— tens of milliseconds to the retry layer's full deadline. Issuing one while
+holding a ``threading.Lock``/``Condition`` turns every sibling that touches
+the same lock into a convoy behind the store's latency (and, under the retry
+plane, behind its backoff sleeps too). The prefetch plane's whole design —
+pull source items and run prefills OUTSIDE the main condition lock — exists
+to uphold this.
+
+Detection is lexical: a call whose method name is in
+:data:`~tools.shuffle_lint.core.STORAGE_OPS`, written inside the body of a
+``with <lock>:`` where the lock expression either was assigned a
+``threading.*`` primitive in this module or has a lock-shaped name. Nested
+``def``/``lambda`` bodies are skipped (they run later, not under the lock).
+Intentional cases (e.g. ``BlockStream.read``'s cursor-serialization) carry an
+inline suppression with a reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.shuffle_lint.core import STORAGE_OPS, FileContext, Violation
+from tools.shuffle_lint.rules.common import (
+    collect_sync_assignments,
+    is_lockish,
+    terminal_name,
+    walk_same_scope,
+)
+
+RULE_ID = "LK01"
+DESCRIPTION = "storage-backend call while holding a threading lock"
+
+#: receivers that are local-filesystem/stdlib namespaces, not storage
+#: backends — ``os.path.exists`` under a build lock is not a ranged GET.
+_LOCAL_FS_RECEIVERS = frozenset({"os", "path", "shutil", "tempfile", "Path"})
+
+POSITIVE = '''
+import threading
+
+class Cache:
+    def __init__(self, backend):
+        self._lock = threading.Lock()
+        self._backend = backend
+        self._cached = None
+
+    def load(self, path):
+        with self._lock:
+            if self._cached is None:
+                # BUG: a ranged GET under the cache lock convoys every reader
+                self._cached = self._backend.read_all(path)
+            return self._cached
+'''
+
+NEGATIVE = '''
+import threading
+
+class Cache:
+    def __init__(self, backend):
+        self._lock = threading.Lock()
+        self._backend = backend
+        self._cached = None
+
+    def load(self, path):
+        with self._lock:
+            cached = self._cached
+        if cached is not None:
+            return cached
+        data = self._backend.read_all(path)   # I/O outside the lock
+        with self._lock:
+            if self._cached is None:
+                self._cached = data
+            return self._cached
+'''
+
+
+def check(ctx: FileContext) -> List[Violation]:
+    sync_names, _conds = collect_sync_assignments(ctx.tree)
+    out: List[Violation] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        lock_expr = next(
+            (
+                item.context_expr
+                for item in node.items
+                if is_lockish(item.context_expr, sync_names)
+            ),
+            None,
+        )
+        if lock_expr is None:
+            continue
+        lock_name = terminal_name(lock_expr) or "<lock>"
+        for sub in walk_same_scope(node.body):
+            if not (isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute)):
+                continue
+            op = sub.func.attr
+            if op not in STORAGE_OPS:
+                continue
+            receiver = terminal_name(sub.func.value) or "?"
+            if receiver in _LOCAL_FS_RECEIVERS:
+                continue
+            out.append(
+                Violation(
+                    RULE_ID, ctx.path, sub.lineno, sub.col_offset,
+                    f"storage op {receiver}.{op}(...) under `with {lock_name}:` "
+                    "(store-latency I/O convoys every sibling on this lock; "
+                    "move the call outside and swap results in under the lock)",
+                )
+            )
+    return out

@@ -1,0 +1,99 @@
+"""MET01 — metric registrations must use names declared in ``metrics/names.py``.
+
+The invariant: :mod:`s3shuffle_tpu.metrics.names` is the single source of
+truth for every metric the package emits — ``tools/trace_report.py
+--selftest`` derives its rendering coverage from it, the README documents
+from it, and dashboards key on it. An instrument registered under an
+undeclared name ships a metric nobody's selftest or docs know about (each of
+PRs 1–3 extended the old hand-maintained list manually and could silently
+miss one); a declared-vs-registered *kind* mismatch breaks renderers that
+dispatch on kind.
+
+Detection: ``*REGISTRY.counter/gauge/histogram("name", ...)`` call sites —
+the first argument must be a string literal, present in ``KNOWN_METRICS``,
+with a matching kind. The rule is inert when the project model has no metric
+table (fixture runs inject one); the registry/names modules themselves are
+skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.shuffle_lint.core import FileContext, Violation
+from tools.shuffle_lint.rules.common import terminal_name
+
+RULE_ID = "MET01"
+DESCRIPTION = "metric name not declared in s3shuffle_tpu/metrics/names.py"
+
+#: fixture model: the only declared metric is read_prefetch_wait_seconds
+POSITIVE = '''
+from s3shuffle_tpu.metrics import registry as _metrics
+
+_H = _metrics.REGISTRY.histogram(
+    "read_prefetch_wiat_seconds",   # BUG: typo'd name, invisible to selftest
+    "Consumer wait for the next prefetched block",
+)
+'''
+
+NEGATIVE = '''
+from s3shuffle_tpu.metrics import registry as _metrics
+
+_H = _metrics.REGISTRY.histogram(
+    "read_prefetch_wait_seconds",
+    "Consumer wait for the next prefetched block",
+)
+'''
+
+_KINDS = {"counter", "gauge", "histogram"}
+_SKIP_SUFFIXES = ("metrics/registry.py", "metrics/names.py")
+
+
+def check(ctx: FileContext) -> List[Violation]:
+    known = ctx.model.metric_names
+    if not known:  # no project model: rule is inert
+        return []
+    norm = ctx.path.replace("\\", "/")
+    if norm.endswith(_SKIP_SUFFIXES):
+        return []
+    out: List[Violation] = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+            continue
+        kind = node.func.attr
+        if kind not in _KINDS:
+            continue
+        if terminal_name(node.func.value) != "REGISTRY":
+            continue
+        if not node.args:
+            continue
+        name_arg = node.args[0]
+        if not (isinstance(name_arg, ast.Constant) and isinstance(name_arg.value, str)):
+            out.append(
+                Violation(
+                    RULE_ID, ctx.path, node.lineno, node.col_offset,
+                    "metric name must be a string literal so the static "
+                    "name registry (metrics/names.py) can account for it",
+                )
+            )
+            continue
+        name = name_arg.value
+        if name not in known:
+            out.append(
+                Violation(
+                    RULE_ID, ctx.path, node.lineno, node.col_offset,
+                    f"metric {name!r} is not declared in "
+                    "s3shuffle_tpu/metrics/names.py (declare it there — the "
+                    "trace_report selftest and docs derive from that table)",
+                )
+            )
+        elif known[name] != kind:
+            out.append(
+                Violation(
+                    RULE_ID, ctx.path, node.lineno, node.col_offset,
+                    f"metric {name!r} registered as {kind} but declared as "
+                    f"{known[name]} in s3shuffle_tpu/metrics/names.py",
+                )
+            )
+    return out

@@ -1,0 +1,173 @@
+"""THR01 — thread/executor lifecycle discipline.
+
+The invariant: a worker process must be able to exit. Every
+``threading.Thread`` needs an explicit ``daemon=`` decision (``daemon=True``
+for background service loops; ``daemon=False`` only with a visible
+``.join()`` somewhere in the module), and every ``ThreadPoolExecutor`` must
+either be a ``with`` context or have a ``.shutdown()`` call on the name it
+is assigned to. Otherwise a forgotten non-daemon helper thread (or an
+executor's worker threads) pins the interpreter alive after the shuffle
+finished — the silent-hang class that only shows up in deploy dry-runs.
+
+Long-lived process-wide pools that intentionally never shut down (the
+chunked-fetch GET executor) carry an inline suppression explaining why.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from tools.shuffle_lint.core import FileContext, Violation
+from tools.shuffle_lint.rules.common import terminal_name
+
+RULE_ID = "THR01"
+DESCRIPTION = "Thread/ThreadPoolExecutor without daemon/join/shutdown discipline"
+
+POSITIVE = '''
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+def start_helper(work):
+    t = threading.Thread(target=work)      # BUG: no daemon decision, never joined
+    t.start()
+    return t
+
+
+def fan_out(jobs):
+    pool = ThreadPoolExecutor(max_workers=4)   # BUG: never shut down
+    return [pool.submit(j) for j in jobs]
+'''
+
+NEGATIVE = '''
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+def start_service(work):
+    t = threading.Thread(target=work, daemon=True, name="svc")
+    t.start()
+    return t
+
+
+def start_worker(work):
+    t = threading.Thread(target=work, daemon=False)
+    t.start()
+    t.join()                                # explicit join discipline
+    return t
+
+
+def fan_out(jobs):
+    with ThreadPoolExecutor(max_workers=4) as pool:
+        return [f.result() for f in [pool.submit(j) for j in jobs]]
+
+
+def fan_out_deferred(jobs):
+    pool = ThreadPoolExecutor(max_workers=4)
+    try:
+        return [pool.submit(j) for j in jobs]
+    finally:
+        pool.shutdown(wait=False)
+'''
+
+
+def _joined_names(tree: ast.Module, method: str) -> Set[str]:
+    """Terminal receiver names that get ``.join()`` / ``.shutdown()`` calls
+    anywhere in the module."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == method
+        ):
+            name = terminal_name(node.func.value)
+            if name is not None:
+                out.add(name)
+    return out
+
+
+def _assign_target(ctx: FileContext, call: ast.Call) -> Optional[str]:
+    """The terminal name the call result is bound to (via Assign/AnnAssign),
+    if any."""
+    parent = getattr(call, "_sl_parent", None)
+    if isinstance(parent, ast.Assign) and parent.value is call:
+        for target in parent.targets:
+            name = terminal_name(target)
+            if name is not None:
+                return name
+    if isinstance(parent, ast.AnnAssign) and parent.value is call:
+        return terminal_name(parent.target)
+    return None
+
+
+def _in_with_item(call: ast.Call) -> bool:
+    parent = getattr(call, "_sl_parent", None)
+    return isinstance(parent, ast.withitem)
+
+
+def check(ctx: FileContext) -> List[Violation]:
+    joined = _joined_names(ctx.tree, "join")
+    shut = _joined_names(ctx.tree, "shutdown")
+    out: List[Violation] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        ctor = terminal_name(node.func)
+        if ctor == "Thread":
+            # only threading.Thread-shaped constructors (target=/daemon= API)
+            if not _looks_like_thread_ctor(node):
+                continue
+            daemon = next(
+                (kw.value for kw in node.keywords if kw.arg == "daemon"), None
+            )
+            target_name = _assign_target(ctx, node)
+            if daemon is None:
+                if target_name is not None and target_name in joined:
+                    continue  # joined explicitly — lifecycle is visible
+                out.append(
+                    Violation(
+                        RULE_ID, ctx.path, node.lineno, node.col_offset,
+                        "Thread(...) without an explicit daemon= decision or "
+                        "a visible .join() — a forgotten non-daemon thread "
+                        "pins the process alive",
+                    )
+                )
+            elif (
+                isinstance(daemon, ast.Constant)
+                and daemon.value is False
+                and (target_name is None or target_name not in joined)
+            ):
+                out.append(
+                    Violation(
+                        RULE_ID, ctx.path, node.lineno, node.col_offset,
+                        "Thread(daemon=False) with no .join() in this module "
+                        "— non-daemon threads need visible join discipline",
+                    )
+                )
+        elif ctor == "ThreadPoolExecutor":
+            if _in_with_item(node):
+                continue
+            target_name = _assign_target(ctx, node)
+            if target_name is not None and target_name in shut:
+                continue
+            out.append(
+                Violation(
+                    RULE_ID, ctx.path, node.lineno, node.col_offset,
+                    "ThreadPoolExecutor not used as a `with` context and "
+                    "never .shutdown() — its worker threads outlive the task",
+                )
+            )
+    return out
+
+
+def _looks_like_thread_ctor(node: ast.Call) -> bool:
+    """``threading.Thread(...)`` / bare ``Thread(...)`` — anything with the
+    stdlib keyword surface; excludes e.g. ``QThread`` subclasses named
+    differently (terminal name already filtered to exactly 'Thread')."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        base = terminal_name(func.value)
+        return base in {"threading", None} or base == "threading"
+    return isinstance(func, ast.Name)

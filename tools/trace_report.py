@@ -23,7 +23,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import sys
 from typing import Dict, List, Optional, Sequence, Tuple
 
 QUANTILES = (0.5, 0.95, 0.99)
@@ -299,6 +298,59 @@ def render(doc: dict, top: int = 10) -> str:
 # ---------------------------------------------------------------------------
 
 
+def _synthetic_snapshot() -> dict:
+    """A registry snapshot covering EVERY metric the package can emit,
+    derived from the single source of truth
+    (:mod:`s3shuffle_tpu.metrics.names`) — a metric registered anywhere in
+    the data plane is automatically part of this selftest's rendering
+    coverage, with no hand-maintained list to forget to extend."""
+    try:
+        from s3shuffle_tpu.metrics.names import KNOWN_METRICS
+    except ModuleNotFoundError:
+        # direct-script invocation (python tools/trace_report.py): sys.path[0]
+        # is tools/, so bootstrap the repo root like `-m` would
+        import os
+        import sys
+
+        sys.path.insert(
+            0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        from s3shuffle_tpu.metrics.names import KNOWN_METRICS
+
+    # synthetic histogram: 90 obs in (0.008, 0.016], 10 in (0.128, 0.256]
+    bounds = [0.001 * 2**i for i in range(10)]
+    buckets = [0] * 11
+    buckets[4] = 90
+    buckets[8] = 10
+    _SAMPLE_LABELS = {"scheme": "file", "op": "read", "direction": "up",
+                      "codec": "native"}
+    _ALT_LABELS = {"scheme": "s3", "op": "open", "direction": "down",
+                   "codec": "zlib"}
+    snapshot: Dict[str, dict] = {}
+    for name, (kind, labelnames) in sorted(KNOWN_METRICS.items()):
+        series_list = []
+        # labeled metrics get TWO series so multi-row/label-grouping
+        # rendering stays covered (each label combination is its own row)
+        label_sets = [_SAMPLE_LABELS, _ALT_LABELS] if labelnames else [None]
+        for values in label_sets:
+            series: dict = {}
+            if values is not None:
+                series["labels"] = {ln: values.get(ln, "x") for ln in labelnames}
+            if kind == "histogram":
+                series.update(
+                    {"le": bounds, "buckets": list(buckets),
+                     "sum": 90 * 0.012 + 10 * 0.2, "count": 100}
+                )
+            else:
+                series["value"] = (1 << 20) if "bytes" in name else 7
+            series_list.append(series)
+        metric = {"kind": kind, "series": series_list}
+        if labelnames:
+            metric["labelnames"] = list(labelnames)
+        snapshot[name] = metric
+    return snapshot
+
+
 def _selftest() -> int:
     trace_doc = {
         "traceEvents": [
@@ -312,11 +364,11 @@ def _selftest() -> int:
     for needle in ("write.commit", "read.prefetch", "p50", "p95", "p99", "MiB"):
         assert needle in text, f"trace render missing {needle!r}:\n{text}"
 
-    # synthetic histogram: 90 obs in (0.008, 0.016], 10 in (0.128, 0.256]
     bounds = [0.001 * 2**i for i in range(10)]
     buckets = [0] * 11
     buckets[4] = 90
     buckets[8] = 10
+    metrics = _synthetic_snapshot()
     report = {
         "shuffle_id": 7,
         "map_tasks": 4,
@@ -330,82 +382,15 @@ def _selftest() -> int:
         "read_wait_seconds": 0.05,
         "spills": 2,
         "max_prefetch_threads": 3,
-        "metrics": {
-            "storage_op_seconds": {
-                "kind": "histogram",
-                "labelnames": ["scheme", "op"],
-                "series": [
-                    {
-                        "labels": {"scheme": "file", "op": "read"},
-                        "le": bounds,
-                        "buckets": buckets,
-                        "sum": 90 * 0.012 + 10 * 0.2,
-                        "count": 100,
-                    }
-                ],
-            },
-            # transfer-plane histograms (chunked ranged GETs / pipelined
-            # commit uploads) — the names the docs point readers at
-            "read_chunk_fetch_seconds": {
-                "kind": "histogram",
-                "series": [{"le": bounds, "buckets": buckets, "sum": 2.0, "count": 100}],
-            },
-            "write_upload_queue_wait_seconds": {
-                "kind": "histogram",
-                "series": [{"le": bounds, "buckets": buckets, "sum": 0.4, "count": 100}],
-            },
-            "write_upload_chunk_seconds": {
-                "kind": "histogram",
-                "series": [{"le": bounds, "buckets": buckets, "sum": 1.1, "count": 100}],
-            },
-            "storage_read_bytes_total": {
-                "kind": "counter",
-                "series": [{"labels": {"scheme": "file"}, "value": 1 << 20}],
-            },
-            # resilient-storage-plane series (classified retries with
-            # backoff) — the names the fault-tolerance docs point readers at
-            "storage_retries_total": {
-                "kind": "counter",
-                "labelnames": ["op", "scheme"],
-                "series": [
-                    {"labels": {"op": "read", "scheme": "file"}, "value": 7},
-                    {"labels": {"op": "open", "scheme": "file"}, "value": 2},
-                ],
-            },
-            "storage_retry_backoff_seconds": {
-                "kind": "histogram",
-                "series": [{"le": bounds, "buckets": buckets, "sum": 0.9, "count": 100}],
-            },
-            "storage_deadline_exceeded_total": {
-                "kind": "counter",
-                "labelnames": ["op", "scheme"],
-                "series": [{"labels": {"op": "read", "scheme": "file"}, "value": 1}],
-            },
-            "read_prefetch_threads": {
-                "kind": "gauge",
-                "series": [{"value": 3}],
-            },
-            "read_chunk_inflight": {
-                "kind": "gauge",
-                "series": [{"value": 4}],
-            },
-        },
+        "metrics": metrics,
     }
     text = render_shuffle_stats(report)
-    for needle in (
-        "shuffle 7",
-        "storage_op_seconds",
-        "read_chunk_fetch_seconds",
-        "write_upload_queue_wait_seconds",
-        "write_upload_chunk_seconds",
-        "read_chunk_inflight",
-        "storage_retries_total",
-        "storage_retry_backoff_seconds",
-        "storage_deadline_exceeded_total",
-        "p95",
-        "throughput",
-    ):
+    # every declared metric name must render — names.py IS the coverage list
+    for needle in ("shuffle 7", "p95", "throughput", *metrics):
         assert needle in text, f"stats render missing {needle!r}:\n{text}"
+    # multi-series rendering: BOTH label rows of a labeled metric appear
+    for needle in ("op=read", "op=open"):
+        assert needle in text, f"multi-series row missing {needle!r}:\n{text}"
     p50 = histogram_quantile(bounds, buckets, 0.5)
     assert 0.008 <= p50 <= 0.016, p50
     p99 = histogram_quantile(bounds, buckets, 0.99)
