@@ -1129,6 +1129,118 @@ def pipelined_commit_gain(
     }
 
 
+def coalesced_read_gain(
+    n_maps: int = 2,
+    n_parts: int = 16,
+    part_bytes: int = 16 * 1024,
+    delay_s: float = 0.02,
+):
+    """Scan-planner probe (reduce side): on a many-small-partitions scan with
+    injected per-request latency, do coalesced segments (one GET per map
+    covering all its partitions) beat the per-block path (one GET per
+    partition)? Both paths drive the SAME scan machinery
+    (``build_scan_iterator``) against the same committed map outputs; only
+    ``coalesce_gap_bytes`` differs (0 = today's per-block request pattern).
+    GET counts come from the latency rule's hit counter (every delayed
+    ``.data`` read is one would-be store round-trip); byte identity is
+    asserted per block, not assumed."""
+    from s3shuffle_tpu.block_ids import ShuffleBlockId
+    from s3shuffle_tpu.config import ShuffleConfig
+    from s3shuffle_tpu.metadata.helper import ScanIndexMemo, ShuffleHelper
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+    from s3shuffle_tpu.storage.fault import FlakyBackend, LatencyRule
+    from s3shuffle_tpu.write.map_output_writer import MapOutputWriter
+
+    try:
+        Dispatcher.reset()
+        cfg = ShuffleConfig(root_dir="memory://bench-coalesce", app_id="bench-coalesce")
+        d = Dispatcher(cfg)
+        helper = ShuffleHelper(d)
+        rng = random.Random(21)
+        truth = {}
+        for m in range(n_maps):
+            w = MapOutputWriter(d, helper, 0, m, n_parts)
+            for p in range(n_parts):
+                data = rng.randbytes(part_bytes)
+                truth[(m, p)] = data
+                pw = w.get_partition_writer(p)
+                pw.write(data)
+                pw.close()
+            w.commit_all_partitions()
+        blocks = [
+            ShuffleBlockId(0, m, p) for m in range(n_maps) for p in range(n_parts)
+        ]
+
+        def run(gap_bytes: int):
+            run_cfg = ShuffleConfig(
+                root_dir="memory://bench-coalesce",
+                app_id="bench-coalesce",
+                coalesce_gap_bytes=gap_bytes,
+            )
+            best, gets, got = float("inf"), 0, None
+            for _rep in range(2):
+                flaky = FlakyBackend(d.backend)
+                rule = flaky.add_latency(
+                    LatencyRule("read", match=".data", delay_s=delay_s)
+                )
+                saved, d.backend = d.backend, flaky
+                try:
+                    d.clear_status_cache()
+                    it = build_scan_iterator_probe(d, helper, blocks, run_cfg)
+                    t0 = time.perf_counter()
+                    got = {}
+                    for s in it:
+                        got[(s.block.map_id, s.block.reduce_id)] = s.readall()
+                        s.close()
+                    best = min(best, time.perf_counter() - t0)
+                    gets = rule.hits
+                finally:
+                    d.backend = saved
+            assert got == truth, "coalesced read corrupted data"
+            return best, gets
+
+        def build_scan_iterator_probe(d, helper, blocks, run_cfg):
+            from s3shuffle_tpu.read.chunked_fetch import ChunkedRangeFetcher
+            from s3shuffle_tpu.read.scan_plan import build_scan_iterator
+
+            return build_scan_iterator(
+                d, ScanIndexMemo(helper), blocks, run_cfg,
+                fetcher=ChunkedRangeFetcher.from_config(run_cfg),
+            )
+
+        serial_wall, serial_gets = run(0)
+        coalesced_wall, coalesced_gets = run(cfg.coalesce_gap_bytes)
+    except Exception as e:  # never fail the bench over this row
+        return {"coalesced_read_error": str(e)[:120]}
+    finally:
+        Dispatcher.reset()
+    return {
+        "coalesced_read_gain": round(serial_wall / coalesced_wall, 2),
+        "coalesced_read_serial_wall_s": round(serial_wall, 3),
+        "coalesced_read_wall_s": round(coalesced_wall, 3),
+        "coalesced_read_gets_per_block": serial_gets,
+        "coalesced_read_gets_coalesced": coalesced_gets,
+        "coalesced_read_get_reduction": round(serial_gets / max(1, coalesced_gets), 2),
+        "coalesced_read_blocks": len(blocks),
+        "coalesced_read_part_bytes": part_bytes,
+        "coalesced_read_latency_ms": delay_s * 1e3,
+    }
+
+
+def scan_planner_knobs():
+    """The scan-planner knobs the headline runs used (ShuffleConfig defaults)
+    — recorded so BENCH rounds stay comparable when a default moves."""
+    from s3shuffle_tpu.config import ShuffleConfig
+
+    cfg = ShuffleConfig()
+    return {
+        "scan_planner": {
+            "coalesce_gap_bytes": cfg.coalesce_gap_bytes,
+            "coalesce_max_bytes": cfg.coalesce_max_bytes,
+        }
+    }
+
+
 def transfer_plane_knobs():
     """The transfer-plane knobs the headline runs used (ShuffleConfig
     defaults) — recorded so BENCH rounds stay comparable when a default
@@ -1170,7 +1282,9 @@ def main():
         **prefetch_adaptive_gain(),
         **chunked_fetch_gain(),
         **pipelined_commit_gain(),
+        **coalesced_read_gain(),
         **transfer_plane_knobs(),
+        **scan_planner_knobs(),
         **load_calibration(),
         **device_kernel_rates(),
     }

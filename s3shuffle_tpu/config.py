@@ -78,6 +78,16 @@ class ShuffleConfig:
     # uploader thread (the S3A fast-upload buffer analog); 0 disables the
     # pipelined upload path (serial drain -> PUT)
     upload_queue_bytes: int = 32 * MiB
+    # --- reduce-side scan planner (TPU-first addition; the reference issues
+    # one ranged GET per sub-block, S3ShuffleBlockStream) ---
+    # merge reduce-side block ranges on the same data object when the byte gap
+    # between them is <= this; the gap bytes are fetched and discarded
+    # (metered as read_coalesce_waste_bytes_total). 0 disables the planner
+    # entirely and preserves the per-block request pattern exactly.
+    coalesce_gap_bytes: int = 1 * MiB
+    # ceiling on one merged segment; also clamped to max_buffer_size_task so
+    # a merged segment always fits the prefetch budget in one prefill
+    coalesce_max_bytes: int = 64 * MiB
     # in-memory budget for key-ordered reduce output before the batch sorter
     # spills sorted columnar runs (analog of Spark's ExternalSorter memory)
     sorter_spill_bytes: int = 256 * MiB
@@ -142,6 +152,10 @@ class ShuffleConfig:
             raise ValueError("fetch_chunk_size must be >= 1")
         if self.fetch_parallelism < 0 or self.upload_queue_bytes < 0:
             raise ValueError("fetch_parallelism / upload_queue_bytes must be >= 0")
+        if self.coalesce_gap_bytes < 0:
+            raise ValueError("coalesce_gap_bytes must be >= 0")
+        if self.coalesce_max_bytes < 1:
+            raise ValueError("coalesce_max_bytes must be >= 1")
         if (
             self.storage_retries < 0
             or self.storage_retry_base_ms < 0

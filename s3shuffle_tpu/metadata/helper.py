@@ -114,6 +114,78 @@ class ShuffleHelper:
         self._checksum_cache.clear()
 
 
+class ScanIndexMemo:
+    """Per-scan read-through memo over a :class:`ShuffleHelper`.
+
+    One reduce scan touches the same map's index (and checksum) object once
+    per member block: range resolution in the scan planner / BlockIterator,
+    then again per block in checksum validation. With
+    ``cache_partition_lengths=False`` (or ``cache_checksums=False``) every one
+    of those touches is a fresh store GET in the bare helper — the knob exists
+    to keep long-lived processes from pinning stale metadata ACROSS scans, not
+    to re-fetch within one. This memo scopes deduplication to a single scan:
+    each metadata object is fetched at most once per memo lifetime regardless
+    of the cache knobs, and a new scan builds a new memo so cross-scan
+    freshness semantics are untouched.
+
+    Failures are memoized too (the same exception instance re-raises), so a
+    missing index — one uncommitted map output in listing mode — costs one
+    lookup per scan instead of one per partition of that map.
+
+    Duck-types the helper's read side (``get_partition_lengths`` /
+    ``get_checksums``), so BlockIterator and the reader's checksum wiring can
+    take either.
+    """
+
+    def __init__(self, helper: ShuffleHelper):
+        self.helper = helper
+        self.dispatcher = helper.dispatcher
+        self._offsets: ConcurrentObjectMap[tuple, object] = ConcurrentObjectMap()
+        self._checksums: ConcurrentObjectMap[tuple, object] = ConcurrentObjectMap()
+
+    @staticmethod
+    def _capture(compute):
+        try:
+            return compute()
+        except (OSError, ValueError) as e:  # FileNotFoundError, corrupt blob
+            return _MemoizedFailure(e)
+
+    @staticmethod
+    def _unwrap(entry):
+        if isinstance(entry, _MemoizedFailure):
+            raise entry.exc
+        return entry
+
+    def get_partition_lengths(self, shuffle_id: int, map_id: int) -> np.ndarray:
+        return self._unwrap(
+            self._offsets.get_or_else_put(
+                (shuffle_id, map_id),
+                lambda _k: self._capture(
+                    lambda: self.helper.get_partition_lengths(shuffle_id, map_id)
+                ),
+            )
+        )
+
+    def get_checksums(self, shuffle_id: int, map_id: int) -> np.ndarray:
+        return self._unwrap(
+            self._checksums.get_or_else_put(
+                (shuffle_id, map_id),
+                lambda _k: self._capture(
+                    lambda: self.helper.get_checksums(shuffle_id, map_id)
+                ),
+            )
+        )
+
+
+class _MemoizedFailure:
+    """Marker wrapper so ConcurrentObjectMap can memoize an exception."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
 def pack_longs_be(values) -> bytes:
     """Big-endian int64 packing (DataOutputStream wire format)."""
     return struct.pack(f">{len(values)}q", *values)

@@ -48,6 +48,11 @@ KNOWN_METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "read_chunk_fetch_seconds": ("histogram", ()),
     "read_chunk_inflight": ("gauge", ()),
     "read_chunked_prefills_total": ("counter", ()),
+    # --- read plane: coalesced scan planner (read/scan_plan.py) ---
+    "read_coalesced_segments_total": ("counter", ()),
+    "read_gets_saved_total": ("counter", ()),
+    "read_coalesce_waste_bytes_total": ("counter", ()),
+    "read_index_prefetch_seconds": ("histogram", ()),
     # --- read plane: checksum validation (read/checksum_stream.py) ---
     "read_checksum_validate_seconds": ("histogram", ()),
     "read_checksum_failures_total": ("counter", ()),

@@ -3,6 +3,13 @@ from s3shuffle_tpu.read.block_iterator import BlockIterator
 from s3shuffle_tpu.read.prefetch import BufferedPrefetchIterator, ThreadPredictor
 from s3shuffle_tpu.read.checksum_stream import ChecksumError, ChecksumValidationStream
 from s3shuffle_tpu.read.reader import ShuffleReadMetrics, ShuffleReader
+from s3shuffle_tpu.read.scan_plan import (
+    CoalescedScanIterator,
+    ScanSegment,
+    SlicedBlockStream,
+    build_scan_iterator,
+    plan_scan,
+)
 
 __all__ = [
     "BlockStream",
@@ -13,4 +20,9 @@ __all__ = [
     "ChecksumValidationStream",
     "ShuffleReader",
     "ShuffleReadMetrics",
+    "CoalescedScanIterator",
+    "ScanSegment",
+    "SlicedBlockStream",
+    "build_scan_iterator",
+    "plan_scan",
 ]

@@ -7,6 +7,14 @@ range (:36-43). A missing index means an uncommitted/partial map output: in
 pure-listing mode it is silently skipped, but when ``use_block_manager`` or
 ``always_create_index`` is set it is rethrown as a consistency-bug canary
 (:46-53).
+
+Divergences from the reference: zero-length blocks are dropped HERE, before a
+stream is even constructed (the reference builds the stream and filters on
+``maxBytes == 0`` later — in listing mode that meant every empty partition in
+range still cost index lookups and stream construction), and ``helper`` may
+be a per-scan :class:`~s3shuffle_tpu.metadata.helper.ScanIndexMemo` so one
+scan never fetches the same index object twice even with
+``cache_partition_lengths=False``.
 """
 
 from __future__ import annotations
@@ -28,11 +36,51 @@ logger = logging.getLogger("s3shuffle_tpu.read")
 ReadableBlockId = Union[ShuffleBlockId, ShuffleBlockBatchId]
 
 
+def reduce_span(block: ReadableBlockId) -> Tuple[int, int]:
+    """The ``[start, end)`` reduce-id range a readable block covers."""
+    if isinstance(block, ShuffleBlockBatchId):
+        return block.start_reduce_id, block.end_reduce_id
+    return block.reduce_id, block.reduce_id + 1
+
+
+def resolve_block_range(
+    helper, block: ReadableBlockId, must_raise: bool
+) -> Union[Tuple[int, int], None]:
+    """Resolve one block to its ``(lo, hi)`` byte range in the data object —
+    the single source of block-resolution semantics, shared by the per-block
+    path (:class:`BlockIterator`) and the coalescing planner
+    (read/scan_plan.py) so the two cannot drift.
+
+    Returns ``None`` when the block should be silently dropped: a zero-length
+    range (no stream construction, no open work), or a missing index in pure
+    listing mode (logged skip). With ``must_raise`` — driver metadata or
+    ``always_create_index`` promised the block — a missing index re-raises as
+    the consistency canary (S3ShuffleBlockIterator.scala:46-53); a reduce
+    range past the index bounds always raises."""
+    start, end = reduce_span(block)
+    try:
+        offsets = helper.get_partition_lengths(block.shuffle_id, block.map_id)
+    except FileNotFoundError:
+        if must_raise:
+            raise
+        logger.warning("Skipping block %s: missing index (listing mode)", block.name)
+        return None
+    if end >= len(offsets):
+        raise IndexError(
+            f"Block {block.name} reduce range [{start},{end}) out of bounds "
+            f"for index with {len(offsets) - 1} partitions"
+        )
+    lo, hi = int(offsets[start]), int(offsets[end])
+    if hi - lo == 0:
+        return None
+    return lo, hi
+
+
 class BlockIterator:
     def __init__(
         self,
         dispatcher: Dispatcher,
-        helper: ShuffleHelper,
+        helper: ShuffleHelper,  # or a duck-typed ScanIndexMemo
         blocks: Iterable[ReadableBlockId],
     ):
         self.dispatcher = dispatcher
@@ -45,25 +93,9 @@ class BlockIterator:
             or self.dispatcher.config.always_create_index
         )
         for block in self._blocks:
-            if isinstance(block, ShuffleBlockBatchId):
-                start, end = block.start_reduce_id, block.end_reduce_id
-            else:
-                start, end = block.reduce_id, block.reduce_id + 1
-            try:
-                offsets = self.helper.get_partition_lengths(block.shuffle_id, block.map_id)
-            except FileNotFoundError:
-                if must_raise:
-                    # Consistency canary (S3ShuffleBlockIterator.scala:46-53):
-                    # driver metadata said this block exists but no index found.
-                    raise
-                logger.warning("Skipping block %s: missing index (listing mode)", block.name)
+            span = resolve_block_range(self.helper, block, must_raise)
+            if span is None:
                 continue
-            if end >= len(offsets):
-                raise IndexError(
-                    f"Block {block.name} reduce range [{start},{end}) out of bounds "
-                    f"for index with {len(offsets) - 1} partitions"
-                )
+            lo, hi = span
             data_block = ShuffleDataBlockId(block.shuffle_id, block.map_id)
-            yield block, BlockStream(
-                self.dispatcher, block, data_block, int(offsets[start]), int(offsets[end])
-            )
+            yield block, BlockStream(self.dispatcher, block, data_block, lo, hi)

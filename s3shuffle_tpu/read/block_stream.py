@@ -40,7 +40,8 @@ class BlockStream(io.RawIOBase):
     def __init__(
         self,
         dispatcher: Dispatcher,
-        block: BlockId,
+        block: BlockId,  # anything with a .name label: a BlockId, or a
+        # scan_plan.ScanSegment when the stream serves a coalesced range
         data_block: ShuffleDataBlockId,
         start_offset: int,
         end_offset: int,

@@ -117,6 +117,12 @@ class PrefetchedBlockStream(io.RawIOBase):
     def readable(self) -> bool:
         return True
 
+    def buffer_view(self) -> memoryview:
+        """Zero-copy view of the prefilled buffer — the coalesced scan
+        planner slices member blocks out of a fetched segment through this
+        (the view stays valid after :meth:`close` drops the buffer ref)."""
+        return memoryview(self._buffer)
+
     def read(self, size: int = -1) -> bytes:
         if size is None or size < 0:
             return self.readall()

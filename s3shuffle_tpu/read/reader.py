@@ -6,9 +6,11 @@ from Spark's BlockStoreShuffleReader. ``read()`` assembles:
 1. block enumeration — driver-metadata mode via the MapOutputTracker
    (:169-180, with contiguous-range batch merging) or store-listing mode
    (:181-196) when ``use_block_manager`` is off;
-2. :class:`BlockIterator` → drop empty blocks + remote-bytes/blocks metrics
+2. block-range resolution → drop empty blocks + remote-bytes/blocks metrics
    (:91-97);
-3. :class:`BufferedPrefetchIterator` (:98);
+3. the prefetching scan iterator — the coalescing planner's segment pipeline
+   by default, or the per-block ``BufferedPrefetchIterator`` path at
+   ``coalesce_gap_bytes=0`` (read/scan_plan.py; :98);
 4. per block: optional :class:`ChecksumValidationStream` over the stored bytes,
    then codec decompression (the analog of ``serializerManager.wrapStream``),
    then the serializer's record iterator (:99-110);
@@ -30,11 +32,10 @@ from s3shuffle_tpu.block_ids import ShuffleBlockBatchId, ShuffleBlockId
 from s3shuffle_tpu.codec import CodecInputStream
 from s3shuffle_tpu.codec.framing import FrameCodec
 from s3shuffle_tpu.dependency import ShuffleDependency
-from s3shuffle_tpu.metadata.helper import ShuffleHelper
+from s3shuffle_tpu.metadata.helper import ScanIndexMemo, ShuffleHelper
 from s3shuffle_tpu.metadata.map_output import MapOutputTrackerLike
-from s3shuffle_tpu.read.block_iterator import BlockIterator, ReadableBlockId
+from s3shuffle_tpu.read.block_iterator import ReadableBlockId
 from s3shuffle_tpu.read.checksum_stream import ChecksumValidationStream
-from s3shuffle_tpu.read.prefetch import BufferedPrefetchIterator
 from s3shuffle_tpu.sorter import ExternalSorter
 from s3shuffle_tpu.storage.dispatcher import Dispatcher
 
@@ -75,6 +76,9 @@ class ShuffleReader:
         self.end_map_index = end_map_index
         self.codec = codec
         self.metrics = ShuffleReadMetrics()
+        # replaced with a fresh memo per scan in _make_prefetcher; this one
+        # only backs a _wrapped_stream call that skipped the pipeline
+        self._scan_memo = ScanIndexMemo(helper)
         cfg = dispatcher.config
         # Batch-fetch eligibility (S3ShuffleReader.scala:55-75): relocatable
         # serializer + concatenatable codec framing (ours always is).
@@ -151,25 +155,36 @@ class ShuffleReader:
         return blocks
 
     # ------------------------------------------------------------------
-    def _make_prefetcher(self) -> BufferedPrefetchIterator:
+    def _count_block(self, _block, nbytes: int) -> None:
+        """Remote-bytes/blocks metrics (:91-97), fed per non-empty block by
+        whichever scan path runs."""
+        self.metrics.remote_blocks_fetched += 1
+        self.metrics.remote_bytes_read += nbytes
+
+    def _make_prefetcher(self):
+        """Build the scan's prefetching stream iterator.
+
+        With ``coalesce_gap_bytes > 0`` the scan planner merges nearby block
+        ranges into fewer, bigger GETs and bulk-prefetches the map indices
+        (read/scan_plan.py — a deliberate divergence from the reference's
+        one-GET-per-block reduce path); at 0 this is the reference-parity
+        per-block pipeline. Either way a fresh per-scan index memo backs
+        range resolution AND checksum-offset lookups, so no index object is
+        fetched twice within one scan regardless of the cache knobs."""
         blocks = self.compute_shuffle_blocks()
         cfg = self.dispatcher.config
-
-        def nonempty_streams():
-            for block, stream in BlockIterator(self.dispatcher, self.helper, blocks):
-                if stream.max_bytes == 0:
-                    continue  # filterNot(maxBytes == 0), :91-97
-                self.metrics.remote_blocks_fetched += 1
-                self.metrics.remote_bytes_read += stream.max_bytes
-                yield block, stream
+        self._scan_memo = ScanIndexMemo(self.helper)
 
         from s3shuffle_tpu.read.chunked_fetch import ChunkedRangeFetcher
+        from s3shuffle_tpu.read.scan_plan import build_scan_iterator
 
-        return BufferedPrefetchIterator(
-            nonempty_streams(),
-            max_buffer_size=cfg.max_buffer_size_task,
-            max_threads=cfg.max_concurrency_task,
+        return build_scan_iterator(
+            self.dispatcher,
+            self._scan_memo,
+            blocks,
+            cfg,
             fetcher=ChunkedRangeFetcher.from_config(cfg),
+            on_block=self._count_block,
         )
 
     def read(self) -> Iterator[Tuple[Any, Any]]:
@@ -207,7 +222,7 @@ class ShuffleReader:
             records = sorter.sorted_iterator()
         return records
 
-    def _finish_read(self, prefetcher: BufferedPrefetchIterator) -> None:
+    def _finish_read(self, prefetcher) -> None:
         """Drain hook: fold prefetcher stats into the task metrics and record
         the reduce-completion ShuffleStats entry (pushed through the tracker
         when it aggregates stats — the metadata-service analog of the
@@ -238,8 +253,10 @@ class ShuffleReader:
         block = prefetched.block
         stream = prefetched
         if cfg.checksum_enabled:
-            offsets = self.helper.get_partition_lengths(block.shuffle_id, block.map_id)
-            checksums = self.helper.get_checksums(block.shuffle_id, block.map_id)
+            # per-scan memo: one index/checksum GET per map per scan even
+            # with the process-wide caches off
+            offsets = self._scan_memo.get_partition_lengths(block.shuffle_id, block.map_id)
+            checksums = self._scan_memo.get_checksums(block.shuffle_id, block.map_id)
             if isinstance(block, ShuffleBlockBatchId):
                 start, end = block.start_reduce_id, block.end_reduce_id
             else:
@@ -251,7 +268,7 @@ class ShuffleReader:
             stream = CodecInputStream(self.codec, stream)
         return stream
 
-    def _chunk_iterator(self, prefetcher: BufferedPrefetchIterator):
+    def _chunk_iterator(self, prefetcher):
         """Record chunks (lists) from every prefetched block.
 
         ``records_read`` is counted at chunk granularity, and a chunk is

@@ -1,0 +1,456 @@
+"""Reduce-side coalesced scan planner: fewer, bigger GETs.
+
+The reference issues one ranged GET per sub-block (S3ShuffleBlockStream — one
+``open``+positioned read per ``ShuffleBlockId``), which on object storage
+makes REQUEST COUNT, not bandwidth, the reduce-side cost and latency driver:
+a scan over many small partitions pays a full store round-trip per partition.
+BlobShuffle (PAPERS.md) makes exactly this point for object-storage
+repartitioning, and the data-pipeline literature (Optimizing High-Throughput
+Distributed Data Pipelines, PAPERS.md) shows planned, batched reads dominate
+ad-hoc per-item fetches. The chunked-fetch plane (PR 2) solved the inverse
+problem — splitting one LARGE read into parallel sub-reads; this module
+solves the many-SMALL-reads side:
+
+1. **Plan**: take the scan's full block list up front, resolve every block's
+   byte range from the map-output indices (bulk-prefetched — see below),
+   drop zero-length ranges before any stream/open work, group ranges by data
+   object, and merge adjacent/nearby ranges into segments under two knobs:
+   ``coalesce_gap_bytes`` (merge across a gap of at most this many bytes —
+   gap bytes are fetched and discarded, metered as
+   ``read_coalesce_waste_bytes_total``) and ``coalesce_max_bytes`` (segment
+   ceiling, additionally clamped to ``max_buffer_size_task`` so a merged
+   segment always completes in one prefill). ``coalesce_gap_bytes=0``
+   disables the planner and preserves the per-block path — and its store
+   request pattern — exactly.
+2. **Fetch**: each merged segment is ONE ranged GET through the existing
+   :class:`BufferedPrefetchIterator` budget/thread machinery (chunk-parallel
+   via :class:`ChunkedRangeFetcher` when the segment outgrows
+   ``fetch_chunk_size``).
+3. **Slice**: the fetched segment buffer is sliced into per-block streams via
+   zero-copy memoryviews, byte-identical to what the per-block path would
+   have delivered; per-block checksum validation downstream is untouched. A
+   segment GET that fails mid-flight degrades exactly like the serial path:
+   every member after the failure point sees a logged-EOF prefix that
+   checksum validation surfaces as ``ChecksumError``, and the prefetch budget
+   releases when the last member slice closes.
+
+**Bulk index prefetch** rides along: the planner collects the distinct map
+indices the scan needs and fans ``get_partition_lengths`` out on a
+scan-scoped executor BEFORE streaming starts, so first-touch index GETs no
+longer serialize one-at-a-time inside prefetch threads. A per-scan
+:class:`~s3shuffle_tpu.metadata.helper.ScanIndexMemo` keeps every index
+object at one fetch per scan even when ``cache_partition_lengths=False``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import logging
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Iterator, List, Optional, Sequence
+
+from s3shuffle_tpu.block_ids import ShuffleDataBlockId
+from s3shuffle_tpu.metadata.helper import ScanIndexMemo
+from s3shuffle_tpu.metrics import registry as _metrics
+from s3shuffle_tpu.read.block_iterator import (
+    BlockIterator,
+    ReadableBlockId,
+    resolve_block_range,
+)
+from s3shuffle_tpu.read.block_stream import BlockStream
+from s3shuffle_tpu.read.prefetch import BufferedPrefetchIterator, PrefetchedBlockStream
+from s3shuffle_tpu.storage.dispatcher import Dispatcher
+
+logger = logging.getLogger("s3shuffle_tpu.read")
+
+_C_SEGMENTS = _metrics.REGISTRY.counter(
+    "read_coalesced_segments_total",
+    "Merged multi-block segments fetched as one ranged GET",
+)
+_C_GETS_SAVED = _metrics.REGISTRY.counter(
+    "read_gets_saved_total",
+    "Ranged GETs the scan planner avoided (member blocks merged minus "
+    "segments issued)",
+)
+_C_WASTE = _metrics.REGISTRY.counter(
+    "read_coalesce_waste_bytes_total",
+    "Gap bytes fetched and discarded by coalesced segments (the over-read "
+    "price of merging across coalesce_gap_bytes)",
+)
+_H_INDEX_PREFETCH = _metrics.REGISTRY.histogram(
+    "read_index_prefetch_seconds",
+    "Wall time of the planner's bulk map-index prefetch fan-out, per scan",
+)
+
+#: per-block bytes counter callback: ``on_block(block_id, intended_bytes)``
+OnBlock = Optional[Callable[[object, int], None]]
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockRange:
+    """One readable block resolved to its byte range in the data object."""
+
+    block: ReadableBlockId
+    start: int
+    end: int
+
+    @property
+    def length(self) -> int:
+        return self.end - self.start
+
+
+class ScanSegment:
+    """A run of :class:`BlockRange` members on one data object, fetched as a
+    single ranged GET over ``[start, end)``."""
+
+    __slots__ = ("data_block", "start", "end", "members")
+
+    def __init__(
+        self,
+        data_block: ShuffleDataBlockId,
+        start: int,
+        end: int,
+        members: List[BlockRange],
+    ):
+        self.data_block = data_block
+        self.start = start
+        self.end = end
+        self.members = members
+
+    @property
+    def length(self) -> int:
+        return self.end - self.start
+
+    @property
+    def waste_bytes(self) -> int:
+        """Gap bytes inside the segment that belong to no member."""
+        return self.length - sum(m.length for m in self.members)
+
+    @property
+    def name(self) -> str:
+        """Log/trace label (the planner's analog of ``BlockId.name``)."""
+        return f"scan_{self.data_block.name}[{self.start}:{self.end})"
+
+    def __repr__(self) -> str:
+        return f"ScanSegment({self.name}, members={len(self.members)})"
+
+
+# ---------------------------------------------------------------------------
+# Planning
+# ---------------------------------------------------------------------------
+
+
+def _bulk_prefetch_indices(memo: ScanIndexMemo, keys: Sequence[tuple], width: int) -> None:
+    """Fan index fetches out on a scan-scoped executor sized to the scan's
+    concurrency budget. Deliberately NOT the shared chunked-fetch pool: that
+    pool is grow-only and its width IS the operator's ``fetch_parallelism``
+    data-GET concurrency cap — growing it here would permanently loosen the
+    cap for every later chunked prefill. Failures are swallowed here
+    (memoized by the memo) and re-raised with full semantics at resolution
+    time, so listing-mode skip vs metadata-mode canary behavior is decided in
+    exactly one place."""
+
+    def fetch_one(shuffle_id: int, map_id: int) -> None:
+        try:
+            memo.get_partition_lengths(shuffle_id, map_id)
+        except (OSError, ValueError) as e:
+            logger.debug(
+                "index prefetch for shuffle %d map %d deferred error: %s",
+                shuffle_id, map_id, e,
+            )
+
+    t0 = time.perf_counter_ns()
+    from s3shuffle_tpu.utils import trace
+
+    with trace.span("read.index_prefetch", maps=len(keys)):
+        with ThreadPoolExecutor(
+            max_workers=min(len(keys), max(1, width)),
+            thread_name_prefix="s3shuffle-index-prefetch",
+        ) as pool:
+            futures = [pool.submit(fetch_one, sid, mid) for sid, mid in keys]
+            for fut in futures:
+                fut.result()
+    if _metrics.enabled():
+        _H_INDEX_PREFETCH.observe((time.perf_counter_ns() - t0) / 1e9)
+
+
+def plan_scan(
+    dispatcher: Dispatcher,
+    memo: ScanIndexMemo,
+    blocks: Sequence[ReadableBlockId],
+    gap_bytes: int,
+    max_bytes: int,
+    prefetch_width: int = 1,
+) -> List[ScanSegment]:
+    """Resolve, filter, group, and merge the scan's block list.
+
+    Zero-length ranges are dropped HERE — before any index re-touch, stream
+    construction, or open work (in listing mode the reader materializes a
+    block id for every partition in range with no size information, so this
+    is where empty partitions get cheap). Missing indices follow
+    BlockIterator's semantics: skipped with a warning in pure listing mode,
+    re-raised as a consistency canary when ``use_block_manager`` or
+    ``always_create_index`` says driver metadata promised the block.
+    """
+    must_raise = (
+        dispatcher.config.use_block_manager
+        or dispatcher.config.always_create_index
+    )
+    keys: List[tuple] = []
+    seen = set()
+    for block in blocks:
+        key = (block.shuffle_id, block.map_id)
+        if key not in seen:
+            seen.add(key)
+            keys.append(key)
+    if len(keys) > 1:
+        _bulk_prefetch_indices(memo, keys, prefetch_width)
+
+    # Resolve ranges (shared semantics with the per-block path: zero-length
+    # drop, listing-mode skip, metadata-mode canary), grouped per data object
+    # in first-appearance order.
+    groups: dict = {}
+    for block in blocks:
+        span = resolve_block_range(memo, block, must_raise)
+        if span is None:
+            continue
+        key = (block.shuffle_id, block.map_id)
+        groups.setdefault(key, []).append(BlockRange(block, span[0], span[1]))
+
+    segments: List[ScanSegment] = []
+    for (shuffle_id, map_id), ranges in groups.items():
+        data_block = ShuffleDataBlockId(shuffle_id, map_id)
+        ranges.sort(key=lambda r: r.start)
+        current: List[BlockRange] = []
+        seg_start = seg_end = 0
+        for r in ranges:
+            if current and (
+                r.start - seg_end <= gap_bytes
+                and max(seg_end, r.end) - seg_start <= max_bytes
+            ):
+                current.append(r)
+                seg_end = max(seg_end, r.end)
+                continue
+            if current:
+                segments.append(ScanSegment(data_block, seg_start, seg_end, current))
+            current = [r]
+            seg_start, seg_end = r.start, r.end
+        if current:
+            segments.append(ScanSegment(data_block, seg_start, seg_end, current))
+    return segments
+
+
+# ---------------------------------------------------------------------------
+# Slicing
+# ---------------------------------------------------------------------------
+
+
+class SlicedBlockStream(io.RawIOBase):
+    """One member block's bytes, sliced zero-copy out of a fetched segment
+    buffer. Presents the :class:`PrefetchedBlockStream` surface the reader
+    consumes (``block`` / ``max_bytes`` / ``read`` / ``readall`` / idempotent
+    ``close``); ``close`` releases the slice's view and notifies the segment's
+    refcount so the LAST member close releases the prefetch budget.
+
+    A segment GET that went short (logged I/O error or EOF below) leaves this
+    slice shorter than ``max_bytes``; reads then return the surviving prefix
+    and EOF — exactly the per-block path's failed-read behavior, surfaced the
+    same way (checksum validation raises on the premature EOF)."""
+
+    def __init__(self, block, view: memoryview, expected_bytes: int, on_close):
+        self.block = block
+        self.max_bytes = expected_bytes
+        self._view = view
+        self._pos = 0
+        self._on_close = on_close
+        self._closed_once = False
+
+    def readable(self) -> bool:
+        return True
+
+    def read(self, size: int = -1) -> bytes:
+        if self._pos >= len(self._view):
+            return b""
+        if size is None or size < 0:
+            size = len(self._view) - self._pos
+        end = min(self._pos + size, len(self._view))
+        out = bytes(self._view[self._pos : end])
+        self._pos = end
+        return out
+
+    def readall(self) -> bytes:
+        out = bytes(self._view[self._pos :])
+        self._pos = len(self._view)
+        return out
+
+    def close(self) -> None:
+        if self._closed_once:
+            if not self.closed:
+                logger.warning("Double close of sliced stream for %s", self.block)
+            return
+        self._closed_once = True
+        self._view = memoryview(b"")
+        if self._on_close is not None:
+            self._on_close()
+        super().close()
+
+
+class CoalescedScanIterator:
+    """Consumer-facing iterator of per-block prefetched streams, driven by a
+    :class:`BufferedPrefetchIterator` over planned segments.
+
+    Single-member segments ride the unchanged per-block path (lazy open,
+    synchronous remainder past the prefetch budget — a lone block may exceed
+    ``coalesce_max_bytes``). Multi-member segments are guaranteed by the
+    planner to fit one prefill, arrive fully buffered, and are sliced into
+    :class:`SlicedBlockStream` members here on the consumer thread."""
+
+    def __init__(
+        self,
+        dispatcher: Dispatcher,
+        segments: Sequence[ScanSegment],
+        max_buffer_size: int,
+        max_threads: int,
+        fetcher=None,
+        on_block: OnBlock = None,
+    ):
+        def segment_streams():
+            for seg in segments:
+                if len(seg.members) == 1:
+                    m = seg.members[0]
+                    if on_block is not None:
+                        on_block(m.block, m.length)
+                    yield m.block, BlockStream(
+                        dispatcher, m.block, seg.data_block, m.start, m.end
+                    )
+                else:
+                    if on_block is not None:
+                        for m in seg.members:
+                            on_block(m.block, m.length)
+                    yield seg, BlockStream(
+                        dispatcher, seg, seg.data_block, seg.start, seg.end
+                    )
+
+        self._inner = BufferedPrefetchIterator(
+            segment_streams(),
+            max_buffer_size=max_buffer_size,
+            max_threads=max_threads,
+            fetcher=fetcher,
+        )
+        self._pending: List[SlicedBlockStream] = []
+
+    def __iter__(self) -> "CoalescedScanIterator":
+        return self
+
+    def __next__(self):
+        while not self._pending:
+            item = self._inner.__next__()  # StopIteration/errors propagate
+            if isinstance(item.block, ScanSegment):
+                self._slice_segment(item)
+            else:
+                return item
+        return self._pending.pop(0)
+
+    def _slice_segment(self, item: PrefetchedBlockStream) -> None:
+        seg: ScanSegment = item.block
+        view = item.buffer_view()
+        fetched = len(view)
+        if fetched < seg.length:
+            # the underlying BlockStream already logged the failed read; this
+            # names the member blocks that inherit the truncation
+            logger.warning(
+                "Coalesced segment %s fetched %d of %d bytes; %d member "
+                "block(s) degrade to logged-EOF prefixes",
+                seg.name, fetched, seg.length, len(seg.members),
+            )
+        if _metrics.enabled():
+            _C_SEGMENTS.inc()
+            _C_GETS_SAVED.inc(len(seg.members) - 1)
+            if fetched == seg.length:
+                _C_WASTE.inc(seg.waste_bytes)
+        remaining = [len(seg.members)]
+        lock = threading.Lock()
+
+        def on_member_close() -> None:
+            with lock:
+                remaining[0] -= 1
+                last = remaining[0] == 0
+            if last:
+                item.close()  # releases the prefetch budget
+
+        for m in seg.members:
+            lo = min(m.start - seg.start, fetched)
+            hi = min(m.end - seg.start, fetched)
+            self._pending.append(
+                SlicedBlockStream(m.block, view[lo:hi], m.length, on_member_close)
+            )
+
+    @property
+    def stats(self) -> dict:
+        return self._inner.stats
+
+
+# ---------------------------------------------------------------------------
+# Entry point
+# ---------------------------------------------------------------------------
+
+
+def build_scan_iterator(
+    dispatcher: Dispatcher,
+    memo: ScanIndexMemo,
+    blocks: Sequence[ReadableBlockId],
+    cfg,
+    fetcher=None,
+    on_block: OnBlock = None,
+) -> Iterator:
+    """Assemble the reduce scan's prefetching block-stream iterator.
+
+    With ``coalesce_gap_bytes > 0``: plan → coalesced segments →
+    :class:`CoalescedScanIterator`. With ``coalesce_gap_bytes = 0``: the
+    per-block path, request-for-request identical to the pre-planner reader
+    (BlockIterator resolves lazily inside the prefetch threads; no bulk index
+    prefetch runs). Both return an iterator of per-block prefetched streams
+    exposing ``.stats`` for the reader's completion accounting.
+    """
+    if cfg.coalesce_gap_bytes > 0:
+        segments = plan_scan(
+            dispatcher,
+            memo,
+            blocks,
+            gap_bytes=cfg.coalesce_gap_bytes,
+            # a multi-block segment must complete in ONE prefill: clamp to the
+            # prefetch budget so slicing never needs a synchronous remainder
+            max_bytes=min(cfg.coalesce_max_bytes, cfg.max_buffer_size_task),
+            # the fan-out is a startup barrier, so size it to the scan's
+            # concurrency budget, not just the chunk-transfer width: a
+            # many-map scan must not serialize index GETs 4 at a time before
+            # the first data byte flows
+            prefetch_width=max(1, cfg.fetch_parallelism, cfg.max_concurrency_task),
+        )
+        return CoalescedScanIterator(
+            dispatcher,
+            segments,
+            max_buffer_size=cfg.max_buffer_size_task,
+            max_threads=cfg.max_concurrency_task,
+            fetcher=fetcher,
+            on_block=on_block,
+        )
+
+    def nonempty_streams():
+        for block, stream in BlockIterator(dispatcher, memo, blocks):
+            if stream.max_bytes == 0:
+                continue  # filterNot(maxBytes == 0) backstop; BlockIterator
+                # already drops empties before constructing streams
+            if on_block is not None:
+                on_block(block, stream.max_bytes)
+            yield block, stream
+
+    return BufferedPrefetchIterator(
+        nonempty_streams(),
+        max_buffer_size=cfg.max_buffer_size_task,
+        max_threads=cfg.max_concurrency_task,
+        fetcher=fetcher,
+    )

@@ -161,6 +161,31 @@ def _series_label(name: str, series: dict) -> str:
     return f"{name}{{{inner}}}"
 
 
+def _counter_total(snapshot: dict, name: str) -> float:
+    return sum(
+        float(s.get("value", 0))
+        for s in snapshot.get(name, {}).get("series", [])
+    )
+
+
+def _scan_planner_line(snapshot: dict) -> Optional[str]:
+    """One-line scan-planner digest: GETs issued vs GETs saved by coalescing,
+    and the over-read (waste) price paid for the merges."""
+    segments = _counter_total(snapshot, "read_coalesced_segments_total")
+    if segments <= 0:
+        return None
+    saved = _counter_total(snapshot, "read_gets_saved_total")
+    waste = _counter_total(snapshot, "read_coalesce_waste_bytes_total")
+    read_bytes = _counter_total(snapshot, "storage_read_bytes_total")
+    line = (
+        f"Scan planner: {segments:g} coalesced segments, {saved:g} GETs saved "
+        f"({segments + saved:g} → {segments:g}), over-read {_fmt_bytes(waste)}"
+    )
+    if read_bytes > 0:
+        line += f" ({100.0 * waste / read_bytes:.2f}% of bytes read)"
+    return line
+
+
 def render_metrics_snapshot(snapshot: dict, top: int = 10) -> str:
     hist_rows: List[Tuple[float, Sequence[str]]] = []
     counter_rows: List[Sequence[str]] = []
@@ -215,6 +240,10 @@ def render_metrics_snapshot(snapshot: dict, top: int = 10) -> str:
         out.append("")
         out.append("Counters:")
         out.append(_table(("counter", "value"), counter_rows))
+    planner = _scan_planner_line(snapshot)
+    if planner:
+        out.append("")
+        out.append(planner)
     if gauge_rows:
         out.append("")
         out.append("Gauges:")
@@ -391,6 +420,10 @@ def _selftest() -> int:
     # multi-series rendering: BOTH label rows of a labeled metric appear
     for needle in ("op=read", "op=open"):
         assert needle in text, f"multi-series row missing {needle!r}:\n{text}"
+    # the scan-planner digest renders from the synthetic planner counters
+    # (7 segments + 7 saved GETs, 1 MiB waste over 2 MiB read = 50%)
+    for needle in ("Scan planner:", "7 GETs saved", "(14 → 7)", "50.00% of bytes read"):
+        assert needle in text, f"planner line missing {needle!r}:\n{text}"
     p50 = histogram_quantile(bounds, buckets, 0.5)
     assert 0.008 <= p50 <= 0.016, p50
     p99 = histogram_quantile(bounds, buckets, 0.99)
