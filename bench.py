@@ -1241,6 +1241,118 @@ def scan_planner_knobs():
     }
 
 
+def _tracker_probe_worker(addr, worker_idx, n_maps, n_parts, lookups, barrier):
+    """One control-plane probe worker process: batched registrations, one
+    snapshot pull, then snapshot-served lookups (the steady-state reduce
+    shape — zero tracker round-trips). Module-level so spawn pickles it."""
+    import numpy as np
+
+    from s3shuffle_tpu.metadata.async_client import AsyncTrackerClient
+    from s3shuffle_tpu.metadata.map_output import STORE_LOCATION, MapStatus
+    from s3shuffle_tpu.metadata.snapshot import MapOutputSnapshot
+
+    client = AsyncTrackerClient(tuple(addr), batch_max=64)
+    sid = 1000 + worker_idx
+    try:
+        barrier.wait(timeout=60)
+        client.register_shuffle(sid, n_parts)
+        sizes = np.arange(n_parts, dtype=np.int64)
+        for m in range(n_maps):
+            client.register_map_output(
+                sid,
+                MapStatus(
+                    map_id=m * 1000, location=STORE_LOCATION,
+                    sizes=sizes, map_index=m,
+                ),
+            )
+        client.flush()
+        epoch, data = client.get_snapshot(sid)
+        snap = MapOutputSnapshot.from_bytes(data)
+        assert epoch == n_maps and len(snap.entries) == n_maps
+        for i in range(lookups):
+            p = i % n_parts
+            out = snap.get_map_sizes_by_range(0, None, p, p + 1)
+            assert len(out) == n_maps
+    finally:
+        client.close()
+
+
+def tracker_scaling(workers=(1, 4, 8), n_maps=64, n_parts=16, lookups=1500):
+    """Control-plane scaling probe (the PR-6 acceptance gate): aggregate
+    tracker-op throughput at 1/4/8 workers against ONE sharded coordinator.
+    Each worker process batch-registers ``n_maps`` outputs (one RPC per
+    batch), pulls the epoch snapshot once, then serves ``lookups`` map-range
+    enumerations locally — the steady-state reduce shape where the
+    coordinator is a background publisher, not a per-lookup dependency.
+    ``tracker_scaling_4w`` is the number to compare against the BENCH_r05
+    ``aggregate_scaling`` 1.21 coordinator-bound baseline."""
+    import multiprocessing as mp
+
+    from s3shuffle_tpu.config import ShuffleConfig
+    from s3shuffle_tpu.metadata.service import MetadataServer
+
+    cfg = ShuffleConfig()
+    ops_per_worker = n_maps + lookups
+    results = {}
+    try:
+        for w in workers:
+            server = MetadataServer(
+                shards=cfg.metadata_shards,
+                shard_endpoints=cfg.metadata_shard_endpoints,
+            ).start()
+            ctx = mp.get_context("spawn")
+            barrier = ctx.Barrier(w + 1)
+            procs = [
+                ctx.Process(
+                    target=_tracker_probe_worker,
+                    args=(list(server.address), i, n_maps, n_parts, lookups, barrier),
+                    daemon=True,
+                )
+                for i in range(w)
+            ]
+            try:
+                for p in procs:
+                    p.start()
+                barrier.wait(timeout=120)  # spawn/connect cost stays outside
+                t0 = time.perf_counter()
+                for p in procs:
+                    p.join(timeout=300)
+                wall = time.perf_counter() - t0
+                if any(p.is_alive() for p in procs) or any(p.exitcode for p in procs):
+                    raise RuntimeError(
+                        f"tracker probe worker failed at {w} workers "
+                        f"(exitcodes {[p.exitcode for p in procs]})"
+                    )
+            finally:
+                for p in procs:
+                    if p.is_alive():
+                        p.terminate()
+                    p.join(timeout=10)
+                server.stop()
+            results[w] = (w * ops_per_worker) / max(wall, 1e-9)
+    except Exception as e:
+        return {"tracker_scaling_error": str(e)[:120]}
+    base = results[workers[0]]
+    out = {
+        "tracker_scaling": {
+            "workers": list(workers),
+            "ops_per_worker": ops_per_worker,
+            "aggregate_ops_per_s": {str(w): round(v) for w, v in results.items()},
+            "knobs": {
+                "metadata_shards": cfg.metadata_shards,
+                "metadata_shard_endpoints": cfg.metadata_shard_endpoints,
+                "metadata_batch_max": cfg.metadata_batch_max,
+                "metadata_snapshots": cfg.metadata_snapshots,
+            },
+            "baseline_aggregate_scaling_r05": 1.21,
+        },
+    }
+    for w, v in results.items():
+        if w != workers[0]:
+            out[f"tracker_scaling_{w}w"] = round(v / base, 2)
+    return out
+
+
 def transfer_plane_knobs():
     """The transfer-plane knobs the headline runs used (ShuffleConfig
     defaults) — recorded so BENCH rounds stay comparable when a default
@@ -1283,6 +1395,7 @@ def main():
         **chunked_fetch_gain(),
         **pipelined_commit_gain(),
         **coalesced_read_gain(),
+        **tracker_scaling(),
         **transfer_plane_knobs(),
         **scan_planner_knobs(),
         **load_calibration(),

@@ -101,6 +101,25 @@ class ShuffleChecksumBlockId(BlockId):
         )
 
 
+@dataclasses.dataclass(frozen=True)
+class ShuffleSnapshotBlockId(BlockId):
+    """The epoch-stamped map-output snapshot object of one shuffle
+    (metadata/snapshot.py) — published by the driver at map-stage close,
+    pulled once per worker. Per-shuffle (not per-map): ``map_id`` is pinned
+    to 0 purely for prefix sharding. The ``.snapmeta`` suffix keeps it
+    invisible to index listing (``parse_index_name``) and to the orphan
+    sweep (``parse_shuffle_object_name``), while living under the shuffle
+    prefix so ``remove_shuffle`` reclaims it."""
+
+    shuffle_id: int
+    epoch: int
+    map_id: int = 0
+
+    @property
+    def name(self) -> str:
+        return f"shuffle_{self.shuffle_id}_snapshot_{self.epoch}.snapmeta"
+
+
 _INDEX_RE = re.compile(r"^shuffle_(\d+)_(\d+)_(\d+)\.index$")
 _ANY_RE = re.compile(r"^shuffle_(\d+)_(\d+)_(\d+)\.(data|index|checksum\..+)$")
 

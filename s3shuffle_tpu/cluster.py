@@ -28,7 +28,7 @@ from typing import Any, Iterable, List, Sequence, Tuple
 
 from s3shuffle_tpu.config import ShuffleConfig
 from s3shuffle_tpu.dependency import ShuffleDependency
-from s3shuffle_tpu.metadata.service import MetadataServer, RemoteMapOutputTracker
+from s3shuffle_tpu.metadata.service import MetadataServer, stage_id_for
 
 logger = logging.getLogger("s3shuffle_tpu.cluster")
 
@@ -36,17 +36,50 @@ logger = logging.getLogger("s3shuffle_tpu.cluster")
 # Built once per worker process by the Pool initializer (one manager, one
 # coordinator connection per worker — not per task).
 _WORKER_MANAGER = None
+# Lazily-built snapshot facade over the worker manager's tracker: reduce
+# tasks that advertise a sealed shuffle's snapshot epoch serve their scan
+# lookups locally (zero tracker round-trips); one instance per process so
+# the snapshot is pulled once, not once per task.
+_WORKER_META = None
 
 
 def _init_worker(cfg_dict: dict, tracker_addr: Tuple[str, int]) -> None:
-    global _WORKER_MANAGER
+    global _WORKER_MANAGER, _WORKER_META
     from s3shuffle_tpu.manager import ShuffleManager
+    from s3shuffle_tpu.metadata.async_client import AsyncTrackerClient
     from s3shuffle_tpu.storage.dispatcher import Dispatcher
 
     Dispatcher.reset()  # fresh process; never inherit another config
     cfg = ShuffleConfig(**cfg_dict)
-    tracker = RemoteMapOutputTracker(tracker_addr)
+    # batched/pipelined control-plane client: registrations buffer and ride
+    # ONE RPC per commit; lookups fan over the coordinator's shard endpoints
+    tracker = AsyncTrackerClient(tracker_addr, batch_max=cfg.metadata_batch_max)
     _WORKER_MANAGER = ShuffleManager(config=cfg, tracker=tracker)
+    _WORKER_META = None
+
+
+def _worker_meta():
+    """The per-process snapshot-backed tracker facade (built on first use)."""
+    global _WORKER_META
+    if _WORKER_META is None:
+        from s3shuffle_tpu.metadata.snapshot import SnapshotBackedTracker
+
+        manager = _WORKER_MANAGER
+
+        def load(shuffle_id: int, epoch: int):
+            from s3shuffle_tpu.block_ids import ShuffleSnapshotBlockId
+
+            path = manager.dispatcher.get_path(
+                ShuffleSnapshotBlockId(shuffle_id, epoch)
+            )
+            try:
+                return manager.dispatcher.backend.read_all(path)
+            except (OSError, ValueError) as e:
+                logger.warning("snapshot object %s unreadable: %s", path, e)
+                return None
+
+        _WORKER_META = SnapshotBackedTracker(manager.tracker, loader=load)
+    return _WORKER_META
 
 
 def _run_map_task(args: Tuple[int, bytes, int, bytes]) -> int:
@@ -63,17 +96,59 @@ def _run_map_task(args: Tuple[int, bytes, int, bytes]) -> int:
     except BaseException:
         writer.stop(success=False)
         raise
+    # commit barrier: the buffered MapStatus registration must be durable on
+    # the coordinator BEFORE this task reports done (one RPC for the whole
+    # commit — a flush failure fails the task, which then retries)
+    manager.tracker.flush()
     return map_id
 
 
-def _run_reduce_task(args: Tuple[int, bytes, int]) -> bytes:
-    shuffle_id, dep_bytes, reduce_id = args
+def _run_reduce_task(args: Tuple[int, bytes, int, object]) -> bytes:
+    shuffle_id, dep_bytes, reduce_id, snap_epoch = args
     manager = _WORKER_MANAGER
     assert manager is not None, "worker pool missing _init_worker initializer"
     dep: ShuffleDependency = pickle.loads(dep_bytes)
+    tracker = None
+    if snap_epoch is not None:
+        meta = _worker_meta()
+        if meta.ensure(shuffle_id, int(snap_epoch)):
+            tracker = meta
     handle = manager.register_shuffle(shuffle_id, dep)
-    reader = manager.get_reader(handle, reduce_id, reduce_id + 1)
+    reader = manager.get_reader(handle, reduce_id, reduce_id + 1, tracker=tracker)
     return pickle.dumps(list(reader.read()), protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def publish_snapshot(tracker, config: ShuffleConfig, shuffle_id: int):
+    """Freeze the (coordinator-side) tracker's map-output table for one
+    SEALED shuffle and publish it as a store object — the epoch-stamped
+    snapshot workers pull once instead of asking the tracker per scan.
+    Returns the stamped epoch (the value to advertise in reduce task
+    descriptors), or None when snapshots are disabled or publication failed
+    (workers then stay on the live-RPC path — strictly the old behavior)."""
+    if not config.metadata_snapshots:
+        return None
+    from s3shuffle_tpu.block_ids import ShuffleSnapshotBlockId
+    from s3shuffle_tpu.metadata.snapshot import build_snapshot
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+
+    try:
+        snap = build_snapshot(tracker, shuffle_id)
+        dispatcher = Dispatcher.get(config)
+        path = dispatcher.get_path(ShuffleSnapshotBlockId(shuffle_id, snap.epoch))
+        with dispatcher.backend.create(path) as sink:
+            sink.write(snap.to_bytes())
+        logger.info(
+            "published map-output snapshot for shuffle %d at epoch %d "
+            "(%d entries)",
+            shuffle_id, snap.epoch, len(snap.entries),
+        )
+        return snap.epoch
+    except Exception:
+        logger.warning(
+            "snapshot publication for shuffle %d failed; reduce scans fall "
+            "back to live tracker RPCs", shuffle_id, exc_info=True,
+        )
+        return None
 
 
 class LocalCluster:
@@ -87,7 +162,10 @@ class LocalCluster:
     def __init__(self, config: ShuffleConfig, num_workers: int = 2):
         self.config = config
         self.num_workers = max(1, num_workers)
-        self.server = MetadataServer().start()
+        self.server = MetadataServer(
+            shards=config.metadata_shards,
+            shard_endpoints=config.metadata_shard_endpoints,
+        ).start()
         self._cfg_dict = dataclasses.asdict(config)
         self._ctx = mp.get_context("spawn")
         self._next_shuffle_id = 0
@@ -121,10 +199,16 @@ class LocalCluster:
             done = pool.map(_run_map_task, map_args)
         logger.info("map stage done: %d tasks (workers now dead)", len(done))
 
+        # the map stage is the epoch barrier: publish the sealed shuffle's
+        # map-output snapshot through the storage plane so reduce workers
+        # serve their scan lookups locally (zero tracker round-trips)
+        snap_epoch = publish_snapshot(self.server.tracker, self.config, shuffle_id)
+
         # map-stage workers are gone; a fresh pool serves the reduce stage —
         # the read path may only depend on the store + metadata service.
         reduce_args = [
-            (shuffle_id, dep_bytes, rid) for rid in range(dep.num_partitions)
+            (shuffle_id, dep_bytes, rid, snap_epoch)
+            for rid in range(dep.num_partitions)
         ]
         with self._ctx.Pool(self.num_workers, *init) as pool:
             blobs = pool.map(_run_reduce_task, reduce_args)
@@ -160,7 +244,11 @@ class DistributedDriver:
         from s3shuffle_tpu.storage.dispatcher import Dispatcher
 
         self.config = config
-        self.server = MetadataServer(host=host, port=port).start()
+        self.server = MetadataServer(
+            host=host, port=port,
+            shards=config.metadata_shards,
+            shard_endpoints=config.metadata_shard_endpoints,
+        ).start()
         self.dispatcher = Dispatcher.get(config)
         self._next_shuffle_id = 0
 
@@ -232,7 +320,7 @@ class DistributedDriver:
             write_input_object(self.dispatcher.backend, path, batch)
             input_paths.append(path)
 
-        map_stage = f"shuffle{shuffle_id}-map"
+        map_stage = stage_id_for(shuffle_id, "map")
         self.server.task_queue.submit_stage(
             map_stage,
             [
@@ -254,13 +342,19 @@ class DistributedDriver:
             logger.warning("orphan sweep failed for shuffle %d", shuffle_id,
                            exc_info=True)
 
+        # the map stage is this shuffle's epoch barrier: seal it with a
+        # store-published snapshot and advertise (epoch) to reduce tasks so
+        # their scans run with zero tracker round-trips
+        snap_epoch = publish_snapshot(self.server.tracker, self.config, shuffle_id)
+
         out_paths = [self._scratch(shuffle_id, f"output_{r}") for r in range(dep.num_partitions)]
-        reduce_stage = f"shuffle{shuffle_id}-reduce"
+        reduce_stage = stage_id_for(shuffle_id, "reduce")
         self.server.task_queue.submit_stage(
             reduce_stage,
             [
                 {"task_id": r, "kind": "reduce", "shuffle_id": shuffle_id,
-                 "reduce_id": r, "dep": desc, "output_path": p}
+                 "reduce_id": r, "dep": desc, "output_path": p,
+                 **({"snapshot": {"epoch": snap_epoch}} if snap_epoch is not None else {})}
                 for r, p in enumerate(out_paths)
             ],
         )

@@ -112,6 +112,23 @@ class ShuffleConfig:
     storage_retry_base_ms: float = 50.0
     # wall-clock budget per op including backoff sleeps; 0 = unbounded
     storage_op_deadline_s: float = 30.0
+    # --- control plane (TPU-first addition; the reference delegates to the
+    # Spark driver's MapOutputTracker RPC + broadcast) ---
+    # tracker shard count on the coordinator: the shuffle/map keyspace is
+    # hashed across this many independent lock domains, so concurrent
+    # registrations/lookups stop serializing on one lock. 1 = flat tracker.
+    metadata_shards: int = 4
+    # EXTRA coordinator listener sockets (each its own accept loop) that
+    # batched clients spread connections across; 0 = primary socket only
+    metadata_shard_endpoints: int = 0
+    # registrations buffered client-side before an automatic batch flush
+    # (flushes also happen at every commit barrier and before any read)
+    metadata_batch_max: int = 64
+    # publish an epoch-stamped map-output snapshot through the storage plane
+    # when a map stage completes; workers pull it once and serve reduce-scan
+    # lookups locally (zero tracker round-trips). false = every lookup is a
+    # live RPC (the pre-snapshot behavior).
+    metadata_snapshots: bool = True
     # --- caches ---
     cache_partition_lengths: bool = True
     cache_checksums: bool = True
@@ -162,6 +179,10 @@ class ShuffleConfig:
             or self.storage_op_deadline_s < 0
         ):
             raise ValueError("storage retry knobs must be >= 0")
+        if self.metadata_shards < 1 or self.metadata_batch_max < 1:
+            raise ValueError("metadata_shards / metadata_batch_max must be >= 1")
+        if self.metadata_shard_endpoints < 0:
+            raise ValueError("metadata_shard_endpoints must be >= 0")
         algo = self.checksum_algorithm.upper()
         if algo not in ("ADLER32", "CRC32", "CRC32C"):
             # Parity: reference supports ADLER32 & CRC32 only and raises

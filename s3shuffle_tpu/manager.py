@@ -175,16 +175,19 @@ class ShuffleManager:
         end_partition: int,
         start_map_index: int = 0,
         end_map_index: Optional[int] = None,
+        tracker: Optional[MapOutputTrackerLike] = None,
     ) -> ShuffleReader:
         """Parity: getReader / getReaderForRange (scala :73-111). In
         fallback-fetch mode the reference delegates to Spark's
         BlockStoreShuffleReader over FallbackStorage paths (:82-99); here the
         same reader runs over the fallback path layout (the dispatcher maps
-        paths accordingly)."""
+        paths accordingly). ``tracker`` overrides the manager's tracker for
+        this one reader — the worker's snapshot-backed facade rides here so
+        a sealed shuffle's scan enumerates blocks with zero tracker RPCs."""
         return ShuffleReader(
             self.dispatcher,
             self.helper,
-            self.tracker,
+            tracker if tracker is not None else self.tracker,
             handle.dependency,
             start_partition,
             end_partition,

@@ -1,3 +1,15 @@
 from s3shuffle_tpu.metadata.helper import ShuffleHelper
+from s3shuffle_tpu.metadata.shard import ShardedMapOutputTracker
+from s3shuffle_tpu.metadata.snapshot import (
+    MapOutputSnapshot,
+    SnapshotBackedTracker,
+    build_snapshot,
+)
 
-__all__ = ["ShuffleHelper"]
+__all__ = [
+    "ShuffleHelper",
+    "ShardedMapOutputTracker",
+    "MapOutputSnapshot",
+    "SnapshotBackedTracker",
+    "build_snapshot",
+]

@@ -62,8 +62,11 @@ def dedupe_latest_attempt(items, logical_of, map_id_of):
 
 class MapOutputTrackerLike(Protocol):
     """The tracker contract the manager/reader depend on — satisfied by the
-    in-process :class:`MapOutputTracker` and the TCP
-    :class:`~s3shuffle_tpu.metadata.service.RemoteMapOutputTracker`."""
+    in-process :class:`MapOutputTracker`, the sharded
+    :class:`~s3shuffle_tpu.metadata.shard.ShardedMapOutputTracker`, the TCP
+    :class:`~s3shuffle_tpu.metadata.service.RemoteMapOutputTracker`, and the
+    snapshot-serving
+    :class:`~s3shuffle_tpu.metadata.snapshot.SnapshotBackedTracker`."""
 
     def register_shuffle(self, shuffle_id: int, num_partitions: int) -> None: ...
 
@@ -78,6 +81,14 @@ class MapOutputTrackerLike(Protocol):
         end_partition: int,
     ) -> List[Tuple[int, List[Tuple[int, int]]]]: ...
 
+    def get_map_sizes_by_ranges(
+        self,
+        shuffle_id: int,
+        start_map_index: int,
+        end_map_index: Optional[int],
+        partition_ranges: List[Tuple[int, int]],
+    ) -> List[List[Tuple[int, List[Tuple[int, int]]]]]: ...
+
     def contains(self, shuffle_id: int) -> bool: ...
 
     def num_partitions(self, shuffle_id: int) -> int: ...
@@ -89,28 +100,98 @@ class MapOutputTrackerLike(Protocol):
     def shuffle_ids(self) -> List[int]: ...
 
 
+def sizes_for_ranges(
+    deduped: List[Tuple[int, MapStatus]],
+    start_map_index: int,
+    end_map_index: Optional[int],
+    partition_ranges: List[Tuple[int, int]],
+) -> List[List[Tuple[int, List[Tuple[int, int]]]]]:
+    """Answer a batch of partition-range queries from one deduped
+    ``[(map_index, status), ...]`` list — one result list per requested
+    ``(start_partition, end_partition)`` range, each in the shape
+    ``get_map_sizes_by_range`` returns. Shared by the plain tracker, the
+    sharded tracker, and the snapshot so every enumeration surface answers
+    identically from identical state."""
+    selected = [
+        status
+        for map_index, status in deduped
+        if map_index >= start_map_index
+        and (end_map_index is None or map_index < end_map_index)
+    ]
+    return [
+        [
+            (
+                status.map_id,
+                [(rid, int(status.sizes[rid])) for rid in range(sp, ep)],
+            )
+            for status in selected
+        ]
+        for sp, ep in partition_ranges
+    ]
+
+
 class MapOutputTracker:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._shuffles: Dict[int, Dict[int, MapStatus]] = {}
         self._num_partitions: Dict[int, int] = {}
+        self._epochs: Dict[int, int] = {}
 
     def register_shuffle(self, shuffle_id: int, num_partitions: int) -> None:
         with self._lock:
             self._shuffles.setdefault(shuffle_id, {})
             self._num_partitions[shuffle_id] = num_partitions
+            self._epochs.setdefault(shuffle_id, 0)
 
     def register_map_output(self, shuffle_id: int, status: MapStatus) -> None:
         with self._lock:
             if shuffle_id not in self._shuffles:
                 raise KeyError(f"Shuffle {shuffle_id} not registered")
             self._shuffles[shuffle_id][status.map_id] = status
+            self._epochs[shuffle_id] = self._epochs.get(shuffle_id, 0) + 1
+
+    def register_map_outputs(
+        self, shuffle_id: int, statuses: List[MapStatus]
+    ) -> None:
+        """Batch registration: one lock acquisition for a whole commit's
+        outputs — the server-side half of the batched-RPC path."""
+        with self._lock:
+            if shuffle_id not in self._shuffles:
+                raise KeyError(f"Shuffle {shuffle_id} not registered")
+            table = self._shuffles[shuffle_id]
+            for status in statuses:
+                table[status.map_id] = status
+            self._epochs[shuffle_id] = self._epochs.get(shuffle_id, 0) + len(statuses)
 
     def contains(self, shuffle_id: int) -> bool:
         return shuffle_id in self._shuffles
 
     def num_partitions(self, shuffle_id: int) -> int:
         return self._num_partitions[shuffle_id]
+
+    def epoch(self, shuffle_id: int) -> int:
+        """Monotonic registration counter for one shuffle — the snapshot
+        staleness stamp: a snapshot built at epoch E answers exactly the
+        state any lookup at epoch E would see."""
+        with self._lock:
+            if shuffle_id not in self._shuffles:
+                raise KeyError(f"Shuffle {shuffle_id} not registered")
+            return self._epochs.get(shuffle_id, 0)
+
+    def deduped_statuses(self, shuffle_id: int) -> List[Tuple[int, MapStatus]]:
+        """One winner per logical map index, ``[(map_index, status), ...]``
+        in sorted logical order — the canonical enumeration every range
+        query and snapshot build starts from."""
+        with self._lock:
+            if shuffle_id not in self._shuffles:
+                raise KeyError(f"Shuffle {shuffle_id} not registered")
+            # one winner per logical index (the commit fence enforces it);
+            # defensively keep the latest-registered attempt if ever two
+            return dedupe_latest_attempt(
+                list(self._shuffles[shuffle_id].values()),
+                logical_of=lambda s: s.map_index,
+                map_id_of=lambda s: s.map_id,
+            )
 
     def get_map_sizes_by_range(
         self,
@@ -125,29 +206,27 @@ class MapOutputTracker:
         returns, minus executor locations (everything is STORE_LOCATION).
         The range filters on the LOGICAL ``map_index`` (Spark's mapIndex);
         the returned ``map_id`` stays attempt-unique — it names the store
-        objects."""
-        with self._lock:
-            if shuffle_id not in self._shuffles:
-                raise KeyError(f"Shuffle {shuffle_id} not registered")
-            # one winner per logical index (the commit fence enforces it);
-            # defensively keep the latest-registered attempt if ever two
-            deduped = dedupe_latest_attempt(
-                self._shuffles[shuffle_id].values(),
-                logical_of=lambda s: s.map_index,
-                map_id_of=lambda s: s.map_id,
-            )
-            out = []
-            for map_index, status in deduped:
-                if map_index < start_map_index:
-                    continue
-                if end_map_index is not None and map_index >= end_map_index:
-                    continue
-                sizes = [
-                    (rid, int(status.sizes[rid]))
-                    for rid in range(start_partition, end_partition)
-                ]
-                out.append((status.map_id, sizes))
-            return out
+        objects. Delegates to the batch form."""
+        return self.get_map_sizes_by_ranges(
+            shuffle_id, start_map_index, end_map_index,
+            [(start_partition, end_partition)],
+        )[0]
+
+    def get_map_sizes_by_ranges(
+        self,
+        shuffle_id: int,
+        start_map_index: int,
+        end_map_index: Optional[int],
+        partition_ranges: List[Tuple[int, int]],
+    ) -> List[List[Tuple[int, List[Tuple[int, int]]]]]:
+        """Batch form of :meth:`get_map_sizes_by_range`: one result list per
+        requested ``(start_partition, end_partition)`` range, resolved from
+        ONE pass over the shuffle's deduped statuses — a reduce task that
+        needs several partition ranges asks once instead of once per range."""
+        return sizes_for_ranges(
+            self.deduped_statuses(shuffle_id),
+            start_map_index, end_map_index, list(partition_ranges),
+        )
 
     def registered_map_ids(self, shuffle_id: int) -> List[int]:
         """The attempt-unique map_ids of every REGISTERED (committed) map
@@ -159,9 +238,16 @@ class MapOutputTracker:
             return sorted(self._shuffles[shuffle_id].keys())
 
     def unregister_shuffle(self, shuffle_id: int) -> None:
+        # NOTE: the local-mode tracker deliberately does NOT drop the
+        # shuffle's ShuffleStats here — reading the report after a context
+        # teardown is a documented flow (test_metrics end-to-end), and the
+        # collector is LRU-bounded regardless. The COORDINATOR paths
+        # (ShardedMapOutputTracker / the service's unregister dispatch) do
+        # drop eagerly: that process aggregates for the whole fleet.
         with self._lock:
             self._shuffles.pop(shuffle_id, None)
             self._num_partitions.pop(shuffle_id, None)
+            self._epochs.pop(shuffle_id, None)
 
     def shuffle_ids(self) -> List[int]:
         with self._lock:

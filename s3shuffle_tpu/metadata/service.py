@@ -18,6 +18,7 @@ code-execution channel.
 
 from __future__ import annotations
 
+import base64
 import json
 import logging
 import random
@@ -31,11 +32,29 @@ from typing import Any, List, Optional, Tuple
 import numpy as np
 
 from s3shuffle_tpu.metadata.map_output import MapOutputTracker, MapStatus
+from s3shuffle_tpu.metrics import registry as _metrics
 
 logger = logging.getLogger("s3shuffle_tpu.metadata.service")
 
+_C_RPC = _metrics.REGISTRY.counter(
+    "meta_rpc_total",
+    "Control-plane RPC round-trips issued by this process, by method and "
+    "client shard connection",
+    labelnames=("method", "shard"),
+)
+
 _LEN = struct.Struct("<I")
 _MAX_FRAME = 64 << 20
+
+
+def stage_id_for(shuffle_id: int, phase: str) -> str:
+    """Canonical stage-id convention (``shuffle<id>-<phase>``) — shared by
+    the driver's stage submission and :meth:`TaskQueue.drop_shuffle`, so
+    shuffle teardown can find every stage that belongs to it."""
+    return f"shuffle{int(shuffle_id)}-{phase}"
+
+
+_STAGE_PREFIX_OF = "shuffle{}-"
 
 
 def _send_frame(sock: socket.socket, obj: Any) -> None:
@@ -276,6 +295,19 @@ class TaskQueue:
         with self._lock:
             self._stages.pop(stage_id, None)
 
+    def drop_shuffle(self, shuffle_id: int) -> int:
+        """Drop every stage belonging to one shuffle (the ``stage_id_for``
+        convention) — wired into ``unregister_shuffle`` dispatch so a
+        long-lived coordinator doesn't accumulate dead stage state (done/
+        failed tables, attempt counters) for shuffles that no longer exist.
+        Returns the number of stages dropped."""
+        prefix = _STAGE_PREFIX_OF.format(int(shuffle_id))
+        with self._lock:
+            doomed = [s for s in self._stages if s.startswith(prefix)]
+            for stage_id in doomed:
+                self._stages.pop(stage_id, None)
+            return len(doomed)
+
     def stop_workers(self) -> None:
         with self._lock:
             self._stopping = True
@@ -370,8 +402,7 @@ class _Handler(socketserver.BaseRequestHandler):
             return queue.stop_workers()
         raise RuntimeError(f"Unknown method: {method}")
 
-    @staticmethod
-    def _dispatch(tracker: MapOutputTracker, req: Any):
+    def _dispatch(self, tracker: MapOutputTracker, req: Any):
         method = req.get("method")
         a = req.get("args", [])
         if method == "ping":
@@ -404,17 +435,63 @@ class _Handler(socketserver.BaseRequestHandler):
                 map_index=int(map_index),
             )
             return tracker.register_map_output(int(shuffle_id), status)
+        if method == "register_map_outputs":
+            # batched form: ONE RPC for a whole commit's outputs. Every entry
+            # must carry map_index (format-2) — same contract as the single
+            # registration path.
+            shuffle_id, entries = int(a[0]), list(a[1])
+            statuses = []
+            for entry in entries:
+                if len(entry) < 4:
+                    raise RuntimeError(
+                        "register_map_outputs entry without map_index: client "
+                        "speaks an older shuffle format; deploy one version "
+                        "per job (see version.SHUFFLE_FORMAT_VERSION)"
+                    )
+                map_id, location, sizes, map_index = entry[:4]
+                statuses.append(
+                    MapStatus(
+                        map_id=int(map_id),
+                        location=str(location),
+                        sizes=np.asarray(sizes, dtype=np.int64),
+                        map_index=int(map_index),
+                    )
+                )
+            return tracker.register_map_outputs(shuffle_id, statuses)
         if method == "get_map_sizes_by_range":
             shuffle_id, smi, emi, sp, ep = a
             return tracker.get_map_sizes_by_range(
                 int(shuffle_id), int(smi), None if emi is None else int(emi), int(sp), int(ep)
             )
+        if method == "get_map_sizes_by_ranges":
+            shuffle_id, smi, emi, ranges = a
+            return tracker.get_map_sizes_by_ranges(
+                int(shuffle_id), int(smi), None if emi is None else int(emi),
+                [(int(sp), int(ep)) for sp, ep in ranges],
+            )
+        if method == "epoch":
+            return tracker.epoch(int(a[0]))
+        if method == "get_snapshot":
+            return self.server.snapshots.get_wire(tracker, int(a[0]))  # type: ignore[attr-defined]
+        if method == "shard_addresses":
+            return [list(addr) for addr in self.server.shard_addresses]  # type: ignore[attr-defined]
         if method == "contains":
             return tracker.contains(int(a[0]))
         if method == "num_partitions":
             return tracker.num_partitions(int(a[0]))
         if method == "unregister_shuffle":
-            return tracker.unregister_shuffle(int(a[0]))
+            sid = int(a[0])
+            # full teardown: tracker state (which drops ShuffleStats), this
+            # shuffle's dead TaskQueue stages, and any cached snapshot — a
+            # long-lived coordinator session must stay bounded across
+            # millions of shuffles
+            queue: TaskQueue = self.server.task_queue  # type: ignore[attr-defined]
+            queue.drop_shuffle(sid)
+            self.server.snapshots.drop(sid)  # type: ignore[attr-defined]
+            from s3shuffle_tpu.metrics.stats import COLLECTOR
+
+            COLLECTOR.drop(sid)  # idempotent with the sharded tracker's drop
+            return tracker.unregister_shuffle(sid)
         if method == "registered_map_ids":
             return tracker.registered_map_ids(int(a[0]))
         if method == "shuffle_ids":
@@ -431,36 +508,106 @@ class _Server(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
 
 
+class SnapshotCache:
+    """Coordinator-side cache of serialized map-output snapshots, keyed by
+    (shuffle, epoch) — ``get_snapshot`` is served from here when the
+    tracker's epoch hasn't moved, so N workers asking for the same sealed
+    shuffle cost one serialization, not N."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._by_shuffle: dict = {}  # shuffle_id -> (epoch, bytes)
+
+    def get_wire(self, tracker, shuffle_id: int) -> dict:
+        """``{"epoch": int, "data_b64": str}`` at the tracker's CURRENT
+        epoch (re-serialized only when the epoch moved)."""
+        from s3shuffle_tpu.metadata.snapshot import build_snapshot
+
+        epoch = tracker.epoch(shuffle_id)
+        with self._lock:
+            cached = self._by_shuffle.get(shuffle_id)
+            if cached is not None and cached[0] == epoch:
+                data = cached[1]
+            else:
+                data = build_snapshot(tracker, shuffle_id).to_bytes()
+                self._by_shuffle[shuffle_id] = (epoch, data)
+        return {"epoch": epoch, "data_b64": base64.b64encode(data).decode("ascii")}
+
+    def drop(self, shuffle_id: int) -> None:
+        with self._lock:
+            self._by_shuffle.pop(shuffle_id, None)
+
+
 class MetadataServer:
-    """Hosts a MapOutputTracker over TCP. Start on the coordinator process;
-    workers connect with :class:`RemoteMapOutputTracker`."""
+    """Hosts a (sharded) map-output tracker over TCP. Start on the
+    coordinator process; workers connect with
+    :class:`RemoteMapOutputTracker` (or the batched
+    :class:`~s3shuffle_tpu.metadata.async_client.AsyncTrackerClient`).
+
+    ``shards`` partitions the tracker keyspace across independent lock
+    domains (see :mod:`s3shuffle_tpu.metadata.shard`); ``shard_endpoints``
+    additionally binds that many EXTRA listener sockets — each with its own
+    accept loop — sharing the same tracker/queue, so clients can spread
+    connections instead of queueing on one accept loop. Endpoints are
+    advertised via the ``shard_addresses`` RPC. ``shards=1`` with no extra
+    endpoints reproduces the pre-sharding topology exactly (a plain tracker
+    is still accepted via ``tracker=``).
+    """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 tracker: Optional[MapOutputTracker] = None):
-        self.tracker = tracker or MapOutputTracker()
+                 tracker=None, shards: int = 4, shard_endpoints: int = 0):
+        from s3shuffle_tpu.metadata.shard import ShardedMapOutputTracker
+
+        self.tracker = tracker or ShardedMapOutputTracker(max(1, int(shards)))
         self.task_queue = TaskQueue()
+        self.snapshots = SnapshotCache()
         self._server = _Server((host, port), _Handler)
-        self._server.tracker = self.tracker  # type: ignore[attr-defined]
-        self._server.task_queue = self.task_queue  # type: ignore[attr-defined]
-        self._thread: Optional[threading.Thread] = None
+        self._shard_servers = [
+            _Server((host, 0), _Handler) for _ in range(max(0, int(shard_endpoints)))
+        ]
+        for srv in self._all_servers():
+            srv.tracker = self.tracker  # type: ignore[attr-defined]
+            srv.task_queue = self.task_queue  # type: ignore[attr-defined]
+            srv.snapshots = self.snapshots  # type: ignore[attr-defined]
+            srv.shard_addresses = []  # type: ignore[attr-defined]
+        addrs = [srv.server_address[:2] for srv in self._shard_servers]
+        for srv in self._all_servers():
+            srv.shard_addresses = addrs  # type: ignore[attr-defined]
+        self._threads: List[threading.Thread] = []
+
+    def _all_servers(self) -> List[_Server]:
+        return [self._server, *self._shard_servers]
 
     @property
     def address(self) -> Tuple[str, int]:
         return self._server.server_address[:2]
 
+    @property
+    def shard_addresses(self) -> List[Tuple[str, int]]:
+        return [srv.server_address[:2] for srv in self._shard_servers]
+
     def start(self) -> "MetadataServer":
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, name="s3shuffle-metadata", daemon=True
+        for i, srv in enumerate(self._all_servers()):
+            thread = threading.Thread(
+                target=srv.serve_forever,
+                name=f"s3shuffle-metadata-{i}" if i else "s3shuffle-metadata",
+                daemon=True,
+            )
+            thread.start()
+            self._threads.append(thread)
+        logger.info(
+            "Metadata service listening on %s:%d (+%d shard endpoints)",
+            *self.address, len(self._shard_servers),
         )
-        self._thread.start()
-        logger.info("Metadata service listening on %s:%d", *self.address)
         return self
 
     def stop(self) -> None:
-        self._server.shutdown()
-        self._server.server_close()
-        if self._thread:
-            self._thread.join(timeout=5)
+        for srv in self._all_servers():
+            srv.shutdown()
+            srv.server_close()
+        for thread in self._threads:
+            thread.join(timeout=5)
+        self._threads = []
 
 
 class RemoteMapOutputTracker:
@@ -484,9 +631,12 @@ class RemoteMapOutputTracker:
         retries: int = 4,
         retry_base_ms: float = 100.0,
         retry_deadline_s: float = 10.0,
+        shard_label: str = "0",
     ):
         self.address = (address[0], int(address[1]))
         self.timeout = timeout
+        #: which client connection this is (``meta_rpc_total``'s shard label)
+        self.shard_label = str(shard_label)
         self.retries = int(retries)
         self.retry_base_ms = float(retry_base_ms)
         self.retry_deadline_s = float(retry_deadline_s)
@@ -517,6 +667,8 @@ class RemoteMapOutputTracker:
         return sock
 
     def _call(self, method: str, *args):
+        if _metrics.enabled():
+            _C_RPC.labels(method=method, shard=self.shard_label).inc()
         policy = self._retry_policy
         with self._lock:
             deadline = (
@@ -595,6 +747,17 @@ class RemoteMapOutputTracker:
             status.map_index,
         )
 
+    def register_map_outputs(self, shuffle_id: int, statuses: List[MapStatus]) -> None:
+        """Batched registration: ONE RPC for a whole commit's outputs."""
+        self._call(
+            "register_map_outputs",
+            shuffle_id,
+            [
+                [s.map_id, s.location, np.asarray(s.sizes).tolist(), s.map_index]
+                for s in statuses
+            ],
+        )
+
     def get_map_sizes_by_range(
         self,
         shuffle_id: int,
@@ -609,6 +772,43 @@ class RemoteMapOutputTracker:
         )
         # JSON turns tuples into lists; restore the documented shape
         return [(int(m), [(int(r), int(n)) for r, n in sizes]) for m, sizes in raw]
+
+    def get_map_sizes_by_ranges(
+        self,
+        shuffle_id: int,
+        start_map_index: int,
+        end_map_index: Optional[int],
+        partition_ranges: List[Tuple[int, int]],
+    ) -> List[List[Tuple[int, List[Tuple[int, int]]]]]:
+        """Batch form: one RPC answers several partition ranges at once —
+        a reduce task spanning multiple ranges asks once, not once per
+        range."""
+        raw = self._call(
+            "get_map_sizes_by_ranges",
+            shuffle_id, start_map_index, end_map_index,
+            [[int(sp), int(ep)] for sp, ep in partition_ranges],
+        )
+        return [
+            [(int(m), [(int(r), int(n)) for r, n in sizes]) for m, sizes in one]
+            for one in raw
+        ]
+
+    def epoch(self, shuffle_id: int) -> int:
+        return int(self._call("epoch", shuffle_id))
+
+    def get_snapshot(self, shuffle_id: int) -> Tuple[int, bytes]:
+        """``(epoch, serialized snapshot bytes)`` at the coordinator's
+        current epoch — the RPC fallback when the storage-plane snapshot
+        object isn't reachable."""
+        import base64 as _b64
+
+        resp = self._call("get_snapshot", shuffle_id)
+        return int(resp["epoch"]), _b64.b64decode(resp["data_b64"])
+
+    def shard_addresses(self) -> List[Tuple[str, int]]:
+        """Extra coordinator listener endpoints (empty when the server
+        binds only the primary socket)."""
+        return [(str(h), int(p)) for h, p in self._call("shard_addresses")]
 
     def contains(self, shuffle_id: int) -> bool:
         return bool(self._call("contains", shuffle_id))

@@ -1,0 +1,202 @@
+"""Sharded map-output tracker — the partitioned half of the control plane.
+
+The single :class:`~s3shuffle_tpu.metadata.map_output.MapOutputTracker`
+serializes every registration and lookup on ONE lock; at fleet scale that
+lock (and the one socket loop in front of it) is the coordinator hotspot
+BlobShuffle (PAPERS.md) argues object-storage shuffles must avoid — the
+BENCH trajectory showed it directly (aggregate_scaling 1.21 at 4 workers).
+This module partitions the keyspace instead: the shuffle/map keyspace is
+hashed across N independent shard states — each shard IS a plain
+:class:`MapOutputTracker` with its own lock — so concurrent registrations
+from different map tasks contend only when they land on the same shard.
+
+Routing hashes the LOGICAL ``map_index`` (never the attempt-strided
+``map_id``), so every attempt of one logical map task lands on the same
+shard and per-shard latest-attempt dedupe stays correct. Range lookups fan
+across shards and merge; a defensive global re-dedupe keeps the merged
+answer identical to what one flat tracker would return even if the routing
+function ever changes between releases.
+
+Epoch stamping lives here (not per shard): a per-shuffle monotonic counter
+incremented on every registration, read by the snapshot publisher
+(:mod:`s3shuffle_tpu.metadata.snapshot`) to stamp immutable map-output
+snapshots — the staleness contract workers use to decide snapshot-vs-RPC.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from s3shuffle_tpu.metadata.map_output import (
+    MapOutputTracker,
+    MapStatus,
+    dedupe_latest_attempt,
+    sizes_for_ranges,
+)
+
+#: Knuth multiplicative constant — spreads sequential map indices across
+#: shards instead of striding them onto one (map indices arrive 0,1,2,...).
+_HASH_MULT = 2654435761
+
+
+def shard_of(shuffle_id: int, map_index: int, num_shards: int) -> int:
+    """Deterministic shard routing on (shuffle, LOGICAL map index)."""
+    return ((shuffle_id * 1000003 + map_index) * _HASH_MULT) % (1 << 32) % num_shards
+
+
+class ShardedMapOutputTracker:
+    """MapOutputTracker-compatible tracker partitioned across N shards.
+
+    Satisfies :class:`~s3shuffle_tpu.metadata.map_output.MapOutputTrackerLike`
+    plus the stats-aggregation surface the metadata service dispatches to, so
+    it drops into :class:`~s3shuffle_tpu.metadata.service.MetadataServer`
+    unchanged.
+    """
+
+    def __init__(self, num_shards: int = 4):
+        if num_shards < 1:
+            raise ValueError("num_shards must be >= 1")
+        self.num_shards = int(num_shards)
+        self._shards = [MapOutputTracker() for _ in range(self.num_shards)]
+        # shuffle-level state (partition counts, epochs) is tiny and rarely
+        # written; one lock for it never contends with per-map registration
+        self._meta_lock = threading.Lock()
+        self._num_partitions: Dict[int, int] = {}
+        self._epochs: Dict[int, int] = {}
+
+    # -- routing -------------------------------------------------------
+    def shard_index(self, shuffle_id: int, map_index: int) -> int:
+        return shard_of(shuffle_id, map_index, self.num_shards)
+
+    def _shard(self, shuffle_id: int, map_index: int) -> MapOutputTracker:
+        return self._shards[self.shard_index(shuffle_id, map_index)]
+
+    # -- registration --------------------------------------------------
+    def register_shuffle(self, shuffle_id: int, num_partitions: int) -> None:
+        with self._meta_lock:
+            self._num_partitions[shuffle_id] = num_partitions
+            self._epochs.setdefault(shuffle_id, 0)
+        for shard in self._shards:
+            shard.register_shuffle(shuffle_id, num_partitions)
+
+    def register_map_output(self, shuffle_id: int, status: MapStatus) -> None:
+        self._shard(shuffle_id, status.map_index).register_map_output(
+            shuffle_id, status
+        )
+        with self._meta_lock:
+            if shuffle_id not in self._num_partitions:
+                return  # raced unregister; the shard raised if never known
+            self._epochs[shuffle_id] = self._epochs.get(shuffle_id, 0) + 1
+
+    def register_map_outputs(
+        self, shuffle_id: int, statuses: List[MapStatus]
+    ) -> None:
+        """Batch registration: group by shard, one lock acquisition per
+        shard touched — the server-side half of the batched-RPC path."""
+        by_shard: Dict[int, List[MapStatus]] = {}
+        for status in statuses:
+            by_shard.setdefault(
+                self.shard_index(shuffle_id, status.map_index), []
+            ).append(status)
+        for idx, group in by_shard.items():
+            self._shards[idx].register_map_outputs(shuffle_id, group)
+        with self._meta_lock:
+            if shuffle_id in self._num_partitions:
+                self._epochs[shuffle_id] = (
+                    self._epochs.get(shuffle_id, 0) + len(statuses)
+                )
+
+    # -- lookups -------------------------------------------------------
+    def contains(self, shuffle_id: int) -> bool:
+        with self._meta_lock:
+            return shuffle_id in self._num_partitions
+
+    def num_partitions(self, shuffle_id: int) -> int:
+        with self._meta_lock:
+            return self._num_partitions[shuffle_id]
+
+    def epoch(self, shuffle_id: int) -> int:
+        with self._meta_lock:
+            if shuffle_id not in self._num_partitions:
+                raise KeyError(f"Shuffle {shuffle_id} not registered")
+            return self._epochs.get(shuffle_id, 0)
+
+    def deduped_statuses(self, shuffle_id: int) -> List[Tuple[int, MapStatus]]:
+        """Merged ``[(map_index, status), ...]`` across all shards in sorted
+        logical order. Same-shard attempts already deduped per shard; the
+        global re-dedupe is a defensive no-op unless routing ever drifted."""
+        merged: List[Tuple[int, MapStatus]] = []
+        for shard in self._shards:
+            merged.extend(shard.deduped_statuses(shuffle_id))
+        deduped = dedupe_latest_attempt(
+            [status for _idx, status in merged],
+            logical_of=lambda s: s.map_index,
+            map_id_of=lambda s: s.map_id,
+        )
+        return deduped
+
+    def get_map_sizes_by_range(
+        self,
+        shuffle_id: int,
+        start_map_index: int,
+        end_map_index: Optional[int],
+        start_partition: int,
+        end_partition: int,
+    ) -> List[Tuple[int, List[Tuple[int, int]]]]:
+        return self.get_map_sizes_by_ranges(
+            shuffle_id, start_map_index, end_map_index,
+            [(start_partition, end_partition)],
+        )[0]
+
+    def get_map_sizes_by_ranges(
+        self,
+        shuffle_id: int,
+        start_map_index: int,
+        end_map_index: Optional[int],
+        partition_ranges: List[Tuple[int, int]],
+    ) -> List[List[Tuple[int, List[Tuple[int, int]]]]]:
+        return sizes_for_ranges(
+            self.deduped_statuses(shuffle_id),
+            start_map_index, end_map_index, list(partition_ranges),
+        )
+
+    def registered_map_ids(self, shuffle_id: int) -> List[int]:
+        ids: List[int] = []
+        for shard in self._shards:
+            ids.extend(shard.registered_map_ids(shuffle_id))
+        return sorted(ids)
+
+    def shuffle_ids(self) -> List[int]:
+        with self._meta_lock:
+            return sorted(self._num_partitions)
+
+    # -- lifecycle -----------------------------------------------------
+    def unregister_shuffle(self, shuffle_id: int) -> None:
+        with self._meta_lock:
+            self._num_partitions.pop(shuffle_id, None)
+            self._epochs.pop(shuffle_id, None)
+        for shard in self._shards:
+            shard.unregister_shuffle(shuffle_id)
+        # the sharded tracker is a COORDINATOR-side type: it aggregates the
+        # whole fleet's ShuffleStats, so a long-lived session (millions of
+        # shuffles) must drop the aggregate with the registration — callers
+        # wanting the final report read it BEFORE unregistering
+        from s3shuffle_tpu.metrics.stats import COLLECTOR
+
+        COLLECTOR.drop(shuffle_id)
+
+    # -- per-shuffle stats aggregation (metrics subsystem) -------------
+    # Same COLLECTOR delegation as the plain tracker: the sharded tracker is
+    # still ONE aggregation point per coordinator process.
+    def report_task_stats(self, entries: List[dict]) -> None:
+        from s3shuffle_tpu.metrics.stats import COLLECTOR
+
+        for entry in entries:
+            COLLECTOR.merge(entry)
+
+    def get_shuffle_stats(self, shuffle_id: int) -> Optional[dict]:
+        from s3shuffle_tpu.metrics.stats import COLLECTOR
+
+        report = COLLECTOR.report(int(shuffle_id))
+        return None if report is None else report.to_dict()

@@ -35,6 +35,12 @@ KNOWN_METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "storage_errors_total": ("counter", ("scheme", "op")),
     "storage_read_bytes_total": ("counter", ("scheme",)),
     "storage_write_bytes_total": ("counter", ("scheme",)),
+    # --- control plane: sharded tracker / batched client / snapshots
+    # (metadata/service.py, metadata/async_client.py, metadata/snapshot.py) ---
+    "meta_rpc_total": ("counter", ("method", "shard")),
+    "meta_batch_flush_seconds": ("histogram", ()),
+    "meta_snapshot_age_seconds": ("gauge", ()),
+    "meta_lookup_source_total": ("counter", ("source",)),
     # --- storage plane: classified retries (storage/retrying.py) ---
     "storage_retries_total": ("counter", ("op", "scheme")),
     "storage_retry_backoff_seconds": ("histogram", ()),

@@ -123,6 +123,11 @@ class ShuffleStatsCollector:
     #: outbox bound: entries awaiting a worker push; local-mode runs never
     #: drain it, so it must not grow with job length
     OUTBOX_MAX = 1024
+    #: per-shuffle aggregate bound: a long-lived session cycling through
+    #: shuffles keeps at most this many recent aggregates (insertion-order
+    #: eviction). Coordinators additionally drop eagerly at
+    #: unregister_shuffle; this is the backstop for everything else.
+    SHUFFLES_MAX = 512
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
@@ -130,16 +135,24 @@ class ShuffleStatsCollector:
         self._outbox: deque = deque(maxlen=self.OUTBOX_MAX)
         self._token = f"{os.getpid()}-{id(self):x}"
 
+    def _agg_locked(self, shuffle_id: int) -> ShuffleStats:
+        """Under the lock: get-or-create one shuffle's aggregate, evicting
+        the OLDEST aggregates past SHUFFLES_MAX (dict preserves insertion
+        order) so session memory stays bounded across unbounded shuffles."""
+        agg = self._per_shuffle.get(shuffle_id)
+        if agg is None:
+            while len(self._per_shuffle) >= self.SHUFFLES_MAX:
+                self._per_shuffle.pop(next(iter(self._per_shuffle)))
+            agg = self._per_shuffle[shuffle_id] = ShuffleStats(shuffle_id)
+        return agg
+
     # -- recording (data-plane hooks) ----------------------------------
     def record(self, ts: TaskStats) -> None:
         if not registry.enabled():
             return
         ts.origin = self._token
         with self._lock:
-            agg = self._per_shuffle.get(ts.shuffle_id)
-            if agg is None:
-                agg = self._per_shuffle[ts.shuffle_id] = ShuffleStats(ts.shuffle_id)
-            agg.add(ts)
+            self._agg_locked(ts.shuffle_id).add(ts)
             self._outbox.append(ts.to_dict())
 
     def record_map(
@@ -182,10 +195,7 @@ class ShuffleStatsCollector:
         if ts.origin == self._token:
             return
         with self._lock:
-            agg = self._per_shuffle.get(ts.shuffle_id)
-            if agg is None:
-                agg = self._per_shuffle[ts.shuffle_id] = ShuffleStats(ts.shuffle_id)
-            agg.add(ts)
+            self._agg_locked(ts.shuffle_id).add(ts)
 
     def drain_outbox(self) -> List[dict]:
         with self._lock:
@@ -222,6 +232,15 @@ class ShuffleStatsCollector:
         reports = self.reports()
         with open(path, "w") as f:
             json.dump({"shuffles": [r.to_dict() for r in reports]}, f)
+
+    def drop(self, shuffle_id: int) -> None:
+        """Forget one shuffle's aggregate — wired into tracker
+        ``unregister_shuffle`` so long-lived sessions don't accumulate stats
+        for shuffles that no longer exist. The outbox is left alone: entries
+        already drained to a coordinator stay counted there, and un-drained
+        local entries age out via the deque bound."""
+        with self._lock:
+            self._per_shuffle.pop(shuffle_id, None)
 
     def reset(self) -> None:
         with self._lock:

@@ -95,13 +95,15 @@ class ShuffleReader:
         if cfg.use_block_manager:
             if self.tracker is None:
                 raise RuntimeError("use_block_manager=True requires a MapOutputTracker")
-            entries = self.tracker.get_map_sizes_by_range(
+            # batch enumeration form: ONE control-plane round-trip for the
+            # whole scan (and with a snapshot-backed tracker, zero) — never
+            # one per partition
+            entries = self.tracker.get_map_sizes_by_ranges(
                 sid,
                 self.start_map_index,
                 self.end_map_index,
-                self.start_partition,
-                self.end_partition,
-            )
+                [(self.start_partition, self.end_partition)],
+            )[0]
             blocks: List[ReadableBlockId] = []
             for map_id, sizes in entries:
                 if self.do_batch_fetch:

@@ -134,7 +134,15 @@ class WorkerAgent:
                 self.config, map_id_attempt_stride=self.ATTEMPT_STRIDE
             )
         self.worker_id = worker_id or f"{socket.gethostname()}-{os.getpid()}"
-        self.manager = ShuffleManager(config=self.config, tracker=self.client)
+        # the manager's tracker is the snapshot-backed facade: once a reduce
+        # task advertises a sealed shuffle's snapshot (pulled ONCE through
+        # the storage plane), every enumeration lookup is served locally —
+        # zero tracker round-trips in steady state. Shuffles without a
+        # snapshot ride self.client exactly as before.
+        from s3shuffle_tpu.metadata.snapshot import SnapshotBackedTracker
+
+        self.meta = SnapshotBackedTracker(self.client, loader=self._load_snapshot)
+        self.manager = ShuffleManager(config=self.config, tracker=self.meta)
         self.tasks_run = 0
         # Refuse to join a coordinator speaking a different shuffle wire
         # format — mixed versions mis-partition silently (see version.py).
@@ -222,9 +230,50 @@ class WorkerAgent:
             "_map_output": captured.get("map_output"),
         }
 
+    def _load_snapshot(self, shuffle_id: int, epoch: int):
+        """Snapshot pull, storage plane first (one GET on the object the
+        driver published), RPC fallback (``get_snapshot``) second. Returns
+        the serialized bytes, or None if neither source can produce the
+        EXACT advertised epoch — the staleness contract: lookups then stay
+        on the live-RPC path rather than serve a mismatched table."""
+        from s3shuffle_tpu.block_ids import ShuffleSnapshotBlockId
+
+        dispatcher = self.manager.dispatcher
+        path = dispatcher.get_path(ShuffleSnapshotBlockId(shuffle_id, epoch))
+        try:
+            return dispatcher.backend.read_all(path)
+        except (OSError, ValueError) as e:
+            logger.warning(
+                "worker %s: snapshot object for shuffle %d epoch %d "
+                "unreadable (%s); falling back to RPC",
+                self.worker_id, shuffle_id, epoch, e,
+            )
+        try:
+            got_epoch, data = self.client.get_snapshot(shuffle_id)
+        except Exception as e:
+            logger.warning(
+                "worker %s: snapshot RPC for shuffle %d failed: %s",
+                self.worker_id, shuffle_id, e,
+            )
+            return None
+        return data if got_epoch == epoch else None
+
     def _run_reduce(self, task: dict, stage_id: str):
         shuffle_id = int(task["shuffle_id"])
         dep = dep_from_descriptor(shuffle_id, task["dep"])
+        snap = task.get("snapshot")
+        if snap:
+            if not self.meta.ensure(shuffle_id, int(snap["epoch"])):
+                logger.warning(
+                    "worker %s: no snapshot at epoch %s for shuffle %d — "
+                    "reduce scan falls back to live tracker RPCs",
+                    self.worker_id, snap.get("epoch"), shuffle_id,
+                )
+        else:
+            # no advertisement ⇒ live RPCs (the staleness contract): a
+            # leftover attachment from an earlier stage of this shuffle
+            # must not answer for a state the driver didn't vouch for
+            self.meta.detach(shuffle_id)
         handle = self.manager.register_shuffle(shuffle_id, dep)
         rid = int(task["reduce_id"])
         reader = self.manager.get_reader(handle, rid, rid + 1)

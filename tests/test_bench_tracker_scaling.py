@@ -1,0 +1,40 @@
+"""Tier-1 wiring for the control-plane scaling probe: the probe must run
+against a real sharded coordinator, record aggregate throughput per worker
+count plus the knob fields that make BENCH rounds comparable, and show the
+multi-worker aggregate above the single-worker one (the full-size bench run
+compares `tracker_scaling_4w` against the BENCH_r05 coordinator-bound
+`aggregate_scaling` 1.21 baseline)."""
+
+import bench
+
+
+def test_tracker_scaling_probe_records_and_scales():
+    # enough per-worker work that the measured wall dominates barrier/join
+    # scheduling noise (a few-ms wall made the direction check flaky);
+    # best-of-two attempts for the scaling direction on loaded CI hosts
+    out = bench.tracker_scaling(workers=(1, 2), n_maps=32, n_parts=8, lookups=12000)
+    assert "tracker_scaling_error" not in out, out
+    probe = out["tracker_scaling"]
+    assert probe["workers"] == [1, 2]
+    assert set(probe["aggregate_ops_per_s"]) == {"1", "2"}
+    assert all(v > 0 for v in probe["aggregate_ops_per_s"].values())
+    from s3shuffle_tpu.config import ShuffleConfig
+
+    cfg = ShuffleConfig()
+    assert probe["knobs"] == {
+        "metadata_shards": cfg.metadata_shards,
+        "metadata_shard_endpoints": cfg.metadata_shard_endpoints,
+        "metadata_batch_max": cfg.metadata_batch_max,
+        "metadata_snapshots": cfg.metadata_snapshots,
+    }
+    assert probe["baseline_aggregate_scaling_r05"] == 1.21
+    # direction check only at smoke size (the snapshot-served steady state
+    # is per-worker-local, so 2 workers must beat 1; the >= 1.21-at-4-workers
+    # gate is asserted on the full bench artifact)
+    scaling = out["tracker_scaling_2w"]
+    if scaling <= 1.0:  # one retry: a loaded host can starve one attempt
+        retry = bench.tracker_scaling(
+            workers=(1, 2), n_maps=32, n_parts=8, lookups=12000
+        )
+        scaling = max(scaling, retry.get("tracker_scaling_2w", 0.0))
+    assert scaling > 1.0, (scaling, out)

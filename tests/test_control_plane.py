@@ -1,0 +1,564 @@
+"""Tests for the sharded async control plane (PR 6): partitioned tracker,
+batched/pipelined RPC, and epoch-stamped snapshot distribution.
+
+The acceptance slice lives here too: a steady-state reduce scan over a
+completed (snapshot-published) shuffle performs ZERO tracker round-trips,
+asserted via ``meta_lookup_source_total``, with shuffle output identical to
+the pre-sharding path."""
+
+import random
+import threading
+
+import numpy as np
+import pytest
+
+from s3shuffle_tpu.config import ShuffleConfig
+from s3shuffle_tpu.metadata.map_output import (
+    STORE_LOCATION,
+    MapOutputTracker,
+    MapStatus,
+)
+from s3shuffle_tpu.metadata.service import (
+    MetadataServer,
+    RemoteMapOutputTracker,
+    stage_id_for,
+)
+from s3shuffle_tpu.metadata.shard import ShardedMapOutputTracker, shard_of
+from s3shuffle_tpu.metadata.snapshot import (
+    MapOutputSnapshot,
+    SnapshotBackedTracker,
+    build_snapshot,
+)
+from s3shuffle_tpu.metrics import registry as mreg
+from s3shuffle_tpu.metrics.stats import COLLECTOR
+
+
+@pytest.fixture
+def metrics_on():
+    mreg.REGISTRY.reset_values()
+    COLLECTOR.reset()
+    mreg.enable()
+    yield mreg.REGISTRY
+    mreg.disable()
+    mreg.REGISTRY.reset_values()
+    COLLECTOR.reset()
+
+
+def _status(map_index: int, attempt: int = 1, parts: int = 8) -> MapStatus:
+    return MapStatus(
+        map_id=map_index * 1000 + (attempt - 1),
+        location=STORE_LOCATION,
+        sizes=np.arange(parts, dtype=np.int64) * (map_index + 1) + attempt,
+        map_index=map_index,
+    )
+
+
+def _fill(tracker, shuffle_id: int, n_maps: int, parts: int = 8, seed: int = 0):
+    rng = random.Random(seed)
+    tracker.register_shuffle(shuffle_id, parts)
+    order = list(range(n_maps))
+    rng.shuffle(order)
+    for idx in order:
+        tracker.register_map_output(shuffle_id, _status(idx, parts=parts))
+        if rng.random() < 0.25:  # duplicate committed attempt
+            tracker.register_map_output(
+                shuffle_id, _status(idx, attempt=2, parts=parts)
+            )
+
+
+def _counter_value(name: str, **labels) -> float:
+    metric = mreg.REGISTRY.get(name)
+    if metric is None:
+        return 0.0
+    key = tuple(str(labels[n]) for n in metric.labelnames)
+    series = metric._series.get(key)
+    return 0.0 if series is None else series.value
+
+
+# ---------------------------------------------------------------------------
+# Sharded tracker
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("num_shards", [1, 3, 8])
+def test_sharded_tracker_matches_plain(num_shards):
+    """The sharded tracker must answer every query identically to one flat
+    tracker over the same registrations — including attempt dedupe and
+    logical-index range filtering."""
+    plain, sharded = MapOutputTracker(), ShardedMapOutputTracker(num_shards)
+    for t in (plain, sharded):
+        _fill(t, 5, n_maps=40, seed=7)
+    queries = [(0, None, 0, 8), (3, 17, 2, 5), (39, None, 0, 1), (0, 1, 7, 8)]
+    for smi, emi, sp, ep in queries:
+        assert plain.get_map_sizes_by_range(5, smi, emi, sp, ep) == \
+            sharded.get_map_sizes_by_range(5, smi, emi, sp, ep)
+    assert plain.registered_map_ids(5) == sharded.registered_map_ids(5)
+    assert plain.num_partitions(5) == sharded.num_partitions(5)
+    assert plain.epoch(5) == sharded.epoch(5)
+    assert sharded.contains(5) and not sharded.contains(6)
+    sharded.unregister_shuffle(5)
+    assert not sharded.contains(5)
+    with pytest.raises(KeyError):
+        sharded.get_map_sizes_by_range(5, 0, None, 0, 8)
+
+
+def test_shard_routing_spreads_and_colocates_attempts():
+    """Sequential map indices must spread across shards (no one-shard
+    hotspot), while all attempts of one logical index land on ONE shard so
+    per-shard dedupe stays correct (routing hashes map_index, never the
+    strided map_id)."""
+    hit = {shard_of(9, idx, 4) for idx in range(32)}
+    assert hit == set(range(4))
+    tracker = ShardedMapOutputTracker(4)
+    tracker.register_shuffle(9, 2)
+    tracker.register_map_output(9, _status(3, attempt=1, parts=2))
+    tracker.register_map_output(9, _status(3, attempt=2, parts=2))
+    out = tracker.get_map_sizes_by_range(9, 0, None, 0, 2)
+    assert [m for m, _s in out] == [3001]  # latest attempt only
+
+
+def test_batch_registration_one_lock_trip():
+    tracker = ShardedMapOutputTracker(4)
+    tracker.register_shuffle(1, 4)
+    tracker.register_map_outputs(1, [_status(i, parts=4) for i in range(10)])
+    assert tracker.epoch(1) == 10
+    assert len(tracker.get_map_sizes_by_range(1, 0, None, 0, 4)) == 10
+
+
+# ---------------------------------------------------------------------------
+# Batched / pipelined RPC
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def service():
+    server = MetadataServer(shards=4, shard_endpoints=2).start()
+    client = RemoteMapOutputTracker(server.address)
+    yield server, client
+    client.close()
+    server.stop()
+
+
+def test_batched_registration_rpc_roundtrip(service):
+    server, client = service
+    client.register_shuffle(2, 4)
+    client.register_map_outputs(2, [_status(i, parts=4) for i in range(12)])
+    out = client.get_map_sizes_by_range(2, 0, None, 0, 4)
+    assert [m for m, _s in out] == [i * 1000 for i in range(12)]
+    assert client.epoch(2) == 12
+    # pre-format entries (no map_index) are refused, same as the single path
+    with pytest.raises(RuntimeError, match="map_index"):
+        client._call("register_map_outputs", 2, [[0, STORE_LOCATION, [1, 2, 3, 4]]])
+
+
+def test_multi_range_batch_lookup_matches_singles(service):
+    _server, client = service
+    client.register_shuffle(3, 6)
+    client.register_map_outputs(3, [_status(i, parts=6) for i in range(9)])
+    ranges = [(0, 2), (2, 5), (5, 6), (1, 1)]
+    batched = client.get_map_sizes_by_ranges(3, 1, 8, ranges)
+    singles = [client.get_map_sizes_by_range(3, 1, 8, sp, ep) for sp, ep in ranges]
+    assert batched == singles  # one RPC == N legacy RPCs, answer-for-answer
+
+
+def test_legacy_single_range_delegates_to_batch_path():
+    tracker = MapOutputTracker()
+    _fill(tracker, 4, n_maps=6, parts=4)
+    calls = []
+    original = tracker.get_map_sizes_by_ranges
+
+    def spy(*args, **kwargs):
+        calls.append(args)
+        return original(*args, **kwargs)
+
+    tracker.get_map_sizes_by_ranges = spy
+    out = tracker.get_map_sizes_by_range(4, 0, None, 1, 3)
+    assert calls and calls[0][3] == [(1, 3)]
+    assert out == original(4, 0, None, [(1, 3)])[0]
+
+
+def test_async_client_batches_and_pipelines(service, metrics_on):
+    from s3shuffle_tpu.metadata.async_client import AsyncTrackerClient
+
+    server, _ = service
+    client = AsyncTrackerClient(server.address, batch_max=64)
+    try:
+        # shard endpoints advertised -> one connection per endpoint + primary
+        assert client.connections == 3
+        client.register_shuffle(7, 4)
+        rpcs_before = _counter_value(
+            "meta_rpc_total", method="register_map_outputs", shard="0"
+        ) + _counter_value(
+            "meta_rpc_total", method="register_map_outputs", shard="1"
+        ) + _counter_value(
+            "meta_rpc_total", method="register_map_outputs", shard="2"
+        )
+        for i in range(24):
+            client.register_map_output(7, _status(i, parts=4))
+        assert client.pending_registrations() == 24  # buffered, not sent
+        client.flush()
+        assert client.pending_registrations() == 0
+        rpcs_after = sum(
+            _counter_value(
+                "meta_rpc_total", method="register_map_outputs", shard=str(s)
+            )
+            for s in range(3)
+        )
+        # 24 registrations rode at most one RPC per connection, not 24
+        assert 1 <= rpcs_after - rpcs_before <= client.connections
+        # pipelined lookups: futures resolve to the same answers
+        futs = [
+            client.get_map_sizes_by_range_async(7, 0, None, p, p + 1)
+            for p in range(4)
+        ]
+        sync = [client.get_map_sizes_by_range(7, 0, None, p, p + 1) for p in range(4)]
+        assert [f.result(timeout=10) for f in futs] == sync
+        # flush-before-read: buffered registrations are visible to lookups
+        client.register_map_output(7, _status(50, parts=4))
+        out = client.get_map_sizes_by_range(7, 50, 51, 0, 1)
+        assert [m for m, _s in out] == [50000]
+        hist = mreg.REGISTRY.get("meta_batch_flush_seconds")
+        assert sum(s.count for s in hist._series.values()) >= 1  # flushes timed
+    finally:
+        client.close()
+
+
+def test_async_client_flush_failure_reaches_committer(service):
+    from s3shuffle_tpu.metadata.async_client import AsyncTrackerClient
+
+    server, _ = service
+    client = AsyncTrackerClient(server.address)
+    try:
+        # shuffle never registered: the deferred KeyError must surface at the
+        # flush (commit) barrier, not vanish with the buffer
+        client.register_map_output(99, _status(0))
+        with pytest.raises(KeyError):
+            client.flush()
+        assert client.pending_registrations() == 0
+    finally:
+        client.close()
+
+
+# ---------------------------------------------------------------------------
+# Snapshots
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_wire_roundtrip_matches_live_tracker():
+    """Snapshot answers must be byte-identical to the live tracker's at the
+    epoch it was built — through a full serialize/deserialize cycle."""
+    tracker = ShardedMapOutputTracker(4)
+    _fill(tracker, 11, n_maps=25, parts=5, seed=3)
+    snap = build_snapshot(tracker, 11)
+    assert snap.epoch == tracker.epoch(11)
+    restored = MapOutputSnapshot.from_bytes(snap.to_bytes())
+    assert restored.to_bytes() == snap.to_bytes()
+    for smi, emi, sp, ep in [(0, None, 0, 5), (4, 19, 1, 3), (0, 1, 0, 0)]:
+        assert restored.get_map_sizes_by_range(smi, emi, sp, ep) == \
+            tracker.get_map_sizes_by_range(11, smi, emi, sp, ep)
+    # the snapshot carries the deduped WINNER set (what reads resolve); the
+    # live registered_map_ids keeps every committed attempt (the orphan
+    # sweep's keep-list) — winners must be a subset, one per logical index
+    winners = sorted(s.map_id for _i, s in tracker.deduped_statuses(11))
+    assert restored.registered_map_ids() == winners
+    assert set(winners) <= set(tracker.registered_map_ids(11))
+    assert restored.num_partitions() == 5
+
+
+def test_snapshot_rejects_corrupt_blobs():
+    tracker = MapOutputTracker()
+    _fill(tracker, 1, n_maps=3, parts=2)
+    data = build_snapshot(tracker, 1).to_bytes()
+    with pytest.raises(ValueError):
+        MapOutputSnapshot.from_bytes(data[:-8])  # truncated
+    with pytest.raises(ValueError):
+        MapOutputSnapshot.from_bytes(b"\x00" * len(data))  # wrong magic
+    with pytest.raises(ValueError):
+        MapOutputSnapshot.from_bytes(data + b"\x00" * 3)  # not /8
+
+
+def test_snapshot_backed_tracker_zero_roundtrips(metrics_on):
+    """The acceptance metric: with a snapshot attached, every enumeration
+    lookup is served locally — the wrapped tracker sees ZERO calls and
+    ``meta_lookup_source_total{source=rpc}`` stays 0."""
+
+    class CountingTracker(MapOutputTracker):
+        def __init__(self):
+            super().__init__()
+            self.lookup_calls = 0
+
+        def get_map_sizes_by_ranges(self, *a, **k):
+            self.lookup_calls += 1
+            return super().get_map_sizes_by_ranges(*a, **k)
+
+        def num_partitions(self, *a):
+            self.lookup_calls += 1
+            return super().num_partitions(*a)
+
+    inner = CountingTracker()
+    _fill(inner, 6, n_maps=10, parts=4)
+    facade = SnapshotBackedTracker(inner)
+    facade.attach(build_snapshot(inner, 6))
+    inner.lookup_calls = 0
+
+    for p in range(4):
+        facade.get_map_sizes_by_range(6, 0, None, p, p + 1)
+    facade.get_map_sizes_by_ranges(6, 0, None, [(0, 2), (2, 4)])
+    assert facade.num_partitions(6) == 4
+    facade.register_shuffle(6, 4)  # idempotent re-register: local no-op
+    assert inner.lookup_calls == 0
+    assert _counter_value("meta_lookup_source_total", source="snapshot") == 6
+    assert _counter_value("meta_lookup_source_total", source="rpc") == 0
+
+    # no snapshot -> rpc path, counted as such
+    inner.register_shuffle(8, 4)
+    inner.register_map_output(8, _status(0, parts=4))
+    facade.get_map_sizes_by_range(8, 0, None, 0, 4)
+    assert inner.lookup_calls == 1
+    assert _counter_value("meta_lookup_source_total", source="rpc") == 1
+
+    # staleness contract: a registration through the facade drops the
+    # snapshot; subsequent lookups re-ask the live tracker
+    facade.register_map_output(6, _status(99, parts=4))
+    facade.get_map_sizes_by_range(6, 0, None, 0, 1)
+    assert inner.lookup_calls == 2
+    assert facade.attached_epoch(6) is None
+
+
+def test_snapshot_ensure_loader_and_epoch_mismatch():
+    inner = MapOutputTracker()
+    _fill(inner, 2, n_maps=4, parts=3)
+    snap_bytes = build_snapshot(inner, 2).to_bytes()
+    epoch = inner.epoch(2)
+    loads = []
+
+    def loader(shuffle_id, want_epoch):
+        loads.append((shuffle_id, want_epoch))
+        return snap_bytes
+
+    facade = SnapshotBackedTracker(inner, loader=loader)
+    assert facade.ensure(2, epoch) is True
+    assert facade.ensure(2, epoch) is True  # cached: loader not re-asked
+    assert loads == [(2, epoch)]
+    # advertised epoch the loader can't produce -> refuse AND drop the
+    # stale attachment: the old-epoch table must not keep serving lookups
+    # the driver didn't vouch for (review finding)
+    assert facade.attached_epoch(2) == epoch
+    assert facade.ensure(2, epoch + 5) is False
+    assert facade.attached_epoch(2) is None
+
+
+def test_snapshot_facade_attachment_bound():
+    """A long-lived worker cycling through shuffles keeps at most
+    MAX_ATTACHED sealed tables resident (oldest evicted; evicted shuffles
+    fall back to live RPCs)."""
+    inner = MapOutputTracker()
+    facade = SnapshotBackedTracker(inner)
+    n = SnapshotBackedTracker.MAX_ATTACHED + 10
+    for sid in range(n):
+        inner.register_shuffle(sid, 2)
+        inner.register_map_output(sid, _status(0, parts=2))
+        facade.attach(build_snapshot(inner, sid))
+    assert len(facade._snapshots) == SnapshotBackedTracker.MAX_ATTACHED
+    assert facade.attached_epoch(0) is None  # oldest evicted
+    assert facade.attached_epoch(n - 1) is not None
+
+
+def test_server_snapshot_cache_serves_and_invalidates(service):
+    server, client = service
+    client.register_shuffle(4, 3)
+    client.register_map_outputs(4, [_status(i, parts=3) for i in range(5)])
+    epoch1, data1 = client.get_snapshot(4)
+    assert epoch1 == 5
+    # cached: identical bytes for an unchanged epoch
+    assert client.get_snapshot(4) == (epoch1, data1)
+    client.register_map_output(4, _status(9, parts=3))
+    epoch2, data2 = client.get_snapshot(4)
+    assert epoch2 == 6 and data2 != data1
+    snap = MapOutputSnapshot.from_bytes(data2)
+    assert snap.get_map_sizes_by_range(0, None, 0, 3) == \
+        client.get_map_sizes_by_range(4, 0, None, 0, 3)
+
+
+# ---------------------------------------------------------------------------
+# Satellite: unregister_shuffle leaves no residue
+# ---------------------------------------------------------------------------
+
+
+def test_unregister_drops_stats_and_stage_state(service, metrics_on):
+    """Long-lived session leak regression: a many-shuffle loop must leave
+    tracker state, ShuffleStats aggregates, and TaskQueue stage tables all
+    bounded (empty) after each shuffle is unregistered."""
+    server, client = service
+    for sid in range(30):
+        client.register_shuffle(sid, 2)
+        client.register_map_outputs(sid, [_status(i, parts=2) for i in range(3)])
+        COLLECTOR.record_map(sid, 0, bytes=10, records=1, seconds=0.1)
+        server.task_queue.submit_stage(
+            stage_id_for(sid, "map"),
+            [{"task_id": 0, "kind": "noop"}],
+        )
+        t = server.task_queue.take_task(f"w{sid}")
+        server.task_queue.complete_task(
+            stage_id_for(sid, "map"), 0, {}, worker_id=f"w{sid}"
+        )
+        assert t["action"] == "run"
+        assert COLLECTOR.report(sid) is not None
+        _ = client.get_snapshot(sid)  # populate the server snapshot cache
+        client.unregister_shuffle(sid)
+        assert not client.contains(sid)
+        assert COLLECTOR.report(sid) is None, "ShuffleStats leaked"
+    assert server.tracker.shuffle_ids() == []
+    assert server.task_queue._stages == {}, "stage state leaked"
+    assert server.snapshots._by_shuffle == {}, "snapshot cache leaked"
+
+
+def test_stats_collector_lru_bound(metrics_on):
+    """The local-mode backstop: sessions that never unregister (or use the
+    plain tracker) still keep at most SHUFFLES_MAX aggregates — oldest
+    evicted first, recent reports readable."""
+    from s3shuffle_tpu.metrics.stats import ShuffleStatsCollector
+
+    collector = ShuffleStatsCollector()
+    n = ShuffleStatsCollector.SHUFFLES_MAX + 40
+    for sid in range(n):
+        collector.record_map(sid, 0, bytes=1, records=1, seconds=0.0)
+    assert len(collector.shuffle_ids()) == ShuffleStatsCollector.SHUFFLES_MAX
+    assert collector.report(0) is None  # oldest evicted
+    assert collector.report(n - 1, include_metrics=False) is not None
+
+
+def test_task_queue_drop_shuffle_scopes_by_convention():
+    from s3shuffle_tpu.metadata.service import TaskQueue
+
+    q = TaskQueue()
+    q.submit_stage(stage_id_for(3, "map"), [{"task_id": 0, "kind": "noop"}])
+    q.submit_stage(stage_id_for(3, "reduce"), [{"task_id": 0, "kind": "noop"}])
+    q.submit_stage(stage_id_for(31, "map"), [{"task_id": 0, "kind": "noop"}])
+    assert q.drop_shuffle(3) == 2
+    assert q.stage_status(stage_id_for(31, "map"))["pending"] == 1  # untouched
+
+
+# ---------------------------------------------------------------------------
+# Satellite: concurrent-registration stress under the lock witness
+# ---------------------------------------------------------------------------
+
+
+def test_concurrent_registration_stress_no_lost_updates():
+    """N writer threads registering across shards while readers look up
+    mid-stage: no lost registrations, no lock-order cycles (runtime
+    witness), and the published snapshot at epoch close byte-identical to
+    the live tracker's answers."""
+    from s3shuffle_tpu.utils import lockwitness
+
+    n_writers, per_writer, parts = 8, 40, 6
+    with lockwitness.watching() as witness:
+        tracker = ShardedMapOutputTracker(4)  # constructed under the witness
+        tracker.register_shuffle(1, parts)
+        stop_readers = threading.Event()
+        errors = []
+
+        def writer(w):
+            try:
+                for i in range(per_writer):
+                    tracker.register_map_output(
+                        1, _status(w * per_writer + i, parts=parts)
+                    )
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        def reader():
+            try:
+                while not stop_readers.is_set():
+                    out = tracker.get_map_sizes_by_range(1, 0, None, 0, parts)
+                    assert len(out) <= n_writers * per_writer
+                    tracker.registered_map_ids(1)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        readers = [threading.Thread(target=reader) for _ in range(3)]
+        writers = [threading.Thread(target=writer, args=(w,)) for w in range(n_writers)]
+        for t in readers + writers:
+            t.start()
+        for t in writers:
+            t.join()
+        stop_readers.set()
+        for t in readers:
+            t.join()
+
+        assert errors == []
+        out = tracker.get_map_sizes_by_range(1, 0, None, 0, parts)
+        assert len(out) == n_writers * per_writer, "lost registrations"
+        assert tracker.epoch(1) == n_writers * per_writer
+        # epoch close: snapshot answers byte-identical to the live tracker
+        snap = MapOutputSnapshot.from_bytes(build_snapshot(tracker, 1).to_bytes())
+        assert snap.get_map_sizes_by_range(0, None, 0, parts) == out
+        cycles = witness.find_cycles()
+    assert cycles == [], witness.format_report()
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: steady-state reduce scan does zero tracker round-trips,
+# output identical to the pre-sharding (snapshot-off) path
+# ---------------------------------------------------------------------------
+
+
+def _run_distributed(tmp_path, tag: str, snapshots: bool, metrics: bool = False):
+    """One in-process DistributedDriver + WorkerAgent shuffle; returns the
+    sorted output records."""
+    import threading as _threading
+
+    from s3shuffle_tpu.batch import RecordBatch
+    from s3shuffle_tpu.cluster import DistributedDriver
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+    from s3shuffle_tpu.worker import WorkerAgent
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/store-{tag}",
+        app_id=f"cp-{tag}",
+        codec="zlib",
+        metadata_snapshots=snapshots,
+    )
+    rng = random.Random(5)
+    recs = [(rng.randbytes(8), rng.randbytes(16)) for _ in range(1200)]
+    batches = [RecordBatch.from_records(recs[i::3]) for i in range(3)]
+
+    driver = DistributedDriver(cfg)
+    agent = WorkerAgent(driver.coordinator_address, config=cfg, worker_id=f"w-{tag}")
+    thread = _threading.Thread(target=agent.run_forever, kwargs={"poll_interval": 0.01})
+    thread.start()
+    try:
+        out = driver.run_sort_shuffle(batches, num_partitions=4)
+        got = [kv for b in out for kv in b.to_records()]
+        assert sorted(got) == sorted(recs)
+        return got
+    finally:
+        driver.shutdown()
+        thread.join(timeout=10)
+        agent.close()
+        Dispatcher.reset()
+
+
+def test_reduce_scan_zero_tracker_roundtrips_end_to_end(tmp_path, metrics_on):
+    """Tier-1 acceptance: with snapshots on, every reduce-scan enumeration
+    is served from the published snapshot (``source=snapshot`` > 0,
+    ``source=rpc`` == 0) and the shuffle output is identical to a run with
+    the snapshot plane disabled (the pre-sharding path)."""
+    got_snap = _run_distributed(tmp_path, "snap", snapshots=True)
+    snap_hits = _counter_value("meta_lookup_source_total", source="snapshot")
+    rpc_lookups = _counter_value("meta_lookup_source_total", source="rpc")
+    assert snap_hits > 0, "no lookup was served from the snapshot"
+    assert rpc_lookups == 0, (
+        f"steady-state reduce scan performed {rpc_lookups:g} tracker "
+        "round-trips; expected zero"
+    )
+    # control-plane RPCs were metered (client side)
+    metric = mreg.REGISTRY.get("meta_rpc_total")
+    assert metric is not None and metric._series, "meta_rpc_total never recorded"
+
+    mreg.REGISTRY.reset_values()
+    got_plain = _run_distributed(tmp_path, "plain", snapshots=False)
+    assert got_snap == got_plain, "snapshot path changed shuffle output"
+    # snapshot plane off: enumeration lookups ride live RPCs again
+    assert _counter_value("meta_lookup_source_total", source="snapshot") == 0

@@ -168,6 +168,34 @@ def _counter_total(snapshot: dict, name: str) -> float:
     )
 
 
+def _control_plane_line(
+    snapshot: dict, reduce_tasks: Optional[int] = None
+) -> Optional[str]:
+    """One-line control-plane digest: tracker RPC round-trips issued (per
+    reduce task when the enclosing ShuffleStats report says how many ran)
+    and how reduce-side lookups were answered — epoch snapshot (zero
+    round-trips) vs live RPC."""
+    rpcs = _counter_total(snapshot, "meta_rpc_total")
+    by_source = {
+        s.get("labels", {}).get("source", "?"): float(s.get("value", 0))
+        for s in snapshot.get("meta_lookup_source_total", {}).get("series", [])
+    }
+    lookups = sum(by_source.values())
+    if rpcs <= 0 and lookups <= 0:
+        return None
+    line = f"Control plane: {rpcs:g} tracker RPCs"
+    if reduce_tasks:
+        line += f" ({rpcs / reduce_tasks:.2f} per reduce task)"
+    if lookups > 0:
+        hits = by_source.get("snapshot", 0.0)
+        line += (
+            f"; lookups {lookups:g} "
+            f"({hits:g} snapshot / {by_source.get('rpc', 0.0):g} rpc, "
+            f"{100.0 * hits / lookups:.2f}% snapshot hit ratio)"
+        )
+    return line
+
+
 def _scan_planner_line(snapshot: dict) -> Optional[str]:
     """One-line scan-planner digest: GETs issued vs GETs saved by coalescing,
     and the over-read (waste) price paid for the merges."""
@@ -186,7 +214,9 @@ def _scan_planner_line(snapshot: dict) -> Optional[str]:
     return line
 
 
-def render_metrics_snapshot(snapshot: dict, top: int = 10) -> str:
+def render_metrics_snapshot(
+    snapshot: dict, top: int = 10, reduce_tasks: Optional[int] = None
+) -> str:
     hist_rows: List[Tuple[float, Sequence[str]]] = []
     counter_rows: List[Sequence[str]] = []
     gauge_rows: List[Sequence[str]] = []
@@ -240,10 +270,13 @@ def render_metrics_snapshot(snapshot: dict, top: int = 10) -> str:
         out.append("")
         out.append("Counters:")
         out.append(_table(("counter", "value"), counter_rows))
-    planner = _scan_planner_line(snapshot)
-    if planner:
-        out.append("")
-        out.append(planner)
+    for line in (
+        _scan_planner_line(snapshot),
+        _control_plane_line(snapshot, reduce_tasks=reduce_tasks),
+    ):
+        if line:
+            out.append("")
+            out.append(line)
     if gauge_rows:
         out.append("")
         out.append("Gauges:")
@@ -296,7 +329,11 @@ def render_shuffle_stats(report: dict, top: int = 10) -> str:
     metrics = report.get("metrics") or {}
     if metrics:
         out.append("")
-        out.append(render_metrics_snapshot(metrics, top=top))
+        out.append(
+            render_metrics_snapshot(
+                metrics, top=top, reduce_tasks=report.get("reduce_tasks") or None
+            )
+        )
     return "\n".join(out)
 
 
@@ -352,9 +389,11 @@ def _synthetic_snapshot() -> dict:
     buckets[4] = 90
     buckets[8] = 10
     _SAMPLE_LABELS = {"scheme": "file", "op": "read", "direction": "up",
-                      "codec": "native"}
+                      "codec": "native", "method": "register_map_outputs",
+                      "shard": "0", "source": "snapshot"}
     _ALT_LABELS = {"scheme": "s3", "op": "open", "direction": "down",
-                   "codec": "zlib"}
+                   "codec": "zlib", "method": "get_map_sizes_by_ranges",
+                   "shard": "1", "source": "rpc"}
     snapshot: Dict[str, dict] = {}
     for name, (kind, labelnames) in sorted(KNOWN_METRICS.items()):
         series_list = []
@@ -424,6 +463,15 @@ def _selftest() -> int:
     # (7 segments + 7 saved GETs, 1 MiB waste over 2 MiB read = 50%)
     for needle in ("Scan planner:", "7 GETs saved", "(14 → 7)", "50.00% of bytes read"):
         assert needle in text, f"planner line missing {needle!r}:\n{text}"
+    # the control-plane digest: two meta_rpc_total series of 7 → 14 RPCs over
+    # 4 reduce tasks; lookup sources 7 snapshot + 7 rpc → 50% hit ratio
+    for needle in (
+        "Control plane: 14 tracker RPCs",
+        "(3.50 per reduce task)",
+        "7 snapshot / 7 rpc",
+        "50.00% snapshot hit ratio",
+    ):
+        assert needle in text, f"control-plane line missing {needle!r}:\n{text}"
     p50 = histogram_quantile(bounds, buckets, 0.5)
     assert 0.008 <= p50 <= 0.016, p50
     p99 = histogram_quantile(bounds, buckets, 0.99)
