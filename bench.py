@@ -1241,6 +1241,121 @@ def scan_planner_knobs():
     }
 
 
+def composite_write_gain(
+    n_maps: int = 64,
+    n_parts: int = 4,
+    part_bytes: int = 2048,
+    delay_s: float = 0.02,
+    group_maps: int = 16,
+):
+    """Write-plane probe: composite map commits vs one-object-per-map at
+    injected PUT latency (the BlobShuffle request-count argument, applied
+    to the write side). The SAME tiny-map workload is written twice: with
+    the composite plane off (one data + one index + one checksum PUT per
+    map) and on (one composite data + one fat index PUT per
+    ``group_maps``-map group). PUT counts come from the latency rule's hit
+    counter on the ``create`` op — every delayed object creation is one
+    would-be store round-trip; byte identity between the two layouts is
+    asserted by reading EVERY block back through the real scan machinery,
+    not assumed."""
+    from s3shuffle_tpu.block_ids import ShuffleBlockId
+    from s3shuffle_tpu.config import ShuffleConfig
+    from s3shuffle_tpu.metadata.helper import ScanIndexMemo, ShuffleHelper
+    from s3shuffle_tpu.read.chunked_fetch import ChunkedRangeFetcher
+    from s3shuffle_tpu.read.scan_plan import build_scan_iterator
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+    from s3shuffle_tpu.storage.fault import FlakyBackend, LatencyRule
+    from s3shuffle_tpu.write.composite_commit import CompositeCommitAggregator
+    from s3shuffle_tpu.write.map_output_writer import MapOutputWriter
+
+    payloads = {
+        (m, p): random.Random(1000 + m * n_parts + p).randbytes(part_bytes)
+        for m in range(n_maps)
+        for p in range(n_parts)
+    }
+
+    def run(composite_maps: int):
+        Dispatcher.reset()
+        cfg = ShuffleConfig(
+            root_dir=f"memory://bench-composite-{composite_maps}",
+            app_id="bench-composite",
+            composite_commit_maps=composite_maps,
+        )
+        d = Dispatcher(cfg)
+        helper = ShuffleHelper(d)
+        flaky = FlakyBackend(d.backend)
+        rule = flaky.add_latency(LatencyRule("create", delay_s=delay_s))
+        d.backend = flaky
+        agg = (
+            CompositeCommitAggregator(d, helper) if composite_maps > 1 else None
+        )
+        t0 = time.perf_counter()
+        for m in range(n_maps):
+            w = MapOutputWriter(d, helper, 0, m, n_parts, aggregator=agg)
+            for p in range(n_parts):
+                pw = w.get_partition_writer(p)
+                pw.write(payloads[(m, p)])
+                pw.close()
+            w.commit_all_partitions()
+        if agg is not None:
+            agg.flush_all()  # the commit barrier
+        wall = time.perf_counter() - t0
+        puts = rule.hits
+        # read EVERY block back through the real scan pipeline
+        blocks = [
+            ShuffleBlockId(0, m, p) for m in range(n_maps) for p in range(n_parts)
+        ]
+        it = build_scan_iterator(
+            d, ScanIndexMemo(helper), blocks, cfg,
+            fetcher=ChunkedRangeFetcher.from_config(cfg),
+        )
+        got = {}
+        for s in it:
+            got[(s.block.map_id, s.block.reduce_id)] = s.readall()
+            s.close()
+        return wall, puts, got
+
+    try:
+        off_wall, off_puts, off_out = run(0)
+        on_wall, on_puts, on_out = run(group_maps)
+        assert off_out == payloads, "per-map layout corrupted data"
+        assert on_out == payloads, "composite layout corrupted data"
+    except Exception as e:  # never fail the bench over this row
+        return {"composite_write_error": str(e)[:120]}
+    finally:
+        Dispatcher.reset()
+    return {
+        "composite_write_gain": round(off_wall / on_wall, 2),
+        "composite_write_put_reduction": round(off_puts / max(1, on_puts), 2),
+        "composite_write_puts_per_map": off_puts,
+        "composite_write_puts_composite": on_puts,
+        "composite_write_serial_wall_s": round(off_wall, 3),
+        "composite_write_wall_s": round(on_wall, 3),
+        "composite_write_maps": n_maps,
+        "composite_write_part_bytes": part_bytes,
+        "composite_write_group_maps": group_maps,
+        "composite_write_put_latency_ms": delay_s * 1e3,
+    }
+
+
+def composite_plane_knobs():
+    """The composite-commit knobs the headline runs used (ShuffleConfig
+    defaults) — recorded so BENCH rounds stay comparable when a default
+    moves."""
+    from s3shuffle_tpu.config import ShuffleConfig
+
+    cfg = ShuffleConfig()
+    return {
+        "composite_plane": {
+            "composite_commit_maps": cfg.composite_commit_maps,
+            "composite_flush_bytes": cfg.composite_flush_bytes,
+            "composite_flush_ms": cfg.composite_flush_ms,
+            "compact_below_bytes": cfg.compact_below_bytes,
+            "tombstone_ttl_s": cfg.tombstone_ttl_s,
+        }
+    }
+
+
 def _tracker_probe_worker(addr, worker_idx, n_maps, n_parts, lookups, barrier):
     """One control-plane probe worker process: batched registrations, one
     snapshot pull, then snapshot-served lookups (the steady-state reduce
@@ -1395,9 +1510,11 @@ def main():
         **chunked_fetch_gain(),
         **pipelined_commit_gain(),
         **coalesced_read_gain(),
+        **composite_write_gain(),
         **tracker_scaling(),
         **transfer_plane_knobs(),
         **scan_planner_knobs(),
+        **composite_plane_knobs(),
         **load_calibration(),
         **device_kernel_rates(),
     }

@@ -120,8 +120,75 @@ class ShuffleSnapshotBlockId(BlockId):
         return f"shuffle_{self.shuffle_id}_snapshot_{self.epoch}.snapmeta"
 
 
+@dataclasses.dataclass(frozen=True)
+class ShuffleCompositeDataBlockId(BlockId):
+    """One composite data object holding MANY map tasks' outputs back to
+    back (write/composite_commit.py). ``group_id`` is the first member's
+    attempt-unique map_id, so names can never collide across workers or
+    attempts. The ``comp`` infix keeps composite objects invisible to the
+    per-map parsers (``parse_index_name`` / ``parse_shuffle_object_name``)
+    — the lifecycle paths that understand composites parse them
+    explicitly."""
+
+    shuffle_id: int
+    group_id: int
+
+    @property
+    def map_id(self) -> int:  # prefix sharding key (Dispatcher.get_path)
+        return self.group_id
+
+    @property
+    def name(self) -> str:
+        return f"shuffle_{self.shuffle_id}_comp_{self.group_id}.data"
+
+
+@dataclasses.dataclass(frozen=True)
+class ShuffleFatIndexBlockId(BlockId):
+    """The fat index sidecar of one composite group: per-member
+    ``(map_id, base_offset)`` plus cumulative partition offsets (and
+    checksums) for every member — BE-int64 wire like the per-map sidecars
+    (metadata/fat_index.py). Its existence is the COMMIT POINT for every
+    member of the group (index-written-last, exactly the per-map
+    contract)."""
+
+    shuffle_id: int
+    group_id: int
+
+    @property
+    def map_id(self) -> int:
+        return self.group_id
+
+    @property
+    def name(self) -> str:
+        return f"shuffle_{self.shuffle_id}_comp_{self.group_id}.cindex"
+
+
+@dataclasses.dataclass(frozen=True)
+class ShuffleTombstoneBlockId(BlockId):
+    """Generation tombstone: a small JSON object naming store objects that
+    were superseded (e.g. singletons rewritten into a composite by the
+    compactor) at one generation stamp. The objects stay readable for
+    in-flight scans; ``Dispatcher.sweep_expired_generations`` deletes them
+    once the stamp is older than ``tombstone_ttl_s``. Lives under the
+    shuffle prefix so ``remove_shuffle`` reclaims it with everything
+    else."""
+
+    shuffle_id: int
+    generation: int
+
+    @property
+    def map_id(self) -> int:
+        return self.generation
+
+    @property
+    def name(self) -> str:
+        return f"shuffle_{self.shuffle_id}_gen_{self.generation}.tomb"
+
+
 _INDEX_RE = re.compile(r"^shuffle_(\d+)_(\d+)_(\d+)\.index$")
 _ANY_RE = re.compile(r"^shuffle_(\d+)_(\d+)_(\d+)\.(data|index|checksum\..+)$")
+_COMPOSITE_RE = re.compile(r"^shuffle_(\d+)_comp_(\d+)\.(data|cindex)$")
+_TOMBSTONE_RE = re.compile(r"^shuffle_(\d+)_gen_(\d+)\.tomb$")
 
 
 def parse_shuffle_object_name(name: str):
@@ -141,6 +208,25 @@ def parse_index_name(name: str) -> ShuffleIndexBlockId | None:
     if m is None:
         return None
     return ShuffleIndexBlockId(int(m.group(1)), int(m.group(2)), int(m.group(3)))
+
+
+def parse_composite_name(name: str):
+    """Parse a composite data / fat-index object name back to
+    ``(shuffle_id, group_id, kind)`` where kind is ``"data"`` or
+    ``"cindex"``, or None for anything else."""
+    m = _COMPOSITE_RE.match(name.rsplit("/", 1)[-1])
+    if m is None:
+        return None
+    return int(m.group(1)), int(m.group(2)), m.group(3)
+
+
+def parse_tombstone_name(name: str):
+    """Parse a generation-tombstone object name back to
+    ``(shuffle_id, generation)``, or None."""
+    m = _TOMBSTONE_RE.match(name.rsplit("/", 1)[-1])
+    if m is None:
+        return None
+    return int(m.group(1)), int(m.group(2))
 
 
 def shuffle_id_of(block: BlockId) -> int:

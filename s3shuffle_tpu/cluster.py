@@ -96,9 +96,15 @@ def _run_map_task(args: Tuple[int, bytes, int, bytes]) -> int:
     except BaseException:
         writer.stop(success=False)
         raise
-    # commit barrier: the buffered MapStatus registration must be durable on
-    # the coordinator BEFORE this task reports done (one RPC for the whole
-    # commit — a flush failure fails the task, which then retries)
+    # commit barrier: pool workers are torn down right after the stage, so
+    # any open composite group must seal BEFORE this task reports done
+    # (registration is group-granular; a pool worker holding an unsealed
+    # group across its own exit would lose the members silently) — then the
+    # buffered MapStatus registrations must be durable on the coordinator
+    # (one RPC for the whole commit — a flush failure fails the task,
+    # which then retries)
+    if manager.composite is not None:
+        manager.composite.flush_shuffle(shuffle_id)
     manager.tracker.flush()
     return map_id
 
@@ -250,6 +256,9 @@ class DistributedDriver:
             shard_endpoints=config.metadata_shard_endpoints,
         ).start()
         self.dispatcher = Dispatcher.get(config)
+        from s3shuffle_tpu.metadata.helper import ShuffleHelper
+
+        self.helper = ShuffleHelper(self.dispatcher)
         self._next_shuffle_id = 0
 
     @property
@@ -341,6 +350,23 @@ class DistributedDriver:
         except Exception:
             logger.warning("orphan sweep failed for shuffle %d", shuffle_id,
                            exc_info=True)
+
+        # small-map compaction (write/compactor.py): rewrite tiny singleton
+        # outputs into composites between the barriers, BEFORE the snapshot
+        # publishes, so reduce scans resolve the compacted layout and the
+        # superseded objects ride their generation tombstones to the TTL
+        # sweep. Best-effort: the old layout stays fully live on failure.
+        if self.config.compact_below_bytes > 0:
+            from s3shuffle_tpu.write.compactor import compact_shuffle
+
+            try:
+                compact_shuffle(
+                    self.dispatcher, self.helper, shuffle_id,
+                    tracker=self.server.tracker,
+                )
+            except Exception:
+                logger.warning("compaction failed for shuffle %d", shuffle_id,
+                               exc_info=True)
 
         # the map stage is this shuffle's epoch barrier: seal it with a
         # store-published snapshot and advertise (epoch) to reduce tasks so

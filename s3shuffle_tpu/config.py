@@ -88,6 +88,29 @@ class ShuffleConfig:
     # ceiling on one merged segment; also clamped to max_buffer_size_task so
     # a merged segment always fits the prefetch budget in one prefill
     coalesce_max_bytes: int = 64 * MiB
+    # --- composite commit plane (TPU-first addition; the reference always
+    # writes one data + one index (+ checksum) object PER MAP TASK, so PUT
+    # count scales with maps — BlobShuffle's request-count argument applied
+    # to the write side) ---
+    # map outputs composed into ONE composite data object + ONE fat index
+    # before the group seals; 0 or 1 disables the plane entirely and
+    # reproduces the one-object-per-map layout op-for-op
+    composite_commit_maps: int = 0
+    # seal the open composite group when its data bytes reach this
+    composite_flush_bytes: int = 64 * MiB
+    # seal groups older than this on the next aggregator touch (commit /
+    # barrier / worker idle poll); 0 disables age-based sealing
+    composite_flush_ms: float = 250.0
+    # a composite-mode map commit spools its payload in memory up to this
+    # many bytes, then overflows to a local temp file
+    composite_spool_bytes: int = 8 * MiB
+    # background compactor: committed singleton data objects smaller than
+    # this are rewritten into composites post-hoc (old objects generation-
+    # stamped, tracker re-pointed); 0 disables compaction
+    compact_below_bytes: int = 0
+    # generation sweep: tombstoned (superseded) objects are deleted once
+    # their generation stamp is older than this many seconds
+    tombstone_ttl_s: float = 300.0
     # in-memory budget for key-ordered reduce output before the batch sorter
     # spills sorted columnar runs (analog of Spark's ExternalSorter memory)
     sorter_spill_bytes: int = 256 * MiB
@@ -173,6 +196,16 @@ class ShuffleConfig:
             raise ValueError("coalesce_gap_bytes must be >= 0")
         if self.coalesce_max_bytes < 1:
             raise ValueError("coalesce_max_bytes must be >= 1")
+        if self.composite_commit_maps < 0 or self.compact_below_bytes < 0:
+            raise ValueError(
+                "composite_commit_maps / compact_below_bytes must be >= 0"
+            )
+        if self.composite_flush_bytes < 1 or self.composite_spool_bytes < 1:
+            raise ValueError(
+                "composite_flush_bytes / composite_spool_bytes must be >= 1"
+            )
+        if self.composite_flush_ms < 0 or self.tombstone_ttl_s < 0:
+            raise ValueError("composite_flush_ms / tombstone_ttl_s must be >= 0")
         if (
             self.storage_retries < 0
             or self.storage_retry_base_ms < 0

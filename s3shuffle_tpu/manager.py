@@ -97,6 +97,21 @@ class ShuffleManager:
             cfg.codec, cfg.codec_block_size, cfg.codec_level, cfg.tpu_batch_blocks,
             tpu_host_fallback=cfg.tpu_host_fallback,
         )
+        # Composite commit plane (write/composite_commit.py): one per-worker
+        # aggregator composing map commits into composite objects + fat
+        # indexes. Registration is group-granular: the default seal callback
+        # registers every member in ONE batched tracker call; worker agents
+        # rebind it to ride their task-completion reports instead.
+        self.composite = None
+        self._failed_composite: Dict[int, Exception] = {}
+        if cfg.composite_commit_maps > 1:
+            from s3shuffle_tpu.write.composite_commit import CompositeCommitAggregator
+
+            self.composite = CompositeCommitAggregator(
+                self.dispatcher, self.helper,
+                on_group_commit=self._register_group,
+                on_group_abort=self._abort_group,
+            )
 
     @property
     def config(self) -> ShuffleConfig:
@@ -139,6 +154,8 @@ class ShuffleManager:
             handle.shuffle_id,
             map_id,
             handle.dependency.num_partitions,
+            map_index=map_index,
+            aggregator=self.composite,
         )
         cls = ShuffleMapWriter
         if handle.kind == "serialized" and handle.dependency.serializer.supports_batches:
@@ -155,16 +172,66 @@ class ShuffleManager:
         )
 
     def _commit_map_output(
-        self, shuffle_id: int, map_id: int, lengths: np.ndarray, map_index: int
+        self,
+        shuffle_id: int,
+        map_id: int,
+        lengths: np.ndarray,
+        map_index: int,
+        message=None,
     ) -> None:
         # MapStatus location rebranding (S3ShuffleWriter.scala:10-18): the
         # output's address is the store, never a worker.
+        if message is not None and message.deferred:
+            # composite commit: visibility belongs to the group seal — the
+            # aggregator's on_group_commit registers every member at once
+            # (the fat index, not this call, is the commit point)
+            return
         self.tracker.register_map_output(
             shuffle_id,
             MapStatus(
                 map_id=map_id, location=STORE_LOCATION, sizes=lengths,
                 map_index=map_index,
             ),
+        )
+
+    def _register_group(self, shuffle_id: int, members) -> None:
+        """Default composite group seal callback: one batched registration
+        for the whole group (the PR-6 commit-barrier RPC shape), plus local
+        composite hints so this process's own reads resolve the members
+        without touching the store for per-map indexes."""
+        self.tracker.register_map_outputs(
+            shuffle_id,
+            [
+                MapStatus(
+                    map_id=m.map_id,
+                    location=STORE_LOCATION,
+                    sizes=m.lengths,
+                    map_index=m.map_index,
+                    composite_group=m.group_id,
+                    base_offset=m.base_offset,
+                )
+                for m in members
+            ],
+        )
+        for m in members:
+            self.helper.note_composite_location(
+                shuffle_id, m.map_id, m.group_id, m.base_offset
+            )
+
+    def _abort_group(self, shuffle_id: int, members, error: Exception) -> None:
+        """A composite group that failed to seal lost its members' outputs
+        AFTER their map tasks returned success (registration was deferred
+        to the seal). The manager has no task framework to fail them
+        through, so the shuffle is poisoned instead: the next read barrier
+        raises loudly rather than silently serving output missing those
+        maps. Worker agents rebind this callback to fail the member tasks
+        directly."""
+        with self._lock:
+            self._failed_composite[shuffle_id] = error
+        logger.error(
+            "composite group seal failed for shuffle %d: %d committed map "
+            "output(s) lost (%s) — reads of this shuffle will now fail",
+            shuffle_id, len(members), error,
         )
 
     # ------------------------------------------------------------------
@@ -184,6 +251,21 @@ class ShuffleManager:
         paths accordingly). ``tracker`` overrides the manager's tracker for
         this one reader — the worker's snapshot-backed facade rides here so
         a sealed shuffle's scan enumerates blocks with zero tracker RPCs."""
+        if self.composite is not None:
+            # commit-barrier flush: a reader built in this process must see
+            # every map this process committed (read-your-writes) — no-op
+            # when the shuffle has no open group
+            self.composite.flush_shuffle(handle.shuffle_id)
+            with self._lock:
+                exc = self._failed_composite.get(handle.shuffle_id)
+            if exc is not None:
+                # a mid-stage group seal failed after its members' tasks
+                # already returned success: their outputs are gone, and a
+                # scan now would silently miss them
+                raise RuntimeError(
+                    f"shuffle {handle.shuffle_id} lost composite-committed "
+                    "map outputs to a failed group seal; re-run the map stage"
+                ) from exc
         return ShuffleReader(
             self.dispatcher,
             self.helper,
@@ -206,6 +288,12 @@ class ShuffleManager:
         """Parity: unregisterShuffle (scala :156-168)."""
         with self._lock:
             self._registered.pop(shuffle_id, None)
+        if self.composite is not None:
+            # an open group's members can never be read now: drop it without
+            # sealing (no fat index PUT for the prefix delete to chase)
+            self.composite.abort_shuffle(shuffle_id)
+            with self._lock:
+                self._failed_composite.pop(shuffle_id, None)
         self.tracker.unregister_shuffle(shuffle_id)
         self.purge_caches(shuffle_id)
         if self.config.cleanup:

@@ -228,6 +228,10 @@ class AsyncTrackerClient:
         self.flush()
         return self._next_conn().registered_map_ids(shuffle_id)
 
+    def composite_locations(self, shuffle_id: int):
+        self.flush()
+        return self._next_conn().composite_locations(shuffle_id)
+
     def shuffle_ids(self) -> List[int]:
         self.flush()
         return self.primary.shuffle_ids()

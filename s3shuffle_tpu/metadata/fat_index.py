@@ -1,0 +1,151 @@
+"""Fat shuffle index — one index object for MANY map outputs.
+
+The per-map layout pays one index (+ optional checksum) PUT per map task;
+for tiny-map swarms that request count, not bandwidth, is the write-side
+wall (BlobShuffle's per-request-cost argument, PAPERS.md). The composite
+commit plane (write/composite_commit.py) composes many map outputs into one
+data object, and THIS sidecar replaces all of their per-map index and
+checksum objects with a single PUT:
+
+- header + per-member ``(map_id, base_offset)`` table;
+- per member, the same cumulative partition offsets ``[0, l0, l0+l1, ...]``
+  a per-map index would hold (member-RELATIVE — readers add
+  ``base_offset``);
+- optionally per member, the same uint32-in-int64 checksum row a per-map
+  checksum object would hold.
+
+Wire format is the index machinery's idiom — big-endian int64 words
+(DataOutputStream format, metadata/helper.py) — so the fat index travels
+and validates exactly like every other metadata blob. Writing the fat
+index is the COMMIT POINT for every member of its group: data object
+first, fat index last, no fat index ⇒ no member is visible (the per-map
+index-written-last contract, lifted to the group).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+#: wire magic ("S3FATIDX"-shaped int64) + format version, first two words
+_MAGIC = 0x5333464154494458
+_VERSION = 1
+
+
+@dataclasses.dataclass
+class FatIndexMember:
+    """One map output inside a composite group."""
+
+    map_id: int
+    map_index: int
+    base_offset: int
+    #: member-relative cumulative offsets, ``num_partitions + 1`` entries
+    offsets: np.ndarray
+    #: per-partition checksum values, or None when checksums were disabled
+    checksums: Optional[np.ndarray] = None
+
+    @property
+    def total_bytes(self) -> int:
+        return int(self.offsets[-1])
+
+
+class FatIndex:
+    """Immutable parsed form of one composite group's fat index object."""
+
+    def __init__(
+        self,
+        shuffle_id: int,
+        group_id: int,
+        num_partitions: int,
+        members: List[FatIndexMember],
+    ):
+        self.shuffle_id = int(shuffle_id)
+        self.group_id = int(group_id)
+        self.num_partitions = int(num_partitions)
+        self.members: Dict[int, FatIndexMember] = {}
+        for m in members:
+            if len(m.offsets) != self.num_partitions + 1:
+                raise ValueError(
+                    f"member {m.map_id} has {len(m.offsets)} offsets, "
+                    f"expected {self.num_partitions + 1}"
+                )
+            self.members[int(m.map_id)] = m
+        self.has_checksums = all(
+            m.checksums is not None for m in members
+        ) and bool(members)
+
+    def member(self, map_id: int) -> FatIndexMember:
+        try:
+            return self.members[int(map_id)]
+        except KeyError:
+            raise FileNotFoundError(
+                f"map {map_id} is not a member of composite group "
+                f"{self.group_id} (shuffle {self.shuffle_id})"
+            ) from None
+
+    # -- wire ----------------------------------------------------------
+    def to_bytes(self) -> bytes:
+        """``[magic, version, shuffle_id, group_id, num_partitions,
+        n_members, has_checksums]`` then ``n_members`` member rows of
+        ``[map_id, map_index, base_offset]``, then ``n_members`` offset
+        rows of ``num_partitions + 1`` words, then (when has_checksums)
+        ``n_members`` checksum rows of ``num_partitions`` words."""
+        members = list(self.members.values())
+        p = self.num_partitions
+        has_ck = 1 if self.has_checksums else 0
+        header = np.array(
+            [_MAGIC, _VERSION, self.shuffle_id, self.group_id, p,
+             len(members), has_ck],
+            dtype=np.int64,
+        )
+        rows = np.zeros((len(members), 3), dtype=np.int64)
+        offs = np.zeros((len(members), p + 1), dtype=np.int64)
+        cks = np.zeros((len(members), p), dtype=np.int64) if has_ck else None
+        for i, m in enumerate(members):
+            rows[i] = (m.map_id, m.map_index, m.base_offset)
+            offs[i] = np.asarray(m.offsets, dtype=np.int64)
+            if cks is not None:
+                cks[i] = np.asarray(m.checksums, dtype=np.int64)
+        parts = [header, rows.reshape(-1), offs.reshape(-1)]
+        if cks is not None:
+            parts.append(cks.reshape(-1))
+        return b"".join(
+            np.ascontiguousarray(a, dtype=">i8").tobytes() for a in parts
+        )
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "FatIndex":
+        if len(data) % 8 != 0 or len(data) < 7 * 8:
+            raise ValueError(f"fat index blob has invalid length {len(data)}")
+        words = np.frombuffer(data, dtype=">i8").astype(np.int64)
+        magic, version, shuffle_id, group_id, p, n, has_ck = (
+            int(w) for w in words[:7]
+        )
+        if magic != _MAGIC:
+            raise ValueError("fat index blob has wrong magic")
+        if version != _VERSION:
+            raise ValueError(f"fat index format version {version} != {_VERSION}")
+        expect = 7 + n * 3 + n * (p + 1) + (n * p if has_ck else 0)
+        if len(words) != expect:
+            raise ValueError(
+                f"fat index blob has {len(words)} words, expected {expect}"
+            )
+        pos = 7
+        rows = words[pos : pos + n * 3].reshape(n, 3)
+        pos += n * 3
+        offs = words[pos : pos + n * (p + 1)].reshape(n, p + 1)
+        pos += n * (p + 1)
+        cks = words[pos:].reshape(n, p) if has_ck else None
+        members = [
+            FatIndexMember(
+                map_id=int(rows[i, 0]),
+                map_index=int(rows[i, 1]),
+                base_offset=int(rows[i, 2]),
+                offsets=np.array(offs[i], dtype=np.int64),
+                checksums=None if cks is None else np.array(cks[i], dtype=np.int64),
+            )
+            for i in range(n)
+        ]
+        return cls(shuffle_id, group_id, p, members)

@@ -17,20 +17,43 @@ Parity: ``S3ShuffleHelper`` (helper/S3ShuffleHelper.scala:12-122):
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import struct
+import threading
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
 from s3shuffle_tpu.block_ids import (
     BlockId,
     ShuffleChecksumBlockId,
+    ShuffleDataBlockId,
+    ShuffleFatIndexBlockId,
     ShuffleIndexBlockId,
+    ShuffleCompositeDataBlockId,
 )
+from s3shuffle_tpu.metadata.fat_index import FatIndex
 from s3shuffle_tpu.storage.dispatcher import Dispatcher
 from s3shuffle_tpu.utils.concurrent_map import ConcurrentObjectMap
 
 logger = logging.getLogger("s3shuffle_tpu.metadata")
+
+
+@dataclasses.dataclass(frozen=True)
+class MapLocation:
+    """Where one map output's bytes live: the data object (a per-map
+    singleton or a composite), and the ABSOLUTE cumulative partition
+    offsets inside it (the member's base offset is already applied —
+    ``offsets[0]`` IS the base — so consumers slice
+    ``[offsets[start], offsets[end])`` without caring which layout wrote
+    the bytes). ``checksums`` is populated from the fat index for
+    composite members and None for singletons (whose checksum object is
+    fetched separately, exactly as before)."""
+
+    data_block: BlockId
+    offsets: np.ndarray
+    checksums: Optional[np.ndarray] = None
 
 
 class ShuffleHelper:
@@ -41,6 +64,19 @@ class ShuffleHelper:
         # cleared on reinitialize regardless.
         self._length_cache: ConcurrentObjectMap[str, np.ndarray] = ConcurrentObjectMap()
         self._checksum_cache: ConcurrentObjectMap[str, np.ndarray] = ConcurrentObjectMap()
+        # Composite layout state: fat indexes are cached like the per-map
+        # sidecars; hints map (shuffle, map) -> (group, base) and come from
+        # tracker registrations (block-manager mode) or a one-shot store
+        # listing (listing mode, built lazily on the first per-map index
+        # miss). All cleared on reinitialize with the other caches.
+        self._fat_cache: ConcurrentObjectMap[str, FatIndex] = ConcurrentObjectMap()
+        self._hints_lock = threading.Lock()
+        self._composite_hints: Dict[Tuple[int, int], Tuple[int, int]] = {}
+        self._listed_shuffles: set = set()
+        # serializes listing discovery so concurrent resolvers BLOCK until
+        # the one listing pass has populated the hints (a non-blocking
+        # "already running" marker would let racers memoize a miss)
+        self._discovery_lock = threading.Lock()
         dispatcher.on_reinitialize(self.clear_caches)
 
     # ------------------------------------------------------------------
@@ -70,12 +106,123 @@ class ShuffleHelper:
         finally:
             stream.close()
 
+    def write_fat_index(self, fat: FatIndex) -> None:
+        """Store one composite group's fat index — the commit point for
+        every member of the group (data object first, this last)."""
+        block = ShuffleFatIndexBlockId(fat.shuffle_id, fat.group_id)
+        data = fat.to_bytes()
+        stream = self.dispatcher.create_block(block)
+        try:
+            stream.write(data)
+        finally:
+            stream.close()
+
     # ------------------------------------------------------------------
     # Read side (read-through caches, S3ShuffleHelper.scala:67-92)
     # ------------------------------------------------------------------
-    def get_partition_lengths(self, shuffle_id: int, map_id: int) -> np.ndarray:
-        """Cumulative offsets array for one map output; raises
-        FileNotFoundError if the index object is absent (uncommitted)."""
+    def note_composite_location(
+        self, shuffle_id: int, map_id: int, group_id: int, base_offset: int
+    ) -> None:
+        """Record that one map output lives in a composite group — fed from
+        tracker registrations (MapStatus.composite_group / base_offset) or
+        listing discovery, consulted BEFORE any per-map index fetch."""
+        with self._hints_lock:
+            self._composite_hints[(int(shuffle_id), int(map_id))] = (
+                int(group_id), int(base_offset),
+            )
+
+    def composite_hint(self, shuffle_id: int, map_id: int):
+        with self._hints_lock:
+            return self._composite_hints.get((int(shuffle_id), int(map_id)))
+
+    def read_fat_index(self, shuffle_id: int, group_id: int) -> FatIndex:
+        """One composite group's fat index, fetched at most once per
+        process (always cached — fat indexes are immutable once written,
+        and one serves MANY maps, so per-call refetch would undo the PUT
+        coalescing on the read side)."""
+        block = ShuffleFatIndexBlockId(shuffle_id, group_id)
+        path = self.dispatcher.get_path(block)
+        return self._fat_cache.get_or_else_put(
+            path,
+            lambda _k: FatIndex.from_bytes(self.dispatcher.backend.read_all(path)),
+        )
+
+    def _discover_composites(self, shuffle_id: int) -> None:
+        """Listing-mode composite discovery: one listing pass finds the
+        shuffle's fat-index objects; reading each (cached) yields every
+        member's ``(group, base)``. Ran at most once per shuffle — later
+        callers block on the discovery lock until the hints are populated,
+        then return (racing threads must never memoize a miss). Gated by
+        the caller so a composite-free deployment never pays the LIST."""
+        with self._discovery_lock:
+            with self._hints_lock:
+                if shuffle_id in self._listed_shuffles:
+                    return
+            groups = self.dispatcher.list_composite_groups(shuffle_id)
+            for group_id in groups:
+                try:
+                    fat = self.read_fat_index(shuffle_id, group_id)
+                except (OSError, ValueError) as e:
+                    logger.warning(
+                        "fat index for shuffle %d group %d unreadable: %s",
+                        shuffle_id, group_id, e,
+                    )
+                    continue
+                for m in fat.members.values():
+                    with self._hints_lock:
+                        self._composite_hints.setdefault(
+                            (shuffle_id, m.map_id), (group_id, m.base_offset)
+                        )
+            with self._hints_lock:
+                self._listed_shuffles.add(shuffle_id)
+
+    def _discovery_allowed(self, shuffle_id: int) -> bool:
+        """Consult the store for composite membership only when composites
+        can exist: the write knob is on in this process, a tracker hint
+        already arrived for this shuffle, or a discovery already ran. Keeps
+        the composite-off op sequence identical to the pre-composite
+        layout (no speculative LISTs on a missing index)."""
+        cfg = self.dispatcher.config
+        if cfg.composite_commit_maps > 1 or cfg.compact_below_bytes > 0:
+            return True
+        with self._hints_lock:
+            if shuffle_id in self._listed_shuffles:
+                return True
+            return any(k[0] == shuffle_id for k in self._composite_hints)
+
+    def _composite_location(
+        self, shuffle_id: int, map_id: int, hint: Tuple[int, int]
+    ) -> MapLocation:
+        group_id, base = hint
+        member = self.read_fat_index(shuffle_id, group_id).member(map_id)
+        return MapLocation(
+            data_block=ShuffleCompositeDataBlockId(shuffle_id, group_id),
+            offsets=member.base_offset + member.offsets,
+            checksums=member.checksums,
+        )
+
+    def resolve_map_location(self, shuffle_id: int, map_id: int) -> MapLocation:
+        """Resolve one map output to its data object + absolute offsets —
+        the single source of which-object-holds-these-bytes truth for both
+        layouts. Raises FileNotFoundError when the map is committed
+        nowhere (no per-map index, no composite membership)."""
+        hint = self.composite_hint(shuffle_id, map_id)
+        if hint is None:
+            try:
+                return MapLocation(
+                    data_block=ShuffleDataBlockId(shuffle_id, map_id),
+                    offsets=self._singleton_offsets(shuffle_id, map_id),
+                )
+            except FileNotFoundError:
+                if not self._discovery_allowed(shuffle_id):
+                    raise
+                self._discover_composites(shuffle_id)
+                hint = self.composite_hint(shuffle_id, map_id)
+                if hint is None:
+                    raise
+        return self._composite_location(shuffle_id, map_id, hint)
+
+    def _singleton_offsets(self, shuffle_id: int, map_id: int) -> np.ndarray:
         block = ShuffleIndexBlockId(shuffle_id, map_id)
         if self.dispatcher.config.cache_partition_lengths:
             return self._length_cache.get_or_else_put(
@@ -83,15 +230,45 @@ class ShuffleHelper:
             )
         return self.read_block_as_array(block)
 
+    def get_partition_lengths(self, shuffle_id: int, map_id: int) -> np.ndarray:
+        """ABSOLUTE cumulative offsets array for one map output (composite
+        members come back base-shifted, so consumers are layout-agnostic);
+        raises FileNotFoundError if the output is uncommitted."""
+        return self.resolve_map_location(shuffle_id, map_id).offsets
+
     def get_checksums(self, shuffle_id: int, map_id: int) -> np.ndarray:
+        hint = self.composite_hint(shuffle_id, map_id)
+        if hint is not None:
+            return self._composite_checksums(shuffle_id, map_id, hint)
         block = ShuffleChecksumBlockId(
             shuffle_id, map_id, algorithm=self.dispatcher.config.checksum_algorithm
         )
-        if self.dispatcher.config.cache_checksums:
-            return self._checksum_cache.get_or_else_put(
-                self.dispatcher.get_path(block), lambda _k: self.read_block_as_array(block)
+        try:
+            if self.dispatcher.config.cache_checksums:
+                return self._checksum_cache.get_or_else_put(
+                    self.dispatcher.get_path(block),
+                    lambda _k: self.read_block_as_array(block),
+                )
+            return self.read_block_as_array(block)
+        except FileNotFoundError:
+            if not self._discovery_allowed(shuffle_id):
+                raise
+            self._discover_composites(shuffle_id)
+            hint = self.composite_hint(shuffle_id, map_id)
+            if hint is None:
+                raise
+            return self._composite_checksums(shuffle_id, map_id, hint)
+
+    def _composite_checksums(
+        self, shuffle_id: int, map_id: int, hint: Tuple[int, int]
+    ) -> np.ndarray:
+        member = self.read_fat_index(shuffle_id, hint[0]).member(map_id)
+        if member.checksums is None:
+            raise FileNotFoundError(
+                f"composite group {hint[0]} carries no checksums for "
+                f"shuffle {shuffle_id} map {map_id}"
             )
-        return self.read_block_as_array(block)
+        return member.checksums
 
     def read_block_as_array(self, block: BlockId) -> np.ndarray:
         path = self.dispatcher.get_path(block)
@@ -108,10 +285,20 @@ class ShuffleHelper:
         needle = f"shuffle_{shuffle_id}_"
         self._length_cache.remove(lambda k: k.rsplit("/", 1)[-1].startswith(needle))
         self._checksum_cache.remove(lambda k: k.rsplit("/", 1)[-1].startswith(needle))
+        self._fat_cache.remove(lambda k: k.rsplit("/", 1)[-1].startswith(needle))
+        with self._hints_lock:
+            self._composite_hints = {
+                k: v for k, v in self._composite_hints.items() if k[0] != shuffle_id
+            }
+            self._listed_shuffles.discard(shuffle_id)
 
     def clear_caches(self) -> None:
         self._length_cache.clear()
         self._checksum_cache.clear()
+        self._fat_cache.clear()
+        with self._hints_lock:
+            self._composite_hints = {}
+            self._listed_shuffles = set()
 
 
 class ScanIndexMemo:
@@ -140,7 +327,7 @@ class ScanIndexMemo:
     def __init__(self, helper: ShuffleHelper):
         self.helper = helper
         self.dispatcher = helper.dispatcher
-        self._offsets: ConcurrentObjectMap[tuple, object] = ConcurrentObjectMap()
+        self._locations: ConcurrentObjectMap[tuple, object] = ConcurrentObjectMap()
         self._checksums: ConcurrentObjectMap[tuple, object] = ConcurrentObjectMap()
 
     @staticmethod
@@ -156,15 +343,21 @@ class ScanIndexMemo:
             raise entry.exc
         return entry
 
-    def get_partition_lengths(self, shuffle_id: int, map_id: int) -> np.ndarray:
+    def resolve_map_location(self, shuffle_id: int, map_id: int) -> MapLocation:
+        """Memoized location resolution — range resolution AND the reader's
+        offset lookups share one entry, so a map's metadata (per-map index
+        or fat index) is touched at most once per scan."""
         return self._unwrap(
-            self._offsets.get_or_else_put(
+            self._locations.get_or_else_put(
                 (shuffle_id, map_id),
                 lambda _k: self._capture(
-                    lambda: self.helper.get_partition_lengths(shuffle_id, map_id)
+                    lambda: self.helper.resolve_map_location(shuffle_id, map_id)
                 ),
             )
         )
+
+    def get_partition_lengths(self, shuffle_id: int, map_id: int) -> np.ndarray:
+        return self.resolve_map_location(shuffle_id, map_id).offsets
 
     def get_checksums(self, shuffle_id: int, map_id: int) -> np.ndarray:
         return self._unwrap(

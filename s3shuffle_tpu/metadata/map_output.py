@@ -39,6 +39,13 @@ class MapStatus:
     location: str
     sizes: np.ndarray  # per reduce partition, stored (compressed) bytes
     map_index: int = -1  # logical map partition index; defaults to map_id
+    #: composite layout coordinates (write/composite_commit.py): the group
+    #: whose composite data object + fat index hold this output, and its
+    #: byte base inside that object. -1 = classic one-object-per-map
+    #: layout. Registration carrying these is what lets readers resolve
+    #: composite members with zero extra store round-trips.
+    composite_group: int = -1
+    base_offset: int = 0
 
     def __post_init__(self) -> None:
         if self.map_index < 0:
@@ -97,6 +104,10 @@ class MapOutputTrackerLike(Protocol):
 
     def registered_map_ids(self, shuffle_id: int) -> List[int]: ...
 
+    def composite_locations(
+        self, shuffle_id: int
+    ) -> List[Tuple[int, int, int]]: ...
+
     def shuffle_ids(self) -> List[int]: ...
 
 
@@ -127,6 +138,19 @@ def sizes_for_ranges(
             for status in selected
         ]
         for sp, ep in partition_ranges
+    ]
+
+
+def composite_locations_of(
+    deduped: List[Tuple[int, MapStatus]]
+) -> List[Tuple[int, int, int]]:
+    """Extract ``[(map_id, group, base_offset), ...]`` composite rows from a
+    deduped status list — shared by the plain tracker, the sharded tracker,
+    and the snapshot so every surface answers identically."""
+    return [
+        (status.map_id, status.composite_group, status.base_offset)
+        for _idx, status in deduped
+        if status.composite_group >= 0
     ]
 
 
@@ -236,6 +260,14 @@ class MapOutputTracker:
             if shuffle_id not in self._shuffles:
                 raise KeyError(f"Shuffle {shuffle_id} not registered")
             return sorted(self._shuffles[shuffle_id].keys())
+
+    def composite_locations(self, shuffle_id: int) -> List[Tuple[int, int, int]]:
+        """``[(map_id, composite_group, base_offset), ...]`` for every
+        winning map output that lives in a composite data object — what a
+        reduce scan seeds the helper's composite hints with so composite
+        members resolve without any per-map index fetch. Empty for a
+        shuffle written in the one-object-per-map layout."""
+        return composite_locations_of(self.deduped_statuses(shuffle_id))
 
     def unregister_shuffle(self, shuffle_id: int) -> None:
         # NOTE: the local-mode tracker deliberately does NOT drop the

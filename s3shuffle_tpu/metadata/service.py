@@ -373,12 +373,18 @@ class _Handler(socketserver.BaseRequestHandler):
                     )
                 m_shuffle, m_map, m_loc, m_sizes, m_idx = a[4][:5]
                 m_idx = int(m_idx)
+                # format-3 composite coordinates; older payloads default to
+                # the classic one-object-per-map layout
+                m_group = int(a[4][5]) if len(a[4]) > 5 else -1
+                m_base = int(a[4][6]) if len(a[4]) > 6 else 0
                 tracker = self.server.tracker  # type: ignore[attr-defined]
                 status = MapStatus(
                     map_id=int(m_map),
                     location=str(m_loc),
                     sizes=np.asarray(m_sizes, dtype=np.int64),
                     map_index=m_idx,
+                    composite_group=m_group,
+                    base_offset=m_base,
                 )
 
                 def on_accept(s=status, sid=int(m_shuffle), t=tracker):
@@ -433,6 +439,8 @@ class _Handler(socketserver.BaseRequestHandler):
                 location=str(location),
                 sizes=np.asarray(sizes, dtype=np.int64),
                 map_index=int(map_index),
+                composite_group=int(a[5]) if len(a) > 5 else -1,
+                base_offset=int(a[6]) if len(a) > 6 else 0,
             )
             return tracker.register_map_output(int(shuffle_id), status)
         if method == "register_map_outputs":
@@ -455,6 +463,8 @@ class _Handler(socketserver.BaseRequestHandler):
                         location=str(location),
                         sizes=np.asarray(sizes, dtype=np.int64),
                         map_index=int(map_index),
+                        composite_group=int(entry[4]) if len(entry) > 4 else -1,
+                        base_offset=int(entry[5]) if len(entry) > 5 else 0,
                     )
                 )
             return tracker.register_map_outputs(shuffle_id, statuses)
@@ -494,6 +504,8 @@ class _Handler(socketserver.BaseRequestHandler):
             return tracker.unregister_shuffle(sid)
         if method == "registered_map_ids":
             return tracker.registered_map_ids(int(a[0]))
+        if method == "composite_locations":
+            return [list(row) for row in tracker.composite_locations(int(a[0]))]
         if method == "shuffle_ids":
             return tracker.shuffle_ids()
         if method == "report_task_stats":
@@ -745,6 +757,8 @@ class RemoteMapOutputTracker:
             status.location,
             np.asarray(status.sizes).tolist(),
             status.map_index,
+            status.composite_group,
+            status.base_offset,
         )
 
     def register_map_outputs(self, shuffle_id: int, statuses: List[MapStatus]) -> None:
@@ -753,7 +767,8 @@ class RemoteMapOutputTracker:
             "register_map_outputs",
             shuffle_id,
             [
-                [s.map_id, s.location, np.asarray(s.sizes).tolist(), s.map_index]
+                [s.map_id, s.location, np.asarray(s.sizes).tolist(), s.map_index,
+                 s.composite_group, s.base_offset]
                 for s in statuses
             ],
         )
@@ -822,6 +837,12 @@ class RemoteMapOutputTracker:
     def registered_map_ids(self, shuffle_id: int) -> List[int]:
         return [int(x) for x in self._call("registered_map_ids", shuffle_id)]
 
+    def composite_locations(self, shuffle_id: int) -> List[Tuple[int, int, int]]:
+        return [
+            (int(m), int(g), int(b))
+            for m, g, b in self._call("composite_locations", shuffle_id)
+        ]
+
     def shuffle_ids(self) -> List[int]:
         return [int(x) for x in self._call("shuffle_ids")]
 
@@ -845,9 +866,11 @@ class RemoteMapOutputTracker:
         self, stage_id: str, task_id, result, worker_id=None, map_output=None
     ) -> bool:
         """``map_output``: optional ``[shuffle_id, map_id, location, sizes,
-        map_index]`` registered atomically with acceptance (see
-        TaskQueue.complete_task). All five elements are required — the
-        server rejects 4-element payloads (pre-format-2 clients)."""
+        map_index, composite_group, base_offset]`` registered atomically
+        with acceptance (see TaskQueue.complete_task). The first five
+        elements are required — the server rejects 4-element payloads
+        (pre-format-2 clients); the composite coordinates default to the
+        one-object-per-map layout when absent."""
         return self._call(
             "q_complete_task", stage_id, task_id, result, worker_id, map_output
         )

@@ -167,6 +167,13 @@ class ShardedMapOutputTracker:
             ids.extend(shard.registered_map_ids(shuffle_id))
         return sorted(ids)
 
+    def composite_locations(self, shuffle_id: int) -> List[Tuple[int, int, int]]:
+        """Composite ``(map_id, group, base_offset)`` rows merged across
+        shards — same answer the flat tracker would give."""
+        from s3shuffle_tpu.metadata.map_output import composite_locations_of
+
+        return composite_locations_of(self.deduped_statuses(shuffle_id))
+
     def shuffle_ids(self) -> List[int]:
         with self._meta_lock:
             return sorted(self._num_partitions)

@@ -57,9 +57,14 @@ _G_SNAPSHOT_AGE = _metrics.REGISTRY.gauge(
     "publish stamp)",
 )
 
-#: wire magic ("S3SHSNAP" as an int64) + format version, first two words
+#: wire magic ("S3SHSNAP" as an int64) + format version, first two words.
+#: v2 adds two per-row words (composite_group, base_offset) so snapshots
+#: carry the composite-commit coordinates; v1 blobs still parse (rows
+#: default to the one-object-per-map layout).
 _MAGIC = 0x5333485348534E41
-_VERSION = 1
+_VERSION = 2
+_ROW_META_V1 = 2  # [map_id, map_index]
+_ROW_META_V2 = 4  # [map_id, map_index, composite_group, base_offset]
 
 
 class MapOutputSnapshot:
@@ -93,6 +98,11 @@ class MapOutputSnapshot:
     def registered_map_ids(self) -> List[int]:
         return sorted(status.map_id for _idx, status in self.entries)
 
+    def composite_locations(self) -> List[tuple]:
+        from s3shuffle_tpu.metadata.map_output import composite_locations_of
+
+        return composite_locations_of(self.entries)
+
     def get_map_sizes_by_ranges(
         self,
         start_map_index: int,
@@ -119,8 +129,10 @@ class MapOutputSnapshot:
         """Serialize as big-endian int64 words (the index sidecar idiom):
         header ``[magic, version, shuffle_id, epoch, num_partitions,
         published_unix_micros, n_entries]`` then one row per entry
-        ``[map_id, map_index, sizes[0..P)]``."""
+        ``[map_id, map_index, composite_group, base_offset,
+        sizes[0..P)]``."""
         p = self._num_partitions
+        meta = _ROW_META_V2
         header = np.array(
             [
                 _MAGIC, _VERSION, self.shuffle_id, self.epoch, p,
@@ -128,17 +140,19 @@ class MapOutputSnapshot:
             ],
             dtype=np.int64,
         )
-        rows = np.zeros((len(self.entries), 2 + p), dtype=np.int64)
+        rows = np.zeros((len(self.entries), meta + p), dtype=np.int64)
         for i, (map_index, status) in enumerate(self.entries):
             rows[i, 0] = status.map_id
             rows[i, 1] = map_index
+            rows[i, 2] = status.composite_group
+            rows[i, 3] = status.base_offset
             sizes = np.asarray(status.sizes, dtype=np.int64)
             if len(sizes) < p:
                 raise ValueError(
                     f"MapStatus for map {status.map_id} has {len(sizes)} "
                     f"sizes, shuffle has {p} partitions"
                 )
-            rows[i, 2:] = sizes[:p]
+            rows[i, meta:] = sizes[:p]
         return (
             np.ascontiguousarray(header, dtype=">i8").tobytes()
             + np.ascontiguousarray(rows, dtype=">i8").tobytes()
@@ -154,22 +168,28 @@ class MapOutputSnapshot:
         )
         if magic != _MAGIC:
             raise ValueError("snapshot blob has wrong magic")
-        if version != _VERSION:
+        if version == 1:
+            meta = _ROW_META_V1  # pre-composite rows
+        elif version == _VERSION:
+            meta = _ROW_META_V2
+        else:
             raise ValueError(f"snapshot format version {version} != {_VERSION}")
-        expect = 7 + n * (2 + p)
+        expect = 7 + n * (meta + p)
         if len(words) != expect:
             raise ValueError(
                 f"snapshot blob has {len(words)} words, expected {expect}"
             )
-        rows = words[7:].reshape(n, 2 + p) if n else words[7:].reshape(0, 2 + p)
+        rows = words[7:].reshape(n, meta + p) if n else words[7:].reshape(0, meta + p)
         entries = [
             (
                 int(rows[i, 1]),
                 MapStatus(
                     map_id=int(rows[i, 0]),
                     location=STORE_LOCATION,
-                    sizes=np.array(rows[i, 2:], dtype=np.int64),
+                    sizes=np.array(rows[i, meta:], dtype=np.int64),
                     map_index=int(rows[i, 1]),
+                    composite_group=int(rows[i, 2]) if meta >= 4 else -1,
+                    base_offset=int(rows[i, 3]) if meta >= 4 else 0,
                 ),
             )
             for i in range(n)
@@ -320,6 +340,12 @@ class SnapshotBackedTracker:
         if snap is not None:
             return snap.registered_map_ids()
         return self._inner.registered_map_ids(shuffle_id)
+
+    def composite_locations(self, shuffle_id: int) -> List[tuple]:
+        snap = self._serve(shuffle_id)
+        if snap is not None:
+            return snap.composite_locations()
+        return self._inner.composite_locations(shuffle_id)
 
     # -- mutations (invalidate, then delegate) -------------------------
     def register_shuffle(self, shuffle_id: int, num_partitions: int) -> None:

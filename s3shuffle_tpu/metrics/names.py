@@ -45,6 +45,8 @@ KNOWN_METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "storage_retries_total": ("counter", ("op", "scheme")),
     "storage_retry_backoff_seconds": ("histogram", ()),
     "storage_deadline_exceeded_total": ("counter", ("op", "scheme")),
+    # --- storage plane: lifecycle sweeps (storage/dispatcher.py) ---
+    "storage_sweep_deleted_total": ("counter", ("reason",)),
     # --- read plane: adaptive prefetch (read/prefetch.py) ---
     "read_prefetch_wait_seconds": ("histogram", ()),
     "read_prefetch_fill_seconds": ("histogram", ()),
@@ -73,6 +75,14 @@ KNOWN_METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "write_upload_queue_wait_seconds": ("histogram", ()),
     "write_upload_queue_bytes": ("gauge", ()),
     "write_upload_chunk_seconds": ("histogram", ()),
+    # --- write plane: composite commits + compactor
+    # (write/composite_commit.py, write/compactor.py) ---
+    "write_composite_members_total": ("counter", ()),
+    "write_composite_groups_total": ("counter", ()),
+    "write_composite_flush_seconds": ("histogram", ()),
+    "write_puts_saved_total": ("counter", ()),
+    "write_compaction_seconds": ("histogram", ()),
+    "write_compacted_objects_total": ("counter", ()),
     # --- codec plane (codec/native.py) ---
     "codec_compress_seconds": ("histogram", ("codec",)),
     "codec_compress_bytes_total": ("counter", ("codec",)),

@@ -25,7 +25,6 @@ from typing import Iterable, Iterator, Tuple, Union
 from s3shuffle_tpu.block_ids import (
     ShuffleBlockBatchId,
     ShuffleBlockId,
-    ShuffleDataBlockId,
 )
 from s3shuffle_tpu.metadata.helper import ShuffleHelper
 from s3shuffle_tpu.read.block_stream import BlockStream
@@ -45,9 +44,11 @@ def reduce_span(block: ReadableBlockId) -> Tuple[int, int]:
 
 def resolve_block_range(
     helper, block: ReadableBlockId, must_raise: bool
-) -> Union[Tuple[int, int], None]:
-    """Resolve one block to its ``(lo, hi)`` byte range in the data object —
-    the single source of block-resolution semantics, shared by the per-block
+) -> Union[Tuple[object, int, int], None]:
+    """Resolve one block to ``(data_block, lo, hi)`` — which data object
+    holds its bytes (a per-map singleton or a composite, via
+    ``resolve_map_location``) and the ABSOLUTE byte range inside it. The
+    single source of block-resolution semantics, shared by the per-block
     path (:class:`BlockIterator`) and the coalescing planner
     (read/scan_plan.py) so the two cannot drift.
 
@@ -59,12 +60,13 @@ def resolve_block_range(
     range past the index bounds always raises."""
     start, end = reduce_span(block)
     try:
-        offsets = helper.get_partition_lengths(block.shuffle_id, block.map_id)
+        location = helper.resolve_map_location(block.shuffle_id, block.map_id)
     except FileNotFoundError:
         if must_raise:
             raise
         logger.warning("Skipping block %s: missing index (listing mode)", block.name)
         return None
+    offsets = location.offsets
     if end >= len(offsets):
         raise IndexError(
             f"Block {block.name} reduce range [{start},{end}) out of bounds "
@@ -73,7 +75,7 @@ def resolve_block_range(
     lo, hi = int(offsets[start]), int(offsets[end])
     if hi - lo == 0:
         return None
-    return lo, hi
+    return location.data_block, lo, hi
 
 
 class BlockIterator:
@@ -96,6 +98,5 @@ class BlockIterator:
             span = resolve_block_range(self.helper, block, must_raise)
             if span is None:
                 continue
-            lo, hi = span
-            data_block = ShuffleDataBlockId(block.shuffle_id, block.map_id)
+            data_block, lo, hi = span
             yield block, BlockStream(self.dispatcher, block, data_block, lo, hi)

@@ -88,6 +88,29 @@ class ShuffleReader:
         ) or cfg.force_batch_fetch
 
     # ------------------------------------------------------------------
+    def _seed_composite_hints(self, sid: int) -> None:
+        """Feed the tracker's composite coordinates into the helper so
+        composite members resolve (object + base offset) without any
+        per-map index fetch. Served locally by a snapshot-backed tracker
+        (zero round-trips) or the in-process tracker; one extra RPC per
+        scan on a bare remote tracker. Best effort: a failure only means
+        resolution falls back to store-side discovery."""
+        cfg = self.dispatcher.config
+        if cfg.composite_commit_maps <= 1 and cfg.compact_below_bytes <= 0:
+            # composite plane off in this deployment: skip the lookup so the
+            # composite-off control-plane traffic stays exactly as before
+            return
+        locs = getattr(self.tracker, "composite_locations", None)
+        if locs is None:
+            return
+        try:
+            for map_id, group_id, base in locs(sid):
+                self.helper.note_composite_location(sid, map_id, group_id, base)
+        except Exception as e:
+            logger.warning(
+                "composite-location seed for shuffle %d failed: %s", sid, e
+            )
+
     def compute_shuffle_blocks(self) -> List[ReadableBlockId]:
         """Parity: computeShuffleBlocks (S3ShuffleReader.scala:160-197)."""
         cfg = self.dispatcher.config
@@ -95,6 +118,7 @@ class ShuffleReader:
         if cfg.use_block_manager:
             if self.tracker is None:
                 raise RuntimeError("use_block_manager=True requires a MapOutputTracker")
+            self._seed_composite_hints(sid)
             # batch enumeration form: ONE control-plane round-trip for the
             # whole scan (and with a snapshot-backed tracker, zero) — never
             # one per partition
@@ -117,8 +141,33 @@ class ShuffleReader:
                     )
             return blocks
         # Listing mode: enumerate committed indices from the store
-        # (S3ShuffleReader.scala:181-196), filtered by map range.
-        indices = self.dispatcher.list_shuffle_indices(sid)
+        # (S3ShuffleReader.scala:181-196), filtered by map range. One
+        # listing pass yields both the per-map ``*.index`` sidecars and the
+        # sealed composite groups; each group's fat index (ONE GET, cached)
+        # enumerates its members and seeds the helper's composite hints so
+        # range resolution never looks for per-map indexes that don't
+        # exist. A map present in both layouts (post-hoc compaction before
+        # the old objects' TTL expired) is deduped — composite hints win at
+        # resolution either way.
+        from s3shuffle_tpu.block_ids import ShuffleIndexBlockId
+
+        singles, groups = self.dispatcher.list_committed_outputs(sid)
+        by_map = {idx.map_id: idx for idx in singles}
+        for group_id in groups:
+            try:
+                fat = self.helper.read_fat_index(sid, group_id)
+            except (OSError, ValueError) as e:
+                logger.warning(
+                    "Skipping composite group %d of shuffle %d: unreadable "
+                    "fat index (%s)", group_id, sid, e,
+                )
+                continue
+            for m in fat.members.values():
+                self.helper.note_composite_location(
+                    sid, m.map_id, group_id, m.base_offset
+                )
+                by_map.setdefault(m.map_id, ShuffleIndexBlockId(sid, m.map_id))
+        indices = [by_map[mid] for mid in sorted(by_map)]
         stride = cfg.map_id_attempt_stride
         if stride:
             # attempt-strided ids (distributed workers): the logical map

@@ -52,7 +52,6 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Iterator, List, Optional, Sequence
 
-from s3shuffle_tpu.block_ids import ShuffleDataBlockId
 from s3shuffle_tpu.metadata.helper import ScanIndexMemo
 from s3shuffle_tpu.metrics import registry as _metrics
 from s3shuffle_tpu.read.block_iterator import (
@@ -110,7 +109,7 @@ class ScanSegment:
 
     def __init__(
         self,
-        data_block: ShuffleDataBlockId,
+        data_block,  # ShuffleDataBlockId or ShuffleCompositeDataBlockId
         start: int,
         end: int,
         members: List[BlockRange],
@@ -211,18 +210,20 @@ def plan_scan(
 
     # Resolve ranges (shared semantics with the per-block path: zero-length
     # drop, listing-mode skip, metadata-mode canary), grouped per data object
-    # in first-appearance order.
+    # in first-appearance order. Grouping on the RESOLVED data object — not
+    # on (shuffle, map) — is what multiplies the composite-commit win: many
+    # maps' outputs landing in one composite object merge into the same
+    # segments, so the GET count drops across maps, not just within one.
     groups: dict = {}
     for block in blocks:
         span = resolve_block_range(memo, block, must_raise)
         if span is None:
             continue
-        key = (block.shuffle_id, block.map_id)
-        groups.setdefault(key, []).append(BlockRange(block, span[0], span[1]))
+        data_block, lo, hi = span
+        groups.setdefault(data_block, []).append(BlockRange(block, lo, hi))
 
     segments: List[ScanSegment] = []
-    for (shuffle_id, map_id), ranges in groups.items():
-        data_block = ShuffleDataBlockId(shuffle_id, map_id)
+    for data_block, ranges in groups.items():
         ranges.sort(key=lambda r: r.start)
         current: List[BlockRange] = []
         seg_start = seg_end = 0

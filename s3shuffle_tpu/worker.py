@@ -144,6 +144,22 @@ class WorkerAgent:
         self.meta = SnapshotBackedTracker(self.client, loader=self._load_snapshot)
         self.manager = ShuffleManager(config=self.config, tracker=self.meta)
         self.tasks_run = 0
+        # Composite commits in worker mode: a map task whose output joined
+        # an open composite group is NOT reported done until the group
+        # seals (the fat index is the commit point, and the completion
+        # report carries the registration) — reports queue here and drain
+        # from the seal callback. Sealing happens at the size/count
+        # thresholds during commits, on the age threshold each poll, and
+        # unconditionally when the task queue runs dry (the commit
+        # barrier). All on the run_once thread — no locking needed beyond
+        # the aggregator's own.
+        self._pending_composite: dict = {}  # (sid, mid) ->
+        # (stage_id, task, result, map_output, stats) — stats is the task's
+        # own drained outbox slice, pushed/discarded with its report
+        self._sealed_members: set = set()
+        if self.manager.composite is not None:
+            self.manager.composite.on_group_commit = self._on_group_sealed
+            self.manager.composite.on_group_abort = self._on_group_aborted
         # Refuse to join a coordinator speaking a different shuffle wire
         # format — mixed versions mis-partition silently (see version.py).
         # The initial connect RETRIES with backoff: worker pods routinely
@@ -206,9 +222,17 @@ class WorkerAgent:
         # a stalled attempt that passed the pre-write fence still cannot
         # register outputs after being reaped
         captured: dict = {}
-        writer.on_commit = lambda sid, mid, lengths, midx: captured.update(
-            map_output=[sid, mid, STORE_LOCATION, np.asarray(lengths).tolist(), midx]
-        )
+
+        def capture(sid, mid, lengths, midx, message=None):
+            payload = [sid, mid, STORE_LOCATION, np.asarray(lengths).tolist(), midx]
+            deferred = message is not None and message.deferred
+            if deferred:
+                # composite coordinates ride the registration payload; the
+                # report itself waits for the group seal (see run_once)
+                payload += [int(message.composite_group), int(message.base_offset)]
+            captured.update(map_output=payload, deferred=deferred)
+
+        writer.on_commit = capture
         try:
             for b in batches:
                 writer.write(b)
@@ -228,6 +252,7 @@ class WorkerAgent:
         return {
             "records": int(sum(b.n for b in batches)),
             "_map_output": captured.get("map_output"),
+            "_composite_deferred": bool(captured.get("deferred")),
         }
 
     def _load_snapshot(self, shuffle_id: int, epoch: int):
@@ -260,6 +285,9 @@ class WorkerAgent:
 
     def _run_reduce(self, task: dict, stage_id: str):
         shuffle_id = int(task["shuffle_id"])
+        # read-your-writes: any composite group this worker still holds open
+        # must seal (and its members report) before a scan runs
+        self._drain_composite(force=True)
         dep = dep_from_descriptor(shuffle_id, task["dep"])
         snap = task.get("snapshot")
         if snap:
@@ -299,8 +327,111 @@ class WorkerAgent:
         if one is running). In-process/test usage must call this — a leaked
         tracker socket is exactly what the suite's ResourceWarning
         strictness turns into a failure."""
+        self._drain_composite(force=True)
         self._stopped = True
         self.client.close()
+
+    # -- composite group lifecycle -------------------------------------
+    def _on_group_sealed(self, shuffle_id: int, members) -> None:
+        """Composite group seal: report every member task whose completion
+        was deferred (the registration payload — with its composite
+        coordinates — rides each report, atomically with acceptance).
+        Members with no queued report are the task currently mid-commit:
+        run_once reports them on the normal path."""
+        for m in members:
+            key = (shuffle_id, m.map_id)
+            entry = self._pending_composite.pop(key, None)
+            if entry is None:
+                self._sealed_members.add(key)
+                continue
+            stage_id, task, result, map_output, stats = entry
+            self._report_completion(
+                stage_id, task, result, map_output, "map", stats=stats
+            )
+
+    def _on_group_aborted(self, shuffle_id: int, members, error: Exception) -> None:
+        """A group that failed to seal loses every member: fail their
+        deferred reports loudly so the driver re-runs the tasks (the
+        currently-committing member's failure propagates as the commit
+        exception instead)."""
+        for m in members:
+            key = (shuffle_id, m.map_id)
+            entry = self._pending_composite.pop(key, None)
+            self._sealed_members.discard(key)
+            if entry is None:
+                continue
+            stage_id, task, _result, _map_output, _stats = entry
+            # the member's captured stats are dropped with it: the retry
+            # attempt re-records and reports the same task
+            logger.error(
+                "composite group seal failed; failing deferred task %s: %s",
+                task.get("task_id"), error,
+            )
+            try:
+                self.client.fail_task(
+                    stage_id, task["task_id"],
+                    f"composite group seal failed: {type(error).__name__}: {error}",
+                    self.worker_id,
+                )
+            except Exception:
+                logger.warning(
+                    "worker %s: could not fail deferred task %s",
+                    self.worker_id, task.get("task_id"), exc_info=True,
+                )
+
+    def _drain_composite(self, force: bool = False) -> None:
+        """Seal groups past their age threshold (every poll) or all open
+        groups (queue ran dry / reduce about to read / shutdown — the
+        commit barrier). Seal failures were already routed to the member
+        tasks by on_group_abort; the flush itself must not kill the poll
+        loop."""
+        agg = self.manager.composite
+        if agg is None:
+            return
+        try:
+            if force:
+                agg.flush_all()
+            else:
+                agg.maybe_flush_stale()
+        except Exception:
+            logger.exception("worker %s: composite flush failed", self.worker_id)
+
+    def _report_completion(
+        self, stage_id, task, result, map_output, kind, stats=None
+    ) -> None:
+        """One completion report + the refused-attempt cleanup shared by the
+        immediate and deferred paths. ``stats`` is the task's OWN outbox
+        slice, captured when its report was deferred — pushing or discarding
+        exactly those entries keeps stats per-task atomic even when several
+        members' reports drain in one seal (draining the global outbox here
+        would mix tasks: an accepted member would push a refused sibling's
+        entries, double-counting the sibling once its retry reports)."""
+        try:
+            accepted = self.client.complete_task(
+                stage_id, task["task_id"], result, self.worker_id, map_output
+            )
+        except Exception:
+            logger.exception(
+                "worker %s: completion report for %s failed",
+                self.worker_id, task.get("task_id"),
+            )
+            return
+        if accepted is False:
+            logger.warning(
+                "worker %s: stale attempt for task %s ignored by coordinator",
+                self.worker_id, task.get("task_id"),
+            )
+            self._delete_refused_attempt_objects(kind, map_output, result)
+        if stats is None:
+            self._push_task_stats(discard=accepted is False)
+        elif stats and accepted is not False:
+            try:
+                self.client.report_task_stats(stats)
+            except Exception:
+                logger.warning(
+                    "worker %s: could not push deferred task stats",
+                    self.worker_id, exc_info=True,
+                )
 
     # -- loop ----------------------------------------------------------
     def run_once(self) -> str:
@@ -308,6 +439,9 @@ class WorkerAgent:
         resp = self.client.take_task(self.worker_id)
         action = resp.get("action")
         if action != "run":
+            # queue dry (or shutdown): this IS the commit barrier for any
+            # open composite group — seal and report the deferred members
+            self._drain_composite(force=True)
             return action
         stage_id, task = resp["stage_id"], resp["task"]
         kind = task.get("kind")
@@ -325,6 +459,34 @@ class WorkerAgent:
         try:
             result = fn(self, task, stage_id)
             map_output = result.pop("_map_output", None) if isinstance(result, dict) else None
+            deferred = (
+                result.pop("_composite_deferred", False)
+                if isinstance(result, dict) else False
+            )
+            if deferred:
+                key = (int(map_output[0]), int(map_output[1]))
+                if key in self._sealed_members:
+                    # the group sealed during this very commit (size/count
+                    # threshold): report on the normal path below
+                    self._sealed_members.discard(key)
+                else:
+                    # capture THIS task's stats entries now (the outbox holds
+                    # only them — reports since the last drain were this
+                    # task's) so the seal-time report pushes or discards
+                    # exactly its own, never a sibling member's
+                    from s3shuffle_tpu.metrics import registry as metrics_registry
+                    from s3shuffle_tpu.metrics.stats import COLLECTOR
+
+                    stats = (
+                        COLLECTOR.drain_outbox()
+                        if metrics_registry.enabled() else []
+                    )
+                    self._pending_composite[key] = (
+                        stage_id, task, result, map_output, stats,
+                    )
+                    self.tasks_run += 1
+                    self._drain_composite()  # age-based seal check
+                    return "run"
             accepted = self.client.complete_task(
                 stage_id, task["task_id"], result, self.worker_id, map_output
             )
@@ -352,6 +514,7 @@ class WorkerAgent:
             self._delete_refused_attempt_objects(kind, map_output, result)
         self._push_task_stats(discard=stale or accepted is False)
         self.tasks_run += 1
+        self._drain_composite()  # age-based seal check every poll
         return "run"
 
     def _push_task_stats(self, discard: bool = False) -> None:
@@ -392,6 +555,17 @@ class WorkerAgent:
         try:
             if kind == "map" and map_output:
                 sid, mid = int(map_output[0]), int(map_output[1])
+                if len(map_output) > 5 and int(map_output[5]) >= 0:
+                    # composite member: its bytes live inside a SHARED
+                    # composite object — deleting that would destroy the
+                    # winners' data. The refused member simply never
+                    # registers; its bytes are reclaimed at shuffle teardown.
+                    logger.info(
+                        "refused attempt map %d is composite group %d "
+                        "member; bytes reclaimed at shuffle teardown",
+                        mid, int(map_output[5]),
+                    )
+                    return
                 blocks = [
                     ShuffleDataBlockId(sid, mid),
                     ShuffleIndexBlockId(sid, mid),

@@ -1,8 +1,10 @@
+from s3shuffle_tpu.write.composite_commit import CompositeCommitAggregator
 from s3shuffle_tpu.write.map_output_writer import MapOutputCommitMessage, MapOutputWriter
 from s3shuffle_tpu.write.measure import MeasuredOutputStream
 from s3shuffle_tpu.write.single_spill import SingleSpillMapOutputWriter
 
 __all__ = [
+    "CompositeCommitAggregator",
     "MapOutputWriter",
     "MapOutputCommitMessage",
     "MeasuredOutputStream",

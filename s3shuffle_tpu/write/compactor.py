@@ -1,0 +1,298 @@
+"""Post-hoc composite compaction for small-map workloads.
+
+A shuffle written with composite commits disabled (or one whose maps ran on
+many workers, each sealing small groups) leaves the store littered with
+tiny per-map objects; every reduce scan pays per-object GETs and every
+namespace listing crawls them. The compactor rewrites committed singleton
+outputs into composite data objects + fat indexes AFTER the map barrier:
+
+1. candidates = committed singleton outputs whose data object is smaller
+   than ``compact_below_bytes``;
+2. chunks of candidates are streamed into fresh composite objects (same
+   group layout the live aggregator writes — readers cannot tell post-hoc
+   composites from live ones), fat index written LAST per group;
+3. the tracker is re-pointed in one batched registration per group (the
+   PR-6 ``register_map_outputs`` path) so new scans resolve the composite;
+4. the superseded per-map objects are **generation-stamped** (a tombstone
+   object, ``Dispatcher.stamp_generation``) — never deleted inline, since
+   an in-flight scan may still hold readers on them — and reclaimed by the
+   TTL sweep (``sweep_expired_generations``) after ``tombstone_ttl_s``.
+
+Crash safety: the fat index is the group's commit point, and the tracker
+re-point happens only after it lands; a crash at any step leaves either
+the old layout fully live, or both layouts live (the tombstone sweep —
+or shuffle teardown — reclaims the loser). Readers are correct under
+both: composite hints take precedence, and the old objects stay readable
+until the TTL expires.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from s3shuffle_tpu.block_ids import (
+    ShuffleChecksumBlockId,
+    ShuffleCompositeDataBlockId,
+    ShuffleDataBlockId,
+    ShuffleFatIndexBlockId,
+    ShuffleIndexBlockId,
+)
+from s3shuffle_tpu.metadata.fat_index import FatIndex, FatIndexMember
+from s3shuffle_tpu.metadata.helper import ShuffleHelper
+from s3shuffle_tpu.metadata.map_output import STORE_LOCATION, MapStatus
+from s3shuffle_tpu.metrics import registry as _metrics
+from s3shuffle_tpu.storage.dispatcher import Dispatcher
+
+logger = logging.getLogger("s3shuffle_tpu.write")
+
+_H_COMPACT = _metrics.REGISTRY.histogram(
+    "write_compaction_seconds",
+    "Wall time of one compact_shuffle pass (read + rewrite + re-point)",
+)
+_C_COMPACTED = _metrics.REGISTRY.counter(
+    "write_compacted_objects_total",
+    "Singleton map outputs rewritten into composites by the compactor",
+)
+
+
+@dataclasses.dataclass
+class CompactionReport:
+    shuffle_id: int
+    groups: int = 0
+    maps: int = 0
+    bytes: int = 0
+    tombstoned: int = 0
+    generations: List[int] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class _Candidate:
+    map_id: int
+    size: int
+    offsets: np.ndarray
+    checksums: Optional[np.ndarray]
+
+
+def compact_shuffle(
+    dispatcher: Dispatcher,
+    helper: ShuffleHelper,
+    shuffle_id: int,
+    tracker=None,
+    below_bytes: Optional[int] = None,
+    maps_per_group: Optional[int] = None,
+) -> CompactionReport:
+    """Rewrite this shuffle's small committed singleton outputs into
+    composite groups; see the module docstring for the protocol. Runs
+    between the map barrier and the reduce stage (the driver wires it
+    behind ``compact_below_bytes``) or post-hoc via
+    ``python -m tools.storage_sweep --compact``."""
+    cfg = dispatcher.config
+    threshold = cfg.compact_below_bytes if below_bytes is None else int(below_bytes)
+    report = CompactionReport(shuffle_id)
+    if threshold <= 0:
+        return report
+    cap_maps = maps_per_group or (
+        cfg.composite_commit_maps if cfg.composite_commit_maps > 1 else 64
+    )
+    t0 = time.perf_counter_ns()
+
+    # The authoritative (map_id -> logical map_index) mapping is the
+    # tracker's own registrations — recomputing it from stride arithmetic
+    # would be wrong on a driver whose config never set the worker stride
+    # (attempt-strided ids would silently land under new logical indices
+    # and DUPLICATE maps in range reads). When the tracker exposes its
+    # deduped table, compaction is also restricted to registered winners
+    # (a dead attempt's singleton is the orphan sweep's job, not ours).
+    known_index = None
+    deduped = getattr(tracker, "deduped_statuses", None)
+    if deduped is not None:
+        try:
+            known_index = {
+                status.map_id: map_index
+                for map_index, status in deduped(shuffle_id)
+            }
+        except Exception as e:
+            logger.warning(
+                "compactor could not read tracker state for shuffle %d: %s",
+                shuffle_id, e,
+            )
+
+    # Rerun safety: a map already living in a composite (an earlier
+    # compaction pass, or a live aggregator group) must never be selected
+    # again — its tombstoned singleton objects are still listed until the
+    # TTL sweep runs, and re-selecting them would rebuild an EXISTING group
+    # id with different membership, overwriting a live committed composite
+    # in place (the one mutation the tombstone protocol exists to prevent).
+    singles, groups = dispatcher.list_committed_outputs(shuffle_id)
+    already_composite = set()
+    for group_id in groups:
+        path = dispatcher.get_path(ShuffleFatIndexBlockId(shuffle_id, group_id))
+        try:
+            fat = FatIndex.from_bytes(dispatcher.backend.read_all(path))
+        except Exception as e:
+            # unreadable membership ⇒ we cannot prove a rerun is safe:
+            # skip this pass entirely rather than risk rebuilding the group
+            logger.warning(
+                "compactor cannot read fat index %s (%s); skipping "
+                "compaction of shuffle %d", path, e, shuffle_id,
+            )
+            return report
+        already_composite.update(fat.members)
+
+    candidates: List[_Candidate] = []
+    for idx in singles:
+        if idx.map_id in already_composite:
+            continue  # superseded singleton awaiting its TTL sweep
+        if known_index is not None and idx.map_id not in known_index:
+            continue  # not a registered winner
+        data_path = dispatcher.get_path(ShuffleDataBlockId(shuffle_id, idx.map_id))
+        try:
+            size = dispatcher.backend.status(data_path).size
+        except OSError:
+            continue  # index-only output (empty map): nothing to compact
+        if size >= threshold:
+            continue
+        try:
+            offsets = helper.read_block_as_array(
+                ShuffleIndexBlockId(shuffle_id, idx.map_id)
+            )
+            checksums: Optional[np.ndarray] = None
+            if cfg.checksum_enabled:
+                checksums = helper.read_block_as_array(
+                    ShuffleChecksumBlockId(
+                        shuffle_id, idx.map_id, algorithm=cfg.checksum_algorithm
+                    )
+                )
+        except (OSError, ValueError) as e:
+            logger.warning(
+                "compactor skipping map %d of shuffle %d: %s",
+                idx.map_id, shuffle_id, e,
+            )
+            continue
+        candidates.append(_Candidate(idx.map_id, int(size), offsets, checksums))
+    if len(candidates) < 2:
+        return report
+
+    stride = cfg.map_id_attempt_stride
+    chunk: List[_Candidate] = []
+    chunk_bytes = 0
+    chunks: List[List[_Candidate]] = []
+    for cand in candidates:
+        if chunk and (
+            len(chunk) >= cap_maps
+            or chunk_bytes + cand.size > cfg.composite_flush_bytes
+        ):
+            chunks.append(chunk)
+            chunk, chunk_bytes = [], 0
+        chunk.append(cand)
+        chunk_bytes += cand.size
+    if len(chunk) >= 2:
+        chunks.append(chunk)
+
+    for members in chunks:
+        if len(members) < 2:
+            continue
+        group_id = members[0].map_id
+        data_block = ShuffleCompositeDataBlockId(shuffle_id, group_id)
+        fat_members: List[FatIndexMember] = []
+        statuses: List[MapStatus] = []
+        old_paths: List[str] = []
+        base = 0
+        sink = dispatcher.create_block(data_block)
+        try:
+            for m in members:
+                payload = dispatcher.backend.read_all(
+                    dispatcher.get_path(ShuffleDataBlockId(shuffle_id, m.map_id))
+                )
+                if len(payload) != int(m.offsets[-1]):
+                    raise IOError(
+                        f"map {m.map_id} data is {len(payload)} bytes, index "
+                        f"says {int(m.offsets[-1])}"
+                    )
+                sink.write(payload)
+                if known_index is not None:
+                    map_index = known_index[m.map_id]
+                else:
+                    map_index = m.map_id // stride if stride else m.map_id
+                fat_members.append(
+                    FatIndexMember(
+                        map_id=m.map_id,
+                        map_index=map_index,
+                        base_offset=base,
+                        offsets=m.offsets,
+                        checksums=m.checksums,
+                    )
+                )
+                statuses.append(
+                    MapStatus(
+                        map_id=m.map_id,
+                        location=STORE_LOCATION,
+                        sizes=np.diff(m.offsets).astype(np.int64),
+                        map_index=map_index,
+                        composite_group=group_id,
+                        base_offset=base,
+                    )
+                )
+                base += len(payload)
+        except Exception as e:
+            try:
+                sink.close()
+            finally:
+                try:
+                    dispatcher.backend.delete(dispatcher.get_path(data_block))
+                except OSError:
+                    pass
+            logger.warning(
+                "compaction of group %d (shuffle %d) aborted: %s — old "
+                "layout stays live", group_id, shuffle_id, e,
+            )
+            continue
+        sink.close()
+        # fat index last: the group's commit point — only now do the
+        # composites become resolvable at all
+        helper.write_fat_index(
+            FatIndex(shuffle_id, group_id, len(fat_members[0].offsets) - 1, fat_members)
+        )
+        # re-point the tracker in one batched registration, then hint the
+        # local helper so this process's next scan skips the per-map indexes
+        if tracker is not None:
+            tracker.register_map_outputs(shuffle_id, statuses)
+        for s in statuses:
+            helper.note_composite_location(
+                shuffle_id, s.map_id, s.composite_group, s.base_offset
+            )
+            old_paths.append(
+                dispatcher.get_path(ShuffleDataBlockId(shuffle_id, s.map_id))
+            )
+            old_paths.append(
+                dispatcher.get_path(ShuffleIndexBlockId(shuffle_id, s.map_id))
+            )
+            if cfg.checksum_enabled:
+                old_paths.append(
+                    dispatcher.get_path(
+                        ShuffleChecksumBlockId(
+                            shuffle_id, s.map_id, algorithm=cfg.checksum_algorithm
+                        )
+                    )
+                )
+        report.generations.append(dispatcher.stamp_generation(shuffle_id, old_paths))
+        report.tombstoned += len(old_paths)
+        report.groups += 1
+        report.maps += len(members)
+        report.bytes += base
+        if _metrics.enabled():
+            _C_COMPACTED.inc(len(members))
+    if _metrics.enabled() and report.groups:
+        _H_COMPACT.observe((time.perf_counter_ns() - t0) / 1e9)
+    if report.groups:
+        logger.info(
+            "Compacted shuffle %d: %d singleton outputs -> %d composite "
+            "group(s), %d bytes; %d objects tombstoned",
+            shuffle_id, report.maps, report.groups, report.bytes, report.tombstoned,
+        )
+    return report

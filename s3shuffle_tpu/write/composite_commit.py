@@ -1,0 +1,479 @@
+"""Composite map commits — write-side PUT coalescing.
+
+The per-map layout PUTs one data + one index (+ optional checksum) object
+per map task, so the store's request count scales with maps: for tiny-map
+swarms PUT count, not bandwidth, is the write-side wall — exactly the
+per-request cost driver BlobShuffle (PAPERS.md) argues object-storage
+shuffles must avoid, and the symmetric half of PR 5's reduce-side GET
+coalescing. This module composes MANY map tasks' outputs into
+
+- ONE composite data object (members appended back to back, streamed
+  through the same measured + pipelined-upload sink a per-map commit
+  uses), and
+- ONE **fat index** object (metadata/fat_index.py) holding every member's
+  ``(map_id, base_offset)``, cumulative partition offsets, and checksums.
+
+The fat index is the COMMIT POINT for the whole group (data object sealed
+first, fat index written last — the per-map index-written-last contract
+lifted to the group): a crash before the fat index lands leaves an
+uncommitted composite no reader can see, reclaimed by the orphan sweep.
+
+Groups seal at three thresholds: member count (``composite_commit_maps``),
+data size (``composite_flush_bytes``), and age (``composite_flush_ms``,
+checked on every aggregator touch — commit, barrier flush, worker idle
+poll). ``composite_commit_maps`` 0/1 disables the plane entirely and the
+writer reproduces the one-object-per-map layout op-for-op.
+
+Registration is group-granular: members become visible to the tracker
+only when their group seals (``on_group_commit`` — the manager registers
+the whole group through the PR-6 batched-registration path; worker agents
+report deferred task completions). A group that fails to seal invokes
+``on_group_abort`` so every member's task can be failed loudly — a half
+written group is never silently half visible.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from s3shuffle_tpu.block_ids import ShuffleCompositeDataBlockId
+from s3shuffle_tpu.metadata.fat_index import FatIndex, FatIndexMember
+from s3shuffle_tpu.metadata.helper import ShuffleHelper
+from s3shuffle_tpu.metrics import registry as _metrics
+from s3shuffle_tpu.storage.dispatcher import Dispatcher
+from s3shuffle_tpu.write.measure import MeasuredOutputStream
+
+logger = logging.getLogger("s3shuffle_tpu.write")
+
+_C_MEMBERS = _metrics.REGISTRY.counter(
+    "write_composite_members_total",
+    "Map outputs committed through composite groups",
+)
+_C_GROUPS = _metrics.REGISTRY.counter(
+    "write_composite_groups_total",
+    "Composite groups sealed (one data + one fat-index PUT each)",
+)
+_H_FLUSH = _metrics.REGISTRY.histogram(
+    "write_composite_flush_seconds",
+    "Group seal latency: final data flush + fat index PUT",
+)
+_C_PUTS_SAVED = _metrics.REGISTRY.counter(
+    "write_puts_saved_total",
+    "Store PUTs avoided by composite commits vs the one-object-per-map "
+    "layout (data+index+checksum per member, minus the group's two)",
+)
+
+
+@dataclasses.dataclass
+class CompositeMember:
+    """One map output committed into a composite group."""
+
+    shuffle_id: int
+    map_id: int
+    map_index: int
+    group_id: int
+    base_offset: int
+    lengths: np.ndarray
+    checksums: Optional[np.ndarray]
+    total_bytes: int
+
+    def offsets(self) -> np.ndarray:
+        """Member-relative cumulative offsets (the fat-index row)."""
+        out = np.zeros(len(self.lengths) + 1, dtype=np.int64)
+        np.cumsum(np.asarray(self.lengths, dtype=np.int64), out=out[1:])
+        return out
+
+
+class _OpenGroup:
+    def __init__(self, shuffle_id: int, group_id: int, num_partitions: int):
+        self.shuffle_id = shuffle_id
+        self.group_id = group_id
+        self.num_partitions = num_partitions
+        self.data_block = ShuffleCompositeDataBlockId(shuffle_id, group_id)
+        self.members: List[CompositeMember] = []
+        self.bytes = 0
+        self.opened_monotonic = time.monotonic()
+        self.sink = None  # created on the first non-empty append
+        #: serializes appends to THIS group's sequential stream only —
+        #: commits for other shuffles' groups never wait on it
+        self.lock = threading.Lock()
+        #: set (under ``lock``) when the group leaves the open registry for
+        #: sealing/teardown: appenders that lose the race re-check this and
+        #: open a fresh group instead of writing into a sealed stream
+        self.detached = False
+
+
+class CompositeCommitAggregator:
+    """Per-worker commit aggregator: composes map commits into composite
+    groups and seals them at size/count/age/barrier thresholds.
+
+    Thread-safe: map tasks on one worker may commit concurrently. The
+    registry lock only guards the shuffle→group table; appends serialize on
+    the GROUP's own lock (they target one sequential store object, so
+    serialization within a group is inherent — and with the pipelined
+    upload plane an append is mostly a bounded-queue push, the actual PUT
+    riding the background uploader), so commits for different shuffles
+    never convoy behind each other's store I/O. Sealing and the
+    registration callbacks run outside every lock, on a group that has
+    been detached first (``_OpenGroup.detached``) — no appender can touch
+    it by then, and one group's seal failure can never orphan another's."""
+
+    def __init__(
+        self,
+        dispatcher: Dispatcher,
+        helper: ShuffleHelper,
+        on_group_commit: Optional[Callable[[int, List[CompositeMember]], None]] = None,
+        on_group_abort: Optional[
+            Callable[[int, List[CompositeMember], Exception], None]
+        ] = None,
+    ):
+        self.dispatcher = dispatcher
+        self.helper = helper
+        self.on_group_commit = on_group_commit
+        self.on_group_abort = on_group_abort
+        cfg = dispatcher.config
+        self.max_members = int(cfg.composite_commit_maps)
+        self.flush_bytes = int(cfg.composite_flush_bytes)
+        self.flush_ms = float(cfg.composite_flush_ms)
+        self._lock = threading.Lock()
+        self._groups: Dict[int, _OpenGroup] = {}
+
+    @property
+    def enabled(self) -> bool:
+        return self.max_members > 1
+
+    # ------------------------------------------------------------------
+    def _make_sink(self, group: _OpenGroup):
+        cfg = self.dispatcher.config
+        raw = self.dispatcher.create_block(group.data_block)
+        measured = MeasuredOutputStream(raw, group.data_block.name)
+        if cfg.upload_queue_bytes > 0:
+            from s3shuffle_tpu.write.pipelined_upload import PipelinedUploadStream
+
+            return PipelinedUploadStream(
+                measured, cfg.upload_queue_bytes, label=group.data_block.name
+            )
+        return measured
+
+    def _append_under_group_lock(
+        self, group: _OpenGroup, payload, total_bytes: int
+    ) -> None:
+        if total_bytes <= 0:
+            return
+        if group.sink is None:
+            group.sink = self._make_sink(group)
+        buffer_size = self.dispatcher.config.buffer_size
+        copied = 0
+        while True:
+            chunk = payload.read(buffer_size)
+            if not chunk:
+                break
+            group.sink.write(chunk)
+            copied += len(chunk)
+        if copied != total_bytes:
+            raise IOError(
+                f"composite append for shuffle {group.shuffle_id} delivered "
+                f"{copied} of {total_bytes} payload bytes"
+            )
+        group.bytes += total_bytes
+
+    def commit_map(
+        self,
+        shuffle_id: int,
+        map_id: int,
+        map_index: int,
+        num_partitions: int,
+        lengths: np.ndarray,
+        checksums: Optional[np.ndarray],
+        payload,
+        total_bytes: int,
+    ):
+        """Append one map task's fully-drained payload to the open group
+        (opening a new one as needed) and return its assigned
+        ``(group_id, base_offset)``. Only COMPLETE payloads are appended —
+        a failure mid-copy aborts the whole group loudly rather than
+        leaving a silently torn composite. Seals the group when the
+        member-count or byte threshold is reached."""
+        seal_now = False
+        failure = None
+        while True:
+            with self._lock:
+                group = self._groups.get(shuffle_id)
+                # `detached` is monotonic (never unset), so this unlocked
+                # read can only be stale-False — the group-lock re-check
+                # below catches that; stale-True is impossible
+                if group is None or group.detached:
+                    group = _OpenGroup(shuffle_id, int(map_id), int(num_partitions))
+                    self._groups[shuffle_id] = group
+            with group.lock:
+                if group.detached:
+                    continue  # lost a race with a concurrent seal: fresh group
+                if group.num_partitions != int(num_partitions):
+                    raise ValueError(
+                        f"composite group for shuffle {shuffle_id} has "
+                        f"{group.num_partitions} partitions, map {map_id} has "
+                        f"{num_partitions}"
+                    )
+                base = group.bytes
+                try:
+                    self._append_under_group_lock(group, payload, int(total_bytes))
+                except Exception as e:
+                    # detach the torn group; its (possibly slow) store
+                    # teardown and the abort callback run OUTSIDE the locks
+                    group.detached = True
+                    doomed = list(group.members)
+                    group.members = []
+                    failure = (group, doomed, e)
+                    break
+                member = CompositeMember(
+                    shuffle_id=int(shuffle_id),
+                    map_id=int(map_id),
+                    map_index=int(map_index),
+                    group_id=group.group_id,
+                    base_offset=base,
+                    lengths=np.asarray(lengths, dtype=np.int64),
+                    checksums=None if checksums is None else np.asarray(checksums, dtype=np.int64),
+                    total_bytes=int(total_bytes),
+                )
+                group.members.append(member)
+                if len(group.members) >= self.max_members or group.bytes >= self.flush_bytes:
+                    group.detached = True
+                    seal_now = True
+            break
+        self._discard_from_registry(shuffle_id, group)  # no-op unless detached
+        if failure is not None:
+            failed_group, doomed, exc = failure
+            self._drop_failed_group(failed_group)
+            # prior members' bytes are gone with the dropped object: fail
+            # them through the abort callback before this commit raises
+            if doomed and self.on_group_abort is not None:
+                self.on_group_abort(shuffle_id, doomed, exc)
+            raise exc
+        if seal_now:
+            self._finish(group)
+        # age-based sealing rides every aggregator touch: other shuffles'
+        # stale groups seal here too, not just on worker idle polls. A
+        # STALE group's seal failure must not fail THIS map's commit — its
+        # own members were already failed through on_group_abort.
+        try:
+            self.maybe_flush_stale()
+        except Exception:
+            logger.exception("age-based composite flush failed")
+        return member.group_id, member.base_offset
+
+    def _discard_from_registry(self, shuffle_id: int, group: _OpenGroup) -> None:
+        """Remove a DETACHED group from the registry (no-op if the group is
+        still open or a fresh group already replaced it)."""
+        if not group.detached:
+            return
+        with self._lock:
+            if self._groups.get(shuffle_id) is group:
+                self._groups.pop(shuffle_id)
+
+    def _detach(self, group: _OpenGroup) -> bool:
+        """Claim a group for sealing/teardown: waits for any in-flight
+        append to finish, then marks it detached. False ⇒ another thread
+        already claimed it (exactly one seal per group)."""
+        with group.lock:
+            if group.detached:
+                return False
+            group.detached = True
+            return True
+
+    def _drop_failed_group(self, group: _OpenGroup) -> None:
+        """Best-effort teardown of a torn group's store state. Callers hold
+        NO lock: the group is already detached from the registry, so nothing
+        else can touch it, and the delete may be a slow store round-trip."""
+        if group.sink is not None:
+            try:
+                group.sink.close()
+            except Exception:
+                logger.debug(
+                    "close of failed composite sink %s failed",
+                    group.data_block.name, exc_info=True,
+                )
+        try:
+            self.dispatcher.backend.delete(self.dispatcher.get_path(group.data_block))
+        except Exception:
+            logger.debug(
+                "delete of failed composite %s failed",
+                group.data_block.name, exc_info=True,
+            )
+
+    # ------------------------------------------------------------------
+    def _finish(self, group: _OpenGroup) -> None:
+        """Seal one detached group: final data flush, then the fat index —
+        the commit point — then the registration callback."""
+        from s3shuffle_tpu.storage.retrying import retry_call
+        from s3shuffle_tpu.utils import trace
+
+        t0 = time.perf_counter_ns()
+        try:
+            with trace.span(
+                "write.composite_flush",
+                group=group.group_id, members=len(group.members),
+            ):
+                if group.sink is not None:
+                    if group.sink.bytes_written != group.bytes:
+                        raise IOError(
+                            f"composite stream position {group.sink.bytes_written} "
+                            f"does not match appended bytes {group.bytes}"
+                        )
+                    group.sink.close()  # final flush; pipelined close blocks
+                fat = FatIndex(
+                    group.shuffle_id,
+                    group.group_id,
+                    group.num_partitions,
+                    [
+                        FatIndexMember(
+                            map_id=m.map_id,
+                            map_index=m.map_index,
+                            base_offset=m.base_offset,
+                            offsets=m.offsets(),
+                            checksums=m.checksums,
+                        )
+                        for m in group.members
+                    ],
+                )
+                # small idempotent-by-overwrite PUT, re-driven at object
+                # granularity like the per-map sidecars; it stays the LAST
+                # write of the group
+                retry_call(
+                    lambda: self.helper.write_fat_index(fat),
+                    getattr(self.dispatcher, "retry_policy", None),
+                    op="commit_fat_index",
+                    scheme=self.dispatcher.backend.scheme,
+                )
+        except Exception as e:
+            # the group is already detached from the registry — no lock
+            # needed for its teardown
+            self._drop_failed_group(group)
+            if self.on_group_abort is not None:
+                self.on_group_abort(group.shuffle_id, list(group.members), e)
+            raise
+        if _metrics.enabled():
+            _H_FLUSH.observe((time.perf_counter_ns() - t0) / 1e9)
+            _C_GROUPS.inc()
+            _C_MEMBERS.inc(len(group.members))
+            per_map_puts = 3 if self.dispatcher.config.checksum_enabled else 2
+            group_puts = (2 if group.sink is not None else 1)
+            _C_PUTS_SAVED.inc(
+                max(0, per_map_puts * len(group.members) - group_puts)
+            )
+        logger.info(
+            "Sealed composite group %s: %d map outputs, %d bytes",
+            group.data_block.name, len(group.members), group.bytes,
+        )
+        if self.on_group_commit is not None:
+            self.on_group_commit(group.shuffle_id, list(group.members))
+
+    # ------------------------------------------------------------------
+    def pending_members(self, shuffle_id: int) -> List[CompositeMember]:
+        """Members sitting in the (unsealed) open group of one shuffle."""
+        with self._lock:
+            group = self._groups.get(shuffle_id)
+            return list(group.members) if group is not None else []
+
+    def _finish_each(self, groups: List[_OpenGroup]) -> int:
+        """Seal several detached groups with PER-GROUP failure isolation:
+        every group gets its seal attempt (a failed one already failed its
+        own members via on_group_abort inside _finish — one group's failure
+        must never leave another's members unsealed, unaborted, and their
+        deferred reports hanging). The first failure re-raises after all
+        groups were attempted. Returns the number sealed."""
+        sealed = 0
+        first_exc: Optional[Exception] = None
+        for group in groups:
+            if not self._detach(group):
+                continue  # a concurrent commit_map seal already claimed it
+            try:
+                self._finish(group)
+                sealed += 1
+            except Exception as e:
+                if first_exc is None:
+                    first_exc = e
+        if first_exc is not None:
+            raise first_exc
+        return sealed
+
+    def flush_shuffle(self, shuffle_id: int) -> None:
+        """Commit-barrier flush: seal this shuffle's open group now."""
+        with self._lock:
+            group = self._groups.pop(shuffle_id, None)
+        if group is not None:
+            self._finish_each([group])
+
+    def flush_all(self) -> None:
+        with self._lock:
+            groups = list(self._groups.values())
+            self._groups = {}
+        self._finish_each(groups)
+
+    def abort_shuffle(self, shuffle_id: int) -> None:
+        """Drop this shuffle's open group WITHOUT sealing (shuffle
+        teardown: the members will never be read, so flushing would only
+        write objects for the prefix delete to reclaim)."""
+        with self._lock:
+            group = self._groups.pop(shuffle_id, None)
+        if group is not None and self._detach(group):
+            self._drop_failed_group(group)
+
+    def maybe_flush_stale(self, now: Optional[float] = None) -> int:
+        """Age-based sealing, checked on every aggregator touch (no
+        background thread — commits, barrier flushes, and the worker's
+        idle poll all drive it). Returns groups sealed."""
+        if self.flush_ms <= 0:
+            return 0
+        now = time.monotonic() if now is None else now
+        doomed: List[_OpenGroup] = []
+        with self._lock:
+            for sid, group in list(self._groups.items()):
+                if (now - group.opened_monotonic) * 1000.0 >= self.flush_ms:
+                    doomed.append(self._groups.pop(sid))
+        return self._finish_each(doomed)
+
+    def close(self) -> None:
+        self.flush_all()
+
+
+class SpooledCommitPayload(io.RawIOBase):
+    """The composite-mode map commit sink: partition drains land here
+    (memory up to ``composite_spool_bytes``, local temp file beyond) and
+    the fully-drained payload is handed to the aggregator at commit.
+    Presents the ``bytes_written`` / flush-all ``close()`` surface
+    MapOutputWriter expects of its stream."""
+
+    def __init__(self, spool_bytes: int):
+        import tempfile
+
+        self._file = tempfile.SpooledTemporaryFile(
+            max_size=max(1, int(spool_bytes)), prefix="s3shuffle-composite-"
+        )
+        self.bytes_written = 0
+
+    def writable(self) -> bool:
+        return True
+
+    def write(self, b) -> int:
+        n = b.nbytes if isinstance(b, memoryview) else len(b)
+        if n:
+            self._file.write(b)
+            self.bytes_written += n
+        return n
+
+    def open_for_read(self):
+        """Rewind and expose the drained payload for the aggregator copy."""
+        self._file.seek(0)
+        return self._file
+
+    def close(self) -> None:
+        if not self.closed:
+            self._file.close()
+        super().close()

@@ -43,6 +43,17 @@ logger = logging.getLogger("s3shuffle_tpu.write")
 class MapOutputCommitMessage:
     partition_lengths: np.ndarray
     checksums: Optional[np.ndarray] = None
+    #: composite layout coordinates: the group this output was composed
+    #: into and its byte base inside the composite data object; group -1
+    #: means the classic one-object-per-map layout. A composite commit's
+    #: visibility is DEFERRED to the group seal (the fat index is the
+    #: commit point), which the aggregator's on_group_commit signals.
+    composite_group: int = -1
+    base_offset: int = 0
+
+    @property
+    def deferred(self) -> bool:
+        return self.composite_group >= 0
 
 
 class MapOutputWriter:
@@ -53,12 +64,18 @@ class MapOutputWriter:
         shuffle_id: int,
         map_id: int,
         num_partitions: int,
+        map_index: Optional[int] = None,
+        aggregator=None,  # CompositeCommitAggregator (write/composite_commit.py)
     ):
         self.dispatcher = dispatcher
         self.helper = helper
         self.shuffle_id = shuffle_id
         self.map_id = map_id
+        self.map_index = map_id if map_index is None else map_index
         self.num_partitions = num_partitions
+        self._composite = (
+            aggregator if aggregator is not None and aggregator.enabled else None
+        )
         cfg = dispatcher.config
         self._checksums_enabled = cfg.checksum_enabled
         self._lengths = np.zeros(num_partitions, dtype=np.int64)
@@ -75,6 +92,18 @@ class MapOutputWriter:
 
     # ------------------------------------------------------------------
     def _init_stream(self) -> io.RawIOBase:
+        if self._stream is None and self._composite is not None:
+            # Composite mode: partition drains spool locally (memory, then
+            # temp file past composite_spool_bytes) and the fully-drained
+            # payload is appended to the worker's open composite group at
+            # commit — no per-map store object is ever created, so an
+            # aborted or empty map triggers zero store ops.
+            from s3shuffle_tpu.write.composite_commit import SpooledCommitPayload
+
+            self._stream = SpooledCommitPayload(
+                self.dispatcher.config.composite_spool_bytes
+            )
+            return self._stream
         if self._stream is None:
             cfg = self.dispatcher.config
             raw = self.dispatcher.create_block(self._block)
@@ -127,6 +156,8 @@ class MapOutputWriter:
         if self._committed:
             raise RuntimeError("commit_all_partitions called twice")
         self._committed = True
+        if self._composite is not None:
+            return self._commit_composite()
         if self._stream is not None:
             if self._stream.bytes_written != self._total_bytes:
                 # S3ShuffleMapOutputWriter.scala:96-100
@@ -165,7 +196,55 @@ class MapOutputWriter:
         checksums = self._checksum_values if self._checksums_enabled else None
         return MapOutputCommitMessage(self._lengths, checksums)
 
+    def _commit_composite(self) -> MapOutputCommitMessage:
+        """Hand the fully-drained payload to the composite aggregator.
+
+        The empty-map contract carries over from the per-map layout (and
+        from PR 2's empty-abort fix): a map that wrote zero bytes claims NO
+        composite slot and triggers NO store ops — unless
+        ``always_create_index`` asks for visible empty outputs, in which
+        case it occupies a zero-byte member row in the fat index."""
+        checksums = self._checksum_values if self._checksums_enabled else None
+        payload = self._stream
+        if payload is not None and payload.bytes_written != self._total_bytes:
+            raise IOError(
+                f"Spooled payload {payload.bytes_written} does not match "
+                f"sum of partition lengths {self._total_bytes}"
+            )
+        if self._total_bytes == 0 and not self.dispatcher.config.always_create_index:
+            if payload is not None:
+                payload.close()
+            return MapOutputCommitMessage(self._lengths, checksums)
+        try:
+            source = payload.open_for_read() if payload is not None else io.BytesIO()
+            group_id, base = self._composite.commit_map(
+                self.shuffle_id,
+                self.map_id,
+                self.map_index,
+                self.num_partitions,
+                self._lengths,
+                checksums,
+                source,
+                self._total_bytes,
+            )
+        finally:
+            if payload is not None:
+                payload.close()
+        return MapOutputCommitMessage(
+            self._lengths, checksums, composite_group=group_id, base_offset=base
+        )
+
     def abort(self, error: Exception | None = None) -> None:
+        if self._composite is not None and self._stream is not None:
+            # composite mode never created a store object for this map: the
+            # spool is local state, dropped here with zero store ops
+            try:
+                self._stream.close()
+            except Exception:
+                logger.debug(
+                    "close of aborted composite spool %s failed",
+                    self._block.name, exc_info=True,
+                )
         if not self._object_created:
             # The data object was never created (zero bytes written): there
             # is no partial object to drop — a delete here would only

@@ -127,7 +127,7 @@ class MapWriterBase:
         map_id: int,
         output_writer: MapOutputWriter,
         codec: Optional[FrameCodec],
-        on_commit: Callable[[int, int, np.ndarray, int], None],
+        on_commit: Callable[..., None],  # (sid, map_id, lengths, map_index, message)
         spill_memory_budget: Optional[int] = None,
         map_index: Optional[int] = None,
     ):
@@ -195,12 +195,15 @@ class MapWriterBase:
             self._cleanup_spill()
 
     def _register_commit(self) -> MapOutputCommitMessage:
-        """Shared commit tail: seal the data object, write index/checksum
-        sidecars, register the MapStatus."""
+        """Shared commit tail: seal the data object (or hand the payload to
+        the composite aggregator), write the sidecars, notify ``on_commit``
+        with the full commit message — composite commits carry their
+        ``(group, base_offset)`` coordinates and visibility defers to the
+        group seal (the registrar decides what that means per mode)."""
         message = self.output_writer.commit_all_partitions()
         self.on_commit(
             self.handle.shuffle_id, self.map_id, message.partition_lengths,
-            self.map_index,
+            self.map_index, message,
         )
         return message
 

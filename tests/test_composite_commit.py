@@ -1,0 +1,707 @@
+"""Composite commit plane (write/composite_commit.py + fat indexes +
+generation-stamped lifecycle).
+
+The plane's contract: composite-committed shuffles are BYTE-IDENTICAL to
+the one-object-per-map layout under every reader mode (tracker-hinted,
+listing-discovered); ``composite_commit_maps`` 0/1 reproduces the per-map
+store op sequence exactly; the fat index is the commit point (no seal ⇒
+no member visible, a failed seal fails every member loudly); empty maps
+claim no slot and trigger no store ops; the compactor rewrites singletons
+post-hoc with generation-stamped old objects that the TTL sweep reclaims;
+and the orphan sweep classifies composites per group.
+"""
+
+import random
+import time
+
+import numpy as np
+import pytest
+
+from s3shuffle_tpu.block_ids import (
+    ShuffleBlockId,
+    ShuffleCompositeDataBlockId,
+    ShuffleDataBlockId,
+    parse_tombstone_name,
+)
+from s3shuffle_tpu.config import ShuffleConfig
+from s3shuffle_tpu.metadata.fat_index import FatIndex, FatIndexMember
+from s3shuffle_tpu.metadata.helper import ScanIndexMemo, ShuffleHelper
+from s3shuffle_tpu.storage.dispatcher import Dispatcher
+from s3shuffle_tpu.storage.fault import FaultRule, FlakyBackend
+from s3shuffle_tpu.write.composite_commit import CompositeCommitAggregator
+from s3shuffle_tpu.write.map_output_writer import MapOutputWriter
+
+
+class RecordingBackend(FlakyBackend):
+    """Records every (op, path) — the request pattern the store bills."""
+
+    def __init__(self, inner):
+        super().__init__(inner)
+        self.ops = []
+
+    def _check(self, op: str, path: str) -> None:
+        self.ops.append((op, path))
+        super()._check(op, path)
+
+    def count(self, op: str, needle: str = "") -> int:
+        return sum(1 for o, p in self.ops if o == op and needle in p)
+
+
+def _env(tmp_path, tag, **cfg_kwargs):
+    cfg = ShuffleConfig(root_dir=f"file://{tmp_path}/{tag}", app_id=tag, **cfg_kwargs)
+    d = Dispatcher(cfg)
+    return cfg, d, ShuffleHelper(d)
+
+
+def _write_maps(d, helper, agg, sid, sizes, seed=0, base_map=0):
+    """sizes[m][p] = byte count; returns ({(m,p): bytes}, [commit messages])."""
+    rng = random.Random(seed)
+    truth, messages = {}, []
+    for i, row in enumerate(sizes):
+        m = base_map + i
+        w = MapOutputWriter(d, helper, sid, m, len(row), aggregator=agg)
+        for p, n in enumerate(row):
+            data = rng.randbytes(n)
+            truth[(m, p)] = data
+            pw = w.get_partition_writer(p)
+            if data:
+                pw.write(data)
+            pw.close()
+        messages.append(w.commit_all_partitions())
+    return truth, messages
+
+
+def _drain_all(d, helper, cfg, sid, sizes):
+    from s3shuffle_tpu.read.chunked_fetch import ChunkedRangeFetcher
+    from s3shuffle_tpu.read.scan_plan import build_scan_iterator
+
+    blocks = [
+        ShuffleBlockId(sid, m, p)
+        for m in range(len(sizes))
+        for p in range(len(sizes[m]))
+    ]
+    it = build_scan_iterator(
+        d, ScanIndexMemo(helper), blocks, cfg,
+        fetcher=ChunkedRangeFetcher.from_config(cfg),
+    )
+    got = {}
+    for s in it:
+        got[(s.block.map_id, s.block.reduce_id)] = s.readall()
+        s.close()
+    return got
+
+
+# ---------------------------------------------------------------------------
+# Fat index wire format
+# ---------------------------------------------------------------------------
+
+
+def test_fat_index_roundtrip_with_and_without_checksums():
+    members = [
+        FatIndexMember(10, 10, 0, np.array([0, 5, 5, 9], dtype=np.int64),
+                       np.array([1, 2, 3], dtype=np.int64)),
+        FatIndexMember(11, 11, 9, np.array([0, 0, 4, 4], dtype=np.int64),
+                       np.array([4, 5, 6], dtype=np.int64)),
+    ]
+    fat = FatIndex(3, 10, 3, members)
+    back = FatIndex.from_bytes(fat.to_bytes())
+    assert back.shuffle_id == 3 and back.group_id == 10 and back.has_checksums
+    assert set(back.members) == {10, 11}
+    m = back.member(11)
+    assert m.base_offset == 9 and list(m.offsets) == [0, 0, 4, 4]
+    assert list(m.checksums) == [4, 5, 6]
+    with pytest.raises(FileNotFoundError):
+        back.member(99)
+
+    no_ck = [FatIndexMember(7, 7, 0, np.array([0, 2], dtype=np.int64))]
+    fat2 = FatIndex(1, 7, 1, no_ck)
+    back2 = FatIndex.from_bytes(fat2.to_bytes())
+    assert not back2.has_checksums and back2.member(7).checksums is None
+
+    with pytest.raises(ValueError):
+        FatIndex.from_bytes(b"short")
+    with pytest.raises(ValueError):
+        FatIndex.from_bytes(b"\x00" * 7 * 8)  # wrong magic
+
+
+# ---------------------------------------------------------------------------
+# Aggregator sealing
+# ---------------------------------------------------------------------------
+
+
+def test_group_seals_at_member_count_and_assigns_bases(tmp_path):
+    Dispatcher.reset()
+    cfg, d, helper = _env(tmp_path, "count", composite_commit_maps=3)
+    sealed = []
+    agg = CompositeCommitAggregator(
+        d, helper, on_group_commit=lambda sid, ms: sealed.append((sid, ms))
+    )
+    sizes = [[50, 60]] * 7
+    truth, messages = _write_maps(d, helper, agg, 0, sizes)
+    # 7 maps at group size 3: two sealed groups, one open member
+    assert len(sealed) == 2
+    assert [len(ms) for _sid, ms in sealed] == [3, 3]
+    assert len(agg.pending_members(0)) == 1
+    # group ids are the first member's map_id; bases accumulate
+    first = sealed[0][1]
+    assert [m.group_id for m in first] == [0, 0, 0]
+    assert [m.base_offset for m in first] == [0, 110, 220]
+    # every commit message carried its coordinates immediately
+    assert [ms.composite_group for ms in messages] == [0, 0, 0, 3, 3, 3, 6]
+    agg.flush_all()  # barrier seals the remainder
+    assert len(sealed) == 3 and len(agg.pending_members(0)) == 0
+    assert _drain_all(d, helper, cfg, 0, sizes) == truth
+
+
+def test_group_seals_at_byte_threshold_and_age(tmp_path):
+    Dispatcher.reset()
+    cfg, d, helper = _env(
+        tmp_path, "bytes",
+        composite_commit_maps=100, composite_flush_bytes=1000,
+        # large: the commit path's built-in stale check must not fire during
+        # the test; the explicit maybe_flush_stale below drives the clock
+        composite_flush_ms=60_000.0,
+    )
+    sealed = []
+    agg = CompositeCommitAggregator(
+        d, helper, on_group_commit=lambda sid, ms: sealed.append(len(ms))
+    )
+    _write_maps(d, helper, agg, 0, [[600], [600]])  # 1200 >= 1000 at map 1
+    assert sealed == [2]
+    # age-based: an open group past composite_flush_ms seals on the next touch
+    _write_maps(d, helper, agg, 0, [[10]], seed=9)
+    assert agg.maybe_flush_stale(now=time.monotonic() + 120.0) == 1
+    assert sealed == [2, 1]
+
+
+def test_group_ids_never_collide_across_attempt_unique_map_ids(tmp_path):
+    Dispatcher.reset()
+    cfg, d, helper = _env(tmp_path, "gid", composite_commit_maps=2)
+    agg = CompositeCommitAggregator(d, helper)
+    for m in (1000, 2000, 3000):  # attempt-strided ids from different maps
+        w = MapOutputWriter(d, helper, 5, m, 1, aggregator=agg)
+        pw = w.get_partition_writer(0)
+        pw.write(b"x" * 8)
+        pw.close()
+        w.commit_all_partitions()
+    agg.flush_all()
+    assert d.list_composite_groups(5) == [1000, 3000]
+
+
+# ---------------------------------------------------------------------------
+# Layout parity
+# ---------------------------------------------------------------------------
+
+
+def test_knob_zero_reproduces_per_map_op_sequence(tmp_path):
+    """composite_commit_maps=0 must be op-for-op identical to the legacy
+    one-object-per-map writer — the same regression PR 5 pinned for
+    coalesce_gap_bytes=0 on the read side."""
+    from s3shuffle_tpu.storage.local import LocalBackend
+
+    sizes = [[100, 0, 50], [0, 30, 60]]
+
+    def run(tag, aggregator_factory):
+        Dispatcher.reset()
+        cfg, d, helper = _env(tmp_path, tag, composite_commit_maps=0)
+        rec = RecordingBackend(LocalBackend())
+        d.backend = rec
+        agg = aggregator_factory(d, helper)
+        _write_maps(d, helper, agg, 0, sizes)
+        # strip the run-specific root from paths so sequences compare
+        return [(op, p.rsplit("/", 1)[-1]) for op, p in rec.ops]
+
+    legacy = run("legacy", lambda d, h: None)
+    knob_off = run("knoboff", lambda d, h: CompositeCommitAggregator(d, h))
+    assert knob_off == legacy
+
+
+def test_composite_byte_identical_to_per_map_layout(tmp_path):
+    sizes = [[200, 0, 77], [0, 10, 0], [64, 64, 64], [1, 2, 3], [500, 1, 0]]
+    outs = {}
+    for tag, maps in (("permap", 0), ("comp", 3)):
+        Dispatcher.reset()
+        cfg, d, helper = _env(tmp_path, tag, composite_commit_maps=maps)
+        agg = CompositeCommitAggregator(d, helper) if maps else None
+        truth, _ = _write_maps(d, helper, agg, 0, sizes, seed=4)
+        if agg is not None:
+            agg.flush_all()
+        outs[tag] = (truth, _drain_all(d, helper, cfg, 0, sizes))
+    assert outs["permap"][1] == {
+        k: v for k, v in outs["permap"][0].items() if v
+    }
+    assert outs["comp"][1] == outs["permap"][1]
+
+
+def test_listing_mode_discovers_composites(tmp_path):
+    """A FRESH helper (new process) in listing mode finds composite members
+    through the cindex listing and serves byte-identical reads, including
+    checksums from the fat index."""
+    Dispatcher.reset()
+    sizes = [[40, 50], [60, 70], [80, 90]]
+    cfg, d, helper = _env(tmp_path, "listing", composite_commit_maps=2,
+                          use_block_manager=False)
+    agg = CompositeCommitAggregator(d, helper)
+    truth, _ = _write_maps(d, helper, agg, 0, sizes)
+    agg.flush_all()
+    fresh = ShuffleHelper(d)  # no hints — must discover by listing
+    assert _drain_all(d, fresh, cfg, 0, sizes) == truth
+    cks = fresh.get_checksums(0, 1)
+    assert len(cks) == 2 and int(cks[0]) != 0
+
+
+# ---------------------------------------------------------------------------
+# Empty maps + aborts (the PR-2 empty-abort contract, composite edition)
+# ---------------------------------------------------------------------------
+
+
+def test_empty_map_claims_no_slot_and_no_store_ops(tmp_path):
+    from s3shuffle_tpu.storage.local import LocalBackend
+
+    Dispatcher.reset()
+    cfg, d, helper = _env(tmp_path, "empty", composite_commit_maps=4)
+    rec = RecordingBackend(LocalBackend())
+    d.backend = rec
+    agg = CompositeCommitAggregator(d, helper)
+    w = MapOutputWriter(d, helper, 0, 0, 3, aggregator=agg)
+    for p in range(3):
+        w.get_partition_writer(p).close()  # zero bytes everywhere
+    msg = w.commit_all_partitions()
+    assert not msg.deferred
+    assert agg.pending_members(0) == []  # no slot claimed
+    assert rec.ops == []  # and NO store op of any kind
+    # ... and always_create_index restores visible empty outputs
+    Dispatcher.reset()
+    cfg2, d2, helper2 = _env(tmp_path, "emptyvis", composite_commit_maps=4,
+                             always_create_index=True)
+    agg2 = CompositeCommitAggregator(d2, helper2)
+    w2 = MapOutputWriter(d2, helper2, 0, 0, 3, aggregator=agg2)
+    for p in range(3):
+        w2.get_partition_writer(p).close()
+    msg2 = w2.commit_all_partitions()
+    assert msg2.deferred and len(agg2.pending_members(0)) == 1
+    agg2.flush_all()
+    fat = helper2.read_fat_index(0, 0)
+    assert fat.member(0).total_bytes == 0
+
+
+def test_aborted_composite_map_triggers_no_store_ops(tmp_path):
+    """Sibling of the PR-2 MapOutputWriter.abort regression: an aborted
+    composite-mode map (even one that buffered bytes) must create nothing
+    and delete nothing — its spool is local state."""
+    from s3shuffle_tpu.storage.local import LocalBackend
+
+    Dispatcher.reset()
+    cfg, d, helper = _env(tmp_path, "abort", composite_commit_maps=4)
+    rec = RecordingBackend(LocalBackend())
+    d.backend = rec
+    agg = CompositeCommitAggregator(d, helper)
+    w = MapOutputWriter(d, helper, 0, 0, 2, aggregator=agg)
+    pw = w.get_partition_writer(0)
+    pw.write(b"y" * 128)
+    pw.close()
+    w.abort(RuntimeError("boom"))
+    assert rec.ops == []
+    assert agg.pending_members(0) == []
+
+
+# ---------------------------------------------------------------------------
+# Commit point + registration
+# ---------------------------------------------------------------------------
+
+
+def test_registration_defers_to_group_seal_and_carries_coordinates(tmp_path):
+    from s3shuffle_tpu.manager import ShuffleManager
+    from s3shuffle_tpu.dependency import HashPartitioner, ShuffleDependency
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/mgr", app_id="mgr", composite_commit_maps=3
+    )
+    mgr = ShuffleManager(config=cfg)
+    dep = ShuffleDependency(0, HashPartitioner(2))
+    handle = mgr.register_shuffle(0, dep)
+    rng = random.Random(0)
+    records = [(rng.randbytes(8), rng.randbytes(16)) for _ in range(300)]
+    for m in range(2):
+        w = mgr.get_writer(handle, m)
+        w.write(records[m::2])
+        w.stop(success=True)
+    # two commits, group of three: nothing registered yet — the fat index
+    # (commit point) has not been written
+    assert mgr.tracker.get_map_sizes_by_range(0, 0, None, 0, 2) == []
+    w = mgr.get_writer(handle, 2)
+    w.write([])
+    # an empty third map claims no slot; the barrier (get_reader) seals
+    reader = mgr.get_reader(handle, 0, 2)
+    entries = mgr.tracker.get_map_sizes_by_range(0, 0, None, 0, 2)
+    assert sorted(m for m, _s in entries) == [0, 1]
+    locs = mgr.tracker.composite_locations(0)
+    assert [(m, g) for m, g, _b in locs] == [(0, 0), (1, 0)]
+    assert sorted(records) == sorted(reader.read())
+    w.stop(success=True)
+
+
+def test_failed_seal_aborts_members_and_drops_composite(tmp_path):
+    Dispatcher.reset()
+    cfg, d, helper = _env(tmp_path, "sealfail", composite_commit_maps=8,
+                          storage_retries=0)
+    aborted = []
+    agg = CompositeCommitAggregator(
+        d, helper,
+        on_group_abort=lambda sid, ms, e: aborted.append((sid, [m.map_id for m in ms], e)),
+    )
+    _write_maps(d, helper, agg, 0, [[100], [100]])
+    flaky = FlakyBackend(
+        d.backend, rules=[FaultRule("create", match=".cindex", exc=IOError)]
+    )
+    d.backend = flaky
+    with pytest.raises(IOError):
+        agg.flush_all()
+    assert aborted and aborted[0][1] == [0, 1]
+    # the torn composite object is gone and nothing is resolvable
+    assert d.list_composite_groups(0) == []
+    with pytest.raises(FileNotFoundError):
+        helper.resolve_map_location(0, 0)
+
+
+def test_manager_poisons_reads_after_mid_stage_seal_failure(tmp_path):
+    """Manager (library/threaded) mode has no task framework to fail a
+    sealed-failed group's members through: the shuffle must be poisoned so
+    the read barrier raises loudly instead of silently serving output
+    missing those maps."""
+    from s3shuffle_tpu.dependency import HashPartitioner, ShuffleDependency
+    from s3shuffle_tpu.manager import ShuffleManager
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/poison", app_id="poison",
+        composite_commit_maps=2, storage_retries=0,
+    )
+    mgr = ShuffleManager(config=cfg)
+    dep = ShuffleDependency(0, HashPartitioner(1))
+    handle = mgr.register_shuffle(0, dep)
+    w = mgr.get_writer(handle, 0)
+    w.write([(b"k", b"v")])
+    w.stop(success=True)  # member 1 committed, report deferred to seal
+    mgr.dispatcher.backend = FlakyBackend(
+        mgr.dispatcher.backend,
+        rules=[FaultRule("create", match=".cindex", exc=IOError)],
+    )
+    w2 = mgr.get_writer(handle, 1)
+    w2.write([(b"k2", b"v2")])
+    with pytest.raises(IOError):
+        w2.stop(success=True)  # count threshold seals mid-stage and fails
+    # an embedder that swallowed the task failure must still not get a
+    # silent partial scan
+    with pytest.raises(RuntimeError, match="lost composite-committed"):
+        mgr.get_reader(handle, 0, 1)
+    mgr.unregister_shuffle(0)  # clears the poison with the shuffle
+
+
+def test_flush_all_isolates_group_failures(tmp_path):
+    """One group's seal failure must not orphan the other open groups:
+    every group gets its seal attempt (the healthy one commits, the torn
+    one aborts its members loudly), and the first failure still surfaces
+    to the flush caller."""
+    Dispatcher.reset()
+    cfg, d, helper = _env(tmp_path, "isolate", composite_commit_maps=8,
+                          storage_retries=0)
+    events = []
+    agg = CompositeCommitAggregator(
+        d, helper,
+        on_group_commit=lambda sid, ms: events.append(("commit", sid)),
+        on_group_abort=lambda sid, ms, e: events.append(("abort", sid)),
+    )
+    _write_maps(d, helper, agg, 0, [[64]])  # shuffle 0's fat index will fail
+    _write_maps(d, helper, agg, 1, [[64]])  # shuffle 1 must seal regardless
+    d.backend = FlakyBackend(
+        d.backend,
+        rules=[FaultRule("create", match="shuffle_0_comp", exc=IOError)],
+    )
+    with pytest.raises(IOError):
+        agg.flush_all()
+    assert sorted(events) == [("abort", 0), ("commit", 1)]
+    assert d.list_composite_groups(1) == [0]
+    assert helper.resolve_map_location(1, 0).data_block == ShuffleCompositeDataBlockId(1, 0)
+
+
+# ---------------------------------------------------------------------------
+# Compactor + generation lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_compactor_rewrites_tombstones_and_ttl_sweep_reclaims(tmp_path, metrics_on):
+    from s3shuffle_tpu.metadata.map_output import MapOutputTracker
+    from s3shuffle_tpu.write.compactor import compact_shuffle
+
+    Dispatcher.reset()
+    sizes = [[100, 120], [90, 80], [70, 60], [50, 40]]
+    cfg, d, helper = _env(tmp_path, "compact", compact_below_bytes=4096)
+    truth, _ = _write_maps(d, helper, None, 0, sizes)  # singleton layout
+    tracker = MapOutputTracker()
+    tracker.register_shuffle(0, 2)
+    from s3shuffle_tpu.metadata.map_output import STORE_LOCATION, MapStatus
+
+    for m, row in enumerate(sizes):
+        tracker.register_map_output(
+            0, MapStatus(map_id=m, location=STORE_LOCATION,
+                         sizes=np.array(row, dtype=np.int64))
+        )
+    report = compact_shuffle(d, helper, 0, tracker=tracker)
+    assert report.groups == 1 and report.maps == 4
+    assert report.tombstoned == 4 * 3  # data+index+checksum per map
+    # tracker re-pointed: every winner now carries composite coordinates
+    locs = tracker.composite_locations(0)
+    assert [(m, g) for m, g, _b in locs] == [(0, 0), (1, 0), (2, 0), (3, 0)]
+    # old objects still live (in-flight scans may hold them) ...
+    assert d.backend.status(d.get_path(ShuffleDataBlockId(0, 0))).size > 0
+    # ... reads resolve the composite and stay byte-identical
+    assert _drain_all(d, helper, cfg, 0, sizes) == truth
+    # TTL sweep with ttl=0 reclaims the superseded generation + tombstone
+    removed = d.sweep_expired_generations(0, ttl_s=0)
+    assert len(removed) == 12 + 1
+    with pytest.raises(OSError):
+        d.backend.status(d.get_path(ShuffleDataBlockId(0, 0)))
+    assert not any(
+        parse_tombstone_name(st.path) for st in d.backend.list_prefix(f"file://{tmp_path}/compact")
+    )
+    # a FRESH helper still reads everything through the composite
+    assert _drain_all(d, ShuffleHelper(d), cfg, 0, sizes) == truth
+    # sweep deletions were metered by reason
+    snap = metrics_on.snapshot(compact=True)
+    by_reason = {
+        s["labels"]["reason"]: s["value"]
+        for s in snap["storage_sweep_deleted_total"]["series"]
+    }
+    assert by_reason.get("generation") == 13
+
+
+def test_compaction_rerun_is_a_no_op_and_never_mutates_live_composites(tmp_path):
+    """Rerun safety: before the TTL sweep reclaims the tombstoned
+    singletons, a second compaction pass (the cron/storage_sweep shape)
+    must select nothing — re-deriving the same group id from still-listed
+    singletons would overwrite a LIVE committed composite in place."""
+    from s3shuffle_tpu.write.compactor import compact_shuffle
+
+    Dispatcher.reset()
+    sizes = [[100, 120], [90, 80], [70, 60]]
+    cfg, d, helper = _env(tmp_path, "rerun", compact_below_bytes=4096)
+    truth, _ = _write_maps(d, helper, None, 0, sizes)
+    first = compact_shuffle(d, helper, 0)
+    assert first.groups == 1
+    comp_path = d.get_path(ShuffleCompositeDataBlockId(0, 0))
+    before = d.backend.read_all(comp_path)
+    # second pass, wider threshold, tracker-less (the CLI shape): no-op
+    second = compact_shuffle(d, helper, 0, below_bytes=1 << 30)
+    assert second.groups == 0 and second.tombstoned == 0
+    assert d.backend.read_all(comp_path) == before
+    assert _drain_all(d, helper, cfg, 0, sizes) == truth
+
+
+def test_orphan_sweep_classifies_composites(tmp_path, metrics_on):
+    Dispatcher.reset()
+    cfg, d, helper = _env(tmp_path, "orphan", composite_commit_maps=2)
+    # group A (maps 0,1): sealed, both winners -> kept
+    agg = CompositeCommitAggregator(d, helper)
+    _write_maps(d, helper, agg, 0, [[10], [20]])
+    # group B (maps 2,3): sealed, NO winners -> reclaimed whole
+    _write_maps(d, helper, agg, 0, [[30], [40]], seed=1, base_map=2)
+    agg.flush_all()
+    groups = d.list_composite_groups(0)
+    assert len(groups) == 2
+    # rename group B's members out of the winner set by picking winners={0,1}
+    # plus an UNCOMMITTED composite: data object with no cindex
+    orphan_data = ShuffleCompositeDataBlockId(0, 999)
+    with d.backend.create(d.get_path(orphan_data)) as s:
+        s.write(b"torn")
+    removed = d.sweep_orphan_attempts(0, winner_map_ids=[0, 1])
+    names = sorted(p.rsplit("/", 1)[-1] for p in removed)
+    assert names == [
+        "shuffle_0_comp_2.cindex", "shuffle_0_comp_2.data",
+        "shuffle_0_comp_999.data",
+    ]
+    # the winners' group survived and still resolves
+    assert helper.resolve_map_location(0, 0).data_block == ShuffleCompositeDataBlockId(0, 0)
+    snap = metrics_on.snapshot(compact=True)
+    by_reason = {
+        s["labels"]["reason"]: s["value"]
+        for s in snap["storage_sweep_deleted_total"]["series"]
+    }
+    assert by_reason == {"orphan": 2, "uncommitted-composite": 1}
+
+
+def test_orphan_sweep_keeps_mixed_groups(tmp_path):
+    Dispatcher.reset()
+    cfg, d, helper = _env(tmp_path, "mixed", composite_commit_maps=2)
+    agg = CompositeCommitAggregator(d, helper)
+    _write_maps(d, helper, agg, 0, [[10], [20]])
+    agg.flush_all()
+    # map 1 is a dead attempt, map 0 won: the shared group must survive
+    removed = d.sweep_orphan_attempts(0, winner_map_ids=[0])
+    assert removed == []
+    assert helper.resolve_map_location(0, 0).data_block == ShuffleCompositeDataBlockId(0, 0)
+
+
+# ---------------------------------------------------------------------------
+# Wire formats
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_v2_roundtrips_composite_coordinates():
+    from s3shuffle_tpu.metadata.map_output import (
+        STORE_LOCATION, MapOutputTracker, MapStatus,
+    )
+    from s3shuffle_tpu.metadata.snapshot import MapOutputSnapshot, build_snapshot
+
+    tracker = MapOutputTracker()
+    tracker.register_shuffle(9, 2)
+    tracker.register_map_output(
+        9, MapStatus(map_id=0, location=STORE_LOCATION,
+                     sizes=np.array([5, 6], dtype=np.int64),
+                     composite_group=0, base_offset=0),
+    )
+    tracker.register_map_output(
+        9, MapStatus(map_id=1, location=STORE_LOCATION,
+                     sizes=np.array([7, 8], dtype=np.int64),
+                     composite_group=0, base_offset=11),
+    )
+    tracker.register_map_output(
+        9, MapStatus(map_id=2, location=STORE_LOCATION,
+                     sizes=np.array([1, 2], dtype=np.int64)),  # singleton
+    )
+    snap = build_snapshot(tracker, 9)
+    back = MapOutputSnapshot.from_bytes(snap.to_bytes())
+    assert back.composite_locations() == [(0, 0, 0), (1, 0, 11)]
+    assert back.composite_locations() == tracker.composite_locations(9)
+    assert back.get_map_sizes_by_range(0, None, 0, 2) == snap.get_map_sizes_by_range(0, None, 0, 2)
+
+
+# ---------------------------------------------------------------------------
+# Distributed workers: deferred completion reports
+# ---------------------------------------------------------------------------
+
+
+def test_distributed_workers_defer_reports_until_group_seal(tmp_path):
+    """WorkerAgent fleet with composite commits: a map task's completion
+    report (which carries its registration) waits for the group seal — the
+    fat index is the commit point — and the queue-dry poll is the barrier
+    that seals the remainder. The sort output must be correct and the
+    store must actually hold composite objects."""
+    import threading
+
+    from s3shuffle_tpu.batch import RecordBatch
+    from s3shuffle_tpu.cluster import DistributedDriver
+    from s3shuffle_tpu.worker import WorkerAgent
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/dist", app_id="dist-comp", codec="zlib",
+        composite_commit_maps=3,
+        composite_flush_ms=0.0,  # only count/size/barrier seals: the last
+        # group MUST ride the queue-dry deferred-report path
+    )
+    rng = random.Random(7)
+    recs = [(rng.randbytes(8), rng.randbytes(24)) for _ in range(800)]
+    batches = [RecordBatch.from_records(recs[i::4]) for i in range(4)]
+
+    driver = DistributedDriver(cfg)
+    agents = [
+        WorkerAgent(driver.coordinator_address, config=cfg, worker_id=f"cw{i}")
+        for i in range(2)
+    ]
+    threads = [
+        threading.Thread(
+            target=a.run_forever, kwargs={"poll_interval": 0.01}, daemon=True
+        )
+        for a in agents
+    ]
+    for t in threads:
+        t.start()
+    try:
+        out = driver.run_sort_shuffle(batches, num_partitions=3)
+        got = []
+        for b in out:
+            got.extend(b.to_records())
+        assert sorted(got) == sorted(recs)
+        # the shuffle really went through composite objects
+        assert driver.dispatcher.list_composite_groups(0)
+        # ... and per-map data objects were never created
+        singles, groups = driver.dispatcher.list_committed_outputs(0)
+        assert singles == [] and groups
+    finally:
+        driver.shutdown(remove_root=True)
+        for t in threads:
+            t.join(timeout=10)
+        for a in agents:
+            a.close()
+    assert all(not t.is_alive() for t in threads)
+
+
+def test_driver_compacts_between_barriers_and_reducers_read_composites(tmp_path):
+    """Composite plane OFF on the workers, compactor ON at the driver: maps
+    write singletons, the driver compacts them between the map barrier and
+    the snapshot publish, and reducers resolve the compacted layout through
+    the snapshot's composite coordinates (wire v2). Output must be correct
+    and the store must hold composites + a generation tombstone."""
+    import threading
+
+    from s3shuffle_tpu.batch import RecordBatch
+    from s3shuffle_tpu.cluster import DistributedDriver
+    from s3shuffle_tpu.worker import WorkerAgent
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/drv", app_id="drv-comp", codec="zlib",
+        compact_below_bytes=1 << 20,  # everything here is tiny: all compact
+    )
+    rng = random.Random(3)
+    recs = [(rng.randbytes(8), rng.randbytes(24)) for _ in range(600)]
+    batches = [RecordBatch.from_records(recs[i::3]) for i in range(3)]
+
+    driver = DistributedDriver(cfg)
+    agents = [
+        WorkerAgent(driver.coordinator_address, config=cfg, worker_id=f"kw{i}")
+        for i in range(2)
+    ]
+    threads = [
+        threading.Thread(
+            target=a.run_forever, kwargs={"poll_interval": 0.01}, daemon=True
+        )
+        for a in agents
+    ]
+    for t in threads:
+        t.start()
+    try:
+        out = driver.run_sort_shuffle(batches, num_partitions=2)
+        got = []
+        for b in out:
+            got.extend(b.to_records())
+        assert sorted(got) == sorted(recs)
+        # the compactor ran: composite groups + a generation tombstone live
+        # in the store (old singletons still present until the TTL sweep)
+        assert driver.dispatcher.list_composite_groups(0)
+        tombs = [
+            st.path
+            for prefix in driver.dispatcher._shuffle_prefixes(0)
+            for st in driver.dispatcher.backend.list_prefix(prefix)
+            if parse_tombstone_name(st.path)
+        ]
+        assert tombs
+    finally:
+        driver.shutdown(remove_root=True)
+        for t in threads:
+            t.join(timeout=10)
+        for a in agents:
+            a.close()
+
+
+@pytest.fixture
+def metrics_on():
+    from s3shuffle_tpu.metrics import registry as mreg
+
+    mreg.REGISTRY.reset_values()
+    mreg.enable()
+    yield mreg.REGISTRY
+    mreg.disable()
+    mreg.REGISTRY.reset_values()

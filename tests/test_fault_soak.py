@@ -80,7 +80,10 @@ def _soak_rules():
     ]
 
 
-def test_fault_soak_shuffle_byte_identical(tmp_path, metrics_on):
+@pytest.mark.parametrize(
+    "composite_maps", [0, 2], ids=["per-map-layout", "composite-commits"]
+)
+def test_fault_soak_shuffle_byte_identical(tmp_path, metrics_on, composite_maps):
     # --- fault-free baseline -------------------------------------------
     Dispatcher.reset()
     clean_cfg = ShuffleConfig(
@@ -91,11 +94,16 @@ def test_fault_soak_shuffle_byte_identical(tmp_path, metrics_on):
     assert clean_out == expected
 
     # --- the soak: same workload over seeded transient weather ---------
+    # composite_maps=2 re-drives the whole soak through the composite
+    # commit plane (groups of 2, fat-index commit point): output must stay
+    # byte-identical and cleanup must leave zero residual objects —
+    # including composites, fat indexes, and generation tombstones.
     Dispatcher.reset()
     soak_cfg = ShuffleConfig(
         root_dir=f"file://{tmp_path}/soak",
         app_id="soak",
         cleanup=True,
+        composite_commit_maps=composite_maps,
         # tight backoff keeps the soak at unit-test speed; the generous
         # retry budget makes exhaustion (p≈0.05 per attempt, independent
         # draws) astronomically unlikely
@@ -114,6 +122,11 @@ def test_fault_soak_shuffle_byte_identical(tmp_path, metrics_on):
 
         # byte-identical to the fault-free run
         assert soak_out == clean_out
+
+        if composite_maps:
+            # the composite plane actually carried the shuffle: sealed
+            # fat indexes exist before teardown
+            assert disp.list_composite_groups(handle.shuffle_id)
 
         # weather actually happened and was healed below the task layer
         hits = sum(rule.hits for rule in flaky.rules)

@@ -405,7 +405,7 @@ def test_spill_triggers_across_multiple_write_calls(env):
         0,
         MapOutputWriter(d, helper, 30, 0, 2),
         codec=None,
-        on_commit=lambda s, m, l, mi: committed.append((s, m)),
+        on_commit=lambda s, m, l, mi, msg=None: committed.append((s, m)),
         spill_memory_budget=1000,
     )
     payload = b"x" * 100

@@ -1,0 +1,85 @@
+"""Store lifecycle sweeps — the namespace janitor.
+
+At millions-of-small-shuffles scale, leaked objects are a real cost: dead
+attempts' outputs, uncommitted composites, and generation-tombstoned
+singletons the compactor superseded all sit in the namespace until
+something reclaims them. Inside a job the driver runs these sweeps at its
+barriers; this CLI is the OUT-of-band entrypoint — cron it against a
+shared bucket, or run it once after a crashed job:
+
+    python -m tools.storage_sweep --root s3://bucket/shuffle/ --app app \\
+        --shuffle 7                      # sweep one shuffle's generations
+    python -m tools.storage_sweep ... --shuffle 7 --ttl 0   # ignore TTL
+    python -m tools.storage_sweep ... --shuffle 7 --orphans --winners 3,7
+    python -m tools.storage_sweep ... --shuffle 7 --compact --below 1048576
+
+Every deletion is metered (``storage_sweep_deleted_total{reason}``) and
+printed; list/delete failures warn and continue (the remove_shuffle
+policy) — a janitor must never die mid-broom.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+from typing import List, Optional
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser(description="s3shuffle_tpu store lifecycle sweeps")
+    ap.add_argument("--root", required=True, help="shuffle root (e.g. file:///tmp/x/)")
+    ap.add_argument("--app", default="app", help="application id in the layout")
+    ap.add_argument("--shuffle", type=int, required=True, help="shuffle id to sweep")
+    ap.add_argument("--ttl", type=float, default=None,
+                    help="generation TTL seconds (default: config tombstone_ttl_s; "
+                         "0 reclaims every stamped generation immediately)")
+    ap.add_argument("--orphans", action="store_true",
+                    help="also sweep dead-attempt orphans (requires --winners)")
+    ap.add_argument("--winners", default="",
+                    help="comma-separated committed map_ids (the keep set) for --orphans")
+    ap.add_argument("--compact", action="store_true",
+                    help="compact small singleton outputs into composites first")
+    ap.add_argument("--below", type=int, default=None,
+                    help="compaction size threshold bytes (default: config "
+                         "compact_below_bytes)")
+    args = ap.parse_args(argv)
+
+    from s3shuffle_tpu.config import ShuffleConfig
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+
+    cfg = ShuffleConfig.from_env(root_dir=args.root, app_id=args.app)
+    dispatcher = Dispatcher.get(cfg)
+    removed_total = 0
+
+    if args.compact:
+        from s3shuffle_tpu.metadata.helper import ShuffleHelper
+        from s3shuffle_tpu.write.compactor import compact_shuffle
+
+        report = compact_shuffle(
+            dispatcher, ShuffleHelper(dispatcher), args.shuffle,
+            below_bytes=args.below,
+        )
+        print(
+            f"compacted shuffle {args.shuffle}: {report.maps} outputs -> "
+            f"{report.groups} group(s), {report.tombstoned} objects tombstoned"
+        )
+
+    if args.orphans:
+        winners = [int(w) for w in args.winners.split(",") if w.strip()]
+        removed = dispatcher.sweep_orphan_attempts(args.shuffle, winners)
+        removed_total += len(removed)
+        for path in removed:
+            print(f"orphan: {path}")
+
+    removed = dispatcher.sweep_expired_generations(args.shuffle, ttl_s=args.ttl)
+    removed_total += len(removed)
+    for path in removed:
+        print(f"generation: {path}")
+    print(f"swept shuffle {args.shuffle}: {removed_total} object(s) reclaimed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

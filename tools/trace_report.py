@@ -214,6 +214,29 @@ def _scan_planner_line(snapshot: dict) -> Optional[str]:
     return line
 
 
+def _write_plane_line(snapshot: dict) -> Optional[str]:
+    """One-line write-plane digest: PUTs the composite commit plane issued
+    vs what the one-object-per-map layout would have issued, the group
+    fill ratio (maps per composite group), and compactor activity."""
+    groups = _counter_total(snapshot, "write_composite_groups_total")
+    compacted = _counter_total(snapshot, "write_compacted_objects_total")
+    if groups <= 0 and compacted <= 0:
+        return None
+    parts = []
+    if groups > 0:
+        members = _counter_total(snapshot, "write_composite_members_total")
+        saved = _counter_total(snapshot, "write_puts_saved_total")
+        issued = 2 * groups  # data + fat index per sealed group
+        parts.append(
+            f"{groups:g} composite groups, {members:g} map outputs "
+            f"({members / groups:.2f} maps/group fill), "
+            f"{saved:g} PUTs saved ({issued + saved:g} → {issued:g})"
+        )
+    if compacted > 0:
+        parts.append(f"compactor rewrote {compacted:g} singleton outputs")
+    return "Write plane: " + "; ".join(parts)
+
+
 def render_metrics_snapshot(
     snapshot: dict, top: int = 10, reduce_tasks: Optional[int] = None
 ) -> str:
@@ -272,6 +295,7 @@ def render_metrics_snapshot(
         out.append(_table(("counter", "value"), counter_rows))
     for line in (
         _scan_planner_line(snapshot),
+        _write_plane_line(snapshot),
         _control_plane_line(snapshot, reduce_tasks=reduce_tasks),
     ):
         if line:
@@ -390,10 +414,10 @@ def _synthetic_snapshot() -> dict:
     buckets[8] = 10
     _SAMPLE_LABELS = {"scheme": "file", "op": "read", "direction": "up",
                       "codec": "native", "method": "register_map_outputs",
-                      "shard": "0", "source": "snapshot"}
+                      "shard": "0", "source": "snapshot", "reason": "orphan"}
     _ALT_LABELS = {"scheme": "s3", "op": "open", "direction": "down",
                    "codec": "zlib", "method": "get_map_sizes_by_ranges",
-                   "shard": "1", "source": "rpc"}
+                   "shard": "1", "source": "rpc", "reason": "generation"}
     snapshot: Dict[str, dict] = {}
     for name, (kind, labelnames) in sorted(KNOWN_METRICS.items()):
         series_list = []
@@ -463,6 +487,20 @@ def _selftest() -> int:
     # (7 segments + 7 saved GETs, 1 MiB waste over 2 MiB read = 50%)
     for needle in ("Scan planner:", "7 GETs saved", "(14 → 7)", "50.00% of bytes read"):
         assert needle in text, f"planner line missing {needle!r}:\n{text}"
+    # the write-plane digest renders from the synthetic composite/compactor
+    # counters (7 groups × 7 members → 1 map/group; 7 PUTs saved on 14)
+    for needle in (
+        "Write plane: 7 composite groups",
+        "(1.00 maps/group fill)",
+        "7 PUTs saved (21 → 14)",
+        "compactor rewrote 7 singleton outputs",
+    ):
+        assert needle in text, f"write-plane line missing {needle!r}:\n{text}"
+    # compactor-only runs (no composite groups) get a well-formed line too
+    solo = _write_plane_line(
+        {"write_compacted_objects_total": {"kind": "counter", "series": [{"value": 7}]}}
+    )
+    assert solo == "Write plane: compactor rewrote 7 singleton outputs", solo
     # the control-plane digest: two meta_rpc_total series of 7 → 14 RPCs over
     # 4 reduce tasks; lookup sources 7 snapshot + 7 rpc → 50% hit ratio
     for needle in (
