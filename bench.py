@@ -847,6 +847,17 @@ def _device_kernel_rates_impl():
             lambda d: tlz._encode_math(d, n_groups)[6:9],  # (n_new, n_split, n_match)
             "tpu_tlz_encode_mb_s",
         )
+        # fused encode+CRC: the write pipeline's actual launch (encode planes
+        # AND per-block CRC32C in one dispatch) — the gap this rate closes
+        # against tpu_tlz_encode_mb_s + tpu_crc32c_mb_s run as two passes is
+        # the whole point of the fusion (BASELINE "fused CRC32C" goal)
+        from s3shuffle_tpu.ops.checksum import raw_crc_graph_fn
+
+        crc_fn = raw_crc_graph_fn(POLY_CRC32C, L, 2 * B)
+        delta_rate(
+            lambda d: tlz._encode_fused_math(d, n_groups, crc_fn)[6:11],
+            "tpu_tlz_encode_fused_mb_s",
+        )
 
         # ratio + correctness from one untimed encode/decode round trip —
         # real payload sizes (including packed-metadata savings) via the
@@ -868,6 +879,16 @@ def _device_kernel_rates_impl():
             )
             comp_bytes += len(prefix) + tlz.GROUP * (n_groups - nm - ns)
         out["tpu_tlz_terasort_ratio"] = round(B * L / comp_bytes, 3)
+
+        # whole-batch vectorized assembly rate on the real encoded arrays
+        # (the host half of a device write; _assemble_batch is what the
+        # write path runs per launch)
+        arrs = (bitmap, cont, split, offs, ks, lits, n_new, n_split, n_match)
+        t0 = time.perf_counter()
+        _payloads = tlz._assemble_batch(arrs, B, n_groups)
+        out["tpu_codec_assembly_mb_s"] = round(
+            B * L / 1e6 / max(time.perf_counter() - t0, 1e-9), 1
+        )
 
         unpack = lambda a: np.unpackbits(  # noqa: E731
             a, axis=1, count=n_groups, bitorder="little"
@@ -1126,6 +1147,203 @@ def pipelined_commit_gain(
         "pipelined_commit_compute_ms": compute_s * 1e3,
         "pipelined_commit_write_latency_ms": delay_s * 1e3,
         "pipelined_commit_queue_bytes": part_bytes * 4,
+    }
+
+
+def _device_shaped_arrays(blocks, block_size):
+    """Device-batch-shaped encode arrays built from the numpy planes encoder
+    (byte-identical match decisions to the device kernel) — the assembly
+    microbench's stand-in for a chip launch on tunnel-down rigs."""
+    import numpy as np
+
+    from s3shuffle_tpu.ops import tlz
+
+    n_groups = block_size // tlz.GROUP
+    b = len(blocks)
+    bm = (n_groups + 7) // 8
+    bitmap = np.zeros((b, bm), np.uint8)
+    cont = np.zeros((b, bm), np.uint8)
+    split = np.zeros((b, bm), np.uint8)
+    offs = np.zeros((b, n_groups), np.uint16)
+    ks = np.zeros((b, n_groups), np.uint8)
+    lits = np.zeros((b, n_groups, tlz.GROUP), np.uint8)
+    n_new = np.zeros(b, np.int32)
+    n_split = np.zeros(b, np.int32)
+    n_match = np.zeros(b, np.int32)
+    for i, blk in enumerate(blocks):
+        bm_b, c_b, s_b, o_b, k_b, l_b, _ng = tlz._encode_planes_numpy(blk)
+        bitmap[i] = np.frombuffer(bm_b, np.uint8)
+        cont[i] = np.frombuffer(c_b, np.uint8)
+        split[i] = np.frombuffer(s_b, np.uint8)
+        o = np.frombuffer(o_b, "<u2")
+        k = np.frombuffer(k_b, np.uint8)
+        lit = np.frombuffer(l_b, np.uint8).reshape(-1, tlz.GROUP)
+        offs[i, : len(o)] = o
+        ks[i, : len(k)] = k
+        lits[i, : len(lit)] = lit
+        n_new[i], n_split[i] = len(o), len(k)
+        n_match[i] = n_groups - len(lit) - len(k)
+    return (bitmap, cont, split, offs, ks, lits, n_new, n_split, n_match)
+
+
+def device_codec_gain(
+    n_blocks: int = 48,
+    block_size: int = 64 * 1024,
+    inflight: int = 3,
+    batch_blocks: int = 4,
+    serialize_ms: float = 3.0,
+    put_ms: float = 6.0,
+):
+    """Device-codec-pipeline probe (write side): with the three-stage
+    pipeline on — serializer fills batch N+1, the shared encode thread
+    compresses batch N, the PR-2 pipelined-upload sink PUTs batch N−1 — the
+    wall must land strictly below the serialize + encode + upload stage-time
+    sum. Runs the HOST TLZ encoder (tpu-hostpath mode: chipless rigs and CI
+    measure the same overlap machinery the chip uses; the encode stage is
+    real compression work either way) over a terasort-shaped payload, with
+    ``serialize_ms`` of producer work per batch and ``put_ms`` injected per
+    store write. Byte identity between the pipelined and synchronous framed
+    streams is asserted, not assumed.
+
+    Also reports the whole-batch vectorized payload assembly speedup vs the
+    old per-block assembly on device-shaped arrays (the host-side half of
+    the batched-launch rework — where the old write path's throughput
+    died)."""
+    import io as _io
+
+    import numpy as np  # noqa: F401 — _device_shaped_arrays returns arrays
+
+    from s3shuffle_tpu.batch import RecordBatch, write_frame
+    from s3shuffle_tpu.codec.framing import CodecOutputStream
+    from s3shuffle_tpu.codec.tpu import TpuCodec
+    from s3shuffle_tpu.ops import tlz
+    from s3shuffle_tpu.write.pipelined_upload import PipelinedUploadStream
+
+    rng = random.Random(77)
+    filler = [rng.randbytes(VALUE_BYTES) for _ in range(64)]
+    need = n_blocks * block_size
+    recs = [
+        (rng.randbytes(KEY_BYTES), filler[rng.randrange(64)])
+        for _ in range(need // (KEY_BYTES + VALUE_BYTES + 8) + 100)
+    ]
+    buf = _io.BytesIO()
+    write_frame(buf, RecordBatch.from_records(recs))
+    payload = buf.getvalue()
+    if len(payload) < need:
+        payload = payload * (need // len(payload) + 1)
+    payload = payload[:need]
+    batch_bytes = batch_blocks * block_size
+    n_batches = (len(payload) + batch_bytes - 1) // batch_bytes
+
+    class SlowSink(_io.RawIOBase):
+        """Injected per-write PUT latency (the store round-trip stand-in)."""
+
+        def __init__(self):
+            self.chunks = []
+
+        def writable(self):
+            return True
+
+        def write(self, b):
+            time.sleep(put_ms / 1e3)
+            data = bytes(b)
+            self.chunks.append(data)
+            return len(data)
+
+    def run(window: int):
+        codec = TpuCodec(
+            block_size=block_size, batch_blocks=batch_blocks,
+            use_device=False, encode_inflight_batches=window,
+        )
+        store = SlowSink()
+        if window > 1:
+            # the real three-stage shape: encode window + background uploader
+            sink = PipelinedUploadStream(
+                store, queue_bytes=batch_bytes * 4, chunk_bytes=batch_bytes
+            )
+        else:
+            sink = store
+        out = CodecOutputStream(codec, sink, close_sink=window > 1)
+        t0 = time.perf_counter()
+        for ofs in range(0, len(payload), batch_bytes):
+            time.sleep(serialize_ms / 1e3)  # serializer fill stand-in
+            out.write(payload[ofs : ofs + batch_bytes])
+        out.close()
+        return time.perf_counter() - t0, b"".join(store.chunks)
+
+    try:
+        # stage times measured separately (the sum the pipeline must beat)
+        serialize_s = n_batches * serialize_ms / 1e3
+        ref_codec = TpuCodec(
+            block_size=block_size, batch_blocks=batch_blocks, use_device=False
+        )
+        t0 = time.perf_counter()
+        framed_ref = ref_codec.compress_framed(payload, n_blocks, block_size)
+        encode_s = time.perf_counter() - t0
+        upload_s = n_batches * put_ms / 1e3
+        sync_wall, framed_sync = run(0)
+        pipe_wall, framed_pipe = run(inflight)
+        if not (framed_sync == framed_pipe == framed_ref):
+            return {"device_codec_error": "pipelined framing differs from synchronous"}
+        if ref_codec.decompress_bytes(framed_pipe) != payload:
+            return {"device_codec_error": "framed stream does not decode to payload"}
+
+        # assembly microbench: vectorized whole-batch packing vs the old
+        # per-block path, on identical device-shaped arrays
+        blocks = [
+            payload[i * block_size : (i + 1) * block_size]
+            for i in range(min(n_blocks, 16))
+        ]
+        arrs = _device_shaped_arrays(blocks, block_size)
+        n_groups = block_size // tlz.GROUP
+        vec_t = per_t = float("inf")
+        vec = per = None
+        for _rep in range(3):
+            t0 = time.perf_counter()
+            vec = tlz._assemble_batch(arrs, len(blocks), n_groups)
+            vec_t = min(vec_t, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            per = [
+                tlz._assemble_from_device(*arrs, i, n_groups)
+                for i in range(len(blocks))
+            ]
+            per_t = min(per_t, time.perf_counter() - t0)
+        if vec != per:
+            return {"device_codec_error": "vectorized assembly differs from per-block"}
+    except Exception as e:  # never fail the bench over this row
+        return {"device_codec_error": str(e)[:120]}
+    stage_sum = serialize_s + encode_s + upload_s
+    return {
+        "device_codec_speedup": round(sync_wall / pipe_wall, 2),
+        "device_codec_pipelined_wall_s": round(pipe_wall, 3),
+        "device_codec_sync_wall_s": round(sync_wall, 3),
+        "device_codec_stage_sum_s": round(stage_sum, 3),
+        "device_codec_wall_below_stage_sum": bool(pipe_wall < stage_sum),
+        "device_codec_byte_identity": True,
+        "device_codec_encode_stage_s": round(encode_s, 3),
+        "device_codec_assembly_mb_s": round(
+            len(blocks) * block_size / 1e6 / max(vec_t, 1e-9), 1
+        ),
+        "device_codec_assembly_speedup": round(per_t / max(vec_t, 1e-9), 2),
+        "device_codec_blocks": n_blocks,
+        "device_codec_block_bytes": block_size,
+        "device_codec_batch_blocks": batch_blocks,
+        "device_codec_inflight": inflight,
+        "device_codec_serialize_ms": serialize_ms,
+        "device_codec_put_latency_ms": put_ms,
+    }
+
+
+def device_codec_knobs():
+    """Knob record for BENCH-round comparability (like transfer_plane)."""
+    from s3shuffle_tpu.config import ShuffleConfig
+
+    cfg = ShuffleConfig()
+    return {
+        "device_codec_plane": {
+            "codec_batch_blocks": cfg.codec_batch_blocks,
+            "encode_inflight_batches": cfg.encode_inflight_batches,
+        }
     }
 
 
@@ -1511,10 +1729,12 @@ def main():
         **pipelined_commit_gain(),
         **coalesced_read_gain(),
         **composite_write_gain(),
+        **device_codec_gain(),
         **tracker_scaling(),
         **transfer_plane_knobs(),
         **scan_planner_knobs(),
         **composite_plane_knobs(),
+        **device_codec_knobs(),
         **load_calibration(),
         **device_kernel_rates(),
     }

@@ -28,15 +28,17 @@ def get_codec(
     name: str,
     block_size: int | None = None,
     level: int = 1,
-    tpu_batch_blocks: int | None = None,
+    codec_batch_blocks: int | None = None,
     tpu_host_fallback: bool = False,
+    encode_inflight_batches: int | None = None,
 ) -> "FrameCodec | None":
     """Resolve a codec by config name. ``none`` → None (raw bytes, no framing,
     still concatenatable). ``auto`` → native if built, else zlib.
     ``block_size=None`` → the codec's own default: 64 KiB for the CPU codecs,
     256 KiB for the TPU codec (ratio improves with block length; its match
-    window is a separate 64 KiB distance cap). ``tpu_batch_blocks`` sizes the
-    device round-trip batch for the tpu codec."""
+    window is a separate 64 KiB distance cap). ``codec_batch_blocks`` sizes
+    the device round-trip batch and ``encode_inflight_batches`` the async
+    encode window for the tpu codec."""
     name = (name or "none").lower()
     if name in ("none", "raw", "off"):
         return None
@@ -72,8 +74,10 @@ def get_codec(
     if name == "tpu":
         from s3shuffle_tpu.codec.tpu import TpuCodec
 
-        if tpu_batch_blocks is not None:
-            bs["batch_blocks"] = tpu_batch_blocks
+        if codec_batch_blocks is not None:
+            bs["batch_blocks"] = codec_batch_blocks
+        if encode_inflight_batches is not None:
+            bs["encode_inflight_batches"] = encode_inflight_batches
         return TpuCodec(host_encode_fallback=tpu_host_fallback, **bs)
     raise ValueError(f"Unknown codec: {name}")
 

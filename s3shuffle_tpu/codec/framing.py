@@ -21,8 +21,13 @@ from __future__ import annotations
 
 import io
 import struct
+import threading
+import time
 from collections import deque
-from typing import BinaryIO, List, Tuple
+from concurrent.futures import ThreadPoolExecutor
+from typing import BinaryIO, List, Optional, Tuple
+
+from s3shuffle_tpu.metrics import registry as _metrics
 
 HEADER = struct.Struct("<BII")
 HEADER_SIZE = HEADER.size  # 9 bytes
@@ -103,6 +108,18 @@ class FrameCodec:
     def frame_block(self, raw: bytes) -> bytes:
         return self.frame_from(raw, self.compress_block(raw))
 
+    def frame_blocks(self, blocks: List[bytes]) -> bytes:
+        """Frame a batch of raw blocks as ONE bytes blob. Compression routes
+        through :meth:`compress_blocks` — so batch codecs keep their device
+        path even for a single-block tail batch — and batch codecs override
+        this to make the whole batch's framing decision ONCE (TpuCodec
+        snapshots its fallback delegate per batch instead of re-reading
+        shared routing state per frame)."""
+        compressed = self.compress_blocks(blocks)
+        return b"".join(
+            self.frame_from(raw, comp) for raw, comp in zip(blocks, compressed)
+        )
+
     def compress_stream(self, sink: BinaryIO) -> "CodecOutputStream":
         return CodecOutputStream(self, sink)
 
@@ -120,15 +137,73 @@ class FrameCodec:
         return self.decompress_stream(io.BytesIO(data)).read()
 
 
+_H_ENCODE_BATCH = _metrics.REGISTRY.histogram(
+    "codec_encode_batch_seconds",
+    "Batch compress+frame call latency (device launch + host assembly)",
+)
+_C_ENCODE_BYTES = _metrics.REGISTRY.counter(
+    "codec_encode_bytes_total", "Raw bytes through batch compress+frame calls"
+)
+_G_ENCODE_INFLIGHT = _metrics.REGISTRY.gauge(
+    "codec_encode_inflight",
+    "Encode batches in flight between serializers and their sinks "
+    "(async batch mode, summed across streams)",
+)
+_C_FUSED_CRC = _metrics.REGISTRY.counter(
+    "codec_fused_crc_total",
+    "Frames whose stored-byte CRC came fused from the encode launch",
+)
+_C_FRAMES = _metrics.REGISTRY.counter(
+    "codec_frames_total", "Frames emitted by codec output streams"
+)
+
+#: process-wide single-thread encode executor: the device is one resource,
+#: so batches from every stream serialize through one worker — which also
+#: makes future completion order == submission order (the streams' ordered
+#: emission leans on it) and lets the tlz staging buffers be reused
+#: per-thread across every batch in the process.
+_encode_executor_lock = threading.Lock()
+_encode_executor: Optional[ThreadPoolExecutor] = None
+
+
+def _get_encode_executor() -> ThreadPoolExecutor:
+    global _encode_executor
+    with _encode_executor_lock:
+        if _encode_executor is None:
+            # shuffle-lint: disable=THR01 reason=process-wide encode pool shared by every codec stream for the process lifetime (one worker serializing device access); concurrent.futures joins idle workers at interpreter exit
+            _encode_executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="s3shuffle-encode"
+            )
+        return _encode_executor
+
+
 class CodecOutputStream(io.RawIOBase):
     """Buffers up to ``block_size`` bytes, then emits one frame. ``close``
     flushes the final short block and closes the sink.
 
     Batch codecs (``codec.batch_blocks > 1``, e.g. the TPU codec) have full
     blocks accumulated and compressed ``batch_blocks`` at a time — one device
-    round-trip per batch — while emitting byte-identical framing."""
+    round-trip per batch — while emitting byte-identical framing.
 
-    def __init__(self, codec: FrameCodec, sink: BinaryIO, close_sink: bool = True):
+    **Async batch mode** (``codec.encode_inflight_batches > 1`` and the codec
+    answers ``wants_async_encode()``): batches are handed to the process-wide
+    encode thread and a bounded window of encode futures rides between the
+    producer and the sink — the serializer fills batch N+1 and the sink
+    (PipelinedUploadStream) PUTs batch N−1 while the chip encodes batch N.
+    Emission is order-preserving (single worker + FIFO harvest), encode
+    failures re-raise on the producer's next ``write``/``flush``/``close``,
+    and ``pending_bytes`` counts in-flight raw bytes so memory budgets see
+    them. When the codec degrades to a delegate or the device probe fails,
+    batches fall back to today's synchronous path mid-stream.
+
+    ``checksum`` (optional FusedChecksumAccumulator-shaped object) receives
+    every emitted byte: per-frame fused CRCs when the codec returns them
+    with the batch (``compress_framed_fused``), byte hashes otherwise — so
+    its final value always equals a byte-serial checksum of the emitted
+    stream."""
+
+    def __init__(self, codec: FrameCodec, sink: BinaryIO, close_sink: bool = True,
+                 checksum=None):
         self._codec = codec
         self._sink = sink
         self._buf = bytearray()
@@ -138,6 +213,15 @@ class CodecOutputStream(io.RawIOBase):
         # native fast path: compress + frame straight from the accumulation
         # buffer in one call (no per-block slicing/joining/header packing)
         self._framed = getattr(codec, "compress_framed", None)
+        self._framed_fused = getattr(codec, "compress_framed_fused", None)
+        # batch framing hook; duck-typed codec stand-ins may only implement
+        # frame_block — fall back to per-block framing for them
+        self._frame_blocks = getattr(codec, "frame_blocks", None)
+        self._checksum = checksum
+        self._wants_async = getattr(codec, "wants_async_encode", None)
+        self._window = max(0, int(getattr(codec, "encode_inflight_batches", 0)))
+        self._inflight: deque = deque()  # (future, raw_byte_count)
+        self._inflight_bytes = 0
 
     def writable(self) -> bool:
         return True
@@ -161,11 +245,94 @@ class CodecOutputStream(io.RawIOBase):
                 self._emit_pending()
         return written
 
+    # ------------------------------------------------------------------
+    # batch emission (sync + async)
+    # ------------------------------------------------------------------
+    def _encode_batch(self, buf, n_blocks: int, bs: int):
+        """Compress+frame one batch (producer thread in sync mode, the shared
+        encode thread in async mode). Returns (framed_bytes, crcs|None)."""
+        mv = memoryview(buf)[: n_blocks * bs]
+        t0 = time.perf_counter_ns()
+        if self._checksum is not None and self._framed_fused is not None:
+            out, crcs = self._framed_fused(mv, n_blocks, bs)
+        else:
+            out, crcs = self._framed(mv, n_blocks, bs), None
+        if _metrics.enabled():
+            _H_ENCODE_BATCH.observe((time.perf_counter_ns() - t0) / 1e9)
+            _C_ENCODE_BYTES.inc(n_blocks * bs)
+        return out, crcs
+
+    def _write_out(self, data, crcs, n_frames: int) -> None:
+        self._sink.write(data)
+        if _metrics.enabled():
+            _C_FRAMES.inc(n_frames)
+        if self._checksum is not None:
+            if crcs is not None:
+                for crc, length in crcs:
+                    self._checksum.add_stored(crc, length)
+                if _metrics.enabled():
+                    _C_FUSED_CRC.inc(len(crcs))
+            else:
+                self._checksum.add_bytes(
+                    data if isinstance(data, bytes) else bytes(data)
+                )
+
+    def _harvest_one(self) -> None:
+        fut, nbytes = self._inflight.popleft()
+        self._inflight_bytes -= nbytes
+        if _metrics.enabled():
+            _G_ENCODE_INFLIGHT.dec(1)
+        try:
+            out, crcs, n_frames = fut.result()
+        except BaseException:
+            self._abort_inflight()
+            raise
+        self._write_out(out, crcs, n_frames)
+
+    def _drain_inflight(self) -> None:
+        while self._inflight:
+            self._harvest_one()
+
+    def _abort_inflight(self) -> None:
+        """A batch failed: drop the rest of the window (the stream is broken
+        — the producer is about to see the failure and abort the write)."""
+        if _metrics.enabled():
+            _G_ENCODE_INFLIGHT.dec(len(self._inflight))
+        for fut, _nbytes in self._inflight:
+            fut.cancel()
+        self._inflight.clear()
+        self._inflight_bytes = 0
+
     def _emit_framed(self, n_blocks: int) -> None:
         bs = self._codec.block_size
         cut = n_blocks * bs
-        out = self._framed(memoryview(self._buf)[:cut], n_blocks, bs)
-        self._sink.write(out)
+        if (
+            self._window > 1
+            and self._wants_async is not None
+            and self._wants_async()
+        ):
+            # hand the WHOLE buffer to the encode thread (it reads only the
+            # first ``cut`` bytes and is never resized, so no copy of the
+            # emitted region); keep the partial-block tail in a fresh buffer
+            buf = self._buf
+            self._buf = bytearray(memoryview(buf)[cut:])
+
+            def job(b=buf, n=n_blocks):
+                out, crcs = self._encode_batch(b, n, bs)
+                return out, crcs, n
+
+            self._inflight.append((_get_encode_executor().submit(job), cut))
+            self._inflight_bytes += cut
+            if _metrics.enabled():
+                _G_ENCODE_INFLIGHT.inc(1)
+            while len(self._inflight) >= self._window:
+                self._harvest_one()
+            return
+        # synchronous path (no window, delegate active, or device probe
+        # failed): drain any in-flight batches first so emission order holds
+        self._drain_inflight()
+        out, crcs = self._encode_batch(self._buf, n_blocks, bs)
+        self._write_out(out, crcs, n_blocks)
         try:
             del self._buf[:cut]
         except BufferError:
@@ -176,22 +343,31 @@ class CodecOutputStream(io.RawIOBase):
             # and let the old one die when the device releases it.
             self._buf = bytearray(memoryview(self._buf)[cut:])
 
+    def _frame_batch(self, blocks: List[bytes]) -> bytes:
+        if self._frame_blocks is not None:
+            return self._frame_blocks(blocks)
+        return b"".join(self._codec.frame_block(b) for b in blocks)
+
     def _emit_pending(self) -> None:
         if not self._pending:
             return
-        if len(self._pending) == 1:
-            self._sink.write(self._codec.frame_block(self._pending[0]))
-        else:
-            compressed = self._codec.compress_blocks(self._pending)
-            for raw, comp in zip(self._pending, compressed):
-                self._sink.write(self._codec.frame_from(raw, comp))
+        # frame_blocks for ANY pending count — a single-block tail batch
+        # used to take frame_block (the per-block HOST path), silently
+        # skipping the device for the last partial batch of every partition
+        out = self._frame_batch(self._pending)
+        self._write_out(out, None, len(self._pending))
         self._pending.clear()
 
     @property
     def pending_bytes(self) -> int:
-        """Raw bytes buffered but not yet framed (partial block + batch queue)
-        — memory-budget accounting must count these."""
-        return len(self._buf) + sum(len(p) for p in self._pending)
+        """Raw bytes buffered but not yet framed (partial block + batch queue
+        + async in-flight batches) — memory-budget accounting must count
+        these."""
+        return (
+            len(self._buf)
+            + sum(len(p) for p in self._pending)
+            + self._inflight_bytes
+        )
 
     def flush_block(self) -> None:
         """Force everything buffered out (used at partition boundaries so
@@ -201,8 +377,13 @@ class CodecOutputStream(io.RawIOBase):
             full = len(self._buf) // bs
             if full:
                 self._emit_framed(full)
+            self._drain_inflight()
             if self._buf:
-                self._sink.write(self._codec.frame_block(bytes(self._buf)))
+                # short tail: route through the codec's batch framing hook
+                # (frame_blocks snapshots routing once and keeps batch
+                # codecs' device/host decision in one place)
+                tail = bytes(self._buf)
+                self._write_out(self._frame_batch([tail]), None, 1)
                 self._buf.clear()
             return
         if self._buf:
@@ -212,7 +393,11 @@ class CodecOutputStream(io.RawIOBase):
 
     def close(self) -> None:
         if not self.closed:
-            self.flush_block()
+            try:
+                self.flush_block()
+            except BaseException:
+                self._abort_inflight()
+                raise
             if self._close_sink:
                 self._sink.close()
             else:

@@ -31,6 +31,7 @@ from typing import List
 import numpy as np
 
 from s3shuffle_tpu.codec.framing import CODEC_IDS, FrameCodec
+from s3shuffle_tpu.metrics import registry as _metrics
 from s3shuffle_tpu.ops import tlz
 from s3shuffle_tpu.ops.checksum import (
     POLY_CRC32C,
@@ -40,6 +41,25 @@ from s3shuffle_tpu.ops.checksum import (
 )
 
 logger = logging.getLogger("s3shuffle_tpu.codec.tpu")
+
+_H_ASSEMBLY = _metrics.REGISTRY.histogram(
+    "codec_assembly_seconds",
+    "Host payload-assembly seconds per device encode batch (metadata "
+    "packing + vectorized plane compaction; the chip does the rest)",
+)
+
+#: host CRC32C for the small header/metadata slices stitched around fused
+#: device CRCs (native C when built, Python table otherwise) — resolved once
+_host_crc32c = None
+
+
+def _crc32c_host(data: bytes) -> int:
+    global _host_crc32c
+    if _host_crc32c is None:
+        from s3shuffle_tpu.utils.checksums import _crc32c_fn
+
+        _host_crc32c = _crc32c_fn()
+    return _host_crc32c(data)
 
 
 #: process-wide backend-probe verdict (None = not probed yet). One probe
@@ -124,6 +144,10 @@ def _probe_device_backend() -> bool:
 class TpuCodec(FrameCodec):
     name = "tpu-lz"
     codec_id = CODEC_IDS["tpu-lz"]
+    #: the encode kernel can return each block's CRC32C with its payload
+    #: planes in the same launch (ops/tlz.py encode_batch_device(poly=...));
+    #: the write plane keys its fused-checksum wiring on this flag
+    supports_fused_checksum = True
 
     def __init__(
         self,
@@ -134,6 +158,10 @@ class TpuCodec(FrameCodec):
         batch_blocks: int = 64,
         use_device: bool | None = None,
         host_encode_fallback: bool = False,
+        # bounded window of encode batches allowed in flight between the
+        # serializer and the sink (CodecOutputStream async batch mode);
+        # <= 1 keeps every batch synchronous on the producer thread
+        encode_inflight_batches: int = 0,
     ):
         if block_size % 128 != 0:
             raise ValueError("TPU codec block_size must be a multiple of 128")
@@ -141,6 +169,8 @@ class TpuCodec(FrameCodec):
             raise ValueError("TPU codec block_size must be <= 256 KiB")
         super().__init__(block_size)
         self.batch_blocks = batch_blocks
+        self.encode_inflight_batches = max(0, int(encode_inflight_batches))
+        self._device_failures = 0  # consecutive device batch-encode failures
         self._use_device = use_device
         #: ``codec=tpu`` chosen but no accelerator attached: reroute ENCODE to
         #: SLZ frames (a different codec_id — readers dispatch per frame, so
@@ -264,56 +294,143 @@ class TpuCodec(FrameCodec):
         return super().frame_from(raw, compressed)
 
     # --- single block (host path: C encoder, numpy fallback/oracle) ---
-    def compress_block(self, data: bytes) -> bytes:
-        delegate = self._encode_delegate()
-        if delegate is not None:
-            return delegate.compress_block(data)
+    def _compress_block_local(self, data: bytes) -> bytes:
+        """TLZ host encode, NO delegate consultation — the device-failure
+        fallback must not re-resolve routing mid-batch."""
         native = tlz._encode_block_native(data)
         if native is not None:
             return native
         return tlz._assemble_payload_numpy(data)
 
+    def compress_block(self, data: bytes) -> bytes:
+        delegate = self._encode_delegate()
+        if delegate is not None:
+            return delegate.compress_block(data)
+        return self._compress_block_local(data)
+
     def decompress_block(self, data: bytes, uncompressed_len: int) -> bytes:
         return tlz.decode_payload_numpy(data, uncompressed_len)
+
+    def _encode_full_blocks(self, mv, n_blocks: int, block_size: int, poly):
+        """Device batch encode of ``n_blocks`` full blocks from a contiguous
+        memoryview, with fused CRCs when ``poly`` is set. A device failure
+        mid-shuffle (tunnel collapse between batches) host-encodes THIS
+        batch — no queued block is ever lost — and after three consecutive
+        failures pins the instance to the host path (each retry would eat an
+        exception + fallback per batch forever)."""
+        if self._device_path():
+            timings: dict = {}
+            try:
+                payloads, crc_info = tlz.encode_batch_device(
+                    mv, n_blocks, block_size,
+                    batch_blocks=self.batch_blocks, poly=poly,
+                    timings=timings,
+                )
+                self._device_failures = 0
+                if _metrics.enabled() and timings.get("assembly_s"):
+                    _H_ASSEMBLY.observe(timings["assembly_s"])
+                return payloads, crc_info
+            except Exception:
+                self._device_failures += 1
+                if self._device_failures >= 3:
+                    self._use_device = False
+                    logger.warning(
+                        "device batch encode failed %d times in a row — "
+                        "pinning this codec to the host TLZ encoder",
+                        self._device_failures, exc_info=True,
+                    )
+                else:
+                    logger.warning(
+                        "device batch encode failed — host-encoding this "
+                        "batch (no queued blocks lost)", exc_info=True,
+                    )
+        payloads = [
+            self._compress_block_local(
+                bytes(mv[i * block_size : (i + 1) * block_size])
+            )
+            for i in range(n_blocks)
+        ]
+        return payloads, None
+
+    def _compress_framed_impl(self, buf, n_blocks: int, block_size: int,
+                              want_crcs: bool):
+        from s3shuffle_tpu.codec.framing import HEADER, HEADER_SIZE
+
+        # routing snapshot: ONE delegate decision per batch — compression
+        # and framing below both use it, so a concurrent probe resolution
+        # (host_encode_fallback flip) can never split a batch across codecs
+        delegate = self._encode_delegate()
+        if delegate is not None:
+            return delegate.compress_framed(buf, n_blocks, block_size), None
+        mv = memoryview(buf)
+        payloads, crc_info = self._encode_full_blocks(
+            mv, n_blocks, block_size, POLY_CRC32C if want_crcs else None
+        )
+        out = bytearray()
+        crcs: List | None = [] if crc_info is not None else None
+        if crc_info is not None:
+            block_crcs, lit_crcs, lit_lens = crc_info
+        for i, pl in enumerate(payloads):
+            if len(pl) >= block_size:  # framing raw escape
+                header = HEADER.pack(0, block_size, block_size)
+                out += header
+                out += mv[i * block_size : (i + 1) * block_size]
+                if crcs is not None:
+                    # stored bytes = header + RAW block; the raw-block CRC
+                    # came fused from the same launch as the encode planes
+                    crcs.append((
+                        crc_combine(
+                            _crc32c_host(header), int(block_crcs[i]),
+                            block_size, POLY_CRC32C,
+                        ),
+                        HEADER_SIZE + block_size,
+                    ))
+            else:
+                header = HEADER.pack(self.codec_id, block_size, len(pl))
+                out += header
+                out += pl
+                if crcs is not None:
+                    # stored bytes = header + metadata prefix + literal
+                    # plane; only the small prefix touches the host CRC
+                    lit_len = int(lit_lens[i])
+                    crcs.append((
+                        crc_combine(
+                            _crc32c_host(header + pl[: len(pl) - lit_len]),
+                            int(lit_crcs[i]), lit_len, POLY_CRC32C,
+                        ),
+                        HEADER_SIZE + len(pl),
+                    ))
+        return bytes(out), crcs
 
     def compress_framed(self, buf, n_blocks: int, block_size: int) -> bytes:
         """Contiguous-buffer fast path (framing.CodecOutputStream hook): the
         accumulated write buffer IS the staging batch, so the device path
         never copies raw bytes on the host — ``np.frombuffer`` view straight
-        into the H2D transfer. The host's remaining work per batch is
-        metadata packing + payload/frame assembly (the bench's
-        ``tpu_devwrite_host_mb_s`` fields time exactly this path)."""
-        from s3shuffle_tpu.codec.framing import HEADER
+        into the H2D transfer, fixed-shape precompiled launches, vectorized
+        whole-batch assembly (the bench's ``tpu_devwrite_host_mb_s`` fields
+        time the assembly path)."""
+        return self._compress_framed_impl(buf, n_blocks, block_size, False)[0]
 
-        delegate = self._encode_delegate()
-        if delegate is not None:
-            return delegate.compress_framed(buf, n_blocks, block_size)
-        mv = memoryview(buf)
-        if self._device_path():
-            # fixed-size device batches: a varying batch dim would recompile
-            # the kernel per distinct size (XLA traces once per shape)
-            payloads = []
-            for s in range(0, n_blocks, self.batch_blocks):
-                e = min(n_blocks, s + self.batch_blocks)
-                payloads.extend(
-                    tlz.encode_buffer_device(
-                        mv[s * block_size : e * block_size], e - s, block_size
-                    )
-                )
-        else:
-            payloads = [
-                self.compress_block(bytes(mv[i * block_size : (i + 1) * block_size]))
-                for i in range(n_blocks)
-            ]
-        out = bytearray()
-        for i, pl in enumerate(payloads):
-            if len(pl) >= block_size:  # framing raw escape
-                out += HEADER.pack(0, block_size, block_size)
-                out += mv[i * block_size : (i + 1) * block_size]
-            else:
-                out += HEADER.pack(self.codec_id, block_size, len(pl))
-                out += pl
-        return bytes(out)
+    def compress_framed_fused(self, buf, n_blocks: int, block_size: int):
+        """:meth:`compress_framed` + per-frame stored-byte CRC32C values from
+        the SAME device launch. Returns ``(framed_bytes, crcs)`` where
+        ``crcs`` is a list of ``(frame_crc, frame_len)`` in emission order —
+        or None when the batch routed to a delegate or the host path (the
+        caller then hashes the bytes itself). Framed bytes are byte-identical
+        to :meth:`compress_framed`'s."""
+        return self._compress_framed_impl(buf, n_blocks, block_size, True)
+
+    def wants_async_encode(self) -> bool:
+        """True when CodecOutputStream should run this codec's batch encode
+        on the shared encode thread (bounded by ``encode_inflight_batches``).
+        Async pays only when THIS codec runs the TLZ encoder itself (device
+        kernels, or the host C encoder standing in for them): when encode is
+        rerouted to the SLZ delegate (``host_encode_fallback`` with no chip)
+        the stream stays synchronous — today's fallback behavior,
+        unchanged."""
+        if self.encode_inflight_batches <= 1:
+            return False
+        return self._encode_delegate() is None
 
     # --- batch (device, with a vectorized-numpy host fallback) ---
     def compress_blocks(self, blocks: List[bytes]) -> List[bytes]:
@@ -322,8 +439,30 @@ class TpuCodec(FrameCodec):
             return delegate.compress_blocks(blocks)
         full = [b for b in blocks if len(b) == self.block_size]
         if not full or not self._device_path():
-            return [self.compress_block(b) for b in blocks]
+            return [self._compress_block_local(b) for b in blocks]
         return tlz.encode_blocks_device(blocks, self.block_size)
+
+    def frame_blocks(self, blocks: List[bytes]) -> bytes:
+        """Batch framing with ONE routing decision for the whole batch: the
+        delegate is snapshotted here and used for both compression and
+        framing, so a concurrent probe resolution flipping
+        ``host_encode_fallback`` mid-call can never stamp payloads with the
+        wrong codec_id (the race noted on the per-frame path, which trusts
+        the thread-local record for the same reason)."""
+        delegate = self._encode_delegate()
+        if delegate is not None:
+            return delegate.frame_blocks(blocks)
+        full = [b for b in blocks if len(b) == self.block_size]
+        if full and self._device_path():
+            payloads = tlz.encode_blocks_device(blocks, self.block_size)
+        else:
+            payloads = [self._compress_block_local(b) for b in blocks]
+        # frame via the BASE rule with this codec's id — deliberately not
+        # self.frame_from, which re-reads the thread-local delegate record
+        return b"".join(
+            FrameCodec.frame_from(self, raw, comp)
+            for raw, comp in zip(blocks, payloads)
+        )
 
     def decompress_blocks(self, blocks) -> List[bytes]:
         if not self._device_path():
@@ -348,10 +487,11 @@ class FusedChecksumAccumulator:
         self._empty = True
 
     def add_bytes(self, data: bytes) -> None:
-        from s3shuffle_tpu.utils.checksums import crc32c_py
-
         if self.poly == POLY_CRC32C:
-            part = crc32c_py(data)
+            # native C when built — this path hashes whole frame batches
+            # whenever the device didn't hand back fused CRCs (host/delegate
+            # routes), so the Python table fallback must be a last resort
+            part = _crc32c_host(data)
         else:
             import zlib
 
@@ -362,6 +502,12 @@ class FusedChecksumAccumulator:
         self.add_bytes(header)
         self._crc = crc_combine(self._crc, payload_crc, payload_len, self.poly)
 
+    def add_stored(self, crc: int, length: int) -> None:
+        """Append ``length`` stored bytes whose full-algorithm CRC is
+        ``crc`` — the form the fused encode launch hands back per frame
+        (``compress_framed_fused``)."""
+        self._crc = crc_combine(self._crc, crc, length, self.poly)
+
     @property
     def value(self) -> int:
         return self._crc
@@ -371,13 +517,36 @@ def fused_compress_and_checksum(
     codec: TpuCodec, blocks: List[bytes], poly: int = POLY_CRC32C
 ):
     """One batch through the device: compress every block AND produce each
-    resulting frame's stored bytes + per-frame payload CRC (computed on
-    device from a single staging pass over the compressed payloads).
+    resulting frame's stored bytes + per-frame stored-byte CRC. On the
+    device path the CRC is FUSED into the encode kernel itself — one launch
+    returns payload planes and CRC values together (ops/tlz.py), with no
+    second staging pass over the compressed bytes. Off-device (or for
+    non-CRC32C polys / short blocks) the pre-fusion route runs: host frames
+    plus one staged device CRC batch.
 
     Returns (frames: List[bytes], frame_crcs: List[int]) where
-    ``crc(b"".join(frames))`` == stitching header/payload CRCs via
+    ``crc(b"".join(frames))`` == stitching frame CRCs via
     :func:`crc_combine` — validated in tests.
     """
+    if (
+        poly == POLY_CRC32C
+        and blocks
+        and all(len(b) == codec.block_size for b in blocks)
+        and codec._encode_delegate() is None
+        and codec._device_path()
+    ):
+        blob = b"".join(blocks)
+        framed, crcs = codec.compress_framed_fused(
+            blob, len(blocks), codec.block_size
+        )
+        if crcs is not None:
+            frames = []
+            off = 0
+            for _crc, length in crcs:
+                frames.append(framed[off : off + length])
+                off += length
+            return frames, [c for c, _len in crcs]
+        # device flipped off mid-call — fall through to the staged route
     payloads = codec.compress_blocks(blocks)
     frames = [codec.frame_from(raw, comp) for raw, comp in zip(blocks, payloads)]
     batch, lengths = stage_right_aligned(frames)

@@ -5,10 +5,10 @@ dispatcher constructor (helper/S3ShuffleDispatcher.scala:36-70), logs every
 value at startup (:81-102), and documents them in README.md:31-85. Defaults
 here match the reference's defaults exactly (SURVEY.md §5.6 flag table).
 
-TPU-first additions: ``codec`` / ``codec_block_size`` / ``tpu_batch_blocks``
-select and tune the block codec (none / zlib / zstd / native C++ / TPU Pallas),
-which replaces the JVM codec streams (``spark.io.compression.*``) the reference
-delegates to Spark.
+TPU-first additions: ``codec`` / ``codec_block_size`` / ``codec_batch_blocks``
+/ ``encode_inflight_batches`` select and tune the block codec (none / zlib /
+zstd / native C++ / TPU Pallas), which replaces the JVM codec streams
+(``spark.io.compression.*``) the reference delegates to Spark.
 """
 
 from __future__ import annotations
@@ -50,6 +50,10 @@ _REFERENCE_KEYS = {
     "spark.shuffle.s3.useSparkShuffleFetch": "use_fallback_fetch",
     "spark.shuffle.checksum.enabled": "checksum_enabled",
     "spark.shuffle.checksum.algorithm": "checksum_algorithm",
+    # legacy name from before the device-codec-pipeline rework (PR 8): the
+    # knob is codec-scoped, not TPU-scoped — configs written against the old
+    # name keep working through from_dict
+    "tpu_batch_blocks": "codec_batch_blocks",
 }
 
 
@@ -170,8 +174,17 @@ class ShuffleConfig:
     codec_block_size: int | None = None
     codec_level: int = 1
     # blocks staged per device round-trip: 64 x the 256 KiB default block
-    # keeps one staging batch at 16 MiB
-    tpu_batch_blocks: int = 64
+    # keeps one staging batch at 16 MiB. (Formerly ``tpu_batch_blocks``;
+    # the old key is still accepted by from_dict.)
+    codec_batch_blocks: int = 64
+    # encode batches allowed in flight between the serializer and the store
+    # sink (CodecOutputStream async batch mode): the serializer fills batch
+    # N+1 and the pipelined-upload sink PUTs batch N-1 while the chip
+    # encodes batch N. <= 1 keeps every batch synchronous on the producer
+    # thread (today's behavior); the window only engages when the codec
+    # itself runs the TLZ encoder (device or host C), never on the SLZ
+    # host-fallback delegate.
+    encode_inflight_batches: int = 2
     # codec=tpu with no accelerator attached: reroute shuffle-write encode to
     # SLZ frames (loud warning) instead of the ~5x-slower host C TLZ encoder;
     # TLZ decode stays active for existing data. false = always encode TLZ.
@@ -212,6 +225,10 @@ class ShuffleConfig:
             or self.storage_op_deadline_s < 0
         ):
             raise ValueError("storage retry knobs must be >= 0")
+        if self.codec_batch_blocks < 1:
+            raise ValueError("codec_batch_blocks must be >= 1")
+        if self.encode_inflight_batches < 0:
+            raise ValueError("encode_inflight_batches must be >= 0")
         if self.metadata_shards < 1 or self.metadata_batch_max < 1:
             raise ValueError("metadata_shards / metadata_batch_max must be >= 1")
         if self.metadata_shard_endpoints < 0:
@@ -242,10 +259,20 @@ class ShuffleConfig:
 
     @classmethod
     def from_env(cls, env: Mapping[str, str] | None = None, **overrides: Any) -> "ShuffleConfig":
-        """Build from ``S3SHUFFLE_<FIELD>`` environment variables."""
+        """Build from ``S3SHUFFLE_<FIELD>`` environment variables. Renamed
+        knobs keep their old env spelling working (``S3SHUFFLE_<OLDNAME>``,
+        from the non-reference aliases in ``_REFERENCE_KEYS``); the new name
+        wins when both are set."""
         env = os.environ if env is None else env
+        fields = {f.name: f for f in dataclasses.fields(cls)}
         kwargs: dict[str, Any] = {}
-        for f in dataclasses.fields(cls):
+        for old, new in _REFERENCE_KEYS.items():
+            if "." in old:  # spark.* reference keys aren't env-shaped
+                continue
+            key = "S3SHUFFLE_" + old.upper()
+            if key in env:
+                kwargs[new] = _coerce(env[key], fields[new].type)
+        for f in fields.values():
             key = "S3SHUFFLE_" + f.name.upper()
             if key in env:
                 kwargs[f.name] = _coerce(env[key], f.type)

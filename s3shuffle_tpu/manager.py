@@ -94,8 +94,10 @@ class ShuffleManager:
         self._lock = threading.Lock()
         cfg = self.dispatcher.config
         self._codec = get_codec(
-            cfg.codec, cfg.codec_block_size, cfg.codec_level, cfg.tpu_batch_blocks,
+            cfg.codec, cfg.codec_block_size, cfg.codec_level,
+            cfg.codec_batch_blocks,
             tpu_host_fallback=cfg.tpu_host_fallback,
+            encode_inflight_batches=cfg.encode_inflight_batches,
         )
         # Composite commit plane (write/composite_commit.py): one per-worker
         # aggregator composing map commits into composite objects + fat

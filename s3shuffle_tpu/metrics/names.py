@@ -86,4 +86,12 @@ KNOWN_METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     # --- codec plane (codec/native.py) ---
     "codec_compress_seconds": ("histogram", ("codec",)),
     "codec_compress_bytes_total": ("counter", ("codec",)),
+    # --- codec plane: device-resident batch pipeline
+    # (codec/framing.py, codec/tpu.py) ---
+    "codec_encode_batch_seconds": ("histogram", ()),
+    "codec_encode_bytes_total": ("counter", ()),
+    "codec_encode_inflight": ("gauge", ()),
+    "codec_fused_crc_total": ("counter", ()),
+    "codec_frames_total": ("counter", ()),
+    "codec_assembly_seconds": ("histogram", ()),
 }

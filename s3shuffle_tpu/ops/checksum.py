@@ -235,6 +235,37 @@ def crc32_batch(blocks, lengths, poly: int = POLY_CRC32C, block_len: int | None 
     return (x ^ zero_crc[lengths]).astype(np.uint32)
 
 
+def zero_run_crcs(poly: int, length: int) -> np.ndarray:
+    """Host-side fixup table: ``crc(0^n)`` for ``n in [0, length]`` (full
+    init/final-xor semantics). Raw zero-init remainders from the device
+    kernels become true CRCs via ``raw ^ zero_run_crcs(poly, L)[n]`` — the
+    front-alignment trick documented in the module header. Public because
+    the fused TLZ encode kernel (ops/tlz.py) applies the fixup host-side to
+    the remainders it gets back with the encode planes."""
+    _w, zero_crc = _weights.get(poly, length)
+    return zero_crc
+
+
+def raw_crc_graph_fn(poly: int, length: int, batch: int):
+    """A traceable ``fn(data_u8) -> (B,) uint32`` raw zero-init remainder op
+    for right-aligned ``(batch, length)`` rows, safe to call INSIDE a larger
+    jit trace — the hook the fused TLZ encode kernel uses to fold the CRC
+    pass into its own launch. Picks the fused Pallas kernel when enabled and
+    the shape tiles (:func:`_use_pallas`), else the MXU bit-matmul; either
+    way the weight table is device-resident, shipped once per (poly, L)."""
+    if _use_pallas(batch, length):
+        from s3shuffle_tpu.ops import crc_pallas
+
+        w_planes = crc_pallas._device_plane_weights(poly, length)
+
+        def fn(data_u8):
+            return crc_pallas.crc_raw_in_graph(data_u8, w_planes)
+
+        return fn
+    w_bits = _device_weights(poly, length)
+    return lambda data_u8: _crc_math(data_u8, w_bits, length)
+
+
 def _use_pallas(b: int, length: int) -> bool:
     """Opt-in (S3SHUFFLE_PALLAS_CRC=1): the fused Pallas kernel keeps the 8x
     bit expansion in VMEM. XLA's fusion is competitive (and on some rigs

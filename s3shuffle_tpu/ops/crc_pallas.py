@@ -71,12 +71,15 @@ def _crc_counts_kernel(data_ref, w_ref, out_ref):
 
 
 @functools.lru_cache(maxsize=8)
-def _counts_call(b: int, length: int, interpret: bool):
+def _counts_pallas(b: int, length: int, interpret: bool):
+    """The raw (unjitted) pallas_call for (b, length) tiles — shared by the
+    standalone jitted kernel and larger fused traces (the TLZ encode kernel
+    embeds it so payload CRCs ride the encode launch, ops/tlz.py)."""
     jax, jnp, pl = _jax()
     from jax.experimental.pallas import tpu as pltpu
 
     grid = (b // _TB, length // _TL)
-    call = pl.pallas_call(
+    return pl.pallas_call(
         _crc_counts_kernel,
         out_shape=jax.ShapeDtypeStruct((b, 32), jnp.int32),
         grid=grid,
@@ -88,13 +91,28 @@ def _counts_call(b: int, length: int, interpret: bool):
         interpret=interpret,
     )
 
+
+def crc_raw_in_graph(data_u8, w_planes, interpret: bool = False):
+    """Raw zero-init remainders of right-aligned rows as a TRACEABLE op:
+    callable inside an enclosing jit (shapes are concrete at trace time), so
+    a fused kernel gets its CRCs in the same launch as its other outputs.
+    B and L must satisfy :func:`supported`."""
+    _jax_mod, jnp, _pl = _jax()
+    b, length = int(data_u8.shape[0]), int(data_u8.shape[1])
+    counts = _counts_pallas(b, length, interpret)(data_u8, w_planes)
+    parity = (counts & 1).astype(jnp.uint32)
+    return jnp.sum(
+        parity << jnp.arange(32, dtype=jnp.uint32)[None, :], axis=1, dtype=jnp.uint32
+    )
+
+
+@functools.lru_cache(maxsize=8)
+def _counts_call(b: int, length: int, interpret: bool):
+    jax, _jnp, _pl = _jax()
+
     @jax.jit
     def kernel(data_u8, w_planes):
-        counts = call(data_u8, w_planes)
-        parity = (counts & 1).astype(jnp.uint32)
-        return jnp.sum(
-            parity << jnp.arange(32, dtype=jnp.uint32)[None, :], axis=1, dtype=jnp.uint32
-        )
+        return crc_raw_in_graph(data_u8, w_planes, interpret)
 
     return kernel
 

@@ -129,7 +129,16 @@ class MapOutputWriter:
                 self._stream = MeasuredOutputStream(buffered, self._block.name)
         return self._stream
 
-    def get_partition_writer(self, reduce_partition_id: int) -> "PartitionWriter":
+    def get_partition_writer(
+        self,
+        reduce_partition_id: int,
+        precomputed_checksum: Optional[int] = None,
+    ) -> "PartitionWriter":
+        """``precomputed_checksum``: the partition's checksum over its stored
+        bytes, already known to the caller (stitched from CRCs fused into
+        the device encode launch — write/spill_writer.py). The writer then
+        skips its byte-serial hashing pass entirely; the recorded value (and
+        the ``.checksum`` sidecar bytes) are identical by construction."""
         if reduce_partition_id <= self._last_partition_id:
             # S3ShuffleMapOutputWriter.scala:67-73
             raise ValueError(
@@ -141,10 +150,13 @@ class MapOutputWriter:
         self._last_partition_id = reduce_partition_id
         checksum = (
             create_checksum(self.dispatcher.config.checksum_algorithm)
-            if self._checksums_enabled
+            if self._checksums_enabled and precomputed_checksum is None
             else None
         )
-        return PartitionWriter(self, reduce_partition_id, checksum)
+        return PartitionWriter(
+            self, reduce_partition_id, checksum,
+            precomputed_checksum if self._checksums_enabled else None,
+        )
 
     def _record_partition(self, reduce_id: int, nbytes: int, checksum_value: int) -> None:
         self._lengths[reduce_id] = nbytes
@@ -274,10 +286,13 @@ class PartitionWriter(io.RawIOBase):
     """Counts and checksums the stored bytes of one reduce partition while
     passing them through to the shared data-object stream."""
 
-    def __init__(self, parent: MapOutputWriter, reduce_id: int, checksum: Optional[Checksum]):
+    def __init__(self, parent: MapOutputWriter, reduce_id: int,
+                 checksum: Optional[Checksum],
+                 precomputed_checksum: Optional[int] = None):
         self._parent = parent
         self.reduce_id = reduce_id
         self._checksum = checksum
+        self._precomputed = precomputed_checksum
         self._count = 0
         self._finalized = False
 
@@ -305,6 +320,9 @@ class PartitionWriter(io.RawIOBase):
         # stays open for the next partition.
         if not self._finalized:
             self._finalized = True
-            value = self._checksum.value if self._checksum is not None else 0
+            if self._precomputed is not None:
+                value = self._precomputed
+            else:
+                value = self._checksum.value if self._checksum is not None else 0
             self._parent._record_partition(self.reduce_id, self._count, value)
         super().close()

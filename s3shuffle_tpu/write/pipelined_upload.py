@@ -76,7 +76,8 @@ class PipelinedUploadStream(io.RawIOBase):
         # enough that the queue holds several (pipelining needs >= 2 slots).
         self._chunk_bytes = int(chunk_bytes or max(64 * 1024, min(self._queue_limit // 4, 8 * MiB)))
         self._buf = bytearray()
-        self._queue: deque[bytes] = deque()
+        # bytes or (zero-copy, immutable-source) memoryview chunks
+        self._queue: deque = deque()
         self._queued_bytes = 0
         self._cond = threading.Condition()
         self._eof = False
@@ -99,14 +100,19 @@ class PipelinedUploadStream(io.RawIOBase):
             return 0
         if self._error is not None:  # surface uploader failure promptly
             raise self._error
-        # Chunks are COPIED off the caller's buffer (it may reuse/release it
-        # after write() returns — spill-copy chunks, BytesIO getbuffer views)
-        # and sliced directly from it, so one huge write (a whole finalized
-        # partition) stages at most chunk_bytes at a time and feels the queue
-        # backpressure per chunk — never a monolithic copy or PUT.
+        # Chunks are COPIED off mutable caller buffers (they may be reused or
+        # released after write() returns — spill-copy chunks, BytesIO
+        # getbuffer views) and sliced directly from them, so one huge write
+        # (a whole finalized partition) stages at most chunk_bytes at a time
+        # and feels the queue backpressure per chunk — never a monolithic
+        # copy or PUT. IMMUTABLE bytes inputs (the async codec pipeline hands
+        # whole encoded batches as bytes) enqueue as zero-copy memoryview
+        # slices instead: the source can't change under the uploader, so the
+        # copy of every uploaded byte disappears.
         mv = memoryview(b)
         if mv.itemsize != 1:
             mv = mv.cast("B")
+        immutable = isinstance(b, bytes)
         self.bytes_written += n
         off = 0
         if self._buf:  # top up the pending partial chunk first
@@ -117,7 +123,8 @@ class PipelinedUploadStream(io.RawIOBase):
                 self._enqueue(bytes(self._buf))
                 self._buf.clear()
         while n - off >= self._chunk_bytes:
-            self._enqueue(bytes(mv[off : off + self._chunk_bytes]))
+            chunk = mv[off : off + self._chunk_bytes]
+            self._enqueue(chunk if immutable else bytes(chunk))
             off += self._chunk_bytes
         if off < n:
             self._buf += mv[off:]

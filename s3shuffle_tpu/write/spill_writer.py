@@ -47,15 +47,24 @@ _H_COMMIT = _metrics.REGISTRY.histogram(
 
 
 class _PartitionPipeline:
-    """serializer → (codec) → in-memory sink for one reduce partition."""
+    """serializer → (codec) → in-memory sink for one reduce partition.
 
-    def __init__(self, serializer, codec: Optional[FrameCodec]):
+    ``fused_checksum`` (optional FusedChecksumAccumulator) rides the codec
+    stream: it receives per-frame CRCs fused into the device encode launch
+    (host byte-hashes for frames the device didn't produce), so at
+    :meth:`finish` its value equals a byte-serial checksum of every stored
+    byte this pipeline ever emitted — spilled segments included — and the
+    commit path can skip re-hashing the partition on the host."""
+
+    def __init__(self, serializer, codec: Optional[FrameCodec],
+                 fused_checksum=None):
         self.sink = io.BytesIO()
+        self.fused_checksum = fused_checksum if codec is not None else None
         if codec is not None:
             from s3shuffle_tpu.codec.framing import CodecOutputStream
 
             self.codec_stream: Optional[CodecOutputStream] = CodecOutputStream(
-                codec, self.sink, close_sink=False
+                codec, self.sink, close_sink=False, checksum=self.fused_checksum
             )
             target = self.codec_stream
         else:
@@ -97,21 +106,37 @@ class _PartitionPipeline:
         self.sink.truncate(0)
         return n
 
-    def finalize_into(self, writer) -> None:
-        """Close the pipeline and stream its remaining bytes into ``writer``
-        (same zero-materialization contract as :meth:`spill_into`)."""
+    def finish(self) -> Optional[int]:
+        """Close the serializer + codec pipeline (final frames emitted into
+        the local sink). Returns this partition's checksum value stitched
+        from the fused per-frame CRCs, or None when the commit path must
+        stream-hash the stored bytes itself."""
         self.record_writer.close()
         if self.codec_stream is not None:
             self.codec_stream.close()
+        return (
+            self.fused_checksum.value
+            if self.fused_checksum is not None
+            else None
+        )
+
+    def drain_into(self, writer) -> None:
+        """Stream the sink's remaining bytes into ``writer`` WITHOUT
+        materializing them (same zero-materialization contract as
+        :meth:`spill_into`). Call :meth:`finish` first."""
         view = self.sink.getbuffer()
         if len(view):
             writer.write(view)
         view.release()
 
+    def finalize_into(self, writer) -> None:
+        """Close the pipeline and stream its remaining bytes into ``writer``
+        (:meth:`finish` + :meth:`drain_into`)."""
+        self.finish()
+        self.drain_into(writer)
+
     def finalize(self) -> bytes:
-        self.record_writer.close()
-        if self.codec_stream is not None:
-            self.codec_stream.close()
+        self.finish()
         return self.sink.getvalue()
 
 
@@ -207,6 +232,25 @@ class MapWriterBase:
         )
         return message
 
+    def _fused_checksum_factory(self):
+        """Per-partition FusedChecksumAccumulator factory, or None. Active
+        when the codec can hand back CRCs fused into its encode launch AND
+        the configured partition checksum is CRC32C (what the device
+        computes): the sidecar value is then stitched from per-frame device
+        CRCs instead of re-hashing every stored byte on the host — sidecar
+        bytes stay byte-identical (regression-tested)."""
+        cfg = self.output_writer.dispatcher.config
+        if (
+            not cfg.checksum_enabled
+            or cfg.checksum_algorithm != "CRC32C"
+            or not getattr(self.codec, "supports_fused_checksum", False)
+        ):
+            return None
+        from s3shuffle_tpu.codec.tpu import FusedChecksumAccumulator
+        from s3shuffle_tpu.ops.checksum import POLY_CRC32C
+
+        return lambda: FusedChecksumAccumulator(POLY_CRC32C)
+
     def _record_spill(self, start_ns: int, nbytes: int) -> None:
         """Metrics hook shared by both buffering strategies' spill paths."""
         if _metrics.enabled():
@@ -240,8 +284,12 @@ class MapWriterBase:
 class ShuffleMapWriter(MapWriterBase):
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
+        fused = self._fused_checksum_factory()
         self._pipelines = [
-            _PartitionPipeline(self.dep.serializer, self.codec)
+            _PartitionPipeline(
+                self.dep.serializer, self.codec,
+                fused() if fused is not None else None,
+            )
             for _ in range(self.dep.num_partitions)
         ]
         self._combine_reducer = None  # columnar map-side combine state
@@ -380,9 +428,15 @@ class ShuffleMapWriter(MapWriterBase):
             self._write_batches(self._combine_reducer.results())
             self._combine_reducer = None
         for pid, pipeline in enumerate(self._pipelines):
-            writer = self.output_writer.get_partition_writer(pid)
+            # finish() BEFORE the writer exists: the codec stream's final
+            # frames land in the local sink and complete the fused checksum,
+            # which then replaces the writer's byte-serial hashing outright
+            fused_value = pipeline.finish()
+            writer = self.output_writer.get_partition_writer(
+                pid, precomputed_checksum=fused_value
+            )
             for offset, length in pipeline.spill_segments:
                 self._copy_spill_range(writer, offset, offset + length)
-            pipeline.finalize_into(writer)
+            pipeline.drain_into(writer)
             writer.close()
         return self._register_commit()

@@ -90,6 +90,13 @@ def pytest_configure(config):
     config.addinivalue_line(
         "filterwarnings", "error::pytest.PytestUnhandledThreadExceptionWarning"
     )
+    # The batched TLZ encode kernels donate their staged input (ops/tlz.py);
+    # XLA:CPU often can't alias uint8 staging buffers and jax warns per
+    # compilation — an expected no-op on the test backend, not a leak.
+    config.addinivalue_line(
+        "filterwarnings",
+        "ignore:Some donated buffers were not usable:UserWarning",
+    )
 
 
 @pytest.fixture(autouse=True)

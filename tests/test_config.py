@@ -120,14 +120,35 @@ def test_trace_disabled_is_noop():
     assert trace.counters() == {}
 
 
-def test_tpu_batch_blocks_flag_reaches_codec():
+def test_codec_batch_blocks_flag_reaches_codec():
     # the flag must actually size the device round-trip batch (was parsed
-    # but unplumbed)
+    # but unplumbed), and the async window knob must reach the codec too
     jax = pytest.importorskip("jax")  # noqa: F841
     from s3shuffle_tpu.config import ShuffleConfig
     from s3shuffle_tpu.manager import ShuffleManager
 
     m = ShuffleManager(
-        ShuffleConfig(root_dir="memory://tpu-flag", codec="tpu", tpu_batch_blocks=16)
+        ShuffleConfig(
+            root_dir="memory://tpu-flag", codec="tpu", codec_batch_blocks=16,
+            encode_inflight_batches=3,
+        )
     )
     assert m._codec.batch_blocks == 16
+    assert m._codec.encode_inflight_batches == 3
+
+
+def test_legacy_tpu_batch_blocks_key_still_accepted():
+    # configs written against the pre-rework knob name translate via
+    # from_dict, like the reference's spark.shuffle.s3.* keys do
+    from s3shuffle_tpu.config import ShuffleConfig
+
+    cfg = ShuffleConfig.from_dict({"tpu_batch_blocks": 32})
+    assert cfg.codec_batch_blocks == 32
+    # ... and via the env path, where the NEW spelling wins when both exist
+    cfg = ShuffleConfig.from_env({"S3SHUFFLE_TPU_BATCH_BLOCKS": "32"})
+    assert cfg.codec_batch_blocks == 32
+    cfg = ShuffleConfig.from_env({
+        "S3SHUFFLE_TPU_BATCH_BLOCKS": "32",
+        "S3SHUFFLE_CODEC_BATCH_BLOCKS": "16",
+    })
+    assert cfg.codec_batch_blocks == 16

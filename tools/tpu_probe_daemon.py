@@ -10,7 +10,14 @@ can see exactly when the tunnel was tried and what it said), and on the
 first successful probe immediately runs the full ``codec=tpu`` shuffle
 end-to-end to capture real shuffle bytes/sec/chip into
 ``bench_tpu_e2e.json``. ``bench.device_kernel_rates`` itself persists the
-kernel-rate measurement to ``bench_tpu_last_good.json`` on success.
+kernel-rate measurement to ``bench_tpu_last_good.json`` on success — since
+the device-codec-pipeline rework that includes the write-gap fields
+``tpu_tlz_encode_fused_mb_s`` (encode + CRC32C in ONE launch) and
+``tpu_codec_assembly_mb_s`` (vectorized host assembly), so successive
+last-good snapshots track the encode gap closing against the 2.8 MB/s
+r5 write-path baseline; the staged probe's ``tlz_encode_fused_warm`` step
+logs the same rate with a host CRC cross-check even from marginal
+windows.
 
 Run detached:  nohup python tools/tpu_probe_daemon.py >/tmp/probe_daemon.out 2>&1 &
 Stop:          touch tools/.probe_stop
