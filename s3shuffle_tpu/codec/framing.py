@@ -219,9 +219,17 @@ class CodecOutputStream(io.RawIOBase):
         self._frame_blocks = getattr(codec, "frame_blocks", None)
         self._checksum = checksum
         self._wants_async = getattr(codec, "wants_async_encode", None)
-        self._window = max(0, int(getattr(codec, "encode_inflight_batches", 0)))
         self._inflight: deque = deque()  # (future, raw_byte_count)
         self._inflight_bytes = 0
+
+    @property
+    def _window(self) -> int:
+        """Async window size, read LIVE from the codec at every batch
+        submission (not cached at construction): the write-side CommitTuner
+        retunes ``encode_inflight_batches`` online, and a retune applies to
+        the next batch of every open stream — a shrink drains down through
+        the harvest loop, a grow widens the window in place."""
+        return max(0, int(getattr(self._codec, "encode_inflight_batches", 0)))
 
     def writable(self) -> bool:
         return True

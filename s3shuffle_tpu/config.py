@@ -156,6 +156,21 @@ class ShuffleConfig:
     # lookups locally (zero tracker round-trips). false = every lookup is a
     # live RPC (the pre-snapshot behavior).
     metadata_snapshots: bool = True
+    # --- online autotuner (TPU-first addition; the reference's only adaptive
+    # element is the prefetch thread-count hill climb) ---
+    # master switch for the closed-loop knob controllers (tuning/): a
+    # read-side ScanTuner (fetch_chunk_size / fetch_parallelism /
+    # coalesce_gap_bytes / max_buffer_size_task) and a write-side CommitTuner
+    # (upload_queue_bytes / composite seal thresholds /
+    # encode_inflight_batches) read the live metrics registry and retune the
+    # knobs online within per-knob clamps. Off (the default) reproduces the
+    # static configuration's store request pattern op-for-op, the same
+    # contract as coalesce_gap_bytes=0 for the scan planner. Knobs whose
+    # static value disables a plane stay disabled either way.
+    autotune: bool = False
+    # controller cooldown: each knob moves at most once per this interval
+    # (cost samples keep accumulating between moves)
+    autotune_interval_s: float = 0.25
     # --- caches ---
     cache_partition_lengths: bool = True
     cache_checksums: bool = True
@@ -229,6 +244,8 @@ class ShuffleConfig:
             raise ValueError("codec_batch_blocks must be >= 1")
         if self.encode_inflight_batches < 0:
             raise ValueError("encode_inflight_batches must be >= 0")
+        if self.autotune_interval_s < 0:
+            raise ValueError("autotune_interval_s must be >= 0")
         if self.metadata_shards < 1 or self.metadata_batch_max < 1:
             raise ValueError("metadata_shards / metadata_batch_max must be >= 1")
         if self.metadata_shard_endpoints < 0:

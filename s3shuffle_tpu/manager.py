@@ -99,6 +99,12 @@ class ShuffleManager:
             tpu_host_fallback=cfg.tpu_host_fallback,
             encode_inflight_batches=cfg.encode_inflight_batches,
         )
+        # Autotune: hand the codec to the write-side tuner so its
+        # encode_inflight_batches window is retuned online (CodecOutputStream
+        # reads the attribute live at every batch submission). No-op when
+        # autotune is off (no tuner on the dispatcher).
+        if getattr(self.dispatcher, "commit_tuner", None) is not None:
+            self.dispatcher.commit_tuner.bind_codec(self._codec)
         # Composite commit plane (write/composite_commit.py): one per-worker
         # aggregator composing map commits into composite objects + fat
         # indexes. Registration is group-granular: the default seal callback

@@ -147,17 +147,23 @@ class ShuffleHelper:
             lambda _k: FatIndex.from_bytes(self.dispatcher.backend.read_all(path)),
         )
 
-    def _discover_composites(self, shuffle_id: int) -> None:
+    def _discover_composites(self, shuffle_id: int, refresh: bool = False) -> bool:
         """Listing-mode composite discovery: one listing pass finds the
         shuffle's fat-index objects; reading each (cached) yields every
         member's ``(group, base)``. Ran at most once per shuffle — later
         callers block on the discovery lock until the hints are populated,
         then return (racing threads must never memoize a miss). Gated by
-        the caller so a composite-free deployment never pays the LIST."""
+        the caller so a composite-free deployment never pays the LIST.
+        ``refresh`` re-lists even after a completed discovery: a
+        reduce-while-map scan may ask for a map that sealed into a composite
+        AFTER this shuffle's one-shot discovery ran (the caller bounds this
+        to one refresh per unresolved map, so a genuinely missing map costs
+        one extra LIST, not a loop). Returns True when a listing actually
+        ran (callers skip the refresh when the plain call just listed)."""
         with self._discovery_lock:
             with self._hints_lock:
-                if shuffle_id in self._listed_shuffles:
-                    return
+                if shuffle_id in self._listed_shuffles and not refresh:
+                    return False
             groups = self.dispatcher.list_composite_groups(shuffle_id)
             for group_id in groups:
                 try:
@@ -175,6 +181,7 @@ class ShuffleHelper:
                         )
             with self._hints_lock:
                 self._listed_shuffles.add(shuffle_id)
+        return True
 
     def _discovery_allowed(self, shuffle_id: int) -> bool:
         """Consult the store for composite membership only when composites
@@ -216,10 +223,20 @@ class ShuffleHelper:
             except FileNotFoundError:
                 if not self._discovery_allowed(shuffle_id):
                     raise
-                self._discover_composites(shuffle_id)
+                listed = self._discover_composites(shuffle_id)
                 hint = self.composite_hint(shuffle_id, map_id)
                 if hint is None:
-                    raise
+                    # Streaming reduce-while-map: the map may have sealed
+                    # into a composite after this shuffle's discovery pass —
+                    # re-list ONCE before declaring it uncommitted (skipped
+                    # when the call above just listed: a genuinely missing
+                    # map still costs one LIST, not two).
+                    if listed:
+                        raise
+                    self._discover_composites(shuffle_id, refresh=True)
+                    hint = self.composite_hint(shuffle_id, map_id)
+                    if hint is None:
+                        raise
         return self._composite_location(shuffle_id, map_id, hint)
 
     def _singleton_offsets(self, shuffle_id: int, map_id: int) -> np.ndarray:
@@ -253,10 +270,18 @@ class ShuffleHelper:
         except FileNotFoundError:
             if not self._discovery_allowed(shuffle_id):
                 raise
-            self._discover_composites(shuffle_id)
+            listed = self._discover_composites(shuffle_id)
             hint = self.composite_hint(shuffle_id, map_id)
             if hint is None:
-                raise
+                # same streaming re-list as resolve_map_location: a map can
+                # seal into a composite after the one-shot discovery (and
+                # the same one-LIST bound when discovery just ran)
+                if listed:
+                    raise
+                self._discover_composites(shuffle_id, refresh=True)
+                hint = self.composite_hint(shuffle_id, map_id)
+                if hint is None:
+                    raise
             return self._composite_checksums(shuffle_id, map_id, hint)
 
     def _composite_checksums(

@@ -86,6 +86,11 @@ KNOWN_METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     # --- codec plane (codec/native.py) ---
     "codec_compress_seconds": ("histogram", ("codec",)),
     "codec_compress_bytes_total": ("counter", ("codec",)),
+    # --- tuning plane: online autotuner
+    # (tuning/controller.py, tuning/tuners.py) ---
+    "tune_decisions_total": ("counter", ("knob", "direction")),
+    "tune_knob_value": ("gauge", ("knob",)),
+    "tune_controller_seconds": ("histogram", ()),
     # --- codec plane: device-resident batch pipeline
     # (codec/framing.py, codec/tpu.py) ---
     "codec_encode_batch_seconds": ("histogram", ()),

@@ -63,6 +63,74 @@ DEFAULT_TIME_BUCKETS = exponential_buckets(1e-4, 2.0, 20)
 DEFAULT_BYTES_BUCKETS = exponential_buckets(256.0, 4.0, 12)
 
 
+def quantile_from_buckets(
+    bounds: Sequence[float], counts: Sequence[int], q: float
+) -> float:
+    """Estimate the q-quantile from per-bin counts (``counts`` has one more
+    entry than ``bounds`` — the +Inf overflow bin). Linear interpolation
+    within the winning bin; overflow answers the last finite bound (a lower
+    bound on the true value). The single home of the bucket math —
+    ``tools/trace_report.py`` and the tuning controllers both read through
+    here."""
+    total = sum(counts)
+    if total == 0:
+        return 0.0
+    target = q * total
+    cum = 0.0
+    for i, n in enumerate(counts):
+        if n == 0:
+            continue
+        if cum + n >= target:
+            if i >= len(bounds):  # overflow bin
+                return float(bounds[-1])
+            lo = float(bounds[i - 1]) if i > 0 else 0.0
+            hi = float(bounds[i])
+            frac = (target - cum) / n
+            return lo + (hi - lo) * min(1.0, max(0.0, frac))
+        cum += n
+    return float(bounds[-1]) if bounds else 0.0
+
+
+class HistogramSnapshot:
+    """Immutable point-in-time histogram read for the closed-loop tuners.
+
+    Produced by :meth:`Histogram.read` WITHOUT touching the per-series
+    writer locks (see there), so a controller polling between decisions can
+    never stall a hot-path ``observe``."""
+
+    __slots__ = ("bounds", "counts", "sum", "count")
+
+    def __init__(
+        self, bounds: Sequence[float], counts: Sequence[int], sum_: float, count: int
+    ):
+        self.bounds = tuple(bounds)
+        self.counts = tuple(counts)
+        self.sum = float(sum_)
+        self.count = int(count)
+
+    def percentile(self, q: float) -> float:
+        return quantile_from_buckets(self.bounds, self.counts, q)
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+    def delta(self, prev: "HistogramSnapshot") -> "HistogramSnapshot":
+        """Interval view since ``prev`` (same instrument, earlier read)."""
+        if prev.bounds != self.bounds or not prev.counts:
+            return self
+        return HistogramSnapshot(
+            self.bounds,
+            [max(0, a - b) for a, b in zip(self.counts, prev.counts)],
+            max(0.0, self.sum - prev.sum),
+            max(0, self.count - prev.count),
+        )
+
+    @classmethod
+    def empty(cls) -> "HistogramSnapshot":
+        return cls((), (), 0.0, 0)
+
+
 class _Metric:
     """Shared series bookkeeping; subclasses define the per-series state."""
 
@@ -224,6 +292,14 @@ class _HistogramSeries:
                 "count": self.count,
             }
 
+    def read(self) -> HistogramSnapshot:
+        """Lock-light read for the tuning controllers: list-element loads
+        are GIL-atomic, so this never touches the writer lock ``observe``
+        takes. The price is a torn view at most one in-flight observation
+        wide (count/sum may disagree by one sample), which interval-delta
+        consumers tolerate by construction."""
+        return HistogramSnapshot(self.bounds, tuple(self.counts), self.sum, self.count)
+
 
 class Histogram(_Metric):
     kind = "histogram"
@@ -248,6 +324,31 @@ class Histogram(_Metric):
         if not _enabled:
             return
         self._default().observe(value)
+
+    def read(self) -> HistogramSnapshot:
+        """Lock-light merged snapshot across every label series — the tuning
+        controllers' read API. Only the series-table lock (taken by series
+        CREATION, not by ``observe``) is held, and only to copy the dict;
+        the per-series writer locks are never touched."""
+        with self._lock:
+            children = list(self._series.values())
+        counts: Optional[list] = None
+        total_sum, total_count = 0.0, 0
+        for child in children:
+            snap = child.read()  # type: ignore[attr-defined]
+            total_sum += snap.sum
+            total_count += snap.count
+            if counts is None:
+                counts = list(snap.counts)
+            else:
+                counts = [a + b for a, b in zip(counts, snap.counts)]
+        if counts is None:
+            return HistogramSnapshot(self.buckets, (0,) * (len(self.buckets) + 1), 0.0, 0)
+        return HistogramSnapshot(self.buckets, counts, total_sum, total_count)
+
+    def percentile(self, q: float) -> float:
+        """Convenience quantile over the merged series."""
+        return self.read().percentile(q)
 
 
 class MetricRegistry:
@@ -329,6 +430,27 @@ class MetricRegistry:
 
 #: process-default registry — the data plane's instruments all live here
 REGISTRY = MetricRegistry()
+
+
+def read_counter_total(name: str, registry: MetricRegistry = REGISTRY) -> float:
+    """Lock-light sum of a counter's series values (0.0 when the instrument
+    does not exist) — the tuners' counter-signal read. Per-series value loads
+    are GIL-atomic; the writer lock ``inc`` takes is never touched."""
+    metric = registry.get(name)
+    if metric is None:
+        return 0.0
+    with metric._lock:
+        children = list(metric._series.values())
+    return sum(float(getattr(c, "value", 0.0)) for c in children)
+
+
+def read_histogram(name: str, registry: MetricRegistry = REGISTRY) -> HistogramSnapshot:
+    """Lock-light merged :class:`HistogramSnapshot` of a histogram (empty
+    snapshot when the instrument does not exist or is another kind)."""
+    metric = registry.get(name)
+    if not isinstance(metric, Histogram):
+        return HistogramSnapshot.empty()
+    return metric.read()
 
 
 def _escape_label(value: str) -> str:

@@ -33,6 +33,7 @@ from typing import Iterator, List, Optional, Tuple
 
 from s3shuffle_tpu.metrics import registry as _metrics
 from s3shuffle_tpu.read.block_stream import BlockStream
+from s3shuffle_tpu.tuning.controller import Controller
 from s3shuffle_tpu.utils.io import read_up_to as _read_up_to
 
 logger = logging.getLogger("s3shuffle_tpu.read")
@@ -58,45 +59,26 @@ _C_THREAD_MOVES = _metrics.REGISTRY.counter(
 )
 
 
-class ThreadPredictor:
-    """Latency-driven hill climb over the prefetch thread count."""
+class ThreadPredictor(Controller):
+    """Latency-driven hill climb over the prefetch thread count — a thin
+    binding of the shared tuning Controller core (tuning/controller.py). The
+    decisions are bit-for-bit the historical predictor's (hysteresis and
+    cooldown off, the same 20-sample ring, ties resolving to fewer threads,
+    the LOSING direction's stale total popped on every move so a drifting
+    backend is re-probed — all pinned by the drift re-probe test)."""
 
     def __init__(self, max_threads: int, initial: int = 1):
-        self.max_threads = max(1, max_threads)
-        self.current = min(max(1, initial), self.max_threads)
-        self._ring: List[int] = []
-        self._totals: dict[int, int] = {}
-
-    def add_measurement_and_predict(self, wait_latency_ns: int) -> int:
-        self._ring.append(wait_latency_ns)
-        if len(self._ring) < RING_SIZE:
-            return self.current
-        total = sum(self._ring)
-        self._ring.clear()
-        self._totals[self.current] = total
-        down = max(1, self.current - 1)
-        up = min(self.max_threads, self.current + 1)
-        # Explore unmeasured neighbors first (optimistically), then move to
-        # whichever measured count had the lowest total wait.
-        for candidate in (up, down):
-            if candidate != self.current and candidate not in self._totals:
-                self.current = candidate
-                return self.current
-        best = min(
-            {c: self._totals[c] for c in {down, self.current, up}}.items(),
-            key=lambda kv: kv[1],
-        )[0]
-        # Re-measure neighbors eventually: forget the LOSING direction's stale
-        # total so a drifting backend (S3 vs NFS vs page cache) is re-probed.
-        # (Popping the winner would be a no-op — it becomes `current` and its
-        # total is overwritten at the next full ring; the loser's total is the
-        # one that would otherwise pin every future comparison.)
-        if best != self.current:
-            for candidate in (down, up):
-                if candidate not in (best, self.current):
-                    self._totals.pop(candidate, None)
-        self.current = best
-        return self.current
+        max_threads = max(1, max_threads)
+        # knob stays UNSET: the predictor has its own dedicated instruments
+        # (read_prefetch_threads / read_prefetch_thread_moves_total) and is
+        # always on — emitting tune_* here would light the trace_report
+        # "Tuning" digest on runs where the opt-in autotuner never ran
+        super().__init__(
+            ladder=range(1, max_threads + 1),
+            initial=min(max(1, initial), max_threads),
+            ring_size=RING_SIZE,
+        )
+        self.max_threads = max_threads
 
 
 class PrefetchedBlockStream(io.RawIOBase):

@@ -223,11 +223,19 @@ class ShuffleReader:
         range resolution AND checksum-offset lookups, so no index object is
         fetched twice within one scan regardless of the cache knobs."""
         blocks = self.compute_shuffle_blocks()
-        cfg = self.dispatcher.config
         self._scan_memo = ScanIndexMemo(self.helper)
 
         from s3shuffle_tpu.read.chunked_fetch import ChunkedRangeFetcher
-        from s3shuffle_tpu.read.scan_plan import build_scan_iterator
+        from s3shuffle_tpu.read.scan_plan import (
+            build_scan_iterator,
+            tuned_scan_config,
+        )
+
+        # Autotuner consult BEFORE the fetcher is built, so the chunk size /
+        # parallelism the fetcher carries match what the planner plans with
+        # (tuner_consulted=True: build_scan_iterator does not consult again
+        # — one consult per scan).
+        cfg = tuned_scan_config(self.dispatcher, self.dispatcher.config)
 
         return build_scan_iterator(
             self.dispatcher,
@@ -236,6 +244,7 @@ class ShuffleReader:
             cfg,
             fetcher=ChunkedRangeFetcher.from_config(cfg),
             on_block=self._count_block,
+            tuner_consulted=True,
         )
 
     def read(self) -> Iterator[Tuple[Any, Any]]:

@@ -399,6 +399,50 @@ class CoalescedScanIterator:
 # ---------------------------------------------------------------------------
 
 
+def tuned_scan_config(dispatcher: Dispatcher, cfg):
+    """The scan-plan-time autotuner consult: ``cfg`` with the read-side
+    knobs replaced by the ScanTuner's current rungs. Identity when the
+    autotune switch is off (the dispatcher then carries no tuner) — the
+    static request pattern is reproduced op-for-op. Callers that build a
+    :class:`ChunkedRangeFetcher` themselves should consult FIRST and pass
+    ``tuner_consulted=True`` to :func:`build_scan_iterator`, so the fetcher
+    and the planner see the same tuned values (one consult per scan)."""
+    tuner = getattr(dispatcher, "scan_tuner", None)
+    if tuner is None or not getattr(cfg, "autotune", False):
+        return cfg
+    return tuner.tuned(cfg)
+
+
+class _ObservedScanIterator:
+    """Pass-through over the scan's stream iterator that feeds the ScanTuner
+    exactly one (wall, bytes) cost sample — at clean exhaustion. A scan that
+    dies mid-flight feeds nothing: a failure's wall time is not evidence
+    about the knob landscape."""
+
+    def __init__(self, inner, tuner):
+        self._inner = inner
+        self._tuner = tuner
+        self._t0 = time.perf_counter()
+        self._reported = False
+
+    def __iter__(self) -> "_ObservedScanIterator":
+        return self
+
+    def __next__(self):
+        try:
+            return next(self._inner)
+        except StopIteration:
+            if not self._reported:
+                self._reported = True
+                wall = time.perf_counter() - self._t0
+                self._tuner.observe_scan(wall, self._inner.stats.get("bytes", 0))
+            raise
+
+    @property
+    def stats(self) -> dict:
+        return self._inner.stats
+
+
 def build_scan_iterator(
     dispatcher: Dispatcher,
     memo: ScanIndexMemo,
@@ -406,6 +450,7 @@ def build_scan_iterator(
     cfg,
     fetcher=None,
     on_block: OnBlock = None,
+    tuner_consulted: bool = False,
 ) -> Iterator:
     """Assemble the reduce scan's prefetching block-stream iterator.
 
@@ -415,7 +460,21 @@ def build_scan_iterator(
     (BlockIterator resolves lazily inside the prefetch threads; no bulk index
     prefetch runs). Both return an iterator of per-block prefetched streams
     exposing ``.stats`` for the reader's completion accounting.
+
+    With ``autotune`` on, the ScanTuner is consulted here — UNLESS the
+    caller already consulted via :func:`tuned_scan_config` and passes the
+    resulting cfg with ``tuner_consulted=True``, which guarantees one
+    consult per scan (the fetcher and the planner can never see rungs from
+    two different moments). Either way the returned iterator reports the
+    scan's (wall, bytes) back to the tuner at exhaustion — the closed
+    loop's feed point.
     """
+    tuner = getattr(dispatcher, "scan_tuner", None)
+    if tuner is not None and getattr(cfg, "autotune", False):
+        if not tuner_consulted:
+            cfg = tuner.tuned(cfg)
+    else:
+        tuner = None
     if cfg.coalesce_gap_bytes > 0:
         segments = plan_scan(
             dispatcher,
@@ -431,7 +490,7 @@ def build_scan_iterator(
             # the first data byte flows
             prefetch_width=max(1, cfg.fetch_parallelism, cfg.max_concurrency_task),
         )
-        return CoalescedScanIterator(
+        it = CoalescedScanIterator(
             dispatcher,
             segments,
             max_buffer_size=cfg.max_buffer_size_task,
@@ -439,6 +498,7 @@ def build_scan_iterator(
             fetcher=fetcher,
             on_block=on_block,
         )
+        return it if tuner is None else _ObservedScanIterator(it, tuner)
 
     def nonempty_streams():
         for block, stream in BlockIterator(dispatcher, memo, blocks):
@@ -449,9 +509,10 @@ def build_scan_iterator(
                 on_block(block, stream.max_bytes)
             yield block, stream
 
-    return BufferedPrefetchIterator(
+    it = BufferedPrefetchIterator(
         nonempty_streams(),
         max_buffer_size=cfg.max_buffer_size_task,
         max_threads=cfg.max_concurrency_task,
         fetcher=fetcher,
     )
+    return it if tuner is None else _ObservedScanIterator(it, tuner)

@@ -75,6 +75,18 @@ class Dispatcher:
             self.supports_rename = self.backend.supports_rename
         else:
             self.supports_rename = config.supports_rename
+        # Online autotuner (tuning/): per-process closed-loop controllers
+        # retuning the transfer knobs from the live metrics registry. Both
+        # stay None when autotune is off — every consult site then reads the
+        # static config value, keeping the store request pattern op-for-op
+        # identical to a tuner-less build.
+        self.scan_tuner = None
+        self.commit_tuner = None
+        if config.autotune:
+            from s3shuffle_tpu.tuning import CommitTuner, ScanTuner
+
+            self.scan_tuner = ScanTuner(config)
+            self.commit_tuner = CommitTuner(config)
         config.log_values()
         logger.info(
             "dispatcher: scheme=%s app_id=%s rename=%s",

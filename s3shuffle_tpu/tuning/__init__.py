@@ -1,0 +1,26 @@
+"""Online autotuner: closed-loop knob controllers over the live metrics
+registry.
+
+- :mod:`~s3shuffle_tpu.tuning.controller` — the shared hill-climb core
+  (ladder clamps, bounded steps, hysteresis, cooldown) that the prefetcher's
+  ``ThreadPredictor`` also binds;
+- :mod:`~s3shuffle_tpu.tuning.tuners` — the read-side :class:`ScanTuner`
+  and write-side :class:`CommitTuner` the Dispatcher constructs when the
+  ``autotune`` config switch is on.
+"""
+
+from s3shuffle_tpu.tuning.controller import (
+    DEFAULT_RING_SIZE,
+    Controller,
+    geometric_ladder,
+)
+from s3shuffle_tpu.tuning.tuners import CommitTuner, ScanTuner, tuner_state
+
+__all__ = [
+    "Controller",
+    "CommitTuner",
+    "DEFAULT_RING_SIZE",
+    "ScanTuner",
+    "geometric_ladder",
+    "tuner_state",
+]

@@ -1,0 +1,208 @@
+"""Shared closed-loop knob controller core.
+
+The reference's only adaptive element is the prefetch thread-count hill
+climb (``S3BufferedPrefetchIterator``'s ThreadPredictor, :32-69); every other
+knob this port grew across PRs 2/5/7/8 — chunk size, fetch parallelism,
+coalesce gap, upload queue, composite seal thresholds, encode window — is
+statically configured while its optimal point depends on store latency,
+partition-size distribution, and skew (the planned-vs-adhoc pipeline argument
+of "Optimizing High-Throughput Distributed Data Pipelines", PAPERS.md, and
+BlobShuffle's request-cost model). This module generalizes the predictor's
+hill climb into ONE reusable :class:`Controller` the read- and write-side
+tuners (:mod:`s3shuffle_tpu.tuning.tuners`) and the prefetcher's
+``ThreadPredictor`` all bind:
+
+- **ladder**: the knob's ordered candidate values — its per-knob clamps ARE
+  the ladder ends, so a controller can never leave its sanctioned range and
+  step sizes are bounded by construction (neighboring rungs only, one rung
+  per decision);
+- **ring**: cost samples (lower is better — consumer wait, wall seconds per
+  MiB) accumulate into a fixed ring; each full ring records a total for the
+  current rung and triggers one decision;
+- **decision**: explore unmeasured neighbors first (optimistically), then
+  move to whichever measured neighbor had the lowest total. Moving away pops
+  the LOSING direction's stale total so a drifting backend (S3 vs NFS vs
+  page cache) is re-probed — the exact semantics the prefetch drift re-probe
+  test pins;
+- **hysteresis**: a neighbor must beat the current rung's total by more than
+  this fraction to win — measurement noise cannot oscillate the knob;
+- **cooldown**: decisions no closer together than ``cooldown_s`` (rings
+  completing inside the window still record their totals but hold the rung).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence
+
+from s3shuffle_tpu.metrics import registry as _metrics
+
+#: samples per decision ring (the reference predictor's 20-sample ring)
+DEFAULT_RING_SIZE = 20
+
+_C_DECISIONS = _metrics.REGISTRY.counter(
+    "tune_decisions_total",
+    "Completed controller decisions by knob and outcome (up/down moves, "
+    "explicit holds)",
+    labelnames=("knob", "direction"),
+)
+_G_KNOB = _metrics.REGISTRY.gauge(
+    "tune_knob_value",
+    "Live tuned value of each autotuned knob",
+    labelnames=("knob",),
+)
+
+
+def geometric_ladder(lo: float, hi: float, factor: float = 2.0) -> List[int]:
+    """Integer rungs ``lo, lo*factor, ... , hi`` (hi always included) — the
+    standard clamp-to-clamp ladder for byte/count knobs."""
+    if lo < 1 or hi < lo or factor <= 1:
+        raise ValueError("need 1 <= lo <= hi and factor > 1")
+    out: List[int] = []
+    v = float(lo)
+    while v < hi:
+        out.append(int(round(v)))
+        v *= factor
+    out.append(int(hi))
+    return sorted(dict.fromkeys(out))
+
+
+class Controller:
+    """Latency/cost-driven hill climb over an ordered value ladder.
+
+    ``add_measurement_and_predict(cost)`` is the whole surface: feed one cost
+    sample, get back the value to use next. With ``hysteresis=0`` and
+    ``cooldown_s=0`` the decisions are bit-for-bit the reference predictor's
+    (ties resolve toward the LOWER rung — the cheaper resource level)."""
+
+    def __init__(
+        self,
+        ladder: Sequence[int],
+        initial: Optional[int] = None,
+        ring_size: int = DEFAULT_RING_SIZE,
+        hysteresis: float = 0.0,
+        cooldown_s: float = 0.0,
+        knob: str = "",
+        time_fn=time.monotonic,
+    ):
+        values = sorted(dict.fromkeys(int(v) for v in ladder))
+        if not values:
+            raise ValueError("ladder must not be empty")
+        self.ladder = values
+        if initial is None:
+            initial = values[0]
+        # clamp the seed onto the nearest rung (exact static values are
+        # inserted into the ladder by the tuners, so autotuned runs START at
+        # the operator's configured value)
+        self._i = min(
+            range(len(values)), key=lambda j: (abs(values[j] - initial), j)
+        )
+        self.ring_size = max(1, int(ring_size))
+        self.hysteresis = max(0.0, float(hysteresis))
+        self.cooldown_s = max(0.0, float(cooldown_s))
+        self.knob = knob
+        self._time = time_fn
+        self._ring: List[float] = []
+        self._totals: Dict[int, float] = {}  # rung value -> ring total
+        self._last_decision = -float("inf")
+        #: completed decision count (full rings processed, including holds) —
+        #: the tuners rotate their round-robin coordinate descent on this
+        self.decisions = 0
+        #: rung changes (up + down moves)
+        self.moves = 0
+        #: rung an in-flight EXPLORATION left from (None = not exploring).
+        #: With hysteresis on, the explored rung must BEAT this rung by the
+        #: margin to keep its position — without the reverse gate, status-quo
+        #: hysteresis plus explore-first turns every flat/noisy landscape
+        #: into a ratchet to the clamp (each new rung has an unmeasured
+        #: neighbor, and the incumbent never has to justify itself).
+        self._explored_from: Optional[int] = None
+
+    # ------------------------------------------------------------------
+    @property
+    def current(self) -> int:
+        return self.ladder[self._i]
+
+    @property
+    def lo(self) -> int:
+        return self.ladder[0]
+
+    @property
+    def hi(self) -> int:
+        return self.ladder[-1]
+
+    def _emit(self, direction: str) -> None:
+        if not self.knob or not _metrics.enabled():
+            return
+        _C_DECISIONS.labels(knob=self.knob, direction=direction).inc()
+        _G_KNOB.labels(knob=self.knob).set(self.current)
+
+    def add_measurement_and_predict(self, cost: float) -> int:
+        """Feed one cost sample (lower is better); returns the rung to use."""
+        self._ring.append(cost)
+        if len(self._ring) < self.ring_size:
+            return self.current
+        total = sum(self._ring)
+        self._ring.clear()
+        self._totals[self.current] = total
+        now = self._time()
+        if self.cooldown_s > 0 and now - self._last_decision < self.cooldown_s:
+            # inside the cooldown window: the total is recorded (fresher
+            # evidence for the next decision) but the rung holds
+            return self.current
+        self._last_decision = now
+        self.decisions += 1
+        down = self.ladder[max(0, self._i - 1)]
+        up = self.ladder[min(len(self.ladder) - 1, self._i + 1)]
+        # Explore unmeasured neighbors first (optimistically), then move to
+        # whichever measured rung had the lowest total cost.
+        for candidate in (up, down):
+            if candidate != self.current and candidate not in self._totals:
+                self._explored_from = self.current
+                self._i = self.ladder.index(candidate)
+                self.moves += 1
+                self._emit("up" if candidate == up else "down")
+                return self.current
+        current = self.current
+        explored_from = self._explored_from
+        self._explored_from = None
+        best = min(
+            {c: self._totals[c] for c in sorted({down, current, up})}.items(),
+            key=lambda kv: kv[1],
+        )[0]
+        if best != current and self.hysteresis > 0.0:
+            # the neighbor must be BETTER by more than the hysteresis margin
+            # — noise-level differences hold the rung instead of oscillating
+            if self._totals[best] >= self._totals[current] * (1.0 - self.hysteresis):
+                best = current
+        if (
+            best == current
+            and self.hysteresis > 0.0
+            and explored_from is not None
+            and explored_from != current
+            and explored_from in self._totals
+            and total >= self._totals[explored_from] * (1.0 - self.hysteresis)
+        ):
+            # Reverse hysteresis gate: this rung was reached by EXPLORATION,
+            # so the burden of proof is on it — not better than where we
+            # came from by the margin means go back. (At hysteresis 0 the
+            # plain min above already returns on ties — the predictor's
+            # pinned behavior — so this gate only engages for the tuners.)
+            best = explored_from
+        if best != current:
+            # Re-measure neighbors eventually: forget the LOSING direction's
+            # stale total so a drifting backend is re-probed (the winner's
+            # total is overwritten at the next full ring anyway).
+            for candidate in (down, up):
+                if candidate not in (best, current):
+                    self._totals.pop(candidate, None)
+            moved_up = best > current
+            self._i = self.ladder.index(best)
+            self.moves += 1
+            self._emit("up" if moved_up else "down")
+        else:
+            self._emit("hold")
+        return self.current
+
+    #: tuner-facing alias (the predictor name is the historical surface)
+    observe = add_measurement_and_predict

@@ -142,6 +142,11 @@ class CompositeCommitAggregator:
         self.max_members = int(cfg.composite_commit_maps)
         self.flush_bytes = int(cfg.composite_flush_bytes)
         self.flush_ms = float(cfg.composite_flush_ms)
+        # CommitTuner (tuning/): retunes the seal thresholds and the sink's
+        # upload-queue depth within clamps. None (autotune off) = the static
+        # knobs, op-for-op. Plane on/off stays a STATIC decision either way
+        # (`enabled` reads the configured member cap, never a tuned one).
+        self._tuner = getattr(dispatcher, "commit_tuner", None)
         self._lock = threading.Lock()
         self._groups: Dict[int, _OpenGroup] = {}
 
@@ -149,16 +154,25 @@ class CompositeCommitAggregator:
     def enabled(self) -> bool:
         return self.max_members > 1
 
+    def _seal_thresholds(self) -> tuple:
+        """The seal-point consult: (member-count cap, byte cap)."""
+        if self._tuner is None:
+            return self.max_members, self.flush_bytes
+        return self._tuner.seal_thresholds(self.max_members, self.flush_bytes)
+
     # ------------------------------------------------------------------
     def _make_sink(self, group: _OpenGroup):
         cfg = self.dispatcher.config
         raw = self.dispatcher.create_block(group.data_block)
         measured = MeasuredOutputStream(raw, group.data_block.name)
-        if cfg.upload_queue_bytes > 0:
+        queue_bytes = cfg.upload_queue_bytes
+        if self._tuner is not None:
+            queue_bytes = self._tuner.upload_queue_bytes(queue_bytes)
+        if queue_bytes > 0:
             from s3shuffle_tpu.write.pipelined_upload import PipelinedUploadStream
 
             return PipelinedUploadStream(
-                measured, cfg.upload_queue_bytes, label=group.data_block.name
+                measured, queue_bytes, label=group.data_block.name
             )
         return measured
 
@@ -243,7 +257,8 @@ class CompositeCommitAggregator:
                     total_bytes=int(total_bytes),
                 )
                 group.members.append(member)
-                if len(group.members) >= self.max_members or group.bytes >= self.flush_bytes:
+                members_cap, bytes_cap = self._seal_thresholds()
+                if len(group.members) >= members_cap or group.bytes >= bytes_cap:
                     group.detached = True
                     seal_now = True
             break
@@ -358,6 +373,13 @@ class CompositeCommitAggregator:
             if self.on_group_abort is not None:
                 self.on_group_abort(group.shuffle_id, list(group.members), e)
             raise
+        if self._tuner is not None and group.bytes > 0:
+            # closed-loop feed: one sealed group = one cost sample for the
+            # write-side controllers (seal wall covers the final data flush
+            # plus the fat-index PUT — the request-count price being tuned)
+            self._tuner.observe_commit(
+                (time.perf_counter_ns() - t0) / 1e9, group.bytes
+            )
         if _metrics.enabled():
             _H_FLUSH.observe((time.perf_counter_ns() - t0) / 1e9)
             _C_GROUPS.inc()

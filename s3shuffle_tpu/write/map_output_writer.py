@@ -26,6 +26,7 @@ from __future__ import annotations
 import dataclasses
 import io
 import logging
+import time
 from typing import Optional
 
 import numpy as np
@@ -108,7 +109,14 @@ class MapOutputWriter:
             cfg = self.dispatcher.config
             raw = self.dispatcher.create_block(self._block)
             self._object_created = True
-            if cfg.upload_queue_bytes > 0:
+            # Autotuner consult at sink creation: the CommitTuner retunes the
+            # upload-queue depth within its clamps; with autotune off (tuner
+            # None) this is exactly the static knob.
+            queue_bytes = cfg.upload_queue_bytes
+            tuner = getattr(self.dispatcher, "commit_tuner", None)
+            if tuner is not None:
+                queue_bytes = tuner.upload_queue_bytes(queue_bytes)
+            if queue_bytes > 0:
                 # Pipelined transfer plane: partition serialization enqueues
                 # bounded chunks; a background thread does the store PUT, so
                 # commit drain/codec work overlaps the upload
@@ -122,7 +130,7 @@ class MapOutputWriter:
 
                 measured = MeasuredOutputStream(raw, self._block.name)
                 self._stream = PipelinedUploadStream(
-                    measured, cfg.upload_queue_bytes, label=self._block.name
+                    measured, queue_bytes, label=self._block.name
                 )
             else:
                 buffered = io.BufferedWriter(raw, buffer_size=cfg.buffer_size)  # type: ignore[arg-type]
@@ -170,6 +178,8 @@ class MapOutputWriter:
         self._committed = True
         if self._composite is not None:
             return self._commit_composite()
+        tuner = getattr(self.dispatcher, "commit_tuner", None)
+        commit_t0 = time.perf_counter() if tuner is not None else 0.0
         if self._stream is not None:
             if self._stream.bytes_written != self._total_bytes:
                 # S3ShuffleMapOutputWriter.scala:96-100
@@ -204,6 +214,12 @@ class MapOutputWriter:
                     self.shuffle_id, self.map_id, self._lengths
                 ),
                 policy, op="commit_index", scheme=scheme,
+            )
+        if tuner is not None and self._total_bytes > 0:
+            # closed-loop feed: one per-map commit = one cost sample (seal
+            # feeds happen in the composite aggregator instead)
+            tuner.observe_commit(
+                time.perf_counter() - commit_t0, self._total_bytes
             )
         checksums = self._checksum_values if self._checksums_enabled else None
         return MapOutputCommitMessage(self._lengths, checksums)

@@ -1,0 +1,405 @@
+"""Online autotuner: controller-core safety, registry read API, and the
+autotune=0 op-for-op contract.
+
+The contract under test (ISSUE 9):
+
+- every tuned run stays inside its per-knob clamps (the ladder ends);
+- the hill climb CONVERGES under a static synthetic cost profile — no
+  oscillation past hysteresis once the landscape is measured;
+- ``autotune=0`` (the default) reproduces the static request pattern
+  op-for-op: no tuners are constructed, every consult site reads the static
+  knob, and a pinned tuner (controllers allowed zero movement) issues the
+  byte-for-byte same store ops as the untuned path;
+- the shared Controller core IS the ThreadPredictor's decision engine (the
+  prefetch drift re-probe semantics, replayed here against the raw core).
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+from s3shuffle_tpu.block_ids import ShuffleBlockId
+from s3shuffle_tpu.config import ShuffleConfig
+from s3shuffle_tpu.metadata.helper import ScanIndexMemo, ShuffleHelper
+from s3shuffle_tpu.metrics import registry as mreg
+from s3shuffle_tpu.metrics.registry import (
+    quantile_from_buckets,
+    read_counter_total,
+    read_histogram,
+)
+from s3shuffle_tpu.read.chunked_fetch import ChunkedRangeFetcher
+from s3shuffle_tpu.read.scan_plan import build_scan_iterator, tuned_scan_config
+from s3shuffle_tpu.storage.dispatcher import Dispatcher
+from s3shuffle_tpu.storage.fault import FlakyBackend
+from s3shuffle_tpu.tuning import CommitTuner, Controller, ScanTuner, geometric_ladder
+from s3shuffle_tpu.write.map_output_writer import MapOutputWriter
+
+
+class RecordingBackend(FlakyBackend):
+    """FlakyBackend that records every (op, path) it sees — the request
+    pattern the store would bill for."""
+
+    def __init__(self, inner):
+        super().__init__(inner)
+        self.ops = []
+
+    def _check(self, op: str, path: str) -> None:
+        self.ops.append((op, path))
+        super()._check(op, path)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_dispatcher():
+    Dispatcher.reset()
+    yield
+    Dispatcher.reset()
+
+
+# ---------------------------------------------------------------------------
+# Controller core
+# ---------------------------------------------------------------------------
+
+
+def test_controller_replays_thread_predictor_drift_semantics():
+    """The raw core makes the exact decisions the predictor's drift re-probe
+    test pins (tuning/controller.py is now the ONLY hill-climb impl)."""
+    c = Controller(ladder=range(1, 4), initial=2, ring_size=20)
+
+    def ring(cost):
+        v = c.current
+        for _ in range(20):
+            v = c.add_measurement_and_predict(cost)
+        return v
+
+    assert ring(100) == 3       # measure 2, explore up
+    assert ring(200) == 2       # 3 is worse -> back to 2
+    assert ring(300) == 1       # explore down
+    assert ring(50) == 1        # 1 wins, hold
+    assert ring(10_000) == 2    # drift: 1 became slow, walk back up
+    assert ring(10_000) == 3
+    assert 1 not in c._totals   # the losing direction's stale total popped
+    assert ring(10_000) == 2
+    assert ring(10_000) == 1    # re-probed with a fresh measurement
+
+
+def test_geometric_ladder_spans_clamps():
+    lad = geometric_ladder(4 * 1024, 64 * 1024)
+    assert lad[0] == 4 * 1024 and lad[-1] == 64 * 1024
+    assert lad == sorted(set(lad))
+    with pytest.raises(ValueError):
+        geometric_ladder(0, 10)
+
+
+@pytest.mark.parametrize("seed", [1, 7, 23, 99])
+def test_controller_converges_inside_clamps(seed):
+    """Seeded property: under a static convex cost profile with bounded
+    noise, every prediction stays inside the ladder clamps, the climb lands
+    within one rung of the optimum, and — with both neighbors measured —
+    hysteresis stops further movement (no oscillation)."""
+    rng = random.Random(seed)
+    ladder = geometric_ladder(1, 64)
+    optimum = rng.choice(ladder[2:-2])
+    initial = rng.choice(ladder)
+
+    def cost(v):
+        import math
+
+        gradient = abs(math.log2(v) - math.log2(optimum))
+        noise = 1.0 + 0.02 * rng.uniform(-1.0, 1.0)  # < hysteresis margin
+        return (1.0 + gradient) * noise
+
+    c = Controller(ladder, initial=initial, ring_size=3, hysteresis=0.1)
+    history = []
+    for _ in range(600):
+        history.append(c.add_measurement_and_predict(cost(c.current)))
+    lo, hi = ladder[0], ladder[-1]
+    assert all(lo <= v <= hi for v in history), "left the clamps"
+    idx = ladder.index
+    settled = history[-90:]
+    assert all(abs(idx(v) - idx(optimum)) <= 1 for v in settled), (
+        f"did not settle near optimum {optimum}: {sorted(set(settled))}"
+    )
+    # no oscillation past hysteresis: once settled the rung stops changing
+    moves_in_tail = sum(1 for a, b in zip(settled, settled[1:]) if a != b)
+    assert moves_in_tail <= 2, f"still oscillating: {moves_in_tail} moves"
+
+
+def test_controller_cooldown_defers_movement():
+    now = [0.0]
+    c = Controller([1, 2, 4], initial=1, ring_size=2, cooldown_s=10.0,
+                   time_fn=lambda: now[0])
+    c.add_measurement_and_predict(5.0)
+    now[0] = 100.0
+    assert c.add_measurement_and_predict(5.0) == 2  # first decision explores
+    # rings completing INSIDE the cooldown window record totals but hold
+    for _ in range(6):
+        c.add_measurement_and_predict(1.0)
+    assert c.current == 2
+    now[0] = 200.0
+    c.add_measurement_and_predict(1.0)
+    c.add_measurement_and_predict(1.0)
+    assert c.current == 4  # window elapsed: exploration resumes
+    assert all(v in (1, 2, 4) for v in [c.current])
+
+
+# ---------------------------------------------------------------------------
+# Registry read API
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_snapshot_percentile_and_delta():
+    mreg.enable()
+    try:
+        h = mreg.REGISTRY.histogram("tune_controller_seconds")
+        h.clear()
+        for _ in range(90):
+            h.observe(0.012)
+        snap1 = h.read()
+        for _ in range(10):
+            h.observe(0.2)
+        snap2 = h.read()
+        assert snap2.count == 100 and snap1.count == 90
+        p50 = snap2.percentile(0.5)
+        assert 0.008 <= p50 <= 0.016
+        assert snap2.percentile(0.5) == quantile_from_buckets(
+            snap2.bounds, snap2.counts, 0.5
+        )
+        delta = snap2.delta(snap1)
+        assert delta.count == 10 and delta.percentile(0.5) >= 0.1
+        assert h.percentile(0.99) >= 0.1
+        assert read_histogram("definitely_not_registered").count == 0
+        assert read_counter_total("definitely_not_registered") == 0.0
+    finally:
+        mreg.disable()
+
+
+def test_histogram_read_never_blocks_on_writer_lock():
+    """The lock-light contract: read() succeeds while a writer HOLDS the
+    per-series lock (a plain dump() would deadlock here)."""
+    mreg.enable()
+    try:
+        h = mreg.REGISTRY.histogram("tune_controller_seconds")
+        h.clear()
+        h.observe(0.01)
+        series = next(iter(h._series.values()))
+        acquired = series._lock.acquire()
+        try:
+            done = []
+
+            def reader():
+                done.append(h.read().count)
+
+            t = threading.Thread(target=reader, daemon=True)
+            t.start()
+            t.join(timeout=2.0)
+            assert done and done[0] == 1, "read() blocked on the writer lock"
+        finally:
+            if acquired:
+                series._lock.release()
+    finally:
+        mreg.disable()
+
+
+# ---------------------------------------------------------------------------
+# autotune=0: the static request pattern, op-for-op
+# ---------------------------------------------------------------------------
+
+
+def _write_and_scan(tmp_path, tag, dispatcher=None, **cfg_kwargs):
+    """Full write→commit→scan through the real machinery with every store op
+    recorded; single-threaded scan so the op ORDER is deterministic."""
+    if dispatcher is not None:
+        cfg, d = dispatcher.config, dispatcher
+    else:
+        cfg = ShuffleConfig(
+            root_dir=f"file://{tmp_path}/{tag}", app_id=tag,
+            max_concurrency_task=1, **cfg_kwargs,
+        )
+        d = Dispatcher(cfg)
+    helper = ShuffleHelper(d)
+    rng = random.Random(5)
+    truth = {}
+    for m in range(2):
+        w = MapOutputWriter(d, helper, 0, m, 6)
+        for p in range(6):
+            data = rng.randbytes(2048)
+            truth[(m, p)] = data
+            pw = w.get_partition_writer(p)
+            pw.write(data)
+            pw.close()
+        w.commit_all_partitions()
+    rec = RecordingBackend(d.backend)
+    d.backend = rec
+    d.clear_status_cache()
+    blocks = [ShuffleBlockId(0, m, p) for m in range(2) for p in range(0, 6, 2)]
+    run_cfg = tuned_scan_config(d, cfg)
+    it = build_scan_iterator(
+        d, ScanIndexMemo(helper), blocks, run_cfg,
+        fetcher=ChunkedRangeFetcher.from_config(run_cfg),
+        tuner_consulted=run_cfg is not cfg,
+    )
+    got = {}
+    for s in it:
+        got[(s.block.map_id, s.block.reduce_id)] = s.readall()
+        s.close()
+    assert got == {(m, p): truth[(m, p)] for m in range(2) for p in range(0, 6, 2)}
+    return d, list(rec.ops)
+
+
+def _strip_root(ops):
+    """Root-independent op MULTISET (sorted): the planner's bulk index
+    prefetch fans out on a pool even in the static baseline, so op ORDER
+    varies with thread scheduling run to run — the billed request pattern
+    (which ops, against which objects, how many times) is the invariant."""
+    return sorted((op, path.rsplit("/", 2)[-1]) for op, path in ops)
+
+
+def test_autotune_off_is_the_static_pattern_op_for_op(tmp_path):
+    d0, ops_a = _write_and_scan(tmp_path, "off-a")
+    assert d0.scan_tuner is None and d0.commit_tuner is None
+    assert tuned_scan_config(d0, d0.config) is d0.config  # identity, no copy
+    Dispatcher.reset()
+    _d1, ops_b = _write_and_scan(tmp_path, "off-b")
+    assert _strip_root(ops_a) == _strip_root(ops_b)  # deterministic baseline
+
+
+def test_pinned_tuner_reproduces_the_static_pattern_op_for_op(tmp_path):
+    """autotune=1 with controllers pinned to their static rung (zero allowed
+    movement) must issue the byte-for-byte same op sequence as autotune=0 —
+    the consult/feed wiring itself is op-transparent."""
+    _d0, ops_off = _write_and_scan(tmp_path, "pin-off")
+    Dispatcher.reset()
+
+    # Build the tuned dispatcher FIRST and pin every controller to its seed
+    # rung (the static config value) before any work runs.
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/pin-on", app_id="pin-on",
+        max_concurrency_task=1, autotune=True,
+    )
+    d = Dispatcher(cfg)
+    for tuner in (d.scan_tuner, d.commit_tuner):
+        for knob in tuner._knobs:
+            knob.controller.ladder = [knob.controller.current]
+            knob.controller._i = 0
+    # sanity: pinned rungs == the static config values (the consult is live)
+    assert d.scan_tuner.tuned(cfg).fetch_chunk_size == cfg.fetch_chunk_size
+    assert d.scan_tuner.tuned(cfg).coalesce_gap_bytes == cfg.coalesce_gap_bytes
+    _d, ops_on = _write_and_scan(tmp_path, "pin-on", dispatcher=d)
+    assert _strip_root(ops_off) == _strip_root(ops_on)
+    # the tuner WAS consulted and fed (this is the wired path, not a bypass)
+    assert sum(len(k.controller._ring) + len(k.controller._totals)
+               for k in d.scan_tuner._knobs) > 0
+
+
+def test_tuned_scan_stays_inside_clamps_and_emits_metrics(tmp_path):
+    mreg.enable()
+    try:
+        cfg = ShuffleConfig(
+            root_dir=f"file://{tmp_path}/clamp", app_id="clamp",
+            autotune=True, autotune_interval_s=0.0,
+        )
+        d = Dispatcher(cfg)
+        tuner = d.scan_tuner
+        # hammer the tuner with adversarial costs: knobs must never leave
+        # their ladders (= the clamp table)
+        rng = random.Random(3)
+        for _ in range(400):
+            tuner.observe_scan(rng.uniform(0.0, 2.0), rng.randrange(1, 1 << 24))
+        for knob in tuner._knobs:
+            # the ShuffleConfig defaults sit inside every clamp pair, so the
+            # ladder ends ARE the clamp table here — except the prefetch
+            # budget, whose ceiling is the OPERATOR'S static value (a memory
+            # cap the tuner may only tune down from)
+            lo, hi = ScanTuner.CLAMPS[knob.field]
+            if knob.field == "max_buffer_size_task":
+                hi = cfg.max_buffer_size_task
+            assert (knob.controller.lo, knob.controller.hi) == (lo, hi)
+            assert lo <= knob.controller.current <= hi, knob.field
+        assert read_counter_total("tune_decisions_total") > 0
+        snap = mreg.REGISTRY.snapshot(compact=True)
+        assert "tune_knob_value" in snap
+        assert read_histogram("tune_controller_seconds").count > 0
+    finally:
+        mreg.disable()
+
+
+# ---------------------------------------------------------------------------
+# CommitTuner consults
+# ---------------------------------------------------------------------------
+
+
+def test_commit_tuner_consults_and_disabled_planes_stay_disabled():
+    cfg = ShuffleConfig(
+        root_dir="memory://at-commit", app_id="atc",
+        autotune=True, composite_commit_maps=16, upload_queue_bytes=0,
+    )
+    tuner = CommitTuner(cfg)
+    # upload queue disabled by the operator: the tuner must not re-enable it
+    assert tuner.upload_queue_bytes(0) == 0
+    members, flush = tuner.seal_thresholds(16, cfg.composite_flush_bytes)
+    assert members == 16 and flush == cfg.composite_flush_bytes  # seed = static
+    lo, hi = CommitTuner.CLAMPS["composite_commit_maps"]
+    for _ in range(200):
+        tuner.observe_commit(0.01, 1 << 20)
+        members, flush = tuner.seal_thresholds(16, cfg.composite_flush_bytes)
+        assert lo <= members <= max(hi, 16)
+    # composite plane off: thresholds pass through untouched
+    assert tuner.seal_thresholds(0, 123) == (0, 123)
+    assert tuner.seal_thresholds(1, 456) == (1, 456)
+
+
+def test_commit_tuner_retunes_bound_codec_window():
+    cfg = ShuffleConfig(
+        root_dir="memory://at-codec", app_id="atd",
+        autotune=True, encode_inflight_batches=2,
+        upload_queue_bytes=0, composite_commit_maps=0,
+    )
+    tuner = CommitTuner(cfg)
+
+    class FakeCodec:
+        encode_inflight_batches = 2
+
+    codec = FakeCodec()
+    tuner.bind_codec(codec)
+    assert codec.encode_inflight_batches == 2  # seed = static
+    # the window knob is the only knob -> every decision lands on it
+    for _ in range(40):
+        tuner.observe_commit(0.01, 1 << 20)
+    lo, hi = CommitTuner.CLAMPS["encode_inflight_batches"]
+    assert lo <= codec.encode_inflight_batches <= hi
+    # an object without the attribute is ignored
+    tuner.bind_codec(object())
+
+
+# ---------------------------------------------------------------------------
+# Shared fetch executor: idle-thread reaping (the grow-only bugfix)
+# ---------------------------------------------------------------------------
+
+
+def test_fetch_executor_reaps_idle_width(monkeypatch):
+    from s3shuffle_tpu.read import chunked_fetch as cf
+
+    # isolate from whatever width earlier tests left behind
+    monkeypatch.setattr(cf, "_executor", None)
+    monkeypatch.setattr(cf, "_executor_width", 0)
+    monkeypatch.setattr(cf, "_executor_wide_use", 0.0)
+
+    cf._submit_fetch(8, lambda: None).result()
+    assert cf._executor_width == 8
+    wide_pool = cf._executor
+    # narrow submits inside the idle window keep the wide pool
+    cf._submit_fetch(2, lambda: None).result()
+    assert cf._executor_width == 8 and cf._executor is wide_pool
+    # age the wide-use stamp past the reap window: the next narrow submit
+    # swaps the pool down (a one-off wide scan no longer pins 8 threads)
+    monkeypatch.setattr(
+        cf, "_executor_wide_use", time.monotonic() - cf._EXECUTOR_REAP_IDLE_S - 1
+    )
+    cf._submit_fetch(2, lambda: None).result()
+    assert cf._executor_width == 2 and cf._executor is not wide_pool
+    # growing again works and refreshes the stamp
+    cf._submit_fetch(4, lambda: None).result()
+    assert cf._executor_width == 4
+    assert time.monotonic() - cf._executor_wide_use < 5.0

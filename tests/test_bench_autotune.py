@@ -1,0 +1,67 @@
+"""Tier-1 wiring for the autotuner scenario matrix: one scenario must run
+through the REAL tuner-consulted machinery, stay byte-identical, beat the
+worst static configuration, and carry the knob/clamp records that make BENCH
+rounds comparable. The FULL matrix (every scenario held to the ≤ 1.1×
+best-static acceptance bar) runs under the ``slow`` marker."""
+
+import pytest
+
+import bench
+
+
+def test_autotune_smoke_scenario_wins_and_is_byte_identical():
+    out = bench.autotune_matrix(scenarios=("s3",), rounds=4, warmup=1)
+    rec = out["autotune"]["s3"]
+    assert "error" not in rec, rec
+    assert rec["byte_identical"], rec
+    # the worst static config (per-range GETs at 20 ms RTT) must lose to the
+    # tuned run decisively — latency-dominated, so robust on a loaded rig
+    assert rec["tuned_wall_s"] < rec["worst_static_wall_s"], rec
+    # the acceptance bar is 1.1x on the full slow matrix; the fast smoke
+    # asserts direction with CI-noise headroom
+    assert rec["tuned_vs_best"] <= 1.5, rec
+    for field in (
+        "static_wall_s", "tuned_total_wall_s", "best_static", "worst_static",
+        "tuned_vs_worst", "autotune_gain", "mode", "rounds", "warmup",
+    ):
+        assert field in rec, field
+    assert out["autotune_gain"] > 1.0, out
+
+
+@pytest.mark.slow
+def test_autotune_full_matrix_meets_acceptance_bar():
+    """The ISSUE-9 acceptance criterion, verbatim: tuned wall ≤ 1.1× the
+    best static configuration on EVERY scenario, strictly better than the
+    worst static configuration on ≥ 3 scenarios, byte-identical output.
+
+    Perf-gate flake shield: a scenario that misses the 1.1× bar is
+    re-evaluated ONCE (fresh cells, fresh tuner) before failing — wall-clock
+    ratios on a shared rig carry scheduler noise the paired-round estimator
+    cannot fully cancel. Byte identity and the ≥3-scenarios-beat-worst
+    criteria get no retry."""
+    out = bench.autotune_matrix()
+    beats_worst = 0
+    for name, rec in out["autotune"].items():
+        assert "error" not in rec, (name, rec)
+        assert rec["byte_identical"], (name, rec)
+        if rec["tuned_vs_best"] > 1.1:
+            retry = bench.autotune_matrix(scenarios=(name,))["autotune"][name]
+            assert retry["byte_identical"], (name, retry)
+            assert retry["tuned_vs_best"] <= 1.1, (name, rec, retry)
+            rec = retry
+        if rec["tuned_vs_worst"] < 1.0:
+            beats_worst += 1
+    assert beats_worst >= 3, out
+
+
+def test_bench_json_records_autotune_knobs():
+    out = bench.autotune_knobs()
+    from s3shuffle_tpu.config import ShuffleConfig
+    from s3shuffle_tpu.tuning.tuners import CommitTuner, ScanTuner
+
+    cfg = ShuffleConfig()
+    plane = out["autotune_plane"]
+    assert plane["autotune"] == cfg.autotune
+    assert plane["autotune_interval_s"] == cfg.autotune_interval_s
+    assert set(plane["scan_clamps"]) == set(ScanTuner.CLAMPS)
+    assert set(plane["commit_clamps"]) == set(CommitTuner.CLAMPS)

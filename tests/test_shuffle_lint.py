@@ -418,13 +418,18 @@ def test_every_declared_metric_is_registered_somewhere():
 
 def test_model_parses_real_declarations():
     model = ProjectModel.load(REPO_ROOT)
-    # knobs that shipped across PRs 1-3 — drift here means CFG01 is blind
+    # knobs that shipped across PRs 1-3 (and the PR-9 autotuner switch) —
+    # drift here means CFG01 is blind
     for knob in ("fetch_chunk_size", "upload_queue_bytes", "storage_retries",
-                 "buffer_size", "root_dir"):
+                 "buffer_size", "root_dir", "autotune", "autotune_interval_s"):
         assert knob in model.config_fields, knob
     assert "log_values" in model.config_methods
     from s3shuffle_tpu.metrics.names import KNOWN_METRICS
 
+    # the PR-9 tuning instruments ride the same single source of truth
+    for name in ("tune_decisions_total", "tune_knob_value",
+                 "tune_controller_seconds"):
+        assert name in KNOWN_METRICS, name
     assert model.metric_names == {k: v[0] for k, v in KNOWN_METRICS.items()}
 
 

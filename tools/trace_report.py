@@ -66,30 +66,35 @@ def _exact_quantile(sorted_values: List[float], q: float) -> float:
     return sorted_values[idx]
 
 
+def _import_quantile():
+    """The bucket math lives in ONE place — the metric registry's
+    snapshot/percentile API (the tuning controllers read the same function).
+    Direct-script invocation (``python tools/trace_report.py``) has tools/ as
+    sys.path[0], so bootstrap the repo root like ``-m`` would."""
+    try:
+        from s3shuffle_tpu.metrics.registry import quantile_from_buckets
+    except ModuleNotFoundError:
+        import os
+        import sys
+
+        sys.path.insert(
+            0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        from s3shuffle_tpu.metrics.registry import quantile_from_buckets
+    return quantile_from_buckets
+
+
+quantile_from_buckets = _import_quantile()
+
+
 def histogram_quantile(
     bounds: Sequence[float], counts: Sequence[int], q: float
 ) -> float:
-    """Estimate the q-quantile from per-bin counts (``counts`` has one more
-    entry than ``bounds`` — the +Inf overflow bin). Linear interpolation
-    within the winning bin; overflow answers the last finite bound (a lower
-    bound on the true value)."""
-    total = sum(counts)
-    if total == 0:
-        return 0.0
-    target = q * total
-    cum = 0.0
-    for i, n in enumerate(counts):
-        if n == 0:
-            continue
-        if cum + n >= target:
-            if i >= len(bounds):  # overflow bin
-                return float(bounds[-1])
-            lo = float(bounds[i - 1]) if i > 0 else 0.0
-            hi = float(bounds[i])
-            frac = (target - cum) / n
-            return lo + (hi - lo) * min(1.0, max(0.0, frac))
-        cum += n
-    return float(bounds[-1]) if bounds else 0.0
+    """Historical CLI-local name; delegates to
+    :func:`s3shuffle_tpu.metrics.registry.quantile_from_buckets` (linear
+    interpolation within the winning bin; the overflow bin answers the last
+    finite bound, a lower bound on the true value)."""
+    return quantile_from_buckets(bounds, counts, q)
 
 
 # ---------------------------------------------------------------------------
@@ -270,6 +275,38 @@ def _codec_line(snapshot: dict) -> Optional[str]:
     return line
 
 
+def _tuning_line(snapshot: dict) -> Optional[str]:
+    """One-line autotuner digest: controller decisions by outcome, the live
+    rung of every tuned knob, and the closed loop's own overhead."""
+    decisions = _counter_total(snapshot, "tune_decisions_total")
+    if decisions <= 0:
+        return None
+    by_dir: Dict[str, float] = {}
+    for s in snapshot.get("tune_decisions_total", {}).get("series", []):
+        d = s.get("labels", {}).get("direction", "?")
+        by_dir[d] = by_dir.get(d, 0.0) + float(s.get("value", 0))
+    line = f"Tuning: {decisions:g} controller decisions"
+    if by_dir:
+        line += (
+            " ("
+            + ", ".join(f"{v:g} {d}" for d, v in sorted(by_dir.items()))
+            + ")"
+        )
+    knobs = {
+        s.get("labels", {}).get("knob", "?"): float(s.get("value", 0))
+        for s in snapshot.get("tune_knob_value", {}).get("series", [])
+    }
+    if knobs:
+        line += "; knobs " + ", ".join(
+            f"{k}={v:g}" for k, v in sorted(knobs.items())
+        )
+    ctrl = snapshot.get("tune_controller_seconds", {}).get("series", [])
+    secs = sum(float(s.get("sum", 0.0)) for s in ctrl)
+    if secs > 0:
+        line += f"; controller overhead {_fmt_seconds(secs)}"
+    return line
+
+
 def render_metrics_snapshot(
     snapshot: dict, top: int = 10, reduce_tasks: Optional[int] = None
 ) -> str:
@@ -330,6 +367,7 @@ def render_metrics_snapshot(
         _scan_planner_line(snapshot),
         _write_plane_line(snapshot),
         _codec_line(snapshot),
+        _tuning_line(snapshot),
         _control_plane_line(snapshot, reduce_tasks=reduce_tasks),
     ):
         if line:
@@ -448,10 +486,12 @@ def _synthetic_snapshot() -> dict:
     buckets[8] = 10
     _SAMPLE_LABELS = {"scheme": "file", "op": "read", "direction": "up",
                       "codec": "native", "method": "register_map_outputs",
-                      "shard": "0", "source": "snapshot", "reason": "orphan"}
+                      "shard": "0", "source": "snapshot", "reason": "orphan",
+                      "knob": "fetch_parallelism"}
     _ALT_LABELS = {"scheme": "s3", "op": "open", "direction": "down",
                    "codec": "zlib", "method": "get_map_sizes_by_ranges",
-                   "shard": "1", "source": "rpc", "reason": "generation"}
+                   "shard": "1", "source": "rpc", "reason": "generation",
+                   "knob": "upload_queue_bytes"}
     snapshot: Dict[str, dict] = {}
     for name, (kind, labelnames) in sorted(KNOWN_METRICS.items()):
         series_list = []
@@ -543,6 +583,17 @@ def _selftest() -> int:
         "7 encode batches in flight",
     ):
         assert needle in text, f"codec line missing {needle!r}:\n{text}"
+    # the tuning digest renders from the synthetic tune_* series (two
+    # decision series of 7 → 14 decisions split 7 up / 7 down; two knob
+    # gauges at 7; the controller-seconds histogram sums to 3.08s)
+    for needle in (
+        "Tuning: 14 controller decisions",
+        "7 down, 7 up",
+        "fetch_parallelism=7",
+        "upload_queue_bytes=7",
+        "controller overhead 3.08s",
+    ):
+        assert needle in text, f"tuning line missing {needle!r}:\n{text}"
     # the control-plane digest: two meta_rpc_total series of 7 → 14 RPCs over
     # 4 reduce tasks; lookup sources 7 snapshot + 7 rpc → 50% hit ratio
     for needle in (
