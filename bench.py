@@ -1445,6 +1445,142 @@ def coalesced_read_gain(
     }
 
 
+def coded_read_gain(
+    n_maps: int = 4,
+    n_parts: int = 4,
+    part_bytes: int = 8 * 1024,
+    delay_s: float = 0.25,
+):
+    """Coding-plane probe (reduce side): with an injected straggler on
+    1-of-n segment objects, does speculative parity reconstruction beat
+    waiting the straggler out? Both modes drive the SAME committed, coded
+    (k=1/m=1 mirrored-parity) outputs through the same scan machinery;
+    only ``speculative_read_quantile`` differs (0 = wait, the uncoded
+    behavior). The speculation threshold comes from the live
+    ``read_prefetch_fill_seconds`` histogram, primed by clean warm scans
+    exactly as a steady-state reduce fleet would have primed it. Byte
+    identity is asserted in BOTH modes — the straggler run must produce
+    the same bytes whether reconstructed or waited for."""
+    from s3shuffle_tpu.block_ids import ShuffleBlockId
+    from s3shuffle_tpu.config import ShuffleConfig
+    from s3shuffle_tpu.metadata.helper import ScanIndexMemo, ShuffleHelper
+    from s3shuffle_tpu.metrics import registry as mreg
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+    from s3shuffle_tpu.storage.fault import FlakyBackend, LatencyRule
+    from s3shuffle_tpu.write.map_output_writer import MapOutputWriter
+
+    metrics_were_on = mreg.enabled()
+    try:
+        Dispatcher.reset()
+        mreg.enable()
+        cfg = ShuffleConfig(
+            root_dir="memory://bench-coded", app_id="bench-coded",
+            parity_segments=1, parity_stripe_k=1, parity_chunk_bytes=4096,
+        )
+        d = Dispatcher(cfg)
+        helper = ShuffleHelper(d)
+        rng = random.Random(31)
+        truth = {}
+        for m in range(n_maps):
+            w = MapOutputWriter(d, helper, 0, m, n_parts)
+            for p in range(n_parts):
+                data = rng.randbytes(part_bytes)
+                truth[(m, p)] = data
+                pw = w.get_partition_writer(p)
+                pw.write(data)
+                pw.close()
+            w.commit_all_partitions()
+        blocks = [
+            ShuffleBlockId(0, m, p) for m in range(n_maps) for p in range(n_parts)
+        ]
+        straggler = f"shuffle_0_{n_maps - 1}_0.data"
+
+        def scan(run_cfg):
+            from s3shuffle_tpu.read.chunked_fetch import ChunkedRangeFetcher
+            from s3shuffle_tpu.read.scan_plan import build_scan_iterator
+
+            it = build_scan_iterator(
+                d, ScanIndexMemo(helper), blocks, run_cfg,
+                fetcher=ChunkedRangeFetcher.from_config(run_cfg),
+            )
+            got = {}
+            for s in it:
+                got[(s.block.map_id, s.block.reduce_id)] = s.readall()
+                s.close()
+            return got
+
+        def run(quantile: float):
+            run_cfg = ShuffleConfig(
+                root_dir="memory://bench-coded", app_id="bench-coded",
+                parity_segments=1, parity_stripe_k=1, parity_chunk_bytes=4096,
+                speculative_read_quantile=quantile,
+            )
+            # warm scans prime the fill histogram (the threshold source)
+            for _ in range(2):
+                assert scan(run_cfg) == truth, "warm scan corrupted data"
+            flaky = FlakyBackend(d.backend)
+            rule = flaky.add_latency(
+                LatencyRule("read", match=straggler, delay_s=delay_s)
+            )
+            saved, d.backend = d.backend, flaky
+            try:
+                d.clear_status_cache()
+                t0 = time.perf_counter()
+                got = scan(run_cfg)
+                wall = time.perf_counter() - t0
+            finally:
+                # the abandoned straggler GET may still be in flight on the
+                # speculation pool; let it drain before unhooking the rule
+                time.sleep(delay_s * 1.2)
+                d.backend = saved
+            assert got == truth, "straggler scan corrupted data"
+            return wall, rule.hits
+
+        uncoded_wall, _hits = run(0.0)
+        coded_wall, _hits2 = run(0.9)
+        snap = mreg.REGISTRY.snapshot(compact=True)
+        recon = sum(
+            s["value"]
+            for s in snap.get("shuffle_parity_reconstructions_total", {}).get(
+                "series", []
+            )
+            if s.get("labels", {}).get("reason") == "straggler"
+        )
+    except Exception as e:  # never fail the bench over this row
+        return {"coded_read_error": str(e)[:120]}
+    finally:
+        if not metrics_were_on:
+            mreg.disable()
+            mreg.REGISTRY.reset_values()
+        Dispatcher.reset()
+    return {
+        "coded_read_gain": round(uncoded_wall / coded_wall, 2),
+        "coded_read_uncoded_wall_s": round(uncoded_wall, 3),
+        "coded_read_wall_s": round(coded_wall, 3),
+        "coded_read_reconstructions": int(recon),
+        "coded_read_straggler_ms": delay_s * 1e3,
+        "coded_read_blocks": len(blocks),
+        "coded_read_part_bytes": part_bytes,
+    }
+
+
+def coded_plane_knobs():
+    """The coding-plane knobs the headline runs used (ShuffleConfig
+    defaults) — recorded so BENCH rounds stay comparable when a default
+    moves."""
+    from s3shuffle_tpu.config import ShuffleConfig
+
+    cfg = ShuffleConfig()
+    return {
+        "coded_plane": {
+            "parity_segments": cfg.parity_segments,
+            "parity_stripe_k": cfg.parity_stripe_k,
+            "parity_chunk_bytes": cfg.parity_chunk_bytes,
+            "speculative_read_quantile": cfg.speculative_read_quantile,
+        }
+    }
+
+
 def scan_planner_knobs():
     """The scan-planner knobs the headline runs used (ShuffleConfig defaults)
     — recorded so BENCH rounds stay comparable when a default moves."""
@@ -2073,11 +2209,13 @@ def main():
         **pipelined_commit_gain(),
         **coalesced_read_gain(),
         **composite_write_gain(),
+        **coded_read_gain(),
         **device_codec_gain(),
         **autotune_gain(),
         **tracker_scaling(),
         **transfer_plane_knobs(),
         **scan_planner_knobs(),
+        **coded_plane_knobs(),
         **composite_plane_knobs(),
         **device_codec_knobs(),
         **autotune_knobs(),

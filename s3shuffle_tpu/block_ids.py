@@ -164,6 +164,44 @@ class ShuffleFatIndexBlockId(BlockId):
 
 
 @dataclasses.dataclass(frozen=True)
+class ShuffleParityBlockId(BlockId):
+    """One parity sidecar of a per-map data object (coding/parity.py):
+    segment ``seg`` of the k-of-n stripe set over
+    ``shuffle_<sid>_<mid>_0.data``. Shares the data object's ``map_id`` so
+    prefix sharding colocates parity with its data, and parses back to
+    ``(shuffle_id, map_id)`` through ``parse_shuffle_object_name`` so the
+    lifecycle sweeps treat it exactly like the data/checksum sidecars:
+    committed by the index, orphaned without one."""
+
+    shuffle_id: int
+    map_id: int
+    seg: int
+
+    @property
+    def name(self) -> str:
+        return f"shuffle_{self.shuffle_id}_{self.map_id}_par{self.seg}.parity"
+
+
+@dataclasses.dataclass(frozen=True)
+class ShuffleCompositeParityBlockId(BlockId):
+    """One parity sidecar of a composite data object — same contract as
+    :class:`ShuffleParityBlockId` but committed by the group's fat index
+    (the composite sweep classifies it with its group)."""
+
+    shuffle_id: int
+    group_id: int
+    seg: int
+
+    @property
+    def map_id(self) -> int:  # prefix sharding key (Dispatcher.get_path)
+        return self.group_id
+
+    @property
+    def name(self) -> str:
+        return f"shuffle_{self.shuffle_id}_comp_{self.group_id}_par{self.seg}.parity"
+
+
+@dataclasses.dataclass(frozen=True)
 class ShuffleTombstoneBlockId(BlockId):
     """Generation tombstone: a small JSON object naming store objects that
     were superseded (e.g. singletons rewritten into a composite by the
@@ -186,8 +224,12 @@ class ShuffleTombstoneBlockId(BlockId):
 
 
 _INDEX_RE = re.compile(r"^shuffle_(\d+)_(\d+)_(\d+)\.index$")
-_ANY_RE = re.compile(r"^shuffle_(\d+)_(\d+)_(\d+)\.(data|index|checksum\..+)$")
-_COMPOSITE_RE = re.compile(r"^shuffle_(\d+)_comp_(\d+)\.(data|cindex)$")
+_ANY_RE = re.compile(
+    r"^shuffle_(\d+)_(\d+)_(?:(\d+)\.(?:data|index|checksum\..+)|par\d+\.parity)$"
+)
+_COMPOSITE_RE = re.compile(
+    r"^shuffle_(\d+)_comp_(\d+)(?:\.(data|cindex)|_par\d+\.(parity))$"
+)
 _TOMBSTONE_RE = re.compile(r"^shuffle_(\d+)_gen_(\d+)\.tomb$")
 
 
@@ -211,13 +253,13 @@ def parse_index_name(name: str) -> ShuffleIndexBlockId | None:
 
 
 def parse_composite_name(name: str):
-    """Parse a composite data / fat-index object name back to
-    ``(shuffle_id, group_id, kind)`` where kind is ``"data"`` or
-    ``"cindex"``, or None for anything else."""
+    """Parse a composite data / fat-index / parity object name back to
+    ``(shuffle_id, group_id, kind)`` where kind is ``"data"``, ``"cindex"``
+    or ``"parity"``, or None for anything else."""
     m = _COMPOSITE_RE.match(name.rsplit("/", 1)[-1])
     if m is None:
         return None
-    return int(m.group(1)), int(m.group(2)), m.group(3)
+    return int(m.group(1)), int(m.group(2)), m.group(3) or m.group(4)
 
 
 def parse_tombstone_name(name: str):

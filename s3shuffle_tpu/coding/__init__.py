@@ -1,0 +1,23 @@
+"""Coded shuffle plane: k-of-n parity objects, degraded reads, speculation.
+
+- :mod:`s3shuffle_tpu.coding.gf` — GF(2^8) math: batched XOR/Vandermonde
+  parity encode (device kernel with host fallback) and the stripe-group
+  decoder.
+- :mod:`s3shuffle_tpu.coding.parity` — parity sidecar objects: geometry,
+  wire format, the streaming write-path accumulator, and the commit/abort
+  helpers.
+- :mod:`s3shuffle_tpu.coding.degraded` — the read-side protocol: loss
+  reconstruction (terminal ``FileNotFoundError`` → rebuild from parity
+  before falling back) and straggler-triggered speculative parity reads.
+"""
+
+from s3shuffle_tpu.coding.parity import (  # noqa: F401
+    ParityAccumulator,
+    ParityGeometry,
+    accumulator_from_config,
+    parity_blocks_for,
+)
+from s3shuffle_tpu.coding.degraded import (  # noqa: F401
+    DegradedReader,
+    SpeculativeFetcher,
+)

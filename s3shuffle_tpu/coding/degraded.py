@@ -1,0 +1,529 @@
+"""Degraded reads: loss reconstruction and straggler-triggered speculation.
+
+Read-side half of the coded shuffle plane. Two triggers, one reconstruction
+engine:
+
+- **Loss** (``reason="loss"``): a data-object GET dies with a terminal
+  ``FileNotFoundError``. :class:`BlockStream` asks :meth:`DegradedReader.
+  reconstruct` for the missing byte range BEFORE falling back to today's
+  logged-EOF → ChecksumError path. Reconstruction is unconditional — if the
+  survivors suffice the scan completes byte-identically (validated by the
+  untouched per-block checksums); if not, behavior is exactly the
+  pre-coding plane's.
+- **Straggler** (``reason="straggler"``): a segment prefill outlives a
+  p99-derived latency threshold (the PR-1 metrics registry's
+  ``read_prefetch_fill_seconds`` histogram through the PR-9 percentile
+  API). :class:`SpeculativeFetcher` races the in-flight GET against parity
+  reconstruction and hands the prefetcher whichever finishes first — the
+  Coded-TeraSort move: reduce proceeds at the speed of the fastest k
+  responses instead of the slowest GET.
+
+Reconstruction per stripe group: read the group's parity slices (ranged
+GETs against the parity sidecars — different objects from the straggler),
+solve parity-only when ``m >= k``; otherwise fill in with sibling data
+chunks from the data object when it is still readable. Sources that fail
+just shrink the survivor set — insufficient survivors return None and the
+caller falls back.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from s3shuffle_tpu.coding import gf
+from s3shuffle_tpu.coding.parity import (
+    HEADER_BYTES,
+    ParityGeometry,
+    parity_blocks_for,
+    parse_parity_header,
+)
+from s3shuffle_tpu.metrics import registry as _metrics
+from s3shuffle_tpu.utils.growpool import GrowReapExecutor
+
+logger = logging.getLogger("s3shuffle_tpu.coding")
+
+_C_SPECULATIVE = _metrics.REGISTRY.counter(
+    "shuffle_parity_speculative_reads_total",
+    "Prefills whose latency crossed the speculation threshold and raced a "
+    "parity reconstruction",
+)
+_C_RECONSTRUCT = _metrics.REGISTRY.counter(
+    "shuffle_parity_reconstructions_total",
+    "Byte ranges served by parity reconstruction instead of the data object",
+    labelnames=("reason",),
+)
+
+#: histogram samples required before a speculation threshold is trusted —
+#: below this the p-quantile of read_prefetch_fill_seconds is noise
+MIN_FILL_SAMPLES = 8
+
+# ---------------------------------------------------------------------------
+# Shared speculation executor — the grow/reap lifecycle from
+# utils/growpool.py, but a SEPARATE pool from the ranged-GET one:
+# speculated primaries block on store GETs, and parking them on the
+# chunked-fetch pool could starve the chunked sub-reads those primaries fan
+# out (both waiting on pool slots = deadlock).
+# ---------------------------------------------------------------------------
+
+_POOL = GrowReapExecutor("s3shuffle-speculate")
+_inflight_lock = threading.Lock()
+_inflight = 0
+
+
+def _submit_speculative(width: int, fn, *args):
+    """Submit sized to AGGREGATE demand: the grow/reap pool widens to the
+    largest width any caller asks for, so requesting max(own width,
+    current in-flight count) keeps N concurrent scans' primaries from
+    serializing behind one scan's width (each prefetch thread ran its own
+    GET with zero queueing before speculation existed — the race must not
+    cost that parallelism)."""
+    global _inflight
+    with _inflight_lock:
+        _inflight += 1
+        want = max(width, _inflight)
+
+    def tracked():
+        global _inflight
+        try:
+            return fn(*args)
+        finally:
+            with _inflight_lock:
+                _inflight -= 1
+
+    return _POOL.submit(want, tracked)
+
+
+# ---------------------------------------------------------------------------
+# Reconstruction engine
+# ---------------------------------------------------------------------------
+
+
+class DegradedReader:
+    """Per-scan reconstruction engine over the scan's resolved geometry.
+
+    Geometry is registered from already-resolved :class:`MapLocation`s (the
+    scan memo makes that free — no extra store ops), keyed by the data
+    object. An empty reader is inert: ``has`` is False everywhere, every
+    reconstruct returns None, and the scan's store request pattern is
+    untouched — the ``parity_segments = 0`` op-for-op contract."""
+
+    def __init__(self, dispatcher):
+        self.dispatcher = dispatcher
+        self._lock = threading.Lock()
+        self._geoms: Dict[str, tuple] = {}  # data path -> (data_block, geometry)
+
+    def register(self, data_block, geometry: Optional[ParityGeometry]) -> None:
+        if geometry is None or geometry.segments <= 0:
+            return
+        with self._lock:
+            self._geoms[data_block.name] = (data_block, geometry)
+
+    def note(self, helper, shuffle_id: int, map_id: int) -> None:
+        """Register one map output's geometry through the (memoized) scan
+        helper — free when the scan already resolved the location."""
+        try:
+            loc = helper.resolve_map_location(shuffle_id, map_id)
+        except (OSError, ValueError):
+            return
+        self.register(loc.data_block, loc.parity)
+
+    def has(self, data_block) -> bool:
+        name = getattr(data_block, "name", None)
+        if name is None:
+            return False
+        with self._lock:
+            return name in self._geoms
+
+    def speculation_viable(self, data_block) -> bool:
+        """Can a FULL-range reconstruction of this object possibly succeed
+        from parity alone? A speculated prefill covers the whole stream
+        range, so every touched stripe group needs all its real chunks
+        solved parity-only — possible iff the parity count covers the
+        group's real-chunk count (m >= k for full groups; a short tail-only
+        object needs just its real chunks). Arming races that can never be
+        won would add pure latency and store ops (sibling reads target the
+        very object that is being slow), so ineligible objects keep the
+        plain prefill; LOSS reconstruction is not gated — it is attempted
+        unconditionally, as the last resort it is."""
+        name = getattr(data_block, "name", None)
+        if name is None:
+            return False
+        with self._lock:
+            entry = self._geoms.get(name)
+        if entry is None:
+            return False
+        geom = entry[1]
+        return geom.segments >= min(geom.stripe_k, max(1, geom.n_chunks))
+
+    def __bool__(self) -> bool:
+        with self._lock:
+            return bool(self._geoms)
+
+    # ------------------------------------------------------------------
+    def reconstruct(self, data_block, start: int, end: int, reason: str) -> Optional[bytes]:
+        """Rebuild the byte range ``[start, end)`` of ``data_block`` from
+        parity (+ surviving sibling chunks). None when the object carries no
+        parity or the survivors are insufficient — the caller then falls
+        back to the pre-coding behavior."""
+        with self._lock:
+            entry = self._geoms.get(getattr(data_block, "name", ""))
+        if entry is None:
+            return None
+        block, geom = entry
+        end = min(end, geom.payload_len)
+        if end <= start:
+            return b""
+        try:
+            out = self._reconstruct_range(block, geom, start, end, reason)
+        except Exception:
+            logger.warning(
+                "parity reconstruction of %s [%d,%d) failed", block.name, start, end,
+                exc_info=True,
+            )
+            return None
+        if out is not None:
+            if _metrics.enabled():
+                _C_RECONSTRUCT.labels(reason=reason).inc()
+            logger.warning(
+                "reconstructed %s [%d,%d) from parity (%s)",
+                block.name, start, end, reason,
+            )
+        return out
+
+    def _reconstruct_range(
+        self, block, geom: ParityGeometry, start: int, end: int, reason: str
+    ) -> Optional[bytes]:
+        c0 = start // geom.chunk_bytes
+        c1 = (end - 1) // geom.chunk_bytes
+        coefs = gf.parity_coefficients(geom.segments, geom.stripe_k)
+        parity_readers = _ParityHandles(self.dispatcher, block, geom)
+        parity_readers.prefetch_span(c0 // geom.stripe_k, c1 // geom.stripe_k)
+        data_reader = _DataHandle(self.dispatcher, block, geom)
+        try:
+            chunks: Dict[int, np.ndarray] = {}
+            for group in range(c0 // geom.stripe_k, c1 // geom.stripe_k + 1):
+                member_lo = group * geom.stripe_k
+                member_hi = min(member_lo + geom.stripe_k, geom.n_chunks)
+                want = [
+                    c - member_lo for c in range(max(c0, member_lo), min(c1 + 1, member_hi))
+                ]
+                if not want:
+                    continue
+                plen = geom.group_parity_len(group)
+                parity_present = parity_readers.read_group(group, plen)
+                # the encoder zero-pads a short FINAL group to k chunks —
+                # those phantom positions are KNOWN zero survivors, so a
+                # tail group needs only as many parity slices as it has
+                # real chunks
+                known: Dict[int, np.ndarray] = {
+                    j: np.zeros(plen, dtype=np.uint8)
+                    for j in range(member_hi - member_lo, geom.stripe_k)
+                }
+                # parity(+phantom)-only first (different objects from the
+                # straggler / loss victim); pull sibling data chunks only
+                # when that cannot determine the group
+                recovered = gf.recover_group(
+                    geom.stripe_k, coefs, dict(known), parity_present, want
+                )
+                if recovered is None:
+                    known.update(
+                        data_reader.read_chunks(
+                            group,
+                            [
+                                j
+                                for j in range(member_hi - member_lo)
+                                if j not in want
+                            ],
+                            plen,
+                        )
+                    )
+                    recovered = gf.recover_group(
+                        geom.stripe_k, coefs, known, parity_present, want
+                    )
+                if recovered is None:
+                    logger.warning(
+                        "cannot reconstruct %s stripe group %d: %d parity + %d "
+                        "sibling survivors for %d missing chunk(s)",
+                        block.name, group, len(parity_present),
+                        data_reader.last_count, len(want),
+                    )
+                    return None
+                for pos, data in recovered.items():
+                    chunks[member_lo + pos] = data
+            parts = []
+            for c in range(c0, c1 + 1):
+                lo, hi = geom.chunk_span(c)
+                chunk = chunks[c][: hi - lo]
+                take_lo = max(start, lo) - lo
+                take_hi = min(end, hi) - lo
+                parts.append(bytes(chunk[take_lo:take_hi]))
+            return b"".join(parts)
+        finally:
+            parity_readers.close()
+            data_reader.close()
+
+
+class _ParityHandles:
+    """Lazy ranged readers over one data object's parity sidecars, with the
+    self-describing header cross-checked on first open."""
+
+    def __init__(self, dispatcher, data_block, geom: ParityGeometry):
+        self.dispatcher = dispatcher
+        self.geom = geom
+        self.blocks = parity_blocks_for(data_block, geom.segments)
+        self._readers: Dict[int, object] = {}
+        self._dead: set = set()
+        self._span_bounds: Optional[Tuple[int, int]] = None
+        self._spans: Dict[int, bytes] = {}
+        self._span_failed: set = set()
+
+    def prefetch_span(self, g_lo: int, g_hi: int) -> None:
+        """Arm ONE contiguous ranged GET per parity object covering every
+        group of the reconstruction [g_lo, g_hi] — the touched slices are
+        adjacent in the sidecar, so without this a multi-group recovery
+        pays one store round-trip per (group x segment)."""
+        lo = self.geom.parity_chunk_offset(g_lo)
+        hi = self.geom.parity_chunk_offset(g_hi) + self.geom.group_parity_len(g_hi)
+        if hi > lo:
+            self._span_bounds = (lo, hi)
+
+    def _from_span(self, seg: int, offset: int, plen: int) -> Optional[bytes]:
+        if self._span_bounds is None or seg in self._span_failed:
+            return None
+        lo, hi = self._span_bounds
+        if offset < lo or offset + plen > hi:
+            return None
+        span = self._spans.get(seg)
+        if span is None:
+            reader = self._reader(seg)
+            if reader is None:
+                return None
+            try:
+                span = reader.read_fully(lo, hi - lo)
+            except OSError as e:
+                logger.warning(
+                    "parity span read %s [%d,%d) failed: %s — degrading to "
+                    "per-group reads", self.blocks[seg].name, lo, hi, e,
+                )
+                self._span_failed.add(seg)
+                return None
+            if len(span) != hi - lo:
+                self._span_failed.add(seg)
+                return None
+            self._spans[seg] = span
+        o = offset - lo
+        return span[o : o + plen]
+
+    def _reader(self, seg: int):
+        if seg in self._dead:
+            return None
+        reader = self._readers.get(seg)
+        if reader is None:
+            try:
+                reader = self.dispatcher.backend.open_ranged(
+                    self.dispatcher.get_path(self.blocks[seg])
+                )
+                header = parse_parity_header(reader.read_fully(0, HEADER_BYTES))
+                if header != self.geom:
+                    raise ValueError(
+                        f"parity object {self.blocks[seg].name} geometry "
+                        f"{header} != recorded {self.geom}"
+                    )
+            except (OSError, ValueError) as e:
+                logger.warning(
+                    "parity segment %s unavailable: %s", self.blocks[seg].name, e
+                )
+                self._dead.add(seg)
+                return None
+            self._readers[seg] = reader
+        return reader
+
+    def read_group(self, group: int, plen: int) -> Dict[int, np.ndarray]:
+        out: Dict[int, np.ndarray] = {}
+        offset = self.geom.parity_chunk_offset(group)
+        for seg in range(self.geom.segments):
+            data = self._from_span(seg, offset, plen)
+            if data is None:
+                reader = self._reader(seg)
+                if reader is None:
+                    continue
+                try:
+                    data = reader.read_fully(offset, plen)
+                except OSError as e:
+                    logger.warning(
+                        "parity read %s group %d failed: %s",
+                        self.blocks[seg].name, group, e,
+                    )
+                    continue
+            if len(data) == plen:
+                out[seg] = np.frombuffer(data, dtype=np.uint8)
+        return out
+
+    def close(self) -> None:
+        for reader in self._readers.values():
+            try:
+                reader.close()
+            except OSError:
+                pass
+        self._readers = {}
+
+
+class _DataHandle:
+    """Lazy ranged reader over the data object itself — sibling-chunk
+    source for partial-range reconstruction; every failure just shrinks
+    the survivor set (the object may be entirely lost)."""
+
+    def __init__(self, dispatcher, data_block, geom: ParityGeometry):
+        self.dispatcher = dispatcher
+        self.block = data_block
+        self.geom = geom
+        self._reader = None
+        self._dead = False
+        self.last_count = 0
+
+    def read_chunks(self, group: int, positions, plen: int) -> Dict[int, np.ndarray]:
+        out: Dict[int, np.ndarray] = {}
+        self.last_count = 0
+        if self._dead:
+            return out
+        if self._reader is None:
+            try:
+                self._reader = self.dispatcher.backend.open_ranged(
+                    self.dispatcher.get_path(self.block)
+                )
+            except OSError as e:
+                logger.warning(
+                    "data object %s unavailable for sibling reads: %s",
+                    self.block.name, e,
+                )
+                self._dead = True
+                return out
+        base = group * self.geom.stripe_k
+        for j in positions:
+            lo, hi = self.geom.chunk_span(base + j)
+            if hi <= lo:
+                continue
+            try:
+                data = self._reader.read_fully(lo, hi - lo)
+            except OSError:
+                continue
+            if len(data) != hi - lo:
+                continue
+            chunk = np.zeros(plen, dtype=np.uint8)
+            chunk[: len(data)] = np.frombuffer(data, dtype=np.uint8)
+            out[j] = chunk
+        self.last_count = len(out)
+        return out
+
+    def close(self) -> None:
+        if self._reader is not None:
+            try:
+                self._reader.close()
+            except OSError:
+                pass
+            self._reader = None
+
+
+# ---------------------------------------------------------------------------
+# Straggler speculation
+# ---------------------------------------------------------------------------
+
+
+class SpeculativeFetcher:
+    """Races slow prefills against parity reconstruction.
+
+    Attached to :class:`BufferedPrefetchIterator` by the scan assembler when
+    the scan has parity-covered objects and ``speculative_read_quantile > 0``.
+    A prefill is eligible when its stream's data object carries parity AND
+    the requested budget covers the whole range (the buffer is then complete
+    — the abandoned primary GET can never corrupt a later cursor read).
+
+    The threshold is the configured quantile of the live
+    ``read_prefetch_fill_seconds`` histogram, resolved once per scan and
+    only once at least :data:`MIN_FILL_SAMPLES` fills have been observed —
+    cold processes never speculate on noise."""
+
+    def __init__(self, recovery: DegradedReader, quantile: float, width: int = 4):
+        self.recovery = recovery
+        self.quantile = float(quantile)
+        self.width = max(1, int(width))
+        self._threshold: Optional[float] = None
+        self._resolved = False
+
+    def eligible(self, stream, bsize: int) -> bool:
+        data_block = getattr(stream, "data_block", None)
+        if data_block is None or bsize < getattr(stream, "max_bytes", 1 << 62):
+            return False
+        return self.recovery.speculation_viable(data_block)
+
+    def threshold_s(self) -> Optional[float]:
+        if not self._resolved:
+            self._resolved = True
+            if 0.0 < self.quantile < 1.0 and _metrics.enabled():
+                hist = _metrics.REGISTRY.histogram("read_prefetch_fill_seconds")
+                snap = hist.read()
+                if snap.count >= MIN_FILL_SAMPLES:
+                    value = snap.percentile(self.quantile)
+                    if value > 0.0:
+                        self._threshold = value
+        return self._threshold
+
+    def prefill(self, stream, bsize: int, primary):
+        """Run ``primary`` (the normal prefill) with a reconstruction race
+        armed at the threshold; identical to ``primary()`` when no threshold
+        is available or reconstruction cannot cover the range. Returns
+        ``(buffer, speculation_won, primary_exec_s)``: the caller must NOT
+        feed a speculation-won fill back into the fill histogram the
+        threshold is derived from (its duration is threshold +
+        reconstruction, which would ratchet the quantile upward exactly
+        when stragglers are sustained), and primary-won fills should
+        observe ``primary_exec_s`` — the GET's own execution time, pool
+        queue wait excluded — for the same reason."""
+        threshold = self.threshold_s()
+        if threshold is None:
+            return primary(), False, None
+        started = threading.Event()
+        exec_s = [None]
+
+        def timed_primary():
+            started.set()
+            t0 = time.perf_counter_ns()
+            try:
+                return primary()
+            finally:
+                exec_s[0] = (time.perf_counter_ns() - t0) / 1e9
+
+        future = _submit_speculative(self.width, timed_primary)
+        # queue wait on the shared pool is NOT store latency: the threshold
+        # clock starts when the GET starts executing, otherwise pool
+        # saturation reads as a straggler storm and every queued healthy
+        # prefill fires a spurious parity race
+        while not started.wait(timeout=threshold):
+            if future.done():
+                return future.result(), False, exec_s[0]
+        try:
+            return future.result(timeout=threshold), False, exec_s[0]
+        except FutureTimeoutError:
+            pass
+        if _metrics.enabled():
+            _C_SPECULATIVE.inc()
+        data = self.recovery.reconstruct(
+            stream.data_block, stream.start_offset, stream.end_offset,
+            reason="straggler",
+        )
+        if data is not None:
+            # the primary GET is abandoned; its late buffer is discarded and
+            # the stream is never cursor-read (bsize covers max_bytes). The
+            # stream's reader close rides the abandoned future so the
+            # consumer never waits out the straggler it just dodged.
+            abandon = getattr(stream, "abandon_close_to", None)
+            if abandon is not None:
+                abandon(future)
+            return data, True, exec_s[0]
+        return future.result(), False, exec_s[0]

@@ -115,6 +115,26 @@ class ShuffleConfig:
     # generation sweep: tombstoned (superseded) objects are deleted once
     # their generation stamp is older than this many seconds
     tombstone_ttl_s: float = 300.0
+    # --- coded shuffle plane (TPU-first addition; the reference tolerates
+    # only transient storage faults — a lost or slow object stalls the scan.
+    # Coded TeraSort / Coded MapReduce, PAPERS.md) ---
+    # parity sidecar objects (m) emitted per data object; 0 disables the
+    # plane entirely and reproduces the uncoded store request pattern
+    # op-for-op (the coalesce_gap_bytes=0 contract). Full-object loss is
+    # recoverable when parity_segments >= parity_stripe_k; smaller m still
+    # covers partial-range loss/corruption and straggler speculation.
+    parity_segments: int = 0
+    # data chunks (k) per stripe group: parity overhead is m/k of the
+    # payload; k=1 degenerates to mirrored replicas (cheapest full-loss
+    # recovery), larger k trades recovery envelope for overhead
+    parity_stripe_k: int = 1
+    # stripe chunk granularity — also the unit of degraded-read GETs
+    parity_chunk_bytes: int = 1 * MiB
+    # straggler speculation: when a segment GET outlives this quantile of
+    # the live read_prefetch_fill_seconds histogram, race it against a
+    # parity reconstruction and take whichever finishes first. 0 disables
+    # speculation (loss reconstruction stays active regardless).
+    speculative_read_quantile: float = 0.99
     # in-memory budget for key-ordered reduce output before the batch sorter
     # spills sorted columnar runs (analog of Spark's ExternalSorter memory)
     sorter_spill_bytes: int = 256 * MiB
@@ -240,6 +260,15 @@ class ShuffleConfig:
             or self.storage_op_deadline_s < 0
         ):
             raise ValueError("storage retry knobs must be >= 0")
+        if self.parity_segments < 0 or self.parity_stripe_k < 1:
+            raise ValueError("parity_segments must be >= 0, parity_stripe_k >= 1")
+        if self.parity_segments + self.parity_stripe_k > 255:
+            # GF(256) erasure coding addresses at most 255 segments total
+            raise ValueError("parity_segments + parity_stripe_k must be <= 255")
+        if self.parity_chunk_bytes < 1:
+            raise ValueError("parity_chunk_bytes must be >= 1")
+        if not (0.0 <= self.speculative_read_quantile < 1.0):
+            raise ValueError("speculative_read_quantile must be in [0, 1)")
         if self.codec_batch_blocks < 1:
             raise ValueError("codec_batch_blocks must be >= 1")
         if self.encode_inflight_batches < 0:

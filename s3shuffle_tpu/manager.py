@@ -199,6 +199,7 @@ class ShuffleManager:
             MapStatus(
                 map_id=map_id, location=STORE_LOCATION, sizes=lengths,
                 map_index=map_index,
+                parity_segments=0 if message is None else message.parity_segments,
             ),
         )
 
@@ -217,6 +218,7 @@ class ShuffleManager:
                     map_index=m.map_index,
                     composite_group=m.group_id,
                     base_offset=m.base_offset,
+                    parity_segments=m.parity_segments,
                 )
                 for m in members
             ],

@@ -29,9 +29,15 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-#: wire magic ("S3FATIDX"-shaped int64) + format version, first two words
+#: wire magic ("S3FATIDX"-shaped int64) + format version, first two words.
+#: v2 appends four header words ``[parity_segments, parity_stripe_k,
+#: parity_chunk_bytes, payload_len]`` — the composite data object's stripe
+#: geometry for the coded shuffle plane (all zero when uncoded); v1 blobs
+#: still parse (geometry defaults to none).
 _MAGIC = 0x5333464154494458
-_VERSION = 1
+_VERSION = 2
+_HEADER_V1 = 7
+_HEADER_V2 = 11
 
 
 @dataclasses.dataclass
@@ -60,10 +66,12 @@ class FatIndex:
         group_id: int,
         num_partitions: int,
         members: List[FatIndexMember],
+        parity=None,  # coding.parity.ParityGeometry of the composite object
     ):
         self.shuffle_id = int(shuffle_id)
         self.group_id = int(group_id)
         self.num_partitions = int(num_partitions)
+        self.parity = parity
         self.members: Dict[int, FatIndexMember] = {}
         for m in members:
             if len(m.offsets) != self.num_partitions + 1:
@@ -88,16 +96,22 @@ class FatIndex:
     # -- wire ----------------------------------------------------------
     def to_bytes(self) -> bytes:
         """``[magic, version, shuffle_id, group_id, num_partitions,
-        n_members, has_checksums]`` then ``n_members`` member rows of
-        ``[map_id, map_index, base_offset]``, then ``n_members`` offset
+        n_members, has_checksums, parity_segments, parity_stripe_k,
+        parity_chunk_bytes, payload_len]`` then ``n_members`` member rows
+        of ``[map_id, map_index, base_offset]``, then ``n_members`` offset
         rows of ``num_partitions + 1`` words, then (when has_checksums)
         ``n_members`` checksum rows of ``num_partitions`` words."""
         members = list(self.members.values())
         p = self.num_partitions
         has_ck = 1 if self.has_checksums else 0
+        par = self.parity
         header = np.array(
             [_MAGIC, _VERSION, self.shuffle_id, self.group_id, p,
-             len(members), has_ck],
+             len(members), has_ck,
+             0 if par is None else int(par.segments),
+             0 if par is None else int(par.stripe_k),
+             0 if par is None else int(par.chunk_bytes),
+             0 if par is None else int(par.payload_len)],
             dtype=np.int64,
         )
         rows = np.zeros((len(members), 3), dtype=np.int64)
@@ -117,22 +131,34 @@ class FatIndex:
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "FatIndex":
-        if len(data) % 8 != 0 or len(data) < 7 * 8:
+        if len(data) % 8 != 0 or len(data) < _HEADER_V1 * 8:
             raise ValueError(f"fat index blob has invalid length {len(data)}")
         words = np.frombuffer(data, dtype=">i8").astype(np.int64)
         magic, version, shuffle_id, group_id, p, n, has_ck = (
-            int(w) for w in words[:7]
+            int(w) for w in words[:_HEADER_V1]
         )
         if magic != _MAGIC:
             raise ValueError("fat index blob has wrong magic")
-        if version != _VERSION:
+        if version == 1:
+            header, parity = _HEADER_V1, None
+        elif version == _VERSION:
+            header = _HEADER_V2
+            if len(words) < header:
+                raise ValueError(f"fat index v2 blob has invalid length {len(data)}")
+            par_m, par_k, par_chunk, par_len = (int(w) for w in words[7:11])
+            parity = None
+            if par_m > 0:
+                from s3shuffle_tpu.coding.parity import ParityGeometry
+
+                parity = ParityGeometry(par_m, par_k, par_chunk, par_len)
+        else:
             raise ValueError(f"fat index format version {version} != {_VERSION}")
-        expect = 7 + n * 3 + n * (p + 1) + (n * p if has_ck else 0)
+        expect = header + n * 3 + n * (p + 1) + (n * p if has_ck else 0)
         if len(words) != expect:
             raise ValueError(
                 f"fat index blob has {len(words)} words, expected {expect}"
             )
-        pos = 7
+        pos = header
         rows = words[pos : pos + n * 3].reshape(n, 3)
         pos += n * 3
         offs = words[pos : pos + n * (p + 1)].reshape(n, p + 1)
@@ -148,4 +174,4 @@ class FatIndex:
             )
             for i in range(n)
         ]
-        return cls(shuffle_id, group_id, p, members)
+        return cls(shuffle_id, group_id, p, members, parity=parity)

@@ -49,11 +49,15 @@ class MapLocation:
     ``[offsets[start], offsets[end])`` without caring which layout wrote
     the bytes). ``checksums`` is populated from the fat index for
     composite members and None for singletons (whose checksum object is
-    fetched separately, exactly as before)."""
+    fetched separately, exactly as before). ``parity`` is the data
+    object's stripe geometry when the coded plane wrote parity sidecars
+    (from the index trailer / fat index) — what the degraded-read path
+    (coding/degraded.py) plans reconstruction with; None = uncoded."""
 
     data_block: BlockId
     offsets: np.ndarray
     checksums: Optional[np.ndarray] = None
+    parity: Optional[object] = None  # coding.parity.ParityGeometry
 
 
 class ShuffleHelper:
@@ -83,12 +87,20 @@ class ShuffleHelper:
     # Write side
     # ------------------------------------------------------------------
     def write_partition_lengths(
-        self, shuffle_id: int, map_id: int, lengths: np.ndarray
+        self, shuffle_id: int, map_id: int, lengths: np.ndarray, parity=None
     ) -> None:
         """lengths (per-partition byte counts) → cumulative offsets
-        ``[0, l0, l0+l1, ...]`` (S3ShuffleHelper.scala:44-47)."""
+        ``[0, l0, l0+l1, ...]`` (S3ShuffleHelper.scala:44-47). ``parity``
+        (a ParityGeometry) appends the 4-word stripe-geometry trailer so
+        readers learn the coded layout from the index they fetch anyway;
+        None (the default, and always when ``parity_segments=0``) keeps
+        the blob byte-identical to the reference wire format."""
         offsets = np.zeros(len(lengths) + 1, dtype=np.int64)
         np.cumsum(np.asarray(lengths, dtype=np.int64), out=offsets[1:])
+        if parity is not None:
+            from s3shuffle_tpu.coding.parity import geometry_trailer_words
+
+            offsets = np.concatenate([offsets, geometry_trailer_words(parity)])
         self.write_array_as_block(ShuffleIndexBlockId(shuffle_id, map_id), offsets)
 
     def write_checksums(self, shuffle_id: int, map_id: int, checksums: np.ndarray) -> None:
@@ -201,11 +213,13 @@ class ShuffleHelper:
         self, shuffle_id: int, map_id: int, hint: Tuple[int, int]
     ) -> MapLocation:
         group_id, base = hint
-        member = self.read_fat_index(shuffle_id, group_id).member(map_id)
+        fat = self.read_fat_index(shuffle_id, group_id)
+        member = fat.member(map_id)
         return MapLocation(
             data_block=ShuffleCompositeDataBlockId(shuffle_id, group_id),
             offsets=member.base_offset + member.offsets,
             checksums=member.checksums,
+            parity=fat.parity,
         )
 
     def resolve_map_location(self, shuffle_id: int, map_id: int) -> MapLocation:
@@ -216,9 +230,11 @@ class ShuffleHelper:
         hint = self.composite_hint(shuffle_id, map_id)
         if hint is None:
             try:
+                offsets, geometry = self._singleton_index(shuffle_id, map_id)
                 return MapLocation(
                     data_block=ShuffleDataBlockId(shuffle_id, map_id),
-                    offsets=self._singleton_offsets(shuffle_id, map_id),
+                    offsets=offsets,
+                    parity=geometry,
                 )
             except FileNotFoundError:
                 if not self._discovery_allowed(shuffle_id):
@@ -239,13 +255,20 @@ class ShuffleHelper:
                         raise
         return self._composite_location(shuffle_id, map_id, hint)
 
-    def _singleton_offsets(self, shuffle_id: int, map_id: int) -> np.ndarray:
+    def _singleton_index(self, shuffle_id: int, map_id: int):
+        """One per-map index blob → ``(offsets, parity_geometry|None)``.
+        The cache keeps the RAW word array (trailer included) so cached and
+        fresh reads parse identically."""
+        from s3shuffle_tpu.coding.parity import split_index_geometry
+
         block = ShuffleIndexBlockId(shuffle_id, map_id)
         if self.dispatcher.config.cache_partition_lengths:
-            return self._length_cache.get_or_else_put(
+            words = self._length_cache.get_or_else_put(
                 self.dispatcher.get_path(block), lambda _k: self.read_block_as_array(block)
             )
-        return self.read_block_as_array(block)
+        else:
+            words = self.read_block_as_array(block)
+        return split_index_geometry(words)
 
     def get_partition_lengths(self, shuffle_id: int, map_id: int) -> np.ndarray:
         """ABSOLUTE cumulative offsets array for one map output (composite

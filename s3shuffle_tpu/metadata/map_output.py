@@ -46,6 +46,12 @@ class MapStatus:
     #: composite members with zero extra store round-trips.
     composite_group: int = -1
     base_offset: int = 0
+    #: coded shuffle plane (coding/): parity sidecar count of the data
+    #: object holding this output (0 = uncoded). Control-plane visibility
+    #: of the redundancy envelope — the full stripe geometry readers
+    #: reconstruct with rides the index sidecar / fat index they fetch
+    #: anyway (metadata/helper.MapLocation.parity).
+    parity_segments: int = 0
 
     def __post_init__(self) -> None:
         if self.map_index < 0:

@@ -374,9 +374,11 @@ class _Handler(socketserver.BaseRequestHandler):
                 m_shuffle, m_map, m_loc, m_sizes, m_idx = a[4][:5]
                 m_idx = int(m_idx)
                 # format-3 composite coordinates; older payloads default to
-                # the classic one-object-per-map layout
+                # the classic one-object-per-map layout. format-4 appends
+                # the coded plane's parity-segment count (default uncoded).
                 m_group = int(a[4][5]) if len(a[4]) > 5 else -1
                 m_base = int(a[4][6]) if len(a[4]) > 6 else 0
+                m_parity = int(a[4][7]) if len(a[4]) > 7 else 0
                 tracker = self.server.tracker  # type: ignore[attr-defined]
                 status = MapStatus(
                     map_id=int(m_map),
@@ -385,6 +387,7 @@ class _Handler(socketserver.BaseRequestHandler):
                     map_index=m_idx,
                     composite_group=m_group,
                     base_offset=m_base,
+                    parity_segments=m_parity,
                 )
 
                 def on_accept(s=status, sid=int(m_shuffle), t=tracker):
@@ -441,6 +444,7 @@ class _Handler(socketserver.BaseRequestHandler):
                 map_index=int(map_index),
                 composite_group=int(a[5]) if len(a) > 5 else -1,
                 base_offset=int(a[6]) if len(a) > 6 else 0,
+                parity_segments=int(a[7]) if len(a) > 7 else 0,
             )
             return tracker.register_map_output(int(shuffle_id), status)
         if method == "register_map_outputs":
@@ -465,6 +469,7 @@ class _Handler(socketserver.BaseRequestHandler):
                         map_index=int(map_index),
                         composite_group=int(entry[4]) if len(entry) > 4 else -1,
                         base_offset=int(entry[5]) if len(entry) > 5 else 0,
+                        parity_segments=int(entry[6]) if len(entry) > 6 else 0,
                     )
                 )
             return tracker.register_map_outputs(shuffle_id, statuses)
@@ -759,6 +764,7 @@ class RemoteMapOutputTracker:
             status.map_index,
             status.composite_group,
             status.base_offset,
+            status.parity_segments,
         )
 
     def register_map_outputs(self, shuffle_id: int, statuses: List[MapStatus]) -> None:
@@ -768,7 +774,7 @@ class RemoteMapOutputTracker:
             shuffle_id,
             [
                 [s.map_id, s.location, np.asarray(s.sizes).tolist(), s.map_index,
-                 s.composite_group, s.base_offset]
+                 s.composite_group, s.base_offset, s.parity_segments]
                 for s in statuses
             ],
         )

@@ -58,13 +58,15 @@ _G_SNAPSHOT_AGE = _metrics.REGISTRY.gauge(
 )
 
 #: wire magic ("S3SHSNAP" as an int64) + format version, first two words.
-#: v2 adds two per-row words (composite_group, base_offset) so snapshots
-#: carry the composite-commit coordinates; v1 blobs still parse (rows
-#: default to the one-object-per-map layout).
+#: v2 added two per-row words (composite_group, base_offset) so snapshots
+#: carry the composite-commit coordinates; v3 adds one more
+#: (parity_segments) for the coded shuffle plane. v1/v2 blobs still parse
+#: (rows default to the one-object-per-map, uncoded layout).
 _MAGIC = 0x5333485348534E41
-_VERSION = 2
+_VERSION = 3
 _ROW_META_V1 = 2  # [map_id, map_index]
 _ROW_META_V2 = 4  # [map_id, map_index, composite_group, base_offset]
+_ROW_META_V3 = 5  # v2 + [parity_segments]
 
 
 class MapOutputSnapshot:
@@ -130,9 +132,9 @@ class MapOutputSnapshot:
         header ``[magic, version, shuffle_id, epoch, num_partitions,
         published_unix_micros, n_entries]`` then one row per entry
         ``[map_id, map_index, composite_group, base_offset,
-        sizes[0..P)]``."""
+        parity_segments, sizes[0..P)]``."""
         p = self._num_partitions
-        meta = _ROW_META_V2
+        meta = _ROW_META_V3
         header = np.array(
             [
                 _MAGIC, _VERSION, self.shuffle_id, self.epoch, p,
@@ -146,6 +148,7 @@ class MapOutputSnapshot:
             rows[i, 1] = map_index
             rows[i, 2] = status.composite_group
             rows[i, 3] = status.base_offset
+            rows[i, 4] = status.parity_segments
             sizes = np.asarray(status.sizes, dtype=np.int64)
             if len(sizes) < p:
                 raise ValueError(
@@ -170,8 +173,10 @@ class MapOutputSnapshot:
             raise ValueError("snapshot blob has wrong magic")
         if version == 1:
             meta = _ROW_META_V1  # pre-composite rows
+        elif version == 2:
+            meta = _ROW_META_V2  # pre-coding rows
         elif version == _VERSION:
-            meta = _ROW_META_V2
+            meta = _ROW_META_V3
         else:
             raise ValueError(f"snapshot format version {version} != {_VERSION}")
         expect = 7 + n * (meta + p)
@@ -190,6 +195,7 @@ class MapOutputSnapshot:
                     map_index=int(rows[i, 1]),
                     composite_group=int(rows[i, 2]) if meta >= 4 else -1,
                     base_offset=int(rows[i, 3]) if meta >= 4 else 0,
+                    parity_segments=int(rows[i, 4]) if meta >= 5 else 0,
                 ),
             )
             for i in range(n)

@@ -91,6 +91,12 @@ KNOWN_METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "tune_decisions_total": ("counter", ("knob", "direction")),
     "tune_knob_value": ("gauge", ("knob",)),
     "tune_controller_seconds": ("histogram", ()),
+    # --- coding plane: k-of-n parity + degraded reads
+    # (coding/parity.py, coding/degraded.py) ---
+    "shuffle_parity_encode_seconds": ("histogram", ()),
+    "shuffle_parity_bytes_written_total": ("counter", ()),
+    "shuffle_parity_speculative_reads_total": ("counter", ()),
+    "shuffle_parity_reconstructions_total": ("counter", ("reason",)),
     # --- codec plane: device-resident batch pipeline
     # (codec/framing.py, codec/tpu.py) ---
     "codec_encode_batch_seconds": ("histogram", ()),

@@ -84,10 +84,12 @@ class BlockIterator:
         dispatcher: Dispatcher,
         helper: ShuffleHelper,  # or a duck-typed ScanIndexMemo
         blocks: Iterable[ReadableBlockId],
+        recovery=None,  # coding.degraded.DegradedReader of the scan
     ):
         self.dispatcher = dispatcher
         self.helper = helper
         self._blocks = iter(blocks)
+        self._recovery = recovery
 
     def __iter__(self) -> Iterator[Tuple[ReadableBlockId, BlockStream]]:
         must_raise = (
@@ -99,4 +101,11 @@ class BlockIterator:
             if span is None:
                 continue
             data_block, lo, hi = span
-            yield block, BlockStream(self.dispatcher, block, data_block, lo, hi)
+            if self._recovery is not None:
+                # register the (already-resolved, memoized — zero extra
+                # store ops) stripe geometry so a lost object reconstructs
+                self._recovery.note(self.helper, block.shuffle_id, block.map_id)
+            yield block, BlockStream(
+                self.dispatcher, block, data_block, lo, hi,
+                recovery=self._recovery,
+            )

@@ -45,6 +45,7 @@ class BlockStream(io.RawIOBase):
         data_block: ShuffleDataBlockId,
         start_offset: int,
         end_offset: int,
+        recovery=None,  # coding.degraded.DegradedReader of the scan (or None)
     ):
         if end_offset < start_offset:
             raise ValueError(f"Invalid range [{start_offset}, {end_offset})")
@@ -54,6 +55,18 @@ class BlockStream(io.RawIOBase):
         self.start_offset = start_offset
         self.end_offset = end_offset
         self.max_bytes = end_offset - start_offset
+        # Coded shuffle plane: on a terminal FileNotFoundError (the object
+        # is LOST, not slow) the range is rebuilt from parity sidecars
+        # before the logged-EOF fallback — see _reconstruct_locked.
+        self._recovery = recovery
+        self._recovered: Optional[bytes] = None  # rebuilt [_pos_at_loss, end)
+        self._recovered_base = 0
+        # Straggler speculation: when reconstruction wins the race, the
+        # abandoned primary GET may hold self._lock for a long store
+        # round-trip — the consumer's close() must not wait behind it
+        # (that wait IS the straggler tail being avoided). The future's
+        # done-callback closes the reader instead (abandon_close_to).
+        self._abandoned_future = None
         self._pos = start_offset
         self._reader: Optional[RangedReader] = None
         # Readers abandoned by _recover_reader_locked: NOT closed at swap
@@ -110,6 +123,33 @@ class BlockStream(io.RawIOBase):
         self._reader = fresh
         return fresh
 
+    def _reconstruct_locked(self, position: int, length: int) -> Optional[bytes]:
+        """Coded-plane loss path (caller holds ``self._lock``): rebuild
+        ``[position, end_offset)`` from parity ONCE, cache it, and serve the
+        requested slice. Returns None when the scan carries no parity for
+        this object or the survivors are insufficient — the caller then
+        falls back to the pre-coding logged-EOF behavior."""
+        if self._recovery is None:
+            return None
+        if self._recovered is None:
+            # one reconstruction covers the stream's WHOLE range — chunked
+            # preads at any position (and the cursor remainder) are all
+            # servable from it, so a lost object costs one parity round.
+            # (Runs under self._lock by design: reconstruction must win or
+            # lose atomically with the failed-EOF marker, the same
+            # single-consumer serialization as the primary read.)
+            data = self._recovery.reconstruct(
+                self.data_block, self.start_offset, self.end_offset, reason="loss"
+            )
+            if data is None:
+                return None
+            self._recovered = data
+            self._recovered_base = self.start_offset
+        lo = position - self._recovered_base
+        if lo < 0:
+            return None
+        return self._recovered[lo : lo + length]
+
     def pread(self, position: int, length: int) -> bytes:
         """Positioned read inside the block range with NO cursor movement.
 
@@ -124,11 +164,19 @@ class BlockStream(io.RawIOBase):
         if length <= 0:
             return b""
         with self._lock:
+            if self._recovered is not None:
+                lo = position - self._recovered_base
+                if lo >= 0:
+                    return self._recovered[lo : lo + length]
             if self._failed:
                 return b""
             try:
                 reader = self._ensure_open()
             except OSError as e:
+                if isinstance(e, FileNotFoundError):
+                    rebuilt = self._reconstruct_locked(position, length)
+                    if rebuilt is not None:
+                        return rebuilt
                 logger.error(
                     "Error opening %s for range [%d,%d): %s",
                     self.block.name, position, position + length, e,
@@ -148,6 +196,11 @@ class BlockStream(io.RawIOBase):
                     return fresh.read_fully(position, length)
                 except OSError as e2:
                     e = e2
+            if isinstance(e, FileNotFoundError):
+                with self._lock:
+                    rebuilt = self._reconstruct_locked(position, length)
+                if rebuilt is not None:
+                    return rebuilt
             logger.error(
                 "Error reading %s range [%d,%d): %s",
                 self.block.name, position, position + length, e,
@@ -165,6 +218,15 @@ class BlockStream(io.RawIOBase):
             if size is None or size < 0:
                 size = remaining
             n = min(size, remaining)
+            if self._recovered is not None:
+                # the object was lost and the remaining range rebuilt from
+                # parity: serve the cursor from the rebuilt buffer
+                lo = self._pos - self._recovered_base
+                data = self._recovered[lo : lo + n]
+                self._pos += len(data)
+                if self._pos >= self.end_offset or not data:
+                    self._close_reader()
+                return data
             data = None
             reader = None
             try:
@@ -181,6 +243,10 @@ class BlockStream(io.RawIOBase):
                         data = fresh.read_fully(self._pos, n)
                     except OSError as e2:
                         e = e2
+                if data is None and isinstance(e, FileNotFoundError):
+                    # REAL loss, not weather: reconstruct unconditionally
+                    # before surfacing the logged-EOF → ChecksumError path
+                    data = self._reconstruct_locked(self._pos, n)
                 if data is None:
                     # Log + EOF, matching S3ShuffleBlockStream.scala:66-70.
                     logger.error("Error reading %s range [%d,%d): %s", self.block.name, self._pos, self.end_offset, e)
@@ -217,8 +283,23 @@ class BlockStream(io.RawIOBase):
             self._reader = None
         self._reader_closed = True
 
+    def abandon_close_to(self, future) -> None:
+        """Speculation won the race: hand reader teardown to ``future``'s
+        completion (the abandoned primary GET). ``close()`` then returns
+        immediately instead of blocking on the straggler's lock hold; the
+        handle is still deterministically closed — by the done-callback the
+        moment the GET finishes (or immediately, if it already has)."""
+        self._abandoned_future = future
+        future.add_done_callback(lambda _f: self._close_reader_threadsafe())
+
+    def _close_reader_threadsafe(self) -> None:
+        with self._lock:
+            self._close_reader()
+
     def close(self) -> None:
         if not self.closed:
-            with self._lock:
-                self._close_reader()
+            if self._abandoned_future is None:
+                with self._lock:
+                    self._close_reader()
+            # else: the abandoned primary's done-callback owns the close
         super().close()

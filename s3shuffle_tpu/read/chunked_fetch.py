@@ -21,11 +21,11 @@ from __future__ import annotations
 
 import threading
 import time
-from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Tuple
 
 from s3shuffle_tpu.metrics import registry as _metrics
 from s3shuffle_tpu.read.block_stream import BlockStream
+from s3shuffle_tpu.utils.growpool import GrowReapExecutor
 from s3shuffle_tpu.utils.io import read_up_to as _read_up_to
 
 _H_CHUNK = _metrics.REGISTRY.histogram(
@@ -42,51 +42,21 @@ _C_CHUNKED = _metrics.REGISTRY.counter(
 )
 
 # ---------------------------------------------------------------------------
-# Shared bounded I/O executor (process-wide, grow-only)
+# Shared bounded I/O executor (process-wide, grow-on-demand, idle-reaped —
+# the lifecycle lives in utils/growpool.py, shared with the coding plane's
+# speculation pool)
 # ---------------------------------------------------------------------------
 
-_executor_lock = threading.Lock()
-_executor: Optional[ThreadPoolExecutor] = None
-_executor_width = 0
-#: idle-reap window: a pool wider than current demand shrinks once no submit
-#: has needed its full width for this long — a one-off wide scan (or a
-#: transient high-parallelism autotune rung) no longer pins threads for the
-#: process lifetime
-_EXECUTOR_REAP_IDLE_S = 30.0
-_executor_wide_use = 0.0  # monotonic stamp of the last full-width submit
+_POOL = GrowReapExecutor("s3shuffle-fetch")
 
 
 def _submit_fetch(width: int, fn, *args):
     """Submit onto the process-wide ranged-GET pool, sized to the largest
     width callers are CURRENTLY asking for (reduce tasks with different
-    configs share one pool, like the dispatcher shares one backend handle).
-    Growing swaps in a wider pool immediately; shrinking is idle-reaped —
-    when every submit for ``_EXECUTOR_REAP_IDLE_S`` wanted less than the
-    pool's width, the pool is swapped down to the requested width and the
-    superseded (wider) pool drains its queued work and retires its threads.
-    Submission happens UNDER the swap lock, so a concurrent swap can never
-    shut the pool down between lookup and submit."""
-    global _executor, _executor_width, _executor_wide_use
-    width = max(1, width)
-    with _executor_lock:
-        now = time.monotonic()
-        shrink = (
-            _executor is not None
-            and width < _executor_width
-            and now - _executor_wide_use >= _EXECUTOR_REAP_IDLE_S
-        )
-        if _executor is None or width > _executor_width or shrink:
-            old = _executor
-            # shuffle-lint: disable=THR01 reason=process-wide pool shared across tasks for the process lifetime; a superseded pool is shut down below (old.shutdown) and concurrent.futures joins idle workers at interpreter exit
-            _executor = ThreadPoolExecutor(
-                max_workers=width, thread_name_prefix="s3shuffle-fetch"
-            )
-            _executor_width = width
-            if old is not None:
-                old.shutdown(wait=False)
-        if width >= _executor_width:
-            _executor_wide_use = now
-        return _executor.submit(fn, *args)
+    configs share one pool, like the dispatcher shares one backend handle);
+    see :class:`~s3shuffle_tpu.utils.growpool.GrowReapExecutor` for the
+    grow/idle-reap policy."""
+    return _POOL.submit(width, fn, *args)
 
 
 class ChunkedRangeFetcher:

@@ -143,6 +143,7 @@ class BufferedPrefetchIterator:
         max_buffer_size: int,
         max_threads: int = 10,
         fetcher=None,
+        speculation=None,
     ):
         self._source = source
         self._max_buffer_size = max(1, max_buffer_size)
@@ -150,6 +151,12 @@ class BufferedPrefetchIterator:
         # split into concurrent ranged sub-reads (byte-identical contract —
         # see read/chunked_fetch.py). None = plain serial prefill.
         self._fetcher = fetcher
+        # Optional SpeculativeFetcher (coding/degraded.py): eligible
+        # prefills race the store GET against a parity reconstruction once
+        # they outlive the fill histogram's configured quantile — the
+        # straggler half of the coded shuffle plane. None/ineligible =
+        # exactly the plain path.
+        self._speculation = speculation
         self._predictor = ThreadPredictor(max_threads)
         self._lock = threading.Condition()
         # Separate lock for pulling source items: next(source) can do store
@@ -281,12 +288,31 @@ class BufferedPrefetchIterator:
                     # ← the actual store GET (chunk-parallel for big prefills
                     # when a fetcher is attached; serial otherwise)
                     if self._fetcher is not None:
-                        buffer = self._fetcher.prefill(stream, bsize)
+                        primary = lambda s=stream, n=bsize: self._fetcher.prefill(s, n)  # noqa: E731
                     else:
-                        buffer = _read_up_to(stream, bsize)
+                        primary = lambda s=stream, n=bsize: _read_up_to(s, n)  # noqa: E731
+                    speculation_won = False
+                    primary_exec_s = None
+                    if (
+                        self._speculation is not None
+                        and self._speculation.eligible(stream, bsize)
+                    ):
+                        buffer, speculation_won, primary_exec_s = (
+                            self._speculation.prefill(stream, bsize, primary)
+                        )
+                    else:
+                        buffer = primary()
                 dt = time.perf_counter_ns() - t0
-                if _metrics.enabled():
-                    _H_FILL.observe(dt / 1e9)
+                # the fill histogram drives the speculation threshold: a
+                # speculation-won fill (duration = threshold +
+                # reconstruction) is excluded, and a raced primary-won fill
+                # observes the GET's own execution time (pool queue wait
+                # excluded) — either would ratchet the quantile upward
+                # during sustained straggler episodes
+                if _metrics.enabled() and not speculation_won:
+                    _H_FILL.observe(
+                        primary_exec_s if primary_exec_s is not None else dt / 1e9
+                    )
                 prefetched = PrefetchedBlockStream(block, stream, buffer, self._release_budget(len(buffer), bsize))
                 with self._lock:
                     self._stat_prefetch_ns += dt

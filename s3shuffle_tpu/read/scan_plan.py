@@ -183,6 +183,7 @@ def plan_scan(
     gap_bytes: int,
     max_bytes: int,
     prefetch_width: int = 1,
+    recovery=None,  # coding.degraded.DegradedReader to feed geometry
 ) -> List[ScanSegment]:
     """Resolve, filter, group, and merge the scan's block list.
 
@@ -220,6 +221,10 @@ def plan_scan(
         if span is None:
             continue
         data_block, lo, hi = span
+        if recovery is not None:
+            # feed the degraded-read engine the (already-resolved, memoized
+            # — zero extra store ops) stripe geometry of this data object
+            recovery.note(memo, block.shuffle_id, block.map_id)
         groups.setdefault(data_block, []).append(BlockRange(block, lo, hi))
 
     segments: List[ScanSegment] = []
@@ -317,6 +322,8 @@ class CoalescedScanIterator:
         max_threads: int,
         fetcher=None,
         on_block: OnBlock = None,
+        recovery=None,
+        speculation=None,
     ):
         def segment_streams():
             for seg in segments:
@@ -325,14 +332,16 @@ class CoalescedScanIterator:
                     if on_block is not None:
                         on_block(m.block, m.length)
                     yield m.block, BlockStream(
-                        dispatcher, m.block, seg.data_block, m.start, m.end
+                        dispatcher, m.block, seg.data_block, m.start, m.end,
+                        recovery=recovery,
                     )
                 else:
                     if on_block is not None:
                         for m in seg.members:
                             on_block(m.block, m.length)
                     yield seg, BlockStream(
-                        dispatcher, seg, seg.data_block, seg.start, seg.end
+                        dispatcher, seg, seg.data_block, seg.start, seg.end,
+                        recovery=recovery,
                     )
 
         self._inner = BufferedPrefetchIterator(
@@ -340,6 +349,7 @@ class CoalescedScanIterator:
             max_buffer_size=max_buffer_size,
             max_threads=max_threads,
             fetcher=fetcher,
+            speculation=speculation,
         )
         self._pending: List[SlicedBlockStream] = []
 
@@ -475,6 +485,22 @@ def build_scan_iterator(
             cfg = tuner.tuned(cfg)
     else:
         tuner = None
+    # Coded shuffle plane (coding/): one degraded-read engine per scan,
+    # fed the stripe geometry of every resolved data object (a memoized
+    # byproduct of range resolution — zero extra store ops). Inert while
+    # empty: an uncoded scan's request pattern is untouched, the
+    # parity_segments=0 op-for-op contract. Speculation additionally needs
+    # the quantile knob on (it can issue EXTRA parity reads by design).
+    from s3shuffle_tpu.coding.degraded import DegradedReader, SpeculativeFetcher
+
+    recovery = DegradedReader(dispatcher)
+    speculation = None
+    if getattr(cfg, "speculative_read_quantile", 0.0) > 0:
+        speculation = SpeculativeFetcher(
+            recovery,
+            cfg.speculative_read_quantile,
+            width=max(1, cfg.max_concurrency_task),
+        )
     if cfg.coalesce_gap_bytes > 0:
         segments = plan_scan(
             dispatcher,
@@ -489,6 +515,7 @@ def build_scan_iterator(
             # many-map scan must not serialize index GETs 4 at a time before
             # the first data byte flows
             prefetch_width=max(1, cfg.fetch_parallelism, cfg.max_concurrency_task),
+            recovery=recovery,
         )
         it = CoalescedScanIterator(
             dispatcher,
@@ -497,11 +524,13 @@ def build_scan_iterator(
             max_threads=cfg.max_concurrency_task,
             fetcher=fetcher,
             on_block=on_block,
+            recovery=recovery,
+            speculation=speculation,
         )
         return it if tuner is None else _ObservedScanIterator(it, tuner)
 
     def nonempty_streams():
-        for block, stream in BlockIterator(dispatcher, memo, blocks):
+        for block, stream in BlockIterator(dispatcher, memo, blocks, recovery=recovery):
             if stream.max_bytes == 0:
                 continue  # filterNot(maxBytes == 0) backstop; BlockIterator
                 # already drops empties before constructing streams
@@ -514,5 +543,6 @@ def build_scan_iterator(
         max_buffer_size=cfg.max_buffer_size_task,
         max_threads=cfg.max_concurrency_task,
         fetcher=fetcher,
+        speculation=speculation,
     )
     return it if tuner is None else _ObservedScanIterator(it, tuner)

@@ -266,11 +266,12 @@ class Dispatcher:
     ) -> None:
         """Composite-aware half of the orphan sweep. A composite data
         object with NO fat index is an uncommitted group (the worker died
-        before the commit point) — no reader can see it, delete. A sealed
-        group whose members are ALL dead attempts is reclaimed whole; a
-        group with at least one winning member is kept (a zombie member's
-        bytes inside it waste space until shuffle teardown, which is
-        logged, never silently)."""
+        before the commit point) — no reader can see it, delete, along
+        with its parity sidecars (``.parity`` is committed-by-index like
+        everything else). A sealed group whose members are ALL dead
+        attempts is reclaimed whole; a group with at least one winning
+        member is kept (a zombie member's bytes inside it waste space
+        until shuffle teardown, which is logged, never silently)."""
         from s3shuffle_tpu.metadata.fat_index import FatIndex
 
         by_group: dict = {}
@@ -278,12 +279,19 @@ class Dispatcher:
             comp = parse_composite_name(st.path)
             if comp is None or comp[0] != shuffle_id:
                 continue
-            by_group.setdefault(comp[1], {})[comp[2]] = st.path
+            entry = by_group.setdefault(comp[1], {"parity": []})
+            if comp[2] == "parity":
+                entry["parity"].append(st.path)
+            else:
+                entry[comp[2]] = st.path
         for group_id, paths in sorted(by_group.items()):
             cindex = paths.get("cindex")
             if cindex is None:
-                # no fat index ⇒ the group never committed
-                self._sweep_delete(paths["data"], "uncommitted-composite", removed)
+                # no fat index ⇒ the group never committed; reclaim the
+                # data object AND its uncommitted parity sidecars
+                for path in [paths.get("data")] + sorted(paths["parity"]):
+                    if path is not None:
+                        self._sweep_delete(path, "uncommitted-composite", removed)
                 continue
             try:
                 fat = FatIndex.from_bytes(self.backend.read_all(cindex))
@@ -304,7 +312,9 @@ class Dispatcher:
                         "shuffle teardown", group_id, shuffle_id, len(dead), len(live),
                     )
                 continue
-            for path in sorted(paths.values()):
+            doomed = [p for k, p in paths.items() if k != "parity"]
+            doomed.extend(paths["parity"])
+            for path in sorted(doomed):
                 self._sweep_delete(path, "orphan", removed)
 
     def sweep_orphan_attempts(self, shuffle_id: int, winner_map_ids) -> List[str]:

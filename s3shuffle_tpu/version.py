@@ -12,10 +12,12 @@ __version__ = "0.1.0"
 #: serializer frames. Bumped on ANY change that would make a different
 #: framework version route or parse shuffle data differently (e.g. r3's
 #: _stable_key_hash fast-path rewrite → 2; r7's composite commit layout —
-#: fat indexes, snapshot wire v2, registration composite coordinates → 3).
+#: fat indexes, snapshot wire v2, registration composite coordinates → 3;
+#: r10's coded shuffle plane — parity sidecars, index geometry trailer,
+#: fat-index v2 header, snapshot wire v3, registration parity field → 4).
 #: Driver and all workers of one job must run the same value; re-reading
 #: kept shuffle data (cleanup=False) across versions is unsupported.
-SHUFFLE_FORMAT_VERSION = 3
+SHUFFLE_FORMAT_VERSION = 4
 
 BUILD_INFO = {
     "name": "s3shuffle_tpu",

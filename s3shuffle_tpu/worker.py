@@ -109,6 +109,16 @@ def read_input_batches(backend, path: str):
 # ---------------------------------------------------------------------------
 
 
+def _with_sealed_parity(map_output, parity_segments: int):
+    """Append a seal-decided parity count to a deferred registration
+    payload — parity is the format-4 word at index 7, decided only when
+    the composite group seals. Identity when parity is off or there is
+    no payload to amend."""
+    if map_output is None or parity_segments <= 0:
+        return map_output
+    return list(map_output[:7]) + [int(parity_segments)]
+
+
 class StaleAttemptError(RuntimeError):
     """This attempt's lease was reaped (worker presumed dead) and another
     attempt owns the task now — abandon quietly, touch nothing shared."""
@@ -156,7 +166,10 @@ class WorkerAgent:
         self._pending_composite: dict = {}  # (sid, mid) ->
         # (stage_id, task, result, map_output, stats) — stats is the task's
         # own drained outbox slice, pushed/discarded with its report
-        self._sealed_members: set = set()
+        # (sid, mid) -> parity segment count of the sealed group: members
+        # whose group sealed during their OWN commit report on the normal
+        # path, which appends the seal-decided parity from here
+        self._sealed_members: dict = {}
         if self.manager.composite is not None:
             self.manager.composite.on_group_commit = self._on_group_sealed
             self.manager.composite.on_group_abort = self._on_group_aborted
@@ -228,8 +241,14 @@ class WorkerAgent:
             deferred = message is not None and message.deferred
             if deferred:
                 # composite coordinates ride the registration payload; the
-                # report itself waits for the group seal (see run_once)
+                # report itself waits for the group seal (see run_once).
+                # The composite object's parity count is only known at the
+                # seal — _on_group_sealed appends it then.
                 payload += [int(message.composite_group), int(message.base_offset)]
+            elif message is not None and message.parity_segments > 0:
+                # coded singleton: parity count rides the registration
+                # (composite coordinates take their defaults positionally)
+                payload += [-1, 0, int(message.parity_segments)]
             captured.update(map_output=payload, deferred=deferred)
 
         writer.on_commit = capture
@@ -342,9 +361,12 @@ class WorkerAgent:
             key = (shuffle_id, m.map_id)
             entry = self._pending_composite.pop(key, None)
             if entry is None:
-                self._sealed_members.add(key)
+                self._sealed_members[key] = int(getattr(m, "parity_segments", 0))
                 continue
             stage_id, task, result, map_output, stats = entry
+            map_output = _with_sealed_parity(
+                map_output, int(getattr(m, "parity_segments", 0))
+            )
             self._report_completion(
                 stage_id, task, result, map_output, "map", stats=stats
             )
@@ -357,7 +379,7 @@ class WorkerAgent:
         for m in members:
             key = (shuffle_id, m.map_id)
             entry = self._pending_composite.pop(key, None)
-            self._sealed_members.discard(key)
+            self._sealed_members.pop(key, None)
             if entry is None:
                 continue
             stage_id, task, _result, _map_output, _stats = entry
@@ -467,8 +489,10 @@ class WorkerAgent:
                 key = (int(map_output[0]), int(map_output[1]))
                 if key in self._sealed_members:
                     # the group sealed during this very commit (size/count
-                    # threshold): report on the normal path below
-                    self._sealed_members.discard(key)
+                    # threshold): report on the normal path below, with the
+                    # seal-decided parity count appended to the payload
+                    sealed_parity = self._sealed_members.pop(key)
+                    map_output = _with_sealed_parity(map_output, sealed_parity)
                 else:
                     # capture THIS task's stats entries now (the outbox holds
                     # only them — reports since the last drain were this
@@ -549,6 +573,7 @@ class WorkerAgent:
             ShuffleChecksumBlockId,
             ShuffleDataBlockId,
             ShuffleIndexBlockId,
+            ShuffleParityBlockId,
         )
 
         dispatcher = self.manager.dispatcher
@@ -573,6 +598,17 @@ class WorkerAgent:
                         sid, mid, algorithm=dispatcher.config.checksum_algorithm
                     ),
                 ]
+                # coded plane: the attempt's parity sidecars landed before
+                # its index — drop them with the rest (payload position 7
+                # when the commit recorded it; the local knob otherwise)
+                parity_n = (
+                    int(map_output[7])
+                    if len(map_output) > 7
+                    else dispatcher.config.parity_segments
+                )
+                blocks.extend(
+                    ShuffleParityBlockId(sid, mid, seg) for seg in range(parity_n)
+                )
                 for block in blocks:
                     dispatcher.backend.delete(dispatcher.get_path(block))
             elif kind == "reduce" and isinstance(result, dict) and result.get("path"):

@@ -41,7 +41,9 @@ from s3shuffle_tpu.block_ids import (
     ShuffleDataBlockId,
     ShuffleFatIndexBlockId,
     ShuffleIndexBlockId,
+    ShuffleParityBlockId,
 )
+from s3shuffle_tpu.coding.parity import split_index_geometry
 from s3shuffle_tpu.metadata.fat_index import FatIndex, FatIndexMember
 from s3shuffle_tpu.metadata.helper import ShuffleHelper
 from s3shuffle_tpu.metadata.map_output import STORE_LOCATION, MapStatus
@@ -76,6 +78,7 @@ class _Candidate:
     size: int
     offsets: np.ndarray
     checksums: Optional[np.ndarray]
+    parity_segments: int = 0
 
 
 def compact_shuffle(
@@ -158,8 +161,10 @@ def compact_shuffle(
         if size >= threshold:
             continue
         try:
-            offsets = helper.read_block_as_array(
-                ShuffleIndexBlockId(shuffle_id, idx.map_id)
+            offsets, geometry = split_index_geometry(
+                helper.read_block_as_array(
+                    ShuffleIndexBlockId(shuffle_id, idx.map_id)
+                )
             )
             checksums: Optional[np.ndarray] = None
             if cfg.checksum_enabled:
@@ -174,7 +179,12 @@ def compact_shuffle(
                 idx.map_id, shuffle_id, e,
             )
             continue
-        candidates.append(_Candidate(idx.map_id, int(size), offsets, checksums))
+        candidates.append(
+            _Candidate(
+                idx.map_id, int(size), offsets, checksums,
+                parity_segments=geometry.segments if geometry else 0,
+            )
+        )
     if len(candidates) < 2:
         return report
 
@@ -262,7 +272,7 @@ def compact_shuffle(
         # local helper so this process's next scan skips the per-map indexes
         if tracker is not None:
             tracker.register_map_outputs(shuffle_id, statuses)
-        for s in statuses:
+        for s, m in zip(statuses, members):
             helper.note_composite_location(
                 shuffle_id, s.map_id, s.composite_group, s.base_offset
             )
@@ -278,6 +288,16 @@ def compact_shuffle(
                         ShuffleChecksumBlockId(
                             shuffle_id, s.map_id, algorithm=cfg.checksum_algorithm
                         )
+                    )
+                )
+            # the singleton's parity covers the superseded data object:
+            # useless once the composite is live, so it rides the same
+            # tombstone generation (the composite's own re-encoded parity
+            # is the ROADMAP follow-on)
+            for i in range(m.parity_segments):
+                old_paths.append(
+                    dispatcher.get_path(
+                        ShuffleParityBlockId(shuffle_id, s.map_id, i)
                     )
                 )
         report.generations.append(dispatcher.stamp_generation(shuffle_id, old_paths))

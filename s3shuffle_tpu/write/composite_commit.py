@@ -83,6 +83,9 @@ class CompositeMember:
     lengths: np.ndarray
     checksums: Optional[np.ndarray]
     total_bytes: int
+    #: parity sidecars of the composite object this member landed in —
+    #: assigned at the group seal (0 until then / when uncoded)
+    parity_segments: int = 0
 
     def offsets(self) -> np.ndarray:
         """Member-relative cumulative offsets (the fat-index row)."""
@@ -101,6 +104,10 @@ class _OpenGroup:
         self.bytes = 0
         self.opened_monotonic = time.monotonic()
         self.sink = None  # created on the first non-empty append
+        #: coded plane: streaming parity tee over the composite payload
+        #: (created with the sink when parity_segments > 0)
+        self.parity = None
+        self.parity_blocks: List = []  # parity ids PUT (teardown deletes)
         #: serializes appends to THIS group's sequential stream only —
         #: commits for other shuffles' groups never wait on it
         self.lock = threading.Lock()
@@ -149,10 +156,55 @@ class CompositeCommitAggregator:
         self._tuner = getattr(dispatcher, "commit_tuner", None)
         self._lock = threading.Lock()
         self._groups: Dict[int, _OpenGroup] = {}
+        # In-flight seal accounting: between a group's detach-for-seal and
+        # the completion of its registration callback there is a window in
+        # which the group is in NO registry yet its members are not visible.
+        # flush_shuffle used to return immediately when another thread held
+        # a shuffle's group in that window — a reduce task could then scan
+        # before the members registered and silently lose their records
+        # (the LocalCluster/ShuffleContext composite record-loss bug,
+        # ROADMAP). Barrier flushes now wait for the counter to drain.
+        self._seal_cv = threading.Condition()
+        self._sealing: Dict[int, int] = {}
 
     @property
     def enabled(self) -> bool:
         return self.max_members > 1
+
+    # -- in-flight seal accounting (group-visibility barrier) ----------
+    def _note_seal_begin(self, shuffle_id: int) -> None:
+        """Must be called ATOMICALLY with the detach that claims a group
+        for sealing (under the group's lock), so no barrier flush can slip
+        between the claim and the counter."""
+        with self._seal_cv:
+            self._sealing[shuffle_id] = self._sealing.get(shuffle_id, 0) + 1
+
+    def _note_seal_end(self, shuffle_id: int) -> None:
+        with self._seal_cv:
+            left = self._sealing.get(shuffle_id, 1) - 1
+            if left <= 0:
+                self._sealing.pop(shuffle_id, None)
+            else:
+                self._sealing[shuffle_id] = left
+            self._seal_cv.notify_all()
+
+    def _await_seals(self, shuffle_id: Optional[int]) -> None:
+        """Block until no seal of ``shuffle_id`` (None = any shuffle) is in
+        flight — the read-your-writes half of the commit barrier: when this
+        returns, every previously claimed group has either registered its
+        members (on_group_commit done) or failed them loudly
+        (on_group_abort done)."""
+
+        def pending() -> bool:
+            if shuffle_id is None:
+                return bool(self._sealing)
+            return self._sealing.get(shuffle_id, 0) > 0
+
+        with self._seal_cv:
+            while pending():
+                # seal completion always notifies; the timeout is only a
+                # missed-notify backstop, not a polling interval
+                self._seal_cv.wait(timeout=2.0)
 
     def _seal_thresholds(self) -> tuple:
         """The seal-point consult: (member-count cap, byte cap)."""
@@ -183,6 +235,12 @@ class CompositeCommitAggregator:
             return
         if group.sink is None:
             group.sink = self._make_sink(group)
+            from s3shuffle_tpu.coding.parity import accumulator_from_config
+
+            # coded plane: one streaming tee per composite object — parity
+            # is group-level (the object is the unit of loss), encoded as
+            # the appends flow, emitted at the seal
+            group.parity = accumulator_from_config(self.dispatcher.config)
         buffer_size = self.dispatcher.config.buffer_size
         copied = 0
         while True:
@@ -190,6 +248,8 @@ class CompositeCommitAggregator:
             if not chunk:
                 break
             group.sink.write(chunk)
+            if group.parity is not None:
+                group.parity.update(chunk)
             copied += len(chunk)
         if copied != total_bytes:
             raise IOError(
@@ -242,6 +302,9 @@ class CompositeCommitAggregator:
                     # detach the torn group; its (possibly slow) store
                     # teardown and the abort callback run OUTSIDE the locks
                     group.detached = True
+                    self._note_seal_begin(shuffle_id)  # barrier covers the
+                    # teardown window too: a concurrent flush must not
+                    # return before on_group_abort failed the members
                     doomed = list(group.members)
                     group.members = []
                     failure = (group, doomed, e)
@@ -260,19 +323,26 @@ class CompositeCommitAggregator:
                 members_cap, bytes_cap = self._seal_thresholds()
                 if len(group.members) >= members_cap or group.bytes >= bytes_cap:
                     group.detached = True
+                    self._note_seal_begin(shuffle_id)  # atomic with detach
                     seal_now = True
             break
         self._discard_from_registry(shuffle_id, group)  # no-op unless detached
         if failure is not None:
             failed_group, doomed, exc = failure
-            self._drop_failed_group(failed_group)
-            # prior members' bytes are gone with the dropped object: fail
-            # them through the abort callback before this commit raises
-            if doomed and self.on_group_abort is not None:
-                self.on_group_abort(shuffle_id, doomed, exc)
+            try:
+                self._drop_failed_group(failed_group)
+                # prior members' bytes are gone with the dropped object: fail
+                # them through the abort callback before this commit raises
+                if doomed and self.on_group_abort is not None:
+                    self.on_group_abort(shuffle_id, doomed, exc)
+            finally:
+                self._note_seal_end(shuffle_id)
             raise exc
         if seal_now:
-            self._finish(group)
+            try:
+                self._finish(group)
+            finally:
+                self._note_seal_end(shuffle_id)
         # age-based sealing rides every aggregator touch: other shuffles'
         # stale groups seal here too, not just on worker idle polls. A
         # STALE group's seal failure must not fail THIS map's commit — its
@@ -294,12 +364,15 @@ class CompositeCommitAggregator:
 
     def _detach(self, group: _OpenGroup) -> bool:
         """Claim a group for sealing/teardown: waits for any in-flight
-        append to finish, then marks it detached. False ⇒ another thread
-        already claimed it (exactly one seal per group)."""
+        append to finish, then marks it detached (and opens this group's
+        in-flight seal window — callers MUST pair a True return with
+        ``_note_seal_end``). False ⇒ another thread already claimed it
+        (exactly one seal per group)."""
         with group.lock:
             if group.detached:
                 return False
             group.detached = True
+            self._note_seal_begin(group.shuffle_id)
             return True
 
     def _drop_failed_group(self, group: _OpenGroup) -> None:
@@ -321,6 +394,10 @@ class CompositeCommitAggregator:
                 "delete of failed composite %s failed",
                 group.data_block.name, exc_info=True,
             )
+        if group.parity_blocks:
+            from s3shuffle_tpu.coding.parity import delete_parity_objects
+
+            delete_parity_objects(self.dispatcher, group.parity_blocks)
 
     # ------------------------------------------------------------------
     def _finish(self, group: _OpenGroup) -> None:
@@ -342,6 +419,19 @@ class CompositeCommitAggregator:
                             f"does not match appended bytes {group.bytes}"
                         )
                     group.sink.close()  # final flush; pipelined close blocks
+                geometry = None
+                if group.parity is not None and group.bytes > 0:
+                    # parity sidecars land BEFORE the fat index — committed
+                    # by it, orphans without it (the per-map contract)
+                    from s3shuffle_tpu.coding.parity import put_parity_objects
+
+                    payloads = group.parity.finish()
+                    geometry = group.parity.geometry
+                    group.parity_blocks = put_parity_objects(
+                        self.dispatcher, group.data_block, geometry, payloads
+                    )
+                    for m in group.members:
+                        m.parity_segments = geometry.segments
                 fat = FatIndex(
                     group.shuffle_id,
                     group.group_id,
@@ -356,6 +446,7 @@ class CompositeCommitAggregator:
                         )
                         for m in group.members
                     ],
+                    parity=geometry,
                 )
                 # small idempotent-by-overwrite PUT, re-driven at object
                 # granularity like the per-map sidecars; it stays the LAST
@@ -421,22 +512,47 @@ class CompositeCommitAggregator:
             except Exception as e:
                 if first_exc is None:
                     first_exc = e
+            finally:
+                self._note_seal_end(group.shuffle_id)
         if first_exc is not None:
             raise first_exc
         return sealed
 
     def flush_shuffle(self, shuffle_id: int) -> None:
-        """Commit-barrier flush: seal this shuffle's open group now."""
+        """Commit-barrier flush: seal this shuffle's open group now, then
+        wait out any seal another thread already has in flight — when this
+        returns, every previously committed member of the shuffle is
+        REGISTERED (or loudly failed), so a reader built next can never
+        scan past an invisible group (the composite record-loss fix)."""
         with self._lock:
             group = self._groups.pop(shuffle_id, None)
-        if group is not None:
-            self._finish_each([group])
+            # open the seal window under the registry lock, atomically with
+            # the pop: _finish_each's _detach can block on a slow in-flight
+            # append before ITS begin fires, and in that gap a sibling
+            # barrier flush would see neither the group nor a seal in
+            # flight and return before the members registered
+            if group is not None:
+                self._note_seal_begin(shuffle_id)
+        try:
+            if group is not None:
+                self._finish_each([group])
+        finally:
+            if group is not None:
+                self._note_seal_end(shuffle_id)
+            self._await_seals(shuffle_id)
 
     def flush_all(self) -> None:
         with self._lock:
             groups = list(self._groups.values())
             self._groups = {}
-        self._finish_each(groups)
+            for g in groups:  # pop→detach gap: see flush_shuffle
+                self._note_seal_begin(g.shuffle_id)
+        try:
+            self._finish_each(groups)
+        finally:
+            for g in groups:
+                self._note_seal_end(g.shuffle_id)
+            self._await_seals(None)
 
     def abort_shuffle(self, shuffle_id: int) -> None:
         """Drop this shuffle's open group WITHOUT sealing (shuffle
@@ -444,8 +560,17 @@ class CompositeCommitAggregator:
         write objects for the prefix delete to reclaim)."""
         with self._lock:
             group = self._groups.pop(shuffle_id, None)
-        if group is not None and self._detach(group):
-            self._drop_failed_group(group)
+            if group is not None:  # pop→detach gap: see flush_shuffle
+                self._note_seal_begin(shuffle_id)
+        try:
+            if group is not None and self._detach(group):
+                try:
+                    self._drop_failed_group(group)
+                finally:
+                    self._note_seal_end(shuffle_id)
+        finally:
+            if group is not None:
+                self._note_seal_end(shuffle_id)
 
     def maybe_flush_stale(self, now: Optional[float] = None) -> int:
         """Age-based sealing, checked on every aggregator touch (no
@@ -459,7 +584,12 @@ class CompositeCommitAggregator:
             for sid, group in list(self._groups.items()):
                 if (now - group.opened_monotonic) * 1000.0 >= self.flush_ms:
                     doomed.append(self._groups.pop(sid))
-        return self._finish_each(doomed)
+                    self._note_seal_begin(sid)  # pop→detach gap: see flush_shuffle
+        try:
+            return self._finish_each(doomed)
+        finally:
+            for g in doomed:
+                self._note_seal_end(g.shuffle_id)
 
     def close(self) -> None:
         self.flush_all()

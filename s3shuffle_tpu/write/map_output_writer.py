@@ -51,6 +51,9 @@ class MapOutputCommitMessage:
     #: commit point), which the aggregator's on_group_commit signals.
     composite_group: int = -1
     base_offset: int = 0
+    #: parity sidecars emitted for the data object holding this output
+    #: (coding/parity.py); 0 = uncoded. Rides the MapStatus registration.
+    parity_segments: int = 0
 
     @property
     def deferred(self) -> bool:
@@ -86,6 +89,15 @@ class MapOutputWriter:
         self._stream: Optional[io.RawIOBase] = None
         self._object_created = False  # create_block ran (even if a later sink
         # constructor failed) — abort() must delete exactly when this is set
+        # Coded shuffle plane (coding/): streaming parity tee over the data
+        # object's bytes. None when parity_segments=0 (op-for-op off switch)
+        # or in composite mode (the aggregator encodes at group level).
+        self._parity_acc = None
+        if self._composite is None:
+            from s3shuffle_tpu.coding.parity import accumulator_from_config
+
+            self._parity_acc = accumulator_from_config(cfg)
+        self._parity_blocks: list = []  # parity ids PUT (abort deletes them)
         self._total_bytes = 0
         self._last_partition_id = -1
         self._committed = False
@@ -188,6 +200,7 @@ class MapOutputWriter:
                     f"sum of partition lengths {self._total_bytes}"
                 )
             self._stream.close()  # final flush to the store, logs bandwidth
+        geometry = self._emit_parity()
         if self._total_bytes > 0 or self.dispatcher.config.always_create_index:
             from s3shuffle_tpu.storage.retrying import retry_call
 
@@ -209,9 +222,12 @@ class MapOutputWriter:
                 )
             # Index written LAST: it is the commit point — a data object with
             # no index is invisible to readers (S3ShuffleBlockIterator.scala:46-53).
+            # With parity on it also carries the stripe-geometry trailer, so
+            # the parity sidecars (PUT above, before this) become committed
+            # exactly when the data object does.
             retry_call(
                 lambda: self.helper.write_partition_lengths(
-                    self.shuffle_id, self.map_id, self._lengths
+                    self.shuffle_id, self.map_id, self._lengths, parity=geometry
                 ),
                 policy, op="commit_index", scheme=scheme,
             )
@@ -222,7 +238,26 @@ class MapOutputWriter:
                 time.perf_counter() - commit_t0, self._total_bytes
             )
         checksums = self._checksum_values if self._checksums_enabled else None
-        return MapOutputCommitMessage(self._lengths, checksums)
+        return MapOutputCommitMessage(
+            self._lengths, checksums,
+            parity_segments=0 if geometry is None else geometry.segments,
+        )
+
+    def _emit_parity(self):
+        """PUT the parity sidecars for this map's data object — BEFORE the
+        index (the commit point), so a crash in between leaves only orphans
+        the sweeps reclaim. Returns the geometry for the index trailer, or
+        None when the coded plane is off / the map is empty."""
+        if self._parity_acc is None or self._total_bytes == 0:
+            return None
+        from s3shuffle_tpu.coding.parity import put_parity_objects
+
+        payloads = self._parity_acc.finish()
+        geometry = self._parity_acc.geometry
+        self._parity_blocks = put_parity_objects(
+            self.dispatcher, self._block, geometry, payloads
+        )
+        return geometry
 
     def _commit_composite(self) -> MapOutputCommitMessage:
         """Hand the fully-drained payload to the composite aggregator.
@@ -293,6 +328,12 @@ class MapOutputWriter:
                     self._block.name, exc_info=True,
                 )
         self.dispatcher.backend.delete(self.dispatcher.get_path(self._block))
+        if self._parity_blocks:
+            from s3shuffle_tpu.coding.parity import delete_parity_objects
+
+            # parity sidecars PUT before the (never-written) index: drop
+            # them with the data object rather than leaving sweep work
+            delete_parity_objects(self.dispatcher, self._parity_blocks)
         logger.warning(
             "Aborted map output %s: %s", self._block.name, error if error else "unknown"
         )
@@ -324,6 +365,10 @@ class PartitionWriter(io.RawIOBase):
             stream.write(b)
             if self._checksum is not None:
                 self._checksum.update(b)
+            if self._parent._parity_acc is not None:
+                # coded plane tee: the streaming parity encoder sees every
+                # stored byte exactly once, in object order
+                self._parent._parity_acc.update(b)
             self._count += n
         return n
 

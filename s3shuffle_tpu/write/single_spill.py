@@ -40,6 +40,24 @@ class SingleSpillMapOutputWriter:
         block = ShuffleDataBlockId(self.shuffle_id, self.map_id)
         dst = self.dispatcher.get_path(block)
         size = os.path.getsize(spill_path)
+        # Coded plane tee: the spill is LOCAL, so stripe it before the move
+        # (the rename below makes the source vanish). Parity PUTs land
+        # before the index — committed-by-index, same as the main writer;
+        # without this tee, single-spill outputs would be silently exempt
+        # from the plane's loss guarantee.
+        from s3shuffle_tpu.coding.parity import (
+            accumulator_from_config,
+            put_parity_objects,
+        )
+
+        acc = accumulator_from_config(self.dispatcher.config) if size else None
+        if acc is not None:
+            with open(spill_path, "rb") as src:
+                while True:
+                    piece = src.read(self.dispatcher.config.buffer_size)
+                    if not piece:
+                        break
+                    acc.update(piece)
         # Rename only works when the store IS the local filesystem (the spill
         # file lives locally) — the reference's condition is "root is file://"
         # (S3SingleSpillShuffleMapOutputWriter.scala:31-52), not merely
@@ -63,6 +81,13 @@ class SingleSpillMapOutputWriter:
                 shutil.copyfileobj(src, sink, length=self.dispatcher.config.buffer_size)
             sink.close()
             os.remove(spill_path)
+        geometry = None
+        if acc is not None:
+            payloads = acc.finish()
+            geometry = acc.geometry
+            put_parity_objects(self.dispatcher, block, geometry, payloads)
         if checksums is not None and self.dispatcher.config.checksum_enabled:
             self.helper.write_checksums(self.shuffle_id, self.map_id, checksums)
-        self.helper.write_partition_lengths(self.shuffle_id, self.map_id, partition_lengths)
+        self.helper.write_partition_lengths(
+            self.shuffle_id, self.map_id, partition_lengths, parity=geometry
+        )

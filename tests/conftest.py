@@ -116,3 +116,27 @@ def _lock_witness_verdict():
         report = _WITNESS.format_report()
         print("\n" + report)
         assert not _WITNESS.find_cycles(), report
+
+
+# Product import is safe here: the lock witness installed above, at module
+# top, before any s3shuffle_tpu import.
+from s3shuffle_tpu.storage.fault import FlakyBackend  # noqa: E402
+
+
+class RecordingBackend(FlakyBackend):
+    """FlakyBackend that records every (op, path) it sees — the request
+    pattern the store would bill for. Shared by the op-for-op regression
+    gates (coalesce gap=0, composite off, autotune off, parity=0): one
+    definition, so a change to FlakyBackend's _check hook or the
+    op-multiset convention cannot silently weaken one gate."""
+
+    def __init__(self, inner):
+        super().__init__(inner)
+        self.ops = []
+
+    def _check(self, op: str, path: str) -> None:
+        self.ops.append((op, path))
+        super()._check(op, path)
+
+    def count(self, op: str, needle: str = "") -> int:
+        return sum(1 for o, p in self.ops if o == op and needle in p)

@@ -37,17 +37,7 @@ from s3shuffle_tpu.tuning import CommitTuner, Controller, ScanTuner, geometric_l
 from s3shuffle_tpu.write.map_output_writer import MapOutputWriter
 
 
-class RecordingBackend(FlakyBackend):
-    """FlakyBackend that records every (op, path) it sees — the request
-    pattern the store would bill for."""
-
-    def __init__(self, inner):
-        super().__init__(inner)
-        self.ops = []
-
-    def _check(self, op: str, path: str) -> None:
-        self.ops.append((op, path))
-        super()._check(op, path)
+from conftest import RecordingBackend  # noqa: E402
 
 
 @pytest.fixture(autouse=True)
@@ -378,28 +368,29 @@ def test_commit_tuner_retunes_bound_codec_window():
 # ---------------------------------------------------------------------------
 
 
-def test_fetch_executor_reaps_idle_width(monkeypatch):
-    from s3shuffle_tpu.read import chunked_fetch as cf
+def test_fetch_executor_reaps_idle_width():
+    # the grow/idle-reap lifecycle both the ranged-GET pool
+    # (read/chunked_fetch.py) and the speculation pool (coding/degraded.py)
+    # bind — tested on a fresh instance of the shared helper
+    from s3shuffle_tpu.utils.growpool import GrowReapExecutor
 
-    # isolate from whatever width earlier tests left behind
-    monkeypatch.setattr(cf, "_executor", None)
-    monkeypatch.setattr(cf, "_executor_width", 0)
-    monkeypatch.setattr(cf, "_executor_wide_use", 0.0)
-
-    cf._submit_fetch(8, lambda: None).result()
-    assert cf._executor_width == 8
-    wide_pool = cf._executor
-    # narrow submits inside the idle window keep the wide pool
-    cf._submit_fetch(2, lambda: None).result()
-    assert cf._executor_width == 8 and cf._executor is wide_pool
-    # age the wide-use stamp past the reap window: the next narrow submit
-    # swaps the pool down (a one-off wide scan no longer pins 8 threads)
-    monkeypatch.setattr(
-        cf, "_executor_wide_use", time.monotonic() - cf._EXECUTOR_REAP_IDLE_S - 1
-    )
-    cf._submit_fetch(2, lambda: None).result()
-    assert cf._executor_width == 2 and cf._executor is not wide_pool
-    # growing again works and refreshes the stamp
-    cf._submit_fetch(4, lambda: None).result()
-    assert cf._executor_width == 4
-    assert time.monotonic() - cf._executor_wide_use < 5.0
+    ex = GrowReapExecutor("test-reap", reap_idle_s=30.0)
+    try:
+        ex.submit(8, lambda: None).result()
+        assert ex.width == 8
+        wide_pool = ex.pool
+        # narrow submits inside the idle window keep the wide pool
+        ex.submit(2, lambda: None).result()
+        assert ex.width == 8 and ex.pool is wide_pool
+        # age the wide-use stamp past the reap window: the next narrow
+        # submit swaps the pool down (a one-off wide scan no longer pins 8
+        # threads)
+        ex.wide_use = time.monotonic() - ex.reap_idle_s - 1
+        ex.submit(2, lambda: None).result()
+        assert ex.width == 2 and ex.pool is not wide_pool
+        # growing again works and refreshes the stamp
+        ex.submit(4, lambda: None).result()
+        assert ex.width == 4
+        assert time.monotonic() - ex.wide_use < 5.0
+    finally:
+        ex.pool.shutdown(wait=True)

@@ -1,0 +1,895 @@
+"""Coded shuffle plane (coding/ + write/read wiring + metadata geometry).
+
+The plane's contract: ``parity_segments = 0`` is op-for-op identical to the
+uncoded store request pattern; with parity on, the data objects' BYTES are
+unchanged (parity is pure sidecar redundancy); a lost data object
+reconstructs byte-identically from parity whenever the survivors suffice
+(always, for full-object loss, when ``m >= k``) and degrades to the exact
+pre-coding logged-EOF → ChecksumError behavior when they don't; straggler
+GETs past the fill-histogram quantile are raced against reconstruction; and
+the lifecycle sweeps treat ``.parity`` as committed-by-index.
+"""
+
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from s3shuffle_tpu.block_ids import (
+    ShuffleBlockId,
+    ShuffleCompositeDataBlockId,
+    ShuffleCompositeParityBlockId,
+    ShuffleDataBlockId,
+    ShuffleParityBlockId,
+    parse_composite_name,
+    parse_shuffle_object_name,
+)
+from s3shuffle_tpu.coding import gf
+from s3shuffle_tpu.coding.parity import (
+    ParityAccumulator,
+    ParityGeometry,
+    parity_header,
+    parse_parity_header,
+    split_index_geometry,
+)
+from s3shuffle_tpu.config import ShuffleConfig
+from s3shuffle_tpu.metadata.helper import ScanIndexMemo, ShuffleHelper
+from s3shuffle_tpu.metrics import registry as mreg
+from s3shuffle_tpu.storage.dispatcher import Dispatcher
+from s3shuffle_tpu.storage.fault import FlakyBackend, LatencyRule
+from s3shuffle_tpu.write.composite_commit import CompositeCommitAggregator
+from s3shuffle_tpu.write.map_output_writer import MapOutputWriter
+
+
+from conftest import RecordingBackend  # noqa: E402
+
+
+@pytest.fixture
+def metrics_on():
+    mreg.REGISTRY.reset_values()
+    mreg.enable()
+    yield mreg.REGISTRY
+    mreg.disable()
+    mreg.REGISTRY.reset_values()
+
+
+def _env(tmp_path, tag, **cfg_kwargs):
+    Dispatcher.reset()
+    cfg = ShuffleConfig(root_dir=f"file://{tmp_path}/{tag}", app_id=tag, **cfg_kwargs)
+    d = Dispatcher(cfg)
+    return cfg, d, ShuffleHelper(d)
+
+
+def _write_maps(d, helper, sid, sizes, seed=0, agg=None):
+    rng = random.Random(seed)
+    truth = {}
+    for m, row in enumerate(sizes):
+        w = MapOutputWriter(d, helper, sid, m, len(row), aggregator=agg)
+        for p, n in enumerate(row):
+            data = rng.randbytes(n)
+            truth[(m, p)] = data
+            pw = w.get_partition_writer(p)
+            if data:
+                pw.write(data)
+            pw.close()
+        w.commit_all_partitions()
+    return truth
+
+
+def _scan(d, helper, cfg, sid, sizes):
+    from s3shuffle_tpu.read.chunked_fetch import ChunkedRangeFetcher
+    from s3shuffle_tpu.read.scan_plan import build_scan_iterator
+
+    blocks = [
+        ShuffleBlockId(sid, m, p)
+        for m in range(len(sizes))
+        for p in range(len(sizes[m]))
+    ]
+    it = build_scan_iterator(
+        d, ScanIndexMemo(helper), blocks, cfg,
+        fetcher=ChunkedRangeFetcher.from_config(cfg),
+    )
+    got = {}
+    for s in it:
+        got[(s.block.map_id, s.block.reduce_id)] = s.readall()
+        s.close()
+    return got
+
+
+def _reconstructions(registry, reason):
+    snap = registry.snapshot(compact=True)
+    return sum(
+        s["value"]
+        for s in snap.get("shuffle_parity_reconstructions_total", {}).get("series", [])
+        if s.get("labels", {}).get("reason") == reason
+    )
+
+
+# ---------------------------------------------------------------------------
+# GF math
+# ---------------------------------------------------------------------------
+
+
+def test_gf_tables_and_inverse():
+    for a in range(1, 256):
+        assert gf.gf_mul(a, gf.gf_inv(a)) == 1
+    assert gf.gf_mul(0, 200) == 0 and gf.gf_mul(7, 0) == 0
+
+
+@pytest.mark.parametrize("k,m", [(1, 1), (2, 1), (3, 2), (2, 2), (4, 2)])
+def test_encode_decode_every_erasure_pattern(k, m):
+    """Any <= m erased data chunks recover from the survivors, for every
+    erasure pattern — the MDS property the loss/straggler paths rely on."""
+    from itertools import combinations
+
+    rng = np.random.default_rng(11)
+    length = 257
+    chunks = rng.integers(0, 256, size=(1, k, length), dtype=np.uint8)
+    coefs = gf.parity_coefficients(m, k)
+    parity = gf.encode_groups(chunks, coefs)[0]  # [m, L]
+    # row 0 is plain XOR
+    assert (parity[0] == np.bitwise_xor.reduce(chunks[0], axis=0)).all()
+    parities = {i: parity[i] for i in range(m)}
+    for n_erased in range(1, m + 1):
+        for erased in combinations(range(k), n_erased):
+            present = {
+                j: chunks[0, j] for j in range(k) if j not in erased
+            }
+            out = gf.recover_group(k, coefs, present, parities, list(erased))
+            assert out is not None, f"unrecoverable: erased {erased}"
+            for j in erased:
+                assert (out[j] == chunks[0, j]).all()
+
+
+def test_decode_insufficient_survivors_returns_none():
+    coefs = gf.parity_coefficients(1, 2)
+    chunks = np.arange(16, dtype=np.uint8).reshape(2, 8)
+    parity = gf.encode_groups(chunks[None], coefs)[0]
+    # both data chunks gone, only one parity: underdetermined
+    assert gf.recover_group(2, coefs, {}, {0: parity[0]}, [0, 1]) is None
+
+
+def test_batched_encode_matches_per_group():
+    rng = np.random.default_rng(3)
+    coefs = gf.parity_coefficients(2, 3)
+    batch = rng.integers(0, 256, size=(9, 3, 64), dtype=np.uint8)
+    whole = gf.encode_groups(batch, coefs)
+    for g in range(9):
+        single = gf.encode_groups(batch[g : g + 1], coefs)
+        assert (whole[g] == single[0]).all()
+
+
+def test_device_kernel_matches_host_when_available():
+    try:
+        import jax  # noqa: F401
+    except Exception:
+        pytest.skip("jax not importable")
+    rng = np.random.default_rng(5)
+    coefs = gf.parity_coefficients(2, 2)
+    batch = rng.integers(0, 256, size=(4, 2, 128), dtype=np.uint8)
+    host = gf._encode_host(batch, coefs)
+    device = gf._encode_device(batch, coefs)
+    if device is None:
+        pytest.skip("device kernel pinned to host in this environment")
+    assert (host == device).all()
+
+
+# ---------------------------------------------------------------------------
+# Streaming accumulator + wire formats
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("k,m,chunk", [(1, 1, 64), (2, 1, 100), (3, 2, 57)])
+def test_accumulator_streaming_equals_whole_payload(k, m, chunk):
+    """Arbitrary write-chunk boundaries produce the same parity bytes as
+    one whole-payload encode — the streaming tee cannot depend on how the
+    commit happens to slice its writes."""
+    rng = random.Random(17)
+    payload = rng.randbytes(5 * k * chunk + 23)  # partial tail group
+
+    whole = ParityAccumulator(m, k, chunk)
+    whole.update(payload)
+    expected = whole.finish()
+
+    pieces = ParityAccumulator(m, k, chunk)
+    pos = 0
+    while pos < len(payload):
+        n = rng.randrange(1, 3 * chunk)
+        pieces.update(payload[pos : pos + n])
+        pos += n
+    assert pieces.finish() == expected
+    geom = pieces.geometry
+    assert geom.payload_len == len(payload)
+    # parity length: one chunk-sized slice per full group + short tail
+    assert len(expected[0]) == sum(
+        geom.group_parity_len(g) for g in range(geom.n_groups)
+    )
+
+
+def test_parity_header_roundtrip_and_rejects():
+    geom = ParityGeometry(2, 3, 4096, 100_000)
+    block = ShuffleDataBlockId(7, 3)
+    data = parity_header(block, geom, 1)
+    assert parse_parity_header(data) == geom
+    with pytest.raises(ValueError):
+        parse_parity_header(b"\x00" * 64)
+    with pytest.raises(ValueError):
+        parse_parity_header(b"short")
+
+
+def test_index_geometry_trailer_roundtrip():
+    offsets = np.array([0, 10, 30], dtype=np.int64)
+    geom = ParityGeometry(1, 2, 512, 30)
+    from s3shuffle_tpu.coding.parity import geometry_trailer_words
+
+    words = np.concatenate([offsets, geometry_trailer_words(geom)])
+    back_offsets, back_geom = split_index_geometry(words)
+    assert (back_offsets == offsets).all()
+    assert back_geom == geom
+    # trailer-less blobs pass through untouched (reference wire compat)
+    plain, none = split_index_geometry(offsets)
+    assert none is None and (plain == offsets).all()
+
+
+def test_fat_index_v2_parity_roundtrip_and_v1_parse():
+    from s3shuffle_tpu.metadata.fat_index import FatIndex, FatIndexMember
+
+    member = FatIndexMember(5, 5, 0, np.array([0, 9], dtype=np.int64))
+    geom = ParityGeometry(2, 2, 1024, 9)
+    fat = FatIndex(1, 5, 1, [member], parity=geom)
+    back = FatIndex.from_bytes(fat.to_bytes())
+    assert back.parity == geom
+    uncoded = FatIndex.from_bytes(FatIndex(1, 5, 1, [member]).to_bytes())
+    assert uncoded.parity is None
+    # hand-build a v1 blob (7-word header) — still parses, no parity
+    v2 = FatIndex(1, 5, 1, [member]).to_bytes()
+    words = np.frombuffer(v2, dtype=">i8").astype(np.int64)
+    v1_words = np.concatenate([words[:7], words[11:]])
+    v1_words[1] = 1  # version
+    v1 = np.ascontiguousarray(v1_words, dtype=">i8").tobytes()
+    parsed = FatIndex.from_bytes(v1)
+    assert parsed.parity is None and parsed.member(5).total_bytes == 9
+
+
+def test_snapshot_wire_v3_carries_parity_and_reads_v2():
+    from s3shuffle_tpu.metadata.map_output import STORE_LOCATION, MapStatus
+    from s3shuffle_tpu.metadata.snapshot import MapOutputSnapshot
+
+    status = MapStatus(
+        map_id=4, location=STORE_LOCATION,
+        sizes=np.array([3, 5], dtype=np.int64), map_index=4,
+        parity_segments=2,
+    )
+    snap = MapOutputSnapshot(9, 1, 2, [(4, status)])
+    back = MapOutputSnapshot.from_bytes(snap.to_bytes())
+    assert back.entries[0][1].parity_segments == 2
+    # v2 blob (4 meta words, version stamp 2) still parses, parity 0
+    words = np.frombuffer(snap.to_bytes(), dtype=">i8").astype(np.int64)
+    v2_rows = np.concatenate([words[7:11], words[12:]])  # drop parity word
+    v2 = np.concatenate([words[:7], v2_rows])
+    v2[1] = 2
+    parsed = MapOutputSnapshot.from_bytes(
+        np.ascontiguousarray(v2, dtype=">i8").tobytes()
+    )
+    assert parsed.entries[0][1].parity_segments == 0
+    assert parsed.entries[0][1].sizes.tolist() == [3, 5]
+
+
+def test_parity_block_names_parse_for_sweeps():
+    assert parse_shuffle_object_name("shuffle_3_17_par0.parity") == (3, 17)
+    assert parse_shuffle_object_name(
+        ShuffleParityBlockId(3, 17, 1).name
+    ) == (3, 17)
+    assert parse_composite_name(
+        ShuffleCompositeParityBlockId(4, 9, 0).name
+    ) == (4, 9, "parity")
+    # parity never parses as an index (invisible to listing enumeration)
+    from s3shuffle_tpu.block_ids import parse_index_name
+
+    assert parse_index_name("shuffle_3_17_par0.parity") is None
+
+
+# ---------------------------------------------------------------------------
+# Loss reconstruction (the acceptance-criteria path)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("k,m", [(1, 1), (2, 2)], ids=["k1m1-mirror", "k2m2-rs"])
+def test_singleton_loss_any_data_object_reconstructs(tmp_path, metrics_on, k, m):
+    """With parity_segments >= 1 (and m >= k), deleting ANY single data
+    object per map still yields byte-identical reduce output — the seeded
+    loss acceptance criterion."""
+    sizes = [[3000, 0, 4111], [2048, 2048, 1], [1, 5000, 777]]
+    cfg, d, helper = _env(
+        tmp_path, f"loss{k}{m}",
+        parity_segments=m, parity_stripe_k=k, parity_chunk_bytes=1024,
+    )
+    truth = _write_maps(d, helper, 0, sizes, seed=k * 10 + m)
+    expected = {key: v for key, v in truth.items() if v}
+    assert _scan(d, helper, cfg, 0, sizes) == expected
+    # delete EVERY map's data object — each scan block must reconstruct
+    for map_id in range(len(sizes)):
+        d.backend.delete(d.get_path(ShuffleDataBlockId(0, map_id)))
+    d.clear_status_cache()
+    assert _scan(d, helper, cfg, 0, sizes) == expected
+    assert _reconstructions(metrics_on, "loss") >= len(sizes)
+
+
+@pytest.mark.parametrize("renameable", [True, False])
+def test_single_spill_path_emits_parity_and_loss_reconstructs(
+    tmp_path, metrics_on, renameable
+):
+    """The third commit path (SingleSpillMapOutputWriter, the dataio
+    committer API) must tee parity like the main writer and the composite
+    aggregator — otherwise its outputs are silently exempt from the coded
+    plane's loss guarantee. Covers both the rename fast path and the
+    stream-copy fallback."""
+    from s3shuffle_tpu.write.single_spill import SingleSpillMapOutputWriter
+
+    sizes = [[3000, 1500]]
+    cfg, d, helper = _env(
+        tmp_path, f"spill{int(renameable)}",
+        parity_segments=1, parity_stripe_k=1, parity_chunk_bytes=1024,
+    )
+    if not renameable:
+        d.supports_rename = False
+    payload = random.Random(11).randbytes(sum(sizes[0]))
+    spill = tmp_path / "spill.bin"
+    spill.write_bytes(payload)
+    w = SingleSpillMapOutputWriter(d, helper, 0, 0)
+    w.transfer_map_spill_file(str(spill), np.array(sizes[0], dtype=np.int64))
+    truth = {
+        (0, 0): payload[: sizes[0][0]],
+        (0, 1): payload[sizes[0][0] :],
+    }
+    assert _scan(d, helper, cfg, 0, sizes) == truth
+    d.backend.status(d.get_path(ShuffleParityBlockId(0, 0, 0)))  # sidecar PUT
+    d.backend.delete(d.get_path(ShuffleDataBlockId(0, 0)))
+    d.clear_status_cache()
+    assert _scan(d, ShuffleHelper(d), cfg, 0, sizes) == truth
+    assert _reconstructions(metrics_on, "loss") >= 1
+
+
+def test_multi_group_reconstruction_coalesces_parity_reads(tmp_path, metrics_on):
+    """Recovering a range spanning many stripe groups must read each parity
+    sidecar's touched span as ONE contiguous ranged GET (header + span),
+    not one GET per (group x segment) — on a high-RTT store the per-group
+    pattern can make reconstruction lose the very straggler race it
+    arms."""
+    from s3shuffle_tpu.storage.local import LocalBackend
+
+    n_groups = 8
+    sizes = [[n_groups * 1024, 512]]  # k=1: one group per 1 KiB chunk
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/span", app_id="span",
+        parity_segments=1, parity_stripe_k=1, parity_chunk_bytes=1024,
+    )
+    d = Dispatcher(cfg)
+    helper = ShuffleHelper(d)
+    rec = RecordingBackend(LocalBackend())
+    d.backend = rec
+    truth = _write_maps(d, helper, 0, sizes, seed=9)
+    d.backend.delete(d.get_path(ShuffleDataBlockId(0, 0)))
+    d.clear_status_cache()
+    rec.ops.clear()
+    assert _scan(d, helper, cfg, 0, sizes) == {k: v for k, v in truth.items() if v}
+    parity_reads = [
+        (op, p) for op, p in rec.ops if op == "read" and p.endswith(".parity")
+    ]
+    # one header read + one span read per reconstructed range (partition 0
+    # covers > 1 group; partition 1's single tail group is also one span)
+    assert len(parity_reads) <= 2 * _reconstructions(metrics_on, "loss")
+
+
+def test_tail_group_loss_recovers_with_phantom_pad_chunks(tmp_path, metrics_on):
+    """A payload shorter than k*chunk_bytes leaves a single short stripe
+    group whose missing positions are the ENCODER's zero-pad phantoms —
+    known survivors, so k=2/m=1 full-object loss of a tail-only object must
+    still reconstruct (one real chunk + one known-zero + one parity)."""
+    sizes = [[700]]  # < chunk_bytes: one group, one real chunk of k=2
+    cfg, d, helper = _env(
+        tmp_path, "tail",
+        parity_segments=1, parity_stripe_k=2, parity_chunk_bytes=1024,
+    )
+    truth = _write_maps(d, helper, 0, sizes, seed=5)
+    assert _scan(d, helper, cfg, 0, sizes) == truth
+    d.backend.delete(d.get_path(ShuffleDataBlockId(0, 0)))
+    d.clear_status_cache()
+    assert _scan(d, helper, cfg, 0, sizes) == truth
+    assert _reconstructions(metrics_on, "loss") >= 1
+
+
+def test_speculation_viability_gate():
+    """m<k objects (full groups unrecoverable parity-only) must not arm
+    races; m>=k and short tail-only objects must."""
+    from s3shuffle_tpu.coding.degraded import DegradedReader
+
+    reader = DegradedReader(dispatcher=None)
+    full = ShuffleDataBlockId(0, 0)
+    reader.register(full, ParityGeometry(1, 4, 1024, 64 * 1024))  # m<k, many groups
+    assert not reader.speculation_viable(full)
+    mirrored = ShuffleDataBlockId(0, 1)
+    reader.register(mirrored, ParityGeometry(1, 1, 1024, 64 * 1024))
+    assert reader.speculation_viable(mirrored)
+    tail_only = ShuffleDataBlockId(0, 2)
+    reader.register(tail_only, ParityGeometry(1, 4, 1024, 700))  # 1 real chunk
+    assert reader.speculation_viable(tail_only)
+
+
+def test_loss_without_sufficient_parity_falls_back_to_checksum_error(
+    tmp_path, metrics_on
+):
+    """k=2/m=1 cannot survive FULL-object loss: behavior must degrade to
+    exactly the pre-coding path — logged EOF surfaced as ChecksumError by
+    the validation downstream (here: short reads), never a wrong-bytes
+    success."""
+    sizes = [[4096, 4096]]
+    cfg, d, helper = _env(
+        tmp_path, "lossfb",
+        parity_segments=1, parity_stripe_k=2, parity_chunk_bytes=512,
+    )
+    truth = _write_maps(d, helper, 0, sizes, seed=2)
+    assert _scan(d, helper, cfg, 0, sizes) == truth
+    d.backend.delete(d.get_path(ShuffleDataBlockId(0, 0)))
+    d.clear_status_cache()
+    got = _scan(d, helper, cfg, 0, sizes)
+    # survivors insufficient: blocks surface as truncated (empty) streams,
+    # the logged-EOF contract checksum validation turns into ChecksumError
+    assert all(v == b"" for v in got.values())
+    assert _reconstructions(metrics_on, "loss") == 0
+
+
+def test_composite_loss_reconstructs_from_group_parity(tmp_path, metrics_on):
+    sizes = [[2500, 100], [900, 1800], [50, 4000]]
+    cfg, d, helper = _env(
+        tmp_path, "closs",
+        composite_commit_maps=3,
+        parity_segments=1, parity_stripe_k=1, parity_chunk_bytes=2048,
+    )
+    agg = CompositeCommitAggregator(d, helper)
+    truth = _write_maps(d, helper, 0, sizes, seed=6, agg=agg)
+    agg.flush_all()
+    # a FRESH helper (listing-mode discovery) on the intact layout
+    assert _scan(d, ShuffleHelper(d), cfg, 0, sizes) == truth
+    d.backend.delete(d.get_path(ShuffleCompositeDataBlockId(0, 0)))
+    d.clear_status_cache()
+    assert _scan(d, ShuffleHelper(d), cfg, 0, sizes) == truth
+    assert _reconstructions(metrics_on, "loss") >= 1
+
+
+def test_end_to_end_checksum_validates_reconstructed_bytes(tmp_path, metrics_on):
+    """Full ShuffleContext reduce over a lost data object: reconstruction
+    feeds the UNCHANGED per-block checksum validation — byte identity is
+    proven end to end, with zero tracker errors."""
+    from s3shuffle_tpu.dependency import HashPartitioner, ShuffleDependency
+    from s3shuffle_tpu.shuffle import ShuffleContext
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/e2e", app_id="e2e", cleanup=True,
+        parity_segments=1, parity_stripe_k=1, parity_chunk_bytes=4096,
+    )
+    rng = random.Random(42)
+    records = [(rng.randbytes(8), rng.randbytes(32)) for _ in range(4000)]
+    with ShuffleContext(config=cfg, num_workers=2) as ctx:
+        sid = next(ctx._next_shuffle_id)
+        dep = ShuffleDependency(sid, HashPartitioner(4))
+        handle = ctx.manager.register_shuffle(sid, dep)
+        per_map = len(records) // 2
+        for map_id in range(2):
+            w = ctx.manager.get_writer(handle, map_id)
+            w.write(records[map_id * per_map : (map_id + 1) * per_map])
+            w.stop(success=True)
+        d = ctx.manager.dispatcher
+        d.backend.delete(d.get_path(ShuffleDataBlockId(sid, 1)))
+        d.clear_status_cache()
+        out = []
+        for rid in range(4):
+            out.extend(ctx.manager.get_reader(handle, rid, rid + 1).read())
+        assert sorted(out) == sorted(records)
+        assert _reconstructions(metrics_on, "loss") >= 1
+        ctx.manager.unregister_shuffle(sid)
+        # zero residual objects, including .parity
+        from s3shuffle_tpu.storage.local import LocalBackend
+
+        assert LocalBackend().list_prefix(f"file://{tmp_path}/e2e") == []
+
+
+# ---------------------------------------------------------------------------
+# Straggler speculation
+# ---------------------------------------------------------------------------
+
+
+def test_straggler_speculation_reconstructs_and_wins(tmp_path, metrics_on):
+    sizes = [[6000, 6000]] * 3
+    cfg, d, helper = _env(
+        tmp_path, "strag",
+        parity_segments=1, parity_stripe_k=1, parity_chunk_bytes=4096,
+        speculative_read_quantile=0.9,
+    )
+    truth = _write_maps(d, helper, 0, sizes, seed=8)
+    # prime the fill histogram past MIN_FILL_SAMPLES with realistic fills
+    for _ in range(3):
+        assert _scan(d, helper, cfg, 0, sizes) == truth
+    flaky = FlakyBackend(d.backend)
+    flaky.add_latency(
+        LatencyRule("read", match="shuffle_0_1_0.data", delay_s=0.5)
+    )
+    saved, d.backend = d.backend, flaky
+    try:
+        d.clear_status_cache()
+        t0 = time.perf_counter()
+        got = _scan(d, helper, cfg, 0, sizes)
+        wall = time.perf_counter() - t0
+    finally:
+        time.sleep(0.6)  # drain the abandoned straggler GET
+        d.backend = saved
+    assert got == truth
+    snap = metrics_on.snapshot(compact=True)
+    spec = sum(
+        s["value"]
+        for s in snap.get("shuffle_parity_speculative_reads_total", {}).get(
+            "series", []
+        )
+    )
+    assert spec >= 1
+    assert _reconstructions(metrics_on, "straggler") >= 1
+    assert wall < 0.45, f"speculation bought no tail win: {wall}"
+
+
+def test_speculation_never_fires_without_samples_or_quantile(tmp_path, metrics_on):
+    sizes = [[2000, 2000]]
+    cfg, d, helper = _env(
+        tmp_path, "nospec",
+        parity_segments=1, parity_stripe_k=1, parity_chunk_bytes=4096,
+        speculative_read_quantile=0.0,
+    )
+    truth = _write_maps(d, helper, 0, sizes, seed=9)
+    assert _scan(d, helper, cfg, 0, sizes) == truth
+    snap = metrics_on.snapshot(compact=True)
+    assert not snap.get("shuffle_parity_speculative_reads_total", {}).get("series")
+
+
+# ---------------------------------------------------------------------------
+# Op-for-op off switch (acceptance: parity_segments=0 == PR-9 HEAD pattern)
+# ---------------------------------------------------------------------------
+
+
+def test_parity_zero_is_op_for_op_and_parity_rides_without_perturbing(tmp_path):
+    """parity_segments=0 issues ZERO .parity ops and byte-identical index
+    blobs; parity_segments>0 adds ONLY .parity ops — the base pattern
+    (multiset of every other store op) is untouched in both write and
+    read."""
+    from s3shuffle_tpu.storage.local import LocalBackend
+
+    sizes = [[3000, 0, 1200], [0, 2048, 5]]
+
+    def run(tag, **extra):
+        Dispatcher.reset()
+        cfg = ShuffleConfig(
+            root_dir=f"file://{tmp_path}/{tag}", app_id=tag, **extra
+        )
+        d = Dispatcher(cfg)
+        helper = ShuffleHelper(d)
+        rec = RecordingBackend(LocalBackend())
+        d.backend = rec
+        truth = _write_maps(d, helper, 0, sizes, seed=1)
+        got = _scan(d, helper, cfg, 0, sizes)
+        assert got == {k: v for k, v in truth.items() if v}
+        return [(op, p.rsplit("/", 1)[-1]) for op, p in rec.ops]
+
+    off = run("off", parity_segments=0)
+    on = run("on", parity_segments=2, parity_stripe_k=2, parity_chunk_bytes=512)
+    assert not any(".parity" in p for _op, p in off)
+    on_base = [(op, p) for op, p in on if ".parity" not in p]
+    assert sorted(on_base) == sorted(off)
+    assert any(".parity" in p for _op, p in on)
+    # and the parity-off index blob is byte-identical to the raw
+    # reference-format cumulative offsets (no trailer)
+    Dispatcher.reset()
+    cfg = ShuffleConfig(root_dir=f"file://{tmp_path}/off", app_id="off")
+    d = Dispatcher(cfg)
+    from s3shuffle_tpu.block_ids import ShuffleIndexBlockId
+
+    blob = d.backend.read_all(d.get_path(ShuffleIndexBlockId(0, 0)))
+    expected = np.ascontiguousarray(
+        np.array([0, 3000, 3000, 4200], dtype=np.int64), dtype=">i8"
+    ).tobytes()
+    assert blob == expected
+
+
+# ---------------------------------------------------------------------------
+# Lifecycle sweeps
+# ---------------------------------------------------------------------------
+
+
+def test_orphan_sweep_reclaims_dead_attempt_parity_keeps_winners(tmp_path):
+    sizes = [[1500, 700]]
+    cfg, d, helper = _env(
+        tmp_path, "sweep",
+        parity_segments=1, parity_stripe_k=1, parity_chunk_bytes=1024,
+    )
+    _write_maps(d, helper, 0, sizes, seed=3)  # winner: map 0
+    # fake a dead attempt: data + parity but NO index (crashed pre-commit)
+    for block in (ShuffleDataBlockId(0, 1000), ShuffleParityBlockId(0, 1000, 0)):
+        with d.backend.create(d.get_path(block)) as s:
+            s.write(b"x" * 64)
+    removed = d.sweep_orphan_attempts(0, winner_map_ids=[0])
+    names = {p.rsplit("/", 1)[-1] for p in removed}
+    assert names == {"shuffle_0_1000_0.data", "shuffle_0_1000_par0.parity"}
+    # winner's parity untouched
+    d.backend.status(d.get_path(ShuffleParityBlockId(0, 0, 0)))
+
+
+def test_orphan_sweep_reclaims_uncommitted_composite_parity(tmp_path):
+    cfg, d, helper = _env(tmp_path, "csweep", composite_commit_maps=2)
+    # uncommitted group: data + parity, no cindex
+    for block in (
+        ShuffleCompositeDataBlockId(0, 5),
+        ShuffleCompositeParityBlockId(0, 5, 0),
+    ):
+        with d.backend.create(d.get_path(block)) as s:
+            s.write(b"y" * 32)
+    removed = d.sweep_orphan_attempts(0, winner_map_ids=[])
+    names = {p.rsplit("/", 1)[-1] for p in removed}
+    assert names == {"shuffle_0_comp_5.data", "shuffle_0_comp_5_par0.parity"}
+
+
+@pytest.mark.parametrize("chunk_bytes", [2000, 4096])
+def test_compactor_strips_geometry_trailer_from_coded_singletons(
+    tmp_path, chunk_bytes
+):
+    """Coded singleton ``.index`` blobs end in the 4-word geometry trailer;
+    the compactor must parse them via ``split_index_geometry`` or the
+    trailer words masquerade as cumulative offsets. Two shapes, both
+    pinned: chunk_bytes != payload makes the payload-length guard abort
+    every group (compaction permanently no-ops for coded shuffles);
+    chunk_bytes == payload slips the guard and the trailer words flow
+    into the committed fat index (crashing FatIndex.to_bytes)."""
+    from s3shuffle_tpu.metadata.map_output import (
+        STORE_LOCATION,
+        MapOutputTracker,
+        MapStatus,
+    )
+    from s3shuffle_tpu.write.compactor import compact_shuffle
+
+    sizes = [[1000, 1000], [900, 1100], [1024, 976], [800, 1200]]
+    # every map's payload is exactly 2000 bytes — chunk_bytes=2000 is the
+    # guard-slipping coincidence, 4096 the common abort shape
+    cfg, d, helper = _env(
+        tmp_path, "codedcompact",
+        parity_segments=1, parity_stripe_k=1, parity_chunk_bytes=chunk_bytes,
+        compact_below_bytes=1 << 20,
+    )
+    truth = _write_maps(d, helper, 0, sizes)
+    tracker = MapOutputTracker()
+    tracker.register_shuffle(0, 2)
+    for m, row in enumerate(sizes):
+        tracker.register_map_output(
+            0,
+            MapStatus(
+                map_id=m, location=STORE_LOCATION,
+                sizes=np.array(row, dtype=np.int64), parity_segments=1,
+            ),
+        )
+    report = compact_shuffle(d, helper, 0, tracker=tracker)
+    assert report.groups == 1 and report.maps == 4
+    # the singletons' parity sidecars ride the same tombstone generation
+    # as the data they cover (stranding them would leak namespace AND
+    # point at data the TTL sweep deletes)
+    assert report.tombstoned == 4 * 4  # data+index+checksum+par0 per map
+    # TTL-sweep the superseded singletons so the scan can only resolve the
+    # composite — proving the fat index carries clean offsets
+    d.sweep_expired_generations(0, ttl_s=0)
+    leftover = [
+        st.path
+        for st in d.backend.list_prefix(f"file://{tmp_path}/codedcompact")
+        if st.path.endswith(".parity")
+    ]
+    assert leftover == []
+    assert _scan(d, ShuffleHelper(d), cfg, 0, sizes) == truth
+
+
+# ---------------------------------------------------------------------------
+# Composite seal-visibility barrier (the record-loss fix)
+# ---------------------------------------------------------------------------
+
+
+def test_flush_shuffle_waits_for_inflight_seal(tmp_path):
+    """A barrier flush arriving while ANOTHER thread is mid-seal must not
+    return until that seal's registration callback completed — the
+    LocalCluster/ShuffleContext composite record-loss race."""
+    cfg, d, helper = _env(tmp_path, "sealwait", composite_commit_maps=2)
+    registered = []
+    release = threading.Event()
+
+    def slow_commit(sid, members):
+        release.wait(timeout=5.0)
+        registered.extend(members)
+
+    agg = CompositeCommitAggregator(d, helper, on_group_commit=slow_commit)
+    sizes = [[128], [128]]  # second commit trips the count seal inline
+
+    sealer = threading.Thread(
+        target=lambda: _write_maps(d, helper, 0, sizes, seed=4, agg=agg)
+    )
+    sealer.start()
+    # wait until the sealer is inside _finish (blocked on the event)
+    deadline = time.monotonic() + 5.0
+    while not agg._sealing and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert agg._sealing, "seal never started"
+
+    flushed = threading.Event()
+
+    def barrier():
+        agg.flush_shuffle(0)
+        flushed.set()
+
+    flusher = threading.Thread(target=barrier)
+    flusher.start()
+    time.sleep(0.05)
+    # the barrier MUST still be waiting: registration hasn't happened
+    assert not flushed.is_set(), "flush returned before the seal registered"
+    assert not registered
+    release.set()
+    flusher.join(timeout=5.0)
+    sealer.join(timeout=5.0)
+    assert flushed.is_set() and len(registered) == 2
+
+
+def test_flush_shuffle_covers_pop_to_detach_gap(tmp_path):
+    """Residual window of the record-loss race: a barrier flush pops the
+    group from the registry, then _detach blocks on the GROUP lock (a slow
+    in-flight append holds it) before the seal counter increments. A
+    sibling barrier flush landing in that gap used to see neither the
+    group nor a seal in flight and return early — the seal window must
+    open atomically with the pop, under the registry lock."""
+    cfg, d, helper = _env(tmp_path, "sealgap", composite_commit_maps=4)
+    registered = []
+    agg = CompositeCommitAggregator(
+        d, helper, on_group_commit=lambda sid, members: registered.extend(members)
+    )
+    _write_maps(d, helper, 0, [[96]], seed=5, agg=agg)  # one open member
+    group = agg._groups[0]
+
+    # simulate the slow in-flight append: hold the group lock so flusher A
+    # pops the group but blocks inside _detach BEFORE noting the seal
+    group.lock.acquire()
+    try:
+        a = threading.Thread(target=lambda: agg.flush_shuffle(0))
+        a.start()
+        deadline = time.monotonic() + 5.0
+        while 0 in agg._groups and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert 0 not in agg._groups, "flusher A never popped the group"
+
+        b_done = threading.Event()
+        b = threading.Thread(
+            target=lambda: (agg.flush_shuffle(0), b_done.set())
+        )
+        b.start()
+        time.sleep(0.05)
+        # B must NOT return while A is stuck pre-detach with the members
+        # still unregistered
+        assert not b_done.is_set(), (
+            "barrier flush returned inside the pop->detach gap"
+        )
+        assert not registered
+    finally:
+        group.lock.release()
+    a.join(timeout=5.0)
+    b.join(timeout=5.0)
+    assert b_done.is_set() and len(registered) == 1
+
+
+@pytest.mark.slow
+def test_distributed_worker_agents_with_parity_and_composites(tmp_path):
+    """Multi-process topology (DistributedDriver + WorkerAgent fleet) with
+    the coded plane AND composite commits on: the parity count must ride
+    the deferred registration payloads to the tracker, and a post-commit
+    composite-object loss must reconstruct during the reduce stage."""
+    import dataclasses
+    import multiprocessing as mp
+
+    from s3shuffle_tpu.batch import RecordBatch
+    from s3shuffle_tpu.cluster import DistributedDriver
+    from tests.test_cluster import _agent_main
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/store", app_id="dist-parity", codec="zlib",
+        composite_commit_maps=2,
+        parity_segments=1, parity_stripe_k=1, parity_chunk_bytes=4096,
+    )
+    rng = random.Random(1)
+    recs = [(rng.randbytes(8), rng.randbytes(24)) for _ in range(3000)]
+    batches = [RecordBatch.from_records(recs[i::3]) for i in range(3)]
+    driver = DistributedDriver(cfg)
+    ctx = mp.get_context("spawn")
+    workers = [
+        ctx.Process(
+            target=_agent_main,
+            args=(list(driver.coordinator_address), dataclasses.asdict(cfg), f"w{i}"),
+            daemon=True,
+        )
+        for i in range(2)
+    ]
+    for w in workers:
+        w.start()
+    try:
+        out = driver.run_sort_shuffle(batches, num_partitions=3)
+        assert sum(b.n for b in out) == 3000
+        # every registered output carries the coded plane's segment count
+        statuses = driver.server.tracker.deduped_statuses(0)
+        assert {s.parity_segments for _i, s in statuses} == {1}
+        assert {s.composite_group >= 0 for _i, s in statuses} == {True}
+    finally:
+        driver.shutdown()
+        for w in workers:
+            if w.is_alive():
+                w.terminate()
+            w.join(timeout=10)
+
+
+def test_sort_by_key_composite_localcluster_regression(tmp_path):
+    """The ROADMAP bug repro shape: bench.gen_partitions →
+    ShuffleContext.sort_by_key → bench._validate with
+    composite_commit_maps=4, num_workers=2 — pre-fix this dropped ~5% of
+    records (a reduce task scanned while a sibling's barrier flush was
+    still sealing). Seal latency is amplified with an injected delay on
+    the fat-index PUT so the race window is wide and the regression
+    deterministic."""
+    import bench
+    from s3shuffle_tpu.serializer import ColumnarKVSerializer
+    from s3shuffle_tpu.shuffle import ShuffleContext
+    from s3shuffle_tpu.storage.local import LocalBackend
+
+    # bench-shaped workload, scaled down to tier-1 size
+    parts = []
+    rng = random.Random(42)
+    from s3shuffle_tpu.batch import RecordBatch
+
+    n_maps, per_map = 6, 3000
+    for _m in range(n_maps):
+        parts.append(
+            RecordBatch.from_records(
+                [
+                    (rng.randbytes(bench.KEY_BYTES), rng.randbytes(bench.VALUE_BYTES))
+                    for _ in range(per_map)
+                ]
+            )
+        )
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/clrace", app_id="clrace", cleanup=True,
+        composite_commit_maps=4,
+    )
+    with ShuffleContext(config=cfg, num_workers=2) as ctx:
+        d = ctx.manager.dispatcher
+        flaky = FlakyBackend(LocalBackend())
+        flaky.add_latency(LatencyRule("create", match=".cindex", delay_s=0.1))
+        d.backend = flaky
+        out = ctx.sort_by_key(
+            parts,
+            num_partitions=bench.N_REDUCERS,
+            serializer=ColumnarKVSerializer(),
+            materialize="batches",
+        )
+        merged = [RecordBatch.concat(p) for p in out]
+        n_records = sum(b.n for b in merged)
+        assert n_records == n_maps * per_map, (
+            f"composite record loss: {n_records} of {n_maps * per_map}"
+        )
+        prev_last = None
+        for b in merged:
+            if b.n == 0:
+                continue
+            sk = b.key_strings(width=bench.KEY_BYTES)
+            assert (sk[:-1] <= sk[1:]).all()
+            if prev_last is not None:
+                assert prev_last <= sk[0]
+            prev_last = sk[-1]

@@ -32,19 +32,7 @@ from s3shuffle_tpu.write.composite_commit import CompositeCommitAggregator
 from s3shuffle_tpu.write.map_output_writer import MapOutputWriter
 
 
-class RecordingBackend(FlakyBackend):
-    """Records every (op, path) — the request pattern the store bills."""
-
-    def __init__(self, inner):
-        super().__init__(inner)
-        self.ops = []
-
-    def _check(self, op: str, path: str) -> None:
-        self.ops.append((op, path))
-        super()._check(op, path)
-
-    def count(self, op: str, needle: str = "") -> int:
-        return sum(1 for o, p in self.ops if o == op and needle in p)
+from conftest import RecordingBackend  # noqa: E402
 
 
 def _env(tmp_path, tag, **cfg_kwargs):

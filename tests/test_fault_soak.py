@@ -148,6 +148,81 @@ def test_fault_soak_shuffle_byte_identical(tmp_path, metrics_on, composite_maps)
     assert snap["storage_retry_backoff_seconds"]["series"][0]["count"] >= retries_total
 
 
+@pytest.mark.parametrize(
+    "k,m", [(1, 1), (2, 2)], ids=["k1m1-mirror", "k2m2-rs"]
+)
+def test_fault_soak_object_loss_mode(tmp_path, metrics_on, k, m):
+    """Object-LOSS soak (the coded shuffle plane's extension of the
+    transient soak): after commit, a seeded subset of data objects is
+    DELETED outright — not flaked, gone — and the reduce must still
+    complete byte-identical via parity reconstruction, with zero residual
+    objects (including ``.parity``) after cleanup."""
+    from s3shuffle_tpu.block_ids import ShuffleDataBlockId
+    from s3shuffle_tpu.storage.local import LocalBackend
+
+    # --- fault-free baseline -------------------------------------------
+    Dispatcher.reset()
+    clean_cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/clean", app_id="loss", cleanup=True
+    )
+    with ShuffleContext(config=clean_cfg, num_workers=2) as ctx:
+        _handle, expected, clean_out = _run_shuffle(ctx)
+    assert clean_out == expected
+
+    # --- the loss soak: same workload, coded layout, seeded deletions --
+    Dispatcher.reset()
+    loss_cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/loss",
+        app_id="loss",
+        cleanup=True,
+        parity_segments=m,
+        parity_stripe_k=k,
+        parity_chunk_bytes=2048,
+    )
+    with ShuffleContext(config=loss_cfg, num_workers=2) as ctx:
+        from s3shuffle_tpu.dependency import HashPartitioner, ShuffleDependency
+
+        records = _records()
+        sid = next(ctx._next_shuffle_id)
+        dep = ShuffleDependency(sid, HashPartitioner(N_PARTS))
+        handle = ctx.manager.register_shuffle(sid, dep)
+        per_map = len(records) // N_MAPS
+        for map_id in range(N_MAPS):
+            w = ctx.manager.get_writer(handle, map_id)
+            w.write(records[map_id * per_map : (map_id + 1) * per_map])
+            w.stop(success=True)
+
+        disp = ctx.manager.dispatcher
+        raw = LocalBackend()
+        # post-commit loss: a seeded subset (here: every other map's data
+        # object — 2 of 3) vanishes before any reduce read
+        rng_loss = __import__("random").Random(77)
+        lost = [mid for mid in range(N_MAPS) if rng_loss.random() < 0.7]
+        assert lost, "seed produced no losses"
+        for mid in lost:
+            disp.backend.delete(disp.get_path(ShuffleDataBlockId(sid, mid)))
+        disp.clear_status_cache()
+
+        out = []
+        for rid in range(N_PARTS):
+            out.extend(ctx.manager.get_reader(handle, rid, rid + 1).read())
+        assert sorted(out) == clean_out  # byte-identical despite the losses
+
+        snap = metrics_on.snapshot(compact=True)
+        recon = sum(
+            s["value"]
+            for s in snap.get("shuffle_parity_reconstructions_total", {}).get(
+                "series", []
+            )
+            if s.get("labels", {}).get("reason") == "loss"
+        )
+        assert recon >= len(lost), f"expected >= {len(lost)} reconstructions"
+
+        # cleanup: zero residual objects, .parity included
+        ctx.manager.unregister_shuffle(handle.shuffle_id)
+        assert raw.list_prefix(f"file://{tmp_path}/loss") == []
+
+
 def test_fault_soak_weather_is_seeded_deterministic(tmp_path):
     # Same seeds + same op sequence ⇒ same fault pattern: the soak is
     # reproducible, not a flake generator. Serial op replay (no thread

@@ -37,20 +37,7 @@ from s3shuffle_tpu.storage.fault import (
 from s3shuffle_tpu.write.map_output_writer import MapOutputWriter
 
 
-class RecordingBackend(FlakyBackend):
-    """FlakyBackend that records every (op, path) it sees — the request
-    pattern the store would bill for."""
-
-    def __init__(self, inner):
-        super().__init__(inner)
-        self.ops = []
-
-    def _check(self, op: str, path: str) -> None:
-        self.ops.append((op, path))
-        super()._check(op, path)
-
-    def count(self, op: str, needle: str) -> int:
-        return sum(1 for o, p in self.ops if o == op and needle in p)
+from conftest import RecordingBackend  # noqa: E402
 
 
 def _make_env(tmp_path, tag="sp", **cfg_kwargs):

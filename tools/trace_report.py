@@ -275,6 +275,43 @@ def _codec_line(snapshot: dict) -> Optional[str]:
     return line
 
 
+def _coding_plane_line(snapshot: dict) -> Optional[str]:
+    """One-line coding-plane digest: parity redundancy bought (bytes +
+    encode wall), and what it paid for — speculative reads raced and byte
+    ranges actually served by reconstruction, split by trigger reason."""
+    parity_bytes = _counter_total(snapshot, "shuffle_parity_bytes_written_total")
+    spec = _counter_total(snapshot, "shuffle_parity_speculative_reads_total")
+    recon = _counter_total(snapshot, "shuffle_parity_reconstructions_total")
+    if parity_bytes <= 0 and spec <= 0 and recon <= 0:
+        return None
+    parts = []
+    if parity_bytes > 0:
+        enc = snapshot.get("shuffle_parity_encode_seconds", {}).get("series", [])
+        enc_s = sum(float(s.get("sum", 0.0)) for s in enc)
+        piece = f"{_fmt_bytes(parity_bytes)} parity written"
+        if enc_s > 0:
+            piece += f" (encode {_fmt_seconds(enc_s)})"
+        parts.append(piece)
+    if spec > 0:
+        parts.append(f"{spec:g} speculative reads")
+    if recon > 0:
+        by_reason = {
+            s.get("labels", {}).get("reason", "?"): float(s.get("value", 0))
+            for s in snapshot.get("shuffle_parity_reconstructions_total", {}).get(
+                "series", []
+            )
+        }
+        piece = f"{recon:g} reconstructions"
+        if by_reason:
+            piece += (
+                " ("
+                + ", ".join(f"{v:g} {r}" for r, v in sorted(by_reason.items()))
+                + ")"
+            )
+        parts.append(piece)
+    return "Coding plane: " + "; ".join(parts)
+
+
 def _tuning_line(snapshot: dict) -> Optional[str]:
     """One-line autotuner digest: controller decisions by outcome, the live
     rung of every tuned knob, and the closed loop's own overhead."""
@@ -366,6 +403,7 @@ def render_metrics_snapshot(
     for line in (
         _scan_planner_line(snapshot),
         _write_plane_line(snapshot),
+        _coding_plane_line(snapshot),
         _codec_line(snapshot),
         _tuning_line(snapshot),
         _control_plane_line(snapshot, reduce_tasks=reduce_tasks),
@@ -575,6 +613,15 @@ def _selftest() -> int:
         {"write_compacted_objects_total": {"kind": "counter", "series": [{"value": 7}]}}
     )
     assert solo == "Write plane: compactor rewrote 7 singleton outputs", solo
+    # the coding-plane digest renders from the synthetic parity series
+    # (1 MiB parity bytes; 7 speculative reads; the labeled reconstruction
+    # counter contributes its two 7-value series = 14)
+    for needle in (
+        "Coding plane: 1.00 MiB parity written",
+        "7 speculative reads",
+        "14 reconstructions",
+    ):
+        assert needle in text, f"coding line missing {needle!r}:\n{text}"
     # the codec digest renders from the synthetic codec-plane series
     # (1 MiB over a 3.08s histogram; 7 fused of 7 frames; gauge 7 in flight)
     for needle in (
