@@ -223,6 +223,12 @@ class ShuffleTombstoneBlockId(BlockId):
         return f"shuffle_{self.shuffle_id}_gen_{self.generation}.tomb"
 
 
+#: wire-schema registry binding (s3shuffle_tpu/wire/schema.py) — object
+#: names ARE wire surface (listing enumeration, the lifecycle sweeps, and
+#: the protocol witness all parse them back); shuffle-lint WIRE01 pins the
+#: grammars below against the registry.
+_WIRE_STRUCTS = ("object_names",)
+
 _INDEX_RE = re.compile(r"^shuffle_(\d+)_(\d+)_(\d+)\.index$")
 _ANY_RE = re.compile(
     r"^shuffle_(\d+)_(\d+)_(?:(\d+)\.(?:data|index|checksum\..+)|par\d+\.parity)$"

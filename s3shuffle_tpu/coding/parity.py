@@ -56,6 +56,11 @@ _C_PARITY_BYTES = _metrics.REGISTRY.counter(
     "Parity sidecar bytes written (the redundancy overhead bought)",
 )
 
+#: wire-schema registry binding (s3shuffle_tpu/wire/schema.py) — checked by
+#: shuffle-lint WIRE01: constant drift without a registry update (and a
+#: SHUFFLE_FORMAT_VERSION bump + back-compat reader) is a lint failure.
+_WIRE_STRUCTS = ("parity_header", "index_geometry_trailer")
+
 #: "S3PARITY"-shaped int64 — first word of every parity object
 PARITY_MAGIC = 0x5333504152495459
 _WIRE_VERSION = 1
@@ -68,6 +73,8 @@ HEADER_BYTES = HEADER_WORDS * 8
 #: chunk_bytes]`` after the cumulative offsets (metadata/helper.py parses
 #: it back out, so offset consumers never see the trailer)
 GEOMETRY_MAGIC = 0x5333504152474D54  # "S3PARGMT"
+#: trailer width in int64 words
+TRAILER_WORDS = 4
 
 #: closed stripe groups buffered before one batched encode call
 ENCODE_BATCH_GROUPS = 16
@@ -314,12 +321,12 @@ def geometry_trailer_words(geometry: ParityGeometry) -> np.ndarray:
 
 def split_index_geometry(words: np.ndarray):
     """Split a raw index-blob int64 array into ``(offsets, geometry|None)``.
-    The trailer is recognized by ``GEOMETRY_MAGIC`` at position -4 — a
-    cumulative byte offset can never reach that value (~6.0e18 bytes), so
-    parity-less indexes (including every reference-written one) pass
-    through untouched."""
-    if len(words) >= 6 and int(words[-4]) == GEOMETRY_MAGIC:
-        offsets = words[:-4]
+    The trailer is recognized by ``GEOMETRY_MAGIC`` at position
+    ``-TRAILER_WORDS`` — a cumulative byte offset can never reach that value
+    (~6.0e18 bytes), so parity-less indexes (including every
+    reference-written one) pass through untouched."""
+    if len(words) >= TRAILER_WORDS + 2 and int(words[-TRAILER_WORDS]) == GEOMETRY_MAGIC:
+        offsets = words[:-TRAILER_WORDS]
         return offsets, ParityGeometry(
             segments=int(words[-3]),
             stripe_k=int(words[-2]),

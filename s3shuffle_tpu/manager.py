@@ -120,6 +120,13 @@ class ShuffleManager:
                 on_group_commit=self._register_group,
                 on_group_abort=self._abort_group,
             )
+        # Runtime protocol witness (utils/protowitness.py): opt-in via
+        # S3SHUFFLE_PROTOCOL_WITNESS=1 — interposes on this manager's
+        # backend and tracker to assert commit-op ordering (index LAST) and
+        # the seal barrier at runtime. None (and zero overhead) when unset.
+        from s3shuffle_tpu.utils import protowitness
+
+        self.protocol_witness = protowitness.maybe_install(self)
 
     @property
     def config(self) -> ShuffleConfig:

@@ -29,6 +29,12 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+#: wire-schema registry binding (s3shuffle_tpu/wire/schema.py) — the
+#: constants below are cross-checked against the registry by shuffle-lint
+#: rule WIRE01; change them only with a registry update + a
+#: SHUFFLE_FORMAT_VERSION bump + a back-compat reader branch.
+_WIRE_STRUCTS = ("fat_index",)
+
 #: wire magic ("S3FATIDX"-shaped int64) + format version, first two words.
 #: v2 appends four header words ``[parity_segments, parity_stripe_k,
 #: parity_chunk_bytes, payload_len]`` — the composite data object's stripe

@@ -39,6 +39,11 @@ from s3shuffle_tpu.utils.concurrent_map import ConcurrentObjectMap
 
 logger = logging.getLogger("s3shuffle_tpu.metadata")
 
+#: wire-schema registry binding (s3shuffle_tpu/wire/schema.py) — this module
+#: owns the per-map index blob (cumulative offsets + optional geometry
+#: trailer) and the checksum sidecar; shuffle-lint WIRE01 pins the claim.
+_WIRE_STRUCTS = ("per_map_index", "checksum_sidecar")
+
 
 @dataclasses.dataclass(frozen=True)
 class MapLocation:
@@ -179,6 +184,7 @@ class ShuffleHelper:
             groups = self.dispatcher.list_composite_groups(shuffle_id)
             for group_id in groups:
                 try:
+                    # shuffle-lint: disable=LK01 reason=the discovery lock exists to run this store read EXACTLY once per shuffle; racing callers must block on it rather than each paying the LIST+GET fan-out
                     fat = self.read_fat_index(shuffle_id, group_id)
                 except (OSError, ValueError) as e:
                     logger.warning(

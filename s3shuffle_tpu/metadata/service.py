@@ -46,6 +46,23 @@ _C_RPC = _metrics.REGISTRY.counter(
 _LEN = struct.Struct("<I")
 _MAX_FRAME = 64 << 20
 
+#: wire-schema registry binding (s3shuffle_tpu/wire/schema.py) — the
+#: registration-payload field counts below are cross-checked by shuffle-lint
+#: WIRE01: growing a payload means updating the registry AND bumping
+#: version.SHUFFLE_FORMAT_VERSION (older payloads must keep parsing through
+#: the defaulted tail fields, the back-compat contract the MIN guards pin).
+_WIRE_STRUCTS = ("rpc_register",)
+
+#: ``register_map_output`` args ``[shuffle_id, map_id, location, sizes,
+#: map_index, composite_group, base_offset, parity_segments]`` — the full
+#: format-4 width, and the minimum the server accepts (format 2+: a payload
+#: without map_index is rejected loudly, never mis-defaulted).
+REGISTER_FIELDS = 8
+REGISTER_MIN_FIELDS = 5
+#: batched ``register_map_outputs`` entries drop the leading shuffle_id
+BATCH_ENTRY_FIELDS = 7
+BATCH_ENTRY_MIN_FIELDS = 4
+
 
 def stage_id_for(shuffle_id: int, phase: str) -> str:
     """Canonical stage-id convention (``shuffle<id>-<phase>``) — shared by
@@ -362,7 +379,7 @@ class _Handler(socketserver.BaseRequestHandler):
             if len(a) > 4 and a[4] is not None:
                 # map-output registration rides the completion atomically:
                 # accepted ⇒ registered; refused (zombie) ⇒ never registered
-                if len(a[4]) < 5:
+                if len(a[4]) < REGISTER_MIN_FIELDS:
                     # pre-format-2 client: its strided map_ids would default
                     # map_index wrong and silently mis-filter range reads —
                     # the exact failure SHUFFLE_FORMAT_VERSION exists to stop
@@ -378,7 +395,7 @@ class _Handler(socketserver.BaseRequestHandler):
                 # the coded plane's parity-segment count (default uncoded).
                 m_group = int(a[4][5]) if len(a[4]) > 5 else -1
                 m_base = int(a[4][6]) if len(a[4]) > 6 else 0
-                m_parity = int(a[4][7]) if len(a[4]) > 7 else 0
+                m_parity = int(a[4][7]) if len(a[4]) >= REGISTER_FIELDS else 0
                 tracker = self.server.tracker  # type: ignore[attr-defined]
                 status = MapStatus(
                     map_id=int(m_map),
@@ -430,7 +447,7 @@ class _Handler(socketserver.BaseRequestHandler):
         if method == "register_shuffle":
             return tracker.register_shuffle(int(a[0]), int(a[1]))
         if method == "register_map_output":
-            if len(a) < 5:
+            if len(a) < REGISTER_MIN_FIELDS:
                 raise RuntimeError(
                     "register_map_output without map_index: client speaks an "
                     "older shuffle format; deploy one version per job "
@@ -454,7 +471,7 @@ class _Handler(socketserver.BaseRequestHandler):
             shuffle_id, entries = int(a[0]), list(a[1])
             statuses = []
             for entry in entries:
-                if len(entry) < 4:
+                if len(entry) < BATCH_ENTRY_MIN_FIELDS:
                     raise RuntimeError(
                         "register_map_outputs entry without map_index: client "
                         "speaks an older shuffle format; deploy one version "
@@ -469,7 +486,9 @@ class _Handler(socketserver.BaseRequestHandler):
                         map_index=int(map_index),
                         composite_group=int(entry[4]) if len(entry) > 4 else -1,
                         base_offset=int(entry[5]) if len(entry) > 5 else 0,
-                        parity_segments=int(entry[6]) if len(entry) > 6 else 0,
+                        parity_segments=(
+                            int(entry[6]) if len(entry) >= BATCH_ENTRY_FIELDS else 0
+                        ),
                     )
                 )
             return tracker.register_map_outputs(shuffle_id, statuses)

@@ -57,6 +57,11 @@ _G_SNAPSHOT_AGE = _metrics.REGISTRY.gauge(
     "publish stamp)",
 )
 
+#: wire-schema registry binding (s3shuffle_tpu/wire/schema.py) — checked by
+#: shuffle-lint WIRE01: constant drift without a registry update (and a
+#: SHUFFLE_FORMAT_VERSION bump + back-compat reader) is a lint failure.
+_WIRE_STRUCTS = ("snapshot",)
+
 #: wire magic ("S3SHSNAP" as an int64) + format version, first two words.
 #: v2 added two per-row words (composite_group, base_offset) so snapshots
 #: carry the composite-commit coordinates; v3 adds one more

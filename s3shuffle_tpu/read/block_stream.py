@@ -171,9 +171,11 @@ class BlockStream(io.RawIOBase):
             if self._failed:
                 return b""
             try:
+                # shuffle-lint: disable=LK01 reason=lazy first-open must win or lose atomically with the _reader slot; hoisting it would open one redundant reader per concurrent pread and every sibling needs the handle before it can proceed anyway
                 reader = self._ensure_open()
             except OSError as e:
                 if isinstance(e, FileNotFoundError):
+                    # shuffle-lint: disable=LK01 reason=loss reconstruction must win or lose atomically with the failed-EOF marker; one reconstruction under the lock serves every sibling pread from the rebuilt buffer
                     rebuilt = self._reconstruct_locked(position, length)
                     if rebuilt is not None:
                         return rebuilt
@@ -190,6 +192,7 @@ class BlockStream(io.RawIOBase):
             return reader.read_fully(position, length)
         except OSError as e:
             with self._lock:
+                # shuffle-lint: disable=LK01 reason=the reopen must be atomic with the _reader slot swap: the sibling-already-swapped identity check (PR-3 review hardening) only holds if no second recovery can interleave
                 fresh = self._recover_reader_locked(e, reader)
             if fresh is not None:
                 try:
@@ -198,6 +201,7 @@ class BlockStream(io.RawIOBase):
                     e = e2
             if isinstance(e, FileNotFoundError):
                 with self._lock:
+                    # shuffle-lint: disable=LK01 reason=loss reconstruction must win or lose atomically with the failed-EOF marker; one reconstruction under the lock serves every sibling pread from the rebuilt buffer
                     rebuilt = self._reconstruct_locked(position, length)
                 if rebuilt is not None:
                     return rebuilt
@@ -230,12 +234,14 @@ class BlockStream(io.RawIOBase):
             data = None
             reader = None
             try:
+                # shuffle-lint: disable=LK01 reason=lazy first-open must win or lose atomically with the _reader slot; the cursor path is single-consumer by contract and serializes against pread siblings on this lock by design
                 reader = self._ensure_open()
                 if reader is None:
                     return b""
                 # shuffle-lint: disable=LK01 reason=cursor path is single-consumer by contract; the lock exists to serialize cursor reads against concurrent pread siblings, so the GET must sit inside it
                 data = reader.read_fully(self._pos, n)
             except OSError as e:
+                # shuffle-lint: disable=LK01 reason=the reopen must be atomic with the _reader slot swap: the sibling-already-swapped identity check (PR-3 review hardening) only holds if no second recovery can interleave
                 fresh = self._recover_reader_locked(e, reader)
                 if fresh is not None:
                     try:
@@ -246,6 +252,7 @@ class BlockStream(io.RawIOBase):
                 if data is None and isinstance(e, FileNotFoundError):
                     # REAL loss, not weather: reconstruct unconditionally
                     # before surfacing the logged-EOF → ChecksumError path
+                    # shuffle-lint: disable=LK01 reason=loss reconstruction must win or lose atomically with the failed-EOF marker; one reconstruction under the lock serves every sibling pread from the rebuilt buffer
                     data = self._reconstruct_locked(self._pos, n)
                 if data is None:
                     # Log + EOF, matching S3ShuffleBlockStream.scala:66-70.

@@ -1,0 +1,13 @@
+"""Wire-format registry package.
+
+:mod:`s3shuffle_tpu.wire.schema` is the single declarative source of truth
+for every on-wire struct the framework reads or writes — store-object blobs
+(index / fat-index / snapshot / parity sidecars), object-name grammars, and
+the versioned RPC payloads. shuffle-lint rule **WIRE01** cross-checks the
+implementing modules against it, and ``python -m tools.shuffle_lint
+--dump-wire-doc`` renders the README "Wire formats" appendix from it.
+"""
+
+from s3shuffle_tpu.wire.schema import WIRE_STRUCTS, render_wire_doc
+
+__all__ = ["WIRE_STRUCTS", "render_wire_doc"]

@@ -1,0 +1,300 @@
+"""Declarative wire-schema registry — the single source of truth for every
+on-wire struct the framework reads or writes.
+
+Six PRs grew the wire surface piecemeal: per-map index blobs gained a
+stripe-geometry trailer, the fat index went v1→v2, snapshots v1→v2→v3,
+registration RPC payloads grew from 5 to 8 fields — and each layer kept its
+own private constants, so nothing could mechanically prove that a struct
+change came with a ``SHUFFLE_FORMAT_VERSION`` bump and a back-compat reader
+(the PR-10 geometry-trailer-parsed-as-offsets bug was exactly such a drift).
+This registry makes the shapes checkable:
+
+- **WIRE01** (``tools/shuffle_lint/rules/wire01.py``) cross-checks every
+  implementing module (it declares the structs it owns via a module-level
+  ``_WIRE_STRUCTS`` tuple) against this table: magic/version/word-count
+  constants must match exactly, every historical ``read_versions`` entry
+  must have a version guard in the reader, and ``current_format`` must not
+  exceed ``version.SHUFFLE_FORMAT_VERSION`` — so editing either side alone
+  (module constants, or this registry without a format bump) is a lint
+  failure, not a silent skew;
+- the golden-bytes corpus under ``tests/fixtures/wire/`` pins that blobs of
+  every historical version decode forever (``tests/test_wire_golden.py``);
+- ``python -m tools.shuffle_lint --dump-wire-doc`` renders the README
+  "Wire formats" appendix from :func:`render_wire_doc`, so the docs cannot
+  drift from the registry either.
+
+NOTE for shuffle-lint: ``WIRE_STRUCTS`` is parsed with ``ast.literal_eval``
+— keep it a PURE LITERAL (no comprehensions, calls, f-strings, or name
+references) so the linter can read it without importing the package.
+
+Field glossary (per struct):
+
+- ``module``: repo-relative path of the implementing module (the one whose
+  ``_WIRE_STRUCTS`` tuple claims this struct);
+- ``constants``: module-level constant name → required value. ``re.compile``
+  assignments are checked against their pattern string;
+- ``read_versions`` / ``current_version``: every struct version the CURRENT
+  reader must still decode, and the one the writer emits. Structs without a
+  version word leave these empty/None;
+- ``since_format`` / ``current_format``: the ``SHUFFLE_FORMAT_VERSION`` at
+  which the struct first shipped and at which its current version shipped.
+  ``current_format`` may never exceed ``version.SHUFFLE_FORMAT_VERSION`` —
+  adding a struct version here REQUIRES bumping version.py;
+- ``layout``: human-readable row descriptions (BE-int64 words unless noted)
+  rendered into the wire-format appendix.
+"""
+
+from __future__ import annotations
+
+#: struct name -> declaration. PURE LITERAL — see module docstring.
+WIRE_STRUCTS = {
+    "per_map_index": {
+        "title": "Per-map index sidecar (`.index`)",
+        "kind": "store object",
+        "module": "s3shuffle_tpu/metadata/helper.py",
+        "constants": {},
+        "read_versions": [],
+        "current_version": None,
+        "since_format": 1,
+        "current_format": 4,
+        "doc": "Cumulative partition offsets of one map output — its "
+               "existence is the COMMIT POINT of the map (index written "
+               "last). Byte-compatible with reference-written index files "
+               "when uncoded.",
+        "layout": [
+            "`num_partitions + 1` words: cumulative offsets `[0, l0, l0+l1, ...]`",
+            "optional 4-word stripe-geometry trailer (format >= 4, parity on; "
+            "see `index_geometry_trailer`)",
+        ],
+    },
+    "index_geometry_trailer": {
+        "title": "Stripe-geometry index trailer (`S3PARGMT`)",
+        "kind": "store object (embedded)",
+        "module": "s3shuffle_tpu/coding/parity.py",
+        "constants": {
+            "GEOMETRY_MAGIC": 0x5333504152474D54,
+            "TRAILER_WORDS": 4,
+        },
+        "read_versions": [],
+        "current_version": None,
+        "since_format": 4,
+        "current_format": 4,
+        "doc": "Appended to a per-map `.index` blob when the coded plane "
+               "wrote parity sidecars; recognized by magic at word -4 and "
+               "split back off before any offset consumer sees the words. "
+               "Absent at parity=0 so the uncoded index stays "
+               "reference-byte-identical.",
+        "layout": [
+            "word 0: magic `S3PARGMT` (0x5333504152474D54)",
+            "word 1: parity segments m",
+            "word 2: stripe k (data chunks per group)",
+            "word 3: chunk bytes (payload_len is the index's own final "
+            "cumulative offset)",
+        ],
+    },
+    "checksum_sidecar": {
+        "title": "Per-map checksum sidecar (`.checksum.<ALGO>`)",
+        "kind": "store object",
+        "module": "s3shuffle_tpu/metadata/helper.py",
+        "constants": {},
+        "read_versions": [],
+        "current_version": None,
+        "since_format": 1,
+        "current_format": 1,
+        "doc": "One uint32-in-int64 checksum per reduce partition, over the "
+               "stored (post-codec) bytes. PUT before the index — committed "
+               "by it.",
+        "layout": ["`num_partitions` words: per-partition checksum values"],
+    },
+    "fat_index": {
+        "title": "Composite fat index (`.cindex`)",
+        "kind": "store object",
+        "module": "s3shuffle_tpu/metadata/fat_index.py",
+        "constants": {
+            "_MAGIC": 0x5333464154494458,
+            "_VERSION": 2,
+            "_HEADER_V1": 7,
+            "_HEADER_V2": 11,
+        },
+        "read_versions": [1, 2],
+        "current_version": 2,
+        "since_format": 3,
+        "current_format": 4,
+        "doc": "One index object for every member of a composite group — "
+               "the group's COMMIT POINT (data object first, fat index "
+               "last). v2 (format 4) appended four stripe-geometry header "
+               "words; v1 blobs still parse (geometry defaults to none).",
+        "layout": [
+            "header v1 (7 words): magic `S3FATIDX`, version, shuffle_id, "
+            "group_id, num_partitions, n_members, has_checksums",
+            "header v2 (+4 words): parity_segments, parity_stripe_k, "
+            "parity_chunk_bytes, payload_len (all zero when uncoded)",
+            "`n_members` rows of `[map_id, map_index, base_offset]`",
+            "`n_members` rows of `num_partitions + 1` member-relative "
+            "cumulative offsets",
+            "when has_checksums: `n_members` rows of `num_partitions` "
+            "checksum words",
+        ],
+    },
+    "snapshot": {
+        "title": "Map-output snapshot (`.snapmeta`)",
+        "kind": "store object",
+        "module": "s3shuffle_tpu/metadata/snapshot.py",
+        "constants": {
+            "_MAGIC": 0x5333485348534E41,
+            "_VERSION": 3,
+            "_ROW_META_V1": 2,
+            "_ROW_META_V2": 4,
+            "_ROW_META_V3": 5,
+        },
+        "read_versions": [1, 2, 3],
+        "current_version": 3,
+        "since_format": 2,
+        "current_format": 4,
+        "doc": "Immutable epoch-stamped copy of one shuffle's deduped "
+               "map-output table, published by the driver at map-stage "
+               "close. v2 (format 3) added composite coordinates per row; "
+               "v3 (format 4) added parity_segments. v1/v2 blobs still "
+               "parse (rows default to the classic uncoded layout).",
+        "layout": [
+            "header (7 words): magic `S3SHSNAP`, version, shuffle_id, "
+            "epoch, num_partitions, published_unix_micros, n_entries",
+            "`n_entries` rows: v1 `[map_id, map_index]`, v2 "
+            "`+[composite_group, base_offset]`, v3 `+[parity_segments]`, "
+            "then `num_partitions` size words",
+        ],
+    },
+    "parity_header": {
+        "title": "Parity sidecar header (`.parity`)",
+        "kind": "store object",
+        "module": "s3shuffle_tpu/coding/parity.py",
+        "constants": {
+            "PARITY_MAGIC": 0x5333504152495459,
+            "_WIRE_VERSION": 1,
+            "HEADER_WORDS": 8,
+        },
+        "read_versions": [1],
+        "current_version": 1,
+        "since_format": 4,
+        "current_format": 4,
+        "doc": "Self-describing header of one k-of-n parity sidecar object; "
+               "the parity payload (one chunk-sized slice per stripe group "
+               "at `HEADER + group * chunk_bytes`) follows. PUT before the "
+               "index — committed by it, an orphan without it.",
+        "layout": [
+            "8 words: magic `S3PARITY`, wire version, shuffle_id, "
+            "seg_index, m, k, chunk_bytes, payload_len",
+            "parity payload bytes (not int64-aligned)",
+        ],
+    },
+    "rpc_register": {
+        "title": "Registration RPC payloads",
+        "kind": "rpc (length-prefixed JSON)",
+        "module": "s3shuffle_tpu/metadata/service.py",
+        "constants": {
+            "REGISTER_FIELDS": 8,
+            "REGISTER_MIN_FIELDS": 5,
+            "BATCH_ENTRY_FIELDS": 7,
+            "BATCH_ENTRY_MIN_FIELDS": 4,
+        },
+        "read_versions": [],
+        "current_version": None,
+        "since_format": 1,
+        "current_format": 4,
+        "doc": "`register_map_output` args `[shuffle_id, map_id, location, "
+               "sizes, map_index, composite_group, base_offset, "
+               "parity_segments]` (8; the server rejects fewer than 5 — "
+               "pre-format-2 clients); batched `register_map_outputs` / "
+               "`q_complete_task` entries drop the leading shuffle_id "
+               "(7 fields, minimum 4 + map_index enforcement). Fields "
+               "past the minimum default to the classic uncoded "
+               "one-object-per-map layout.",
+        "layout": [
+            "register_map_output args: shuffle_id, map_id, location, "
+            "sizes[], map_index (format 2+), composite_group (format 3+), "
+            "base_offset (format 3+), parity_segments (format 4+)",
+            "batch entry / q_complete_task map_output row: same minus the "
+            "leading shuffle_id (q_complete_task keeps it: 8 fields, "
+            "min 5)",
+        ],
+    },
+    "object_names": {
+        "title": "Store object-name grammar",
+        "kind": "object names",
+        "module": "s3shuffle_tpu/block_ids.py",
+        "constants": {
+            "_INDEX_RE": "^shuffle_(\\d+)_(\\d+)_(\\d+)\\.index$",
+            "_ANY_RE": "^shuffle_(\\d+)_(\\d+)_(?:(\\d+)\\.(?:data|index|"
+                       "checksum\\..+)|par\\d+\\.parity)$",
+            "_COMPOSITE_RE": "^shuffle_(\\d+)_comp_(\\d+)(?:\\.(data|cindex)"
+                             "|_par\\d+\\.(parity))$",
+            "_TOMBSTONE_RE": "^shuffle_(\\d+)_gen_(\\d+)\\.tomb$",
+        },
+        "read_versions": [],
+        "current_version": None,
+        "since_format": 1,
+        "current_format": 4,
+        "doc": "Object names ARE wire surface: listing-mode enumeration, "
+               "the orphan/TTL sweeps, and the protocol witness all parse "
+               "them back. The `comp` infix / `.snapmeta` / `.tomb` "
+               "suffixes keep new object kinds invisible to the per-map "
+               "parsers by construction.",
+        "layout": [
+            "data: `shuffle_<sid>_<mid>_0.data`; index: "
+            "`shuffle_<sid>_<mid>_0.index`; checksum: "
+            "`shuffle_<sid>_<mid>_0.checksum.<ALGO>`",
+            "parity: `shuffle_<sid>_<mid>_par<i>.parity`",
+            "composite: `shuffle_<sid>_comp_<gid>.data` / `.cindex` / "
+            "`_par<i>.parity`",
+            "snapshot: `shuffle_<sid>_snapshot_<epoch>.snapmeta`; "
+            "tombstone: `shuffle_<sid>_gen_<gen>.tomb`",
+        ],
+    },
+}
+
+
+def max_current_format() -> int:
+    """The registry's own view of the newest wire shape — must equal or
+    trail ``version.SHUFFLE_FORMAT_VERSION`` (WIRE01 enforces per struct)."""
+    return max(s["current_format"] for s in WIRE_STRUCTS.values())
+
+
+def render_wire_doc() -> str:
+    """Markdown "Wire formats" appendix, generated from the registry
+    (``python -m tools.shuffle_lint --dump-wire-doc``). The README embeds
+    this between ``wire-doc`` markers; ``tests/test_wire_golden.py`` pins
+    that the embedded copy matches, so docs cannot drift from the schema."""
+    from s3shuffle_tpu.version import SHUFFLE_FORMAT_VERSION
+
+    lines = [
+        "All multi-word blobs are big-endian int64 words (the DataOutputStream",
+        "idiom) unless noted. Current `SHUFFLE_FORMAT_VERSION`: "
+        f"**{SHUFFLE_FORMAT_VERSION}**. Generated from",
+        "`s3shuffle_tpu/wire/schema.py` — do not edit by hand.",
+        "",
+    ]
+    for name, spec in WIRE_STRUCTS.items():
+        lines.append(f"### {spec['title']} (`{name}`)")
+        lines.append("")
+        meta = [f"declared in `{spec['module']}`", spec["kind"]]
+        if spec["current_version"] is not None:
+            meta.append(
+                f"writes v{spec['current_version']}, reads "
+                + "/".join(f"v{v}" for v in spec["read_versions"])
+            )
+        meta.append(
+            f"format {spec['since_format']}"
+            + (
+                f"→{spec['current_format']}"
+                if spec["current_format"] != spec["since_format"]
+                else ""
+            )
+        )
+        lines.append("*" + "; ".join(meta) + "*")
+        lines.append("")
+        lines.append(spec["doc"])
+        lines.append("")
+        for row in spec["layout"]:
+            lines.append(f"- {row}")
+        lines.append("")
+    return "\n".join(lines).rstrip() + "\n"

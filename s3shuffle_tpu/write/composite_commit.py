@@ -297,6 +297,7 @@ class CompositeCommitAggregator:
                     )
                 base = group.bytes
                 try:
+                    # shuffle-lint: disable=LK01 reason=appends target ONE sequential store object so serialization within the group is inherent; the per-group lock IS the design (registry lock stays I/O-free, cross-shuffle commits never convoy) and the append is mostly a bounded-queue push onto the pipelined uploader
                     self._append_under_group_lock(group, payload, int(total_bytes))
                 except Exception as e:
                     # detach the torn group; its (possibly slow) store

@@ -1,0 +1,160 @@
+"""Regenerate the golden wire-fixture corpus.
+
+    python tests/fixtures/wire/gen_fixtures.py
+
+The blobs pin back-compat PERMANENTLY: current readers must decode every
+historical version forever (tests/test_wire_golden.py). Historical-version
+blobs (snapshot v1/v2, fat index v1) are hand-assembled here from the
+layouts in s3shuffle_tpu/wire/schema.py because the current writers only
+emit the newest version — that is the point: once written, these bytes
+never change, even when the writers move on.
+
+Rerun ONLY when adding blobs for a NEW version (never to "fix" an old
+blob — an old blob that stops decoding is a broken reader, not a stale
+fixture). Current-version blobs double as writer-stability pins: the test
+asserts today's writers reproduce them byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+SNAP_MAGIC = 0x5333485348534E41  # "S3SHSNAP"
+FAT_MAGIC = 0x5333464154494458  # "S3FATIDX"
+GEOM_MAGIC = 0x5333504152474D54  # "S3PARGMT"
+
+#: shared scenario: shuffle 3, 4 partitions, two map outputs
+SID, EPOCH, P = 3, 2, 4
+PUBLISHED_US = 1_700_000_000_000_000  # fixed stamp: blobs must be stable
+
+
+def be(words) -> bytes:
+    return np.ascontiguousarray(np.asarray(words), dtype=">i8").tobytes()
+
+
+def snapshot_v1() -> bytes:
+    # header + per-row [map_id, map_index, sizes[0..P)]
+    return be(
+        [SNAP_MAGIC, 1, SID, EPOCH, P, PUBLISHED_US, 2]
+        + [7, 0, 10, 20, 30, 40]
+        + [9, 1, 11, 21, 31, 41]
+    )
+
+
+def snapshot_v2() -> bytes:
+    # v2 rows add [composite_group, base_offset]
+    return be(
+        [SNAP_MAGIC, 2, SID, EPOCH, P, PUBLISHED_US, 2]
+        + [7, 0, -1, 0, 10, 20, 30, 40]
+        + [9, 1, 5, 100, 11, 21, 31, 41]
+    )
+
+
+def snapshot_v3() -> bytes:
+    from s3shuffle_tpu.metadata.map_output import STORE_LOCATION, MapStatus
+    from s3shuffle_tpu.metadata.snapshot import MapOutputSnapshot
+
+    entries = [
+        (0, MapStatus(map_id=7, location=STORE_LOCATION,
+                      sizes=np.array([10, 20, 30, 40], dtype=np.int64),
+                      map_index=0)),
+        (1, MapStatus(map_id=9, location=STORE_LOCATION,
+                      sizes=np.array([11, 21, 31, 41], dtype=np.int64),
+                      map_index=1, composite_group=5, base_offset=100,
+                      parity_segments=2)),
+    ]
+    snap = MapOutputSnapshot(SID, EPOCH, P, entries,
+                             published_unix=PUBLISHED_US / 1e6)
+    return snap.to_bytes()
+
+
+def fat_index_v1() -> bytes:
+    # 7-word header, member rows, member-relative offsets, checksum rows
+    return be(
+        [FAT_MAGIC, 1, SID, 11, P, 2, 1]
+        + [20, 0, 0] + [21, 1, 100]
+        + [0, 25, 50, 75, 100] + [0, 16, 32, 48, 64]
+        + [101, 102, 103, 104] + [201, 202, 203, 204]
+    )
+
+
+def fat_index_v2() -> bytes:
+    from s3shuffle_tpu.coding.parity import ParityGeometry
+    from s3shuffle_tpu.metadata.fat_index import FatIndex, FatIndexMember
+
+    members = [
+        FatIndexMember(
+            map_id=20, map_index=0, base_offset=0,
+            offsets=np.array([0, 25, 50, 75, 100], dtype=np.int64),
+            checksums=np.array([101, 102, 103, 104], dtype=np.int64),
+        ),
+        FatIndexMember(
+            map_id=21, map_index=1, base_offset=100,
+            offsets=np.array([0, 16, 32, 48, 64], dtype=np.int64),
+            checksums=np.array([201, 202, 203, 204], dtype=np.int64),
+        ),
+    ]
+    parity = ParityGeometry(segments=2, stripe_k=4, chunk_bytes=32,
+                            payload_len=164)
+    return FatIndex(SID, 11, P, members, parity=parity).to_bytes()
+
+
+def index_plain_v1() -> bytes:
+    # cumulative offsets only — byte-identical to the reference writer
+    return be([0, 10, 30, 60, 100])
+
+
+def index_geom_v4() -> bytes:
+    # format-4 coded layout: same offsets + the 4-word geometry trailer
+    return be([0, 10, 30, 60, 100, GEOM_MAGIC, 2, 4, 32])
+
+
+def checksum_v1() -> bytes:
+    return be([101, 102, 103, 104])
+
+
+def parity_header_v1() -> bytes:
+    from s3shuffle_tpu.block_ids import ShuffleDataBlockId
+    from s3shuffle_tpu.coding.parity import ParityGeometry, parity_header
+
+    geometry = ParityGeometry(segments=2, stripe_k=4, chunk_bytes=32,
+                              payload_len=100)
+    header = parity_header(ShuffleDataBlockId(SID, 7), geometry, seg=1)
+    return header + b"\xaa" * 32  # one parity chunk of payload
+
+
+BLOBS = {
+    "snapshot_v1.bin": snapshot_v1,
+    "snapshot_v2.bin": snapshot_v2,
+    "snapshot_v3.bin": snapshot_v3,
+    "fat_index_v1.bin": fat_index_v1,
+    "fat_index_v2.bin": fat_index_v2,
+    "index_plain_v1.bin": index_plain_v1,
+    "index_geom_v4.bin": index_geom_v4,
+    "checksum_v1.bin": checksum_v1,
+    "parity_header_v1.bin": parity_header_v1,
+}
+
+
+def main() -> None:
+    for name, make in BLOBS.items():
+        path = os.path.join(HERE, name)
+        data = make()
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                if f.read() == data:
+                    print(f"  {name}: unchanged ({len(data)} bytes)")
+                    continue
+            print(f"  {name}: REWRITTEN — golden bytes must never change "
+                  "for an existing version; only do this for NEW blobs")
+        with open(path, "wb") as f:
+            f.write(data)
+        print(f"  {name}: wrote {len(data)} bytes")
+
+
+if __name__ == "__main__":
+    main()

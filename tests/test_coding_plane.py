@@ -55,6 +55,22 @@ def metrics_on():
     mreg.REGISTRY.reset_values()
 
 
+@pytest.fixture(autouse=True)
+def _protocol_witness(monkeypatch):
+    """Every ShuffleContext/manager these e2e tests build self-installs the
+    runtime protocol witness; teardown asserts each ran with zero
+    commit-protocol violations — the coded plane's loss/speculation runs
+    double as protocol checks. (Component-level tests that drive the
+    dispatcher directly construct no manager and are unaffected.)"""
+    from s3shuffle_tpu.utils import protowitness
+
+    monkeypatch.setenv("S3SHUFFLE_PROTOCOL_WITNESS", "1")
+    protowitness.drain_installed()
+    yield
+    for witness in protowitness.drain_installed():
+        witness.assert_clean()
+
+
 def _env(tmp_path, tag, **cfg_kwargs):
     Dispatcher.reset()
     cfg = ShuffleConfig(root_dir=f"file://{tmp_path}/{tag}", app_id=tag, **cfg_kwargs)

@@ -9,6 +9,11 @@ timeouts, 503/SlowDown on read/open/status plus one transient create) must
 The faults land UNDER the retry layer (FlakyBackend wrapped by
 RetryingBackend), the deployment topology the resilient storage plane is
 built for; payloads are small so the whole soak stays in tier-1 territory.
+
+Every soak also runs under the runtime protocol witness
+(utils/protowitness.py) wrapped OVER the fault + retry layers, so each run
+doubles as a commit-protocol check: commit-op ordering (index PUT last)
+and the seal barrier must hold even while the weather forces re-drives.
 """
 
 import pytest
@@ -25,6 +30,7 @@ from s3shuffle_tpu.storage.fault import (
     transient_timeout,
 )
 from s3shuffle_tpu.storage.retrying import RetryingBackend
+from s3shuffle_tpu.utils import protowitness
 
 N_MAPS = 3
 N_PARTS = 4
@@ -118,7 +124,11 @@ def test_fault_soak_shuffle_byte_identical(tmp_path, metrics_on, composite_maps)
         raw = LocalBackend()
         flaky = FlakyBackend(raw, rules=_soak_rules())
         disp.backend = RetryingBackend(flaky, disp.retry_policy)
-        handle, _expected2, soak_out = _run_shuffle(ctx)
+        # witness wraps LAST — over fault + retry — so it checks the op
+        # order the product code actually commits, after healing
+        with protowitness.watching(ctx.manager) as witness:
+            handle, _expected2, soak_out = _run_shuffle(ctx)
+        witness.assert_clean()
 
         # byte-identical to the fault-free run
         assert soak_out == clean_out
@@ -186,27 +196,29 @@ def test_fault_soak_object_loss_mode(tmp_path, metrics_on, k, m):
         sid = next(ctx._next_shuffle_id)
         dep = ShuffleDependency(sid, HashPartitioner(N_PARTS))
         handle = ctx.manager.register_shuffle(sid, dep)
-        per_map = len(records) // N_MAPS
-        for map_id in range(N_MAPS):
-            w = ctx.manager.get_writer(handle, map_id)
-            w.write(records[map_id * per_map : (map_id + 1) * per_map])
-            w.stop(success=True)
+        with protowitness.watching(ctx.manager) as witness:
+            per_map = len(records) // N_MAPS
+            for map_id in range(N_MAPS):
+                w = ctx.manager.get_writer(handle, map_id)
+                w.write(records[map_id * per_map : (map_id + 1) * per_map])
+                w.stop(success=True)
 
-        disp = ctx.manager.dispatcher
-        raw = LocalBackend()
-        # post-commit loss: a seeded subset (here: every other map's data
-        # object — 2 of 3) vanishes before any reduce read
-        rng_loss = __import__("random").Random(77)
-        lost = [mid for mid in range(N_MAPS) if rng_loss.random() < 0.7]
-        assert lost, "seed produced no losses"
-        for mid in lost:
-            disp.backend.delete(disp.get_path(ShuffleDataBlockId(sid, mid)))
-        disp.clear_status_cache()
+            disp = ctx.manager.dispatcher
+            # post-commit loss: a seeded subset (here: every other map's
+            # data object — 2 of 3) vanishes before any reduce read
+            rng_loss = __import__("random").Random(77)
+            lost = [mid for mid in range(N_MAPS) if rng_loss.random() < 0.7]
+            assert lost, "seed produced no losses"
+            for mid in lost:
+                disp.backend.delete(disp.get_path(ShuffleDataBlockId(sid, mid)))
+            disp.clear_status_cache()
 
-        out = []
-        for rid in range(N_PARTS):
-            out.extend(ctx.manager.get_reader(handle, rid, rid + 1).read())
-        assert sorted(out) == clean_out  # byte-identical despite the losses
+            out = []
+            for rid in range(N_PARTS):
+                out.extend(ctx.manager.get_reader(handle, rid, rid + 1).read())
+            assert sorted(out) == clean_out  # byte-identical despite losses
+        # degraded reads + reconstruction must still respect the protocol
+        witness.assert_clean()
 
         snap = metrics_on.snapshot(compact=True)
         recon = sum(
@@ -218,9 +230,10 @@ def test_fault_soak_object_loss_mode(tmp_path, metrics_on, k, m):
         )
         assert recon >= len(lost), f"expected >= {len(lost)} reconstructions"
 
-        # cleanup: zero residual objects, .parity included
+        # cleanup: zero residual objects, .parity included (raw listing —
+        # no fault or witness layer in the way)
         ctx.manager.unregister_shuffle(handle.shuffle_id)
-        assert raw.list_prefix(f"file://{tmp_path}/loss") == []
+        assert LocalBackend().list_prefix(f"file://{tmp_path}/loss") == []
 
 
 def test_fault_soak_weather_is_seeded_deterministic(tmp_path):

@@ -1,0 +1,320 @@
+"""Protocol witness (utils/protowitness.py): commit-op ordering and the
+seal barrier, checked at runtime.
+
+The fail-pre-fix test reverts the PR-10 seal-barrier fix
+(``CompositeCommitAggregator._await_seals``) and shows the witness catching
+the composite record-loss race the fix exists to prevent — the regression
+proof ORD01's static view cannot give (the race is a runtime interleaving,
+not a statement order).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from s3shuffle_tpu.config import ShuffleConfig
+from s3shuffle_tpu.dependency import HashPartitioner, ShuffleDependency
+from s3shuffle_tpu.metadata.fat_index import FatIndex, FatIndexMember
+from s3shuffle_tpu.shuffle import ShuffleContext
+from s3shuffle_tpu.storage.backend import MemoryBackend
+from s3shuffle_tpu.storage.dispatcher import Dispatcher
+from s3shuffle_tpu.utils import protowitness
+from s3shuffle_tpu.utils.protowitness import (
+    ProtocolViolationError,
+    ProtocolWitness,
+    WitnessedBackend,
+    classify,
+)
+
+N_PARTS = 4
+N_RECORDS = 800
+
+
+def _records():
+    import random
+
+    rng = random.Random(7)
+    return [(rng.randbytes(8), rng.randbytes(16)) for _ in range(N_RECORDS)]
+
+
+def _run_shuffle(ctx, n_maps=3):
+    records = _records()
+    sid = next(ctx._next_shuffle_id)
+    dep = ShuffleDependency(sid, HashPartitioner(N_PARTS))
+    handle = ctx.manager.register_shuffle(sid, dep)
+    per_map = len(records) // n_maps
+    for map_id in range(n_maps):
+        hi = (map_id + 1) * per_map if map_id < n_maps - 1 else len(records)
+        w = ctx.manager.get_writer(handle, map_id)
+        w.write(records[map_id * per_map : hi])
+        w.stop(success=True)
+    out = []
+    for rid in range(N_PARTS):
+        out.extend(ctx.manager.get_reader(handle, rid, rid + 1).read())
+    return handle, sorted(records), sorted(out)
+
+
+# ---------------------------------------------------------------------------
+# Object-name classification (the witness's event grammar)
+# ---------------------------------------------------------------------------
+
+
+def test_classify_grammar():
+    assert classify("root/7/shuffle_3_7_0.data") == ("data", ("map", 3, 7))
+    assert classify("shuffle_3_7_0.index") == ("index", ("map", 3, 7))
+    assert classify("shuffle_3_7_0.checksum.CRC32C") == (
+        "checksum", ("map", 3, 7),
+    )
+    assert classify("shuffle_3_7_par1.parity") == ("parity", ("map", 3, 7))
+    assert classify("shuffle_3_comp_9.data") == ("data", ("comp", 3, 9))
+    assert classify("shuffle_3_comp_9.cindex") == ("index", ("comp", 3, 9))
+    assert classify("shuffle_3_comp_9_par0.parity") == (
+        "parity", ("comp", 3, 9),
+    )
+    # lifecycle objects are outside the commit protocol
+    assert classify("shuffle_3_snapshot_2.snapmeta") is None
+    assert classify("shuffle_3_gen_5.tomb") is None
+    assert classify("some/other/file.txt") is None
+
+
+# ---------------------------------------------------------------------------
+# Commit-op ordering over a wrapped backend
+# ---------------------------------------------------------------------------
+
+
+def _put(backend, path, payload=b"x"):
+    with backend.create(path) as s:
+        s.write(payload)
+
+
+def test_post_commit_sidecar_put_flagged():
+    w = ProtocolWitness()
+    backend = WitnessedBackend(MemoryBackend(), w)
+    _put(backend, "memory:///r/shuffle_1_2_0.data")
+    _put(backend, "memory:///r/shuffle_1_2_0.index")
+    assert w.violations == []
+    # BUG shape: a parity PUT for the same commit after its index landed
+    _put(backend, "memory:///r/shuffle_1_2_par0.parity")
+    assert any("AFTER the commit point" in v for v in w.violations)
+
+
+def test_index_put_while_data_stream_open_flagged():
+    w = ProtocolWitness()
+    backend = WitnessedBackend(MemoryBackend(), w)
+    data = backend.create("memory:///r/shuffle_1_2_0.data")
+    data.write(b"payload")
+    _put(backend, "memory:///r/shuffle_1_2_0.index")  # data not closed yet
+    data.close()
+    assert any("still open" in v for v in w.violations)
+
+
+def test_index_reput_is_allowed():
+    # the retry layer re-drives sidecar PUTs whole; an index overwrite is
+    # idempotent, not a protocol breach
+    w = ProtocolWitness()
+    backend = WitnessedBackend(MemoryBackend(), w)
+    _put(backend, "memory:///r/shuffle_1_2_0.data")
+    _put(backend, "memory:///r/shuffle_1_2_0.index")
+    _put(backend, "memory:///r/shuffle_1_2_0.index")
+    assert w.violations == []
+
+
+def test_rename_counts_as_data_commit():
+    # the single-spill fast path renames the local spill into the data slot
+    w = ProtocolWitness()
+    backend = WitnessedBackend(MemoryBackend(), w)
+    _put(backend, "memory:///r/spill.tmp")
+    assert backend.rename("memory:///r/spill.tmp", "memory:///r/shuffle_1_2_0.data")
+    _put(backend, "memory:///r/shuffle_1_2_0.index")
+    assert w.violations == []
+
+
+def test_failed_create_retry_is_not_a_double_put():
+    class _Flaky(MemoryBackend):
+        def __init__(self):
+            super().__init__()
+            self.fail_next = True
+
+        def create(self, path):
+            if self.fail_next:
+                self.fail_next = False
+                raise TimeoutError("transient")
+            return super().create(path)
+
+    w = ProtocolWitness()
+    backend = WitnessedBackend(_Flaky(), w)
+    with pytest.raises(TimeoutError):
+        backend.create("memory:///r/shuffle_1_2_0.data")
+    _put(backend, "memory:///r/shuffle_1_2_0.data")
+    _put(backend, "memory:///r/shuffle_1_2_0.index")
+    assert w.violations == []
+
+
+def test_assert_clean_raises_with_details():
+    w = ProtocolWitness()
+    backend = WitnessedBackend(MemoryBackend(), w)
+    _put(backend, "memory:///r/shuffle_1_2_0.index")
+    _put(backend, "memory:///r/shuffle_1_2_0.checksum.CRC32C")
+    with pytest.raises(ProtocolViolationError, match="commit point"):
+        w.assert_clean()
+
+
+# ---------------------------------------------------------------------------
+# Seal barrier: fat-index membership vs tracker registration
+# ---------------------------------------------------------------------------
+
+
+def _fat_blob(sid=5, gid=11, mids=(20, 21)):
+    members = [
+        FatIndexMember(
+            map_id=m, map_index=i, base_offset=i * 64,
+            offsets=np.array([0, 16, 32, 48, 64], dtype=np.int64),
+        )
+        for i, m in enumerate(mids)
+    ]
+    return FatIndex(sid, gid, 4, members).to_bytes()
+
+
+def test_lookup_inside_seal_window_flagged():
+    w = ProtocolWitness()
+    backend = WitnessedBackend(MemoryBackend(), w)
+    _put(backend, "memory:///r/shuffle_5_comp_11.data")
+    _put(backend, "memory:///r/shuffle_5_comp_11.cindex", _fat_blob())
+    # committed but unregistered: an enumeration now is the record-loss race
+    w.note_lookup(5)
+    assert any("seal-barrier breach" in v for v in w.violations)
+
+
+def test_lookup_after_registration_clean():
+    from s3shuffle_tpu.metadata.map_output import STORE_LOCATION, MapStatus
+
+    w = ProtocolWitness()
+    backend = WitnessedBackend(MemoryBackend(), w)
+    _put(backend, "memory:///r/shuffle_5_comp_11.data")
+    _put(backend, "memory:///r/shuffle_5_comp_11.cindex", _fat_blob())
+    w.note_registered(5, [20, 21])
+    w.note_lookup(5)
+    w.note_read("memory:///r/shuffle_5_comp_11.data")
+    assert w.violations == []
+    # MapStatus import is exercised by the e2e runs below; keep the symbol
+    # referenced so this focused test and those stay in the same module
+    assert MapStatus(map_id=1, location=STORE_LOCATION, sizes=[1]).map_id == 1
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: clean runs stay clean, env-var wiring works
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "composite_maps", [0, 2], ids=["per-map-layout", "composite-commits"]
+)
+def test_witnessed_shuffle_run_is_clean(tmp_path, composite_maps):
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/w", app_id="pw", cleanup=True,
+        composite_commit_maps=composite_maps,
+    )
+    with ShuffleContext(config=cfg, num_workers=2) as ctx:
+        with protowitness.watching(ctx.manager) as w:
+            _handle, expected, out = _run_shuffle(ctx)
+            assert out == expected
+        w.assert_clean()
+
+
+def test_witnessed_coded_run_is_clean(tmp_path):
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/coded", app_id="pw", cleanup=True,
+        parity_segments=1, parity_stripe_k=2, parity_chunk_bytes=1024,
+    )
+    with ShuffleContext(config=cfg, num_workers=2) as ctx:
+        with protowitness.watching(ctx.manager) as w:
+            _handle, expected, out = _run_shuffle(ctx)
+            assert out == expected
+        w.assert_clean()
+
+
+def test_env_var_installs_witness(tmp_path, monkeypatch):
+    Dispatcher.reset()
+    monkeypatch.setenv("S3SHUFFLE_PROTOCOL_WITNESS", "1")
+    cfg = ShuffleConfig(root_dir=f"file://{tmp_path}/env", app_id="pw")
+    with ShuffleContext(config=cfg, num_workers=1) as ctx:
+        assert ctx.manager.protocol_witness is not None
+        _handle, expected, out = _run_shuffle(ctx, n_maps=2)
+        assert out == expected
+        ctx.manager.protocol_witness.assert_clean()
+
+
+def test_env_var_off_means_nothing_wrapped(tmp_path, monkeypatch):
+    Dispatcher.reset()
+    monkeypatch.delenv("S3SHUFFLE_PROTOCOL_WITNESS", raising=False)
+    cfg = ShuffleConfig(root_dir=f"file://{tmp_path}/off", app_id="pw")
+    with ShuffleContext(config=cfg, num_workers=1) as ctx:
+        assert ctx.manager.protocol_witness is None
+        assert not isinstance(
+            ctx.manager.dispatcher.backend, protowitness.WitnessedBackend
+        )
+
+
+# ---------------------------------------------------------------------------
+# FAIL-PRE-FIX: reverting the PR-10 seal barrier trips the witness
+# ---------------------------------------------------------------------------
+
+
+def test_seal_barrier_revert_caught_by_witness(tmp_path, monkeypatch):
+    """Revert ``_await_seals`` (the PR-10 fix) and replay the record-loss
+    interleaving deterministically: thread B's group seal lands the fat
+    index, then parks before the registration callback; the main thread's
+    reader — whose barrier flush now returns without draining B's seal —
+    enumerates map outputs inside the window. The witness must flag it."""
+    from s3shuffle_tpu.write.composite_commit import CompositeCommitAggregator
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/revert", app_id="pw", cleanup=True,
+        composite_commit_maps=8,  # far above 2 maps: no threshold seal
+    )
+    # THE REVERT: the barrier no longer waits out in-flight seals
+    monkeypatch.setattr(
+        CompositeCommitAggregator, "_await_seals",
+        lambda self, shuffle_id: None,
+    )
+    with ShuffleContext(config=cfg, num_workers=2) as ctx:
+        with protowitness.watching(ctx.manager) as w:
+            records = _records()
+            sid = next(ctx._next_shuffle_id)
+            dep = ShuffleDependency(sid, HashPartitioner(N_PARTS))
+            handle = ctx.manager.register_shuffle(sid, dep)
+            for map_id in range(2):
+                wtr = ctx.manager.get_writer(handle, map_id)
+                wtr.write(records[map_id * 400 : (map_id + 1) * 400])
+                wtr.stop(success=True)
+
+            agg = ctx.manager.composite
+            committed_evt, resume_evt = threading.Event(), threading.Event()
+            original_commit = agg.on_group_commit
+
+            def parked_commit(shuffle_id, members):
+                committed_evt.set()  # fat index already landed (commit point)
+                assert resume_evt.wait(10)
+                original_commit(shuffle_id, members)
+
+            agg.on_group_commit = parked_commit
+            sealer = threading.Thread(
+                target=agg.flush_shuffle, args=(sid,), daemon=True
+            )
+            sealer.start()
+            assert committed_evt.wait(10)
+            try:
+                # pre-fix behavior: this returns immediately (no group in the
+                # registry, no barrier wait) and the scan misses the members
+                reader = ctx.manager.get_reader(handle, 0, 1)
+                reader.read()
+            finally:
+                resume_evt.set()
+                sealer.join(timeout=10)
+            assert any("seal-barrier breach" in v for v in w.violations), (
+                "witness missed the record-loss race:\n" + "\n".join(w.violations)
+            )

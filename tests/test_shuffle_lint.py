@@ -23,6 +23,18 @@ FIXTURE_MODEL = ProjectModel(
     config_fields={"buffer_size", "root_dir"},
     config_methods={"log_values", "from_dict", "from_env", "scheme"},
     metric_names={"read_prefetch_wait_seconds": "histogram"},
+    metric_labels={"read_prefetch_wait_seconds": ()},
+    wire_structs={
+        "demo": {
+            "module": "<fixture>",
+            "constants": {"_MAGIC": 7, "_VERSION": 2},
+            "read_versions": [1, 2],
+            "current_version": 2,
+            "since_format": 1,
+            "current_format": 1,
+        }
+    },
+    shuffle_format_version=1,
 )
 
 
@@ -332,6 +344,243 @@ def f(x):
 
 
 # ---------------------------------------------------------------------------
+# MET01 label sets + CFG01 dead knobs (the satellite halves)
+# ---------------------------------------------------------------------------
+
+
+def test_met01_registration_labelnames_drift_flagged():
+    model = ProjectModel(
+        metric_names={"meta_lookup_source_total": "counter"},
+        metric_labels={"meta_lookup_source_total": ("source",)},
+    )
+    src = (
+        "from s3shuffle_tpu.metrics import registry as _metrics\n"
+        "_C = _metrics.REGISTRY.counter(\n"
+        '    "meta_lookup_source_total", "d", labelnames=("mode",),\n'
+        ")\n"
+    )
+    fired = [v for v in _lint(src, model=model) if v.rule == "MET01"]
+    assert fired and "label" in fired[0].message.lower()
+
+
+def test_met01_labels_callsite_key_drift_flagged():
+    model = ProjectModel(
+        metric_names={"meta_lookup_source_total": "counter"},
+        metric_labels={"meta_lookup_source_total": ("source",)},
+    )
+    src = (
+        "from s3shuffle_tpu.metrics import registry as _metrics\n"
+        "_C = _metrics.REGISTRY.counter(\n"
+        '    "meta_lookup_source_total", "d", labelnames=("source",),\n'
+        ")\n"
+        "def hit():\n"
+        '    _C.labels(mode="snapshot").inc()\n'
+    )
+    fired = [v for v in _lint(src, model=model) if v.rule == "MET01"]
+    assert fired, "label-key drift at the .labels() call site passed lint"
+    src_ok = src.replace('mode="snapshot"', 'source="snapshot"')
+    assert [v for v in _lint(src_ok, model=model) if v.rule == "MET01"] == []
+
+
+def _dead_knob_project(tmp_path, suppress=False):
+    pkg = tmp_path / "s3shuffle_tpu"
+    pkg.mkdir()
+    (tmp_path / "pyproject.toml").write_text("")
+    reserved = (
+        "    reserved_knob: int = 0"
+        + ("  # shuffle-lint: disable=CFG01 reason=held for the elastic-fleet PR\n"
+           if suppress else "\n")
+    )
+    (pkg / "config.py").write_text(
+        "class ShuffleConfig:\n"
+        "    buffer_size: int = 4096\n" + reserved
+    )
+    (pkg / "user.py").write_text(
+        "def f(config):\n    return config.buffer_size\n"
+    )
+    # dead-knob detection only arms on a broad scan (>= 10 package files)
+    for i in range(10):
+        (pkg / f"filler_{i}.py").write_text(f"VALUE_{i} = {i}\n")
+    return [str(pkg)]
+
+
+def test_cfg01_dead_knob_detected_on_broad_scan(tmp_path):
+    violations = lint_paths(
+        _dead_knob_project(tmp_path), project_root=str(tmp_path)
+    )
+    dead = [
+        v for v in violations
+        if v.rule == "CFG01" and not v.suppressed and "never read" in v.message
+    ]
+    assert len(dead) == 1 and "reserved_knob" in dead[0].message
+    assert not any("buffer_size" in v.message for v in dead)
+
+
+def test_cfg01_dead_knob_reserved_suppression(tmp_path):
+    violations = lint_paths(
+        _dead_knob_project(tmp_path, suppress=True),
+        project_root=str(tmp_path),
+    )
+    assert [v for v in violations if not v.suppressed] == [], "\n".join(
+        v.format() for v in violations if not v.suppressed
+    )
+    held = [v for v in violations if v.suppressed and v.rule == "CFG01"]
+    assert held and held[0].reason == "held for the elastic-fleet PR"
+
+
+def test_cfg01_dead_knob_inert_on_narrow_scan(tmp_path):
+    paths = _dead_knob_project(tmp_path)
+    for i in range(10):  # shrink below the arming threshold
+        os.unlink(os.path.join(paths[0], f"filler_{i}.py"))
+    violations = lint_paths(paths, project_root=str(tmp_path))
+    assert [v for v in violations if not v.suppressed] == [], (
+        "dead-knob detection must not fire vacuously on a narrow scan"
+    )
+
+
+# ---------------------------------------------------------------------------
+# ORD01 fail-pre-fix: reordering a REAL commit path trips lint
+# ---------------------------------------------------------------------------
+
+
+def _find_stmt(body, predicate):
+    """Depth-first search for the first statement matching ``predicate``;
+    returns (containing_list, index)."""
+    import ast as _ast
+
+    for i, stmt in enumerate(body):
+        if predicate(stmt):
+            return body, i
+        for child_body in (
+            getattr(stmt, "body", []),
+            getattr(stmt, "orelse", []),
+            getattr(stmt, "finalbody", []),
+        ):
+            if isinstance(child_body, list) and child_body:
+                found = _find_stmt(child_body, predicate)
+                if found is not None:
+                    return found
+        for handler in getattr(stmt, "handlers", []):
+            found = _find_stmt(handler.body, predicate)
+            if found is not None:
+                return found
+    return None
+
+
+def _calls_in(stmt):
+    import ast as _ast
+
+    return {
+        node.func.attr if isinstance(node.func, _ast.Attribute)
+        else getattr(node.func, "id", None)
+        for node in _ast.walk(stmt)
+        if isinstance(node, _ast.Call)
+    }
+
+
+def test_ord01_flags_reordered_real_commit_path():
+    """The regression proof: take the ACTUAL per-map commit path
+    (write/map_output_writer.py), move the data close AFTER the index PUT —
+    the exact torn-commit reorder ORD01 exists to forbid — and lint must
+    fail; the unmodified file must stay clean. A future refactor that
+    reorders the commit sequence cannot land without tripping this."""
+    import ast as _ast
+
+    path = os.path.join(PKG, "write", "map_output_writer.py")
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+
+    # the real file is ORD01-clean as written
+    clean = [
+        v for v in lint_source(source, path)
+        if v.rule == "ORD01" and not v.suppressed
+    ]
+    assert clean == [], "\n".join(v.format() for v in clean)
+
+    tree = _ast.parse(source)
+    fn = next(
+        node for node in _ast.walk(tree)
+        if isinstance(node, _ast.FunctionDef)
+        and node.name == "commit_all_partitions"
+    )
+    # THE REORDER: pull `self._stream.close()` out of its slot and run it
+    # after everything else — i.e. after write_partition_lengths committed
+    found = _find_stmt(
+        fn.body,
+        lambda s: isinstance(s, _ast.Expr) and "close" in _calls_in(s),
+    )
+    assert found is not None, "commit path no longer closes a stream?"
+    body, i = found
+    close_stmt = body.pop(i)
+    fn.body.append(close_stmt)
+    assert any(
+        "write_partition_lengths" in _calls_in(s) for s in _ast.walk(fn)
+        if isinstance(s, _ast.stmt)
+    ), "commit path no longer writes an index?"
+
+    mutated = _ast.unparse(_ast.fix_missing_locations(tree))
+    fired = [
+        v for v in lint_source(mutated, path)
+        if v.rule == "ORD01" and not v.suppressed
+    ]
+    assert fired, (
+        "ORD01 missed the index-before-data-close reorder of the real "
+        "commit path — the regression guard is dead"
+    )
+    assert any("commit point" in v.message for v in fired)
+
+
+def test_ord01_flags_parity_put_after_fat_index_in_composite_path():
+    """Same proof on the composite commit path: move the parity PUT after
+    write_fat_index (the group's commit point) and ORD01 must fire."""
+    import ast as _ast
+
+    path = os.path.join(PKG, "write", "composite_commit.py")
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    clean = [
+        v for v in lint_source(source, path)
+        if v.rule == "ORD01" and not v.suppressed
+    ]
+    assert clean == [], "\n".join(v.format() for v in clean)
+
+    tree = _ast.parse(source)
+    fn = next(
+        node for node in _ast.walk(tree)
+        if isinstance(node, _ast.FunctionDef)
+        and any(
+            "write_fat_index" in _calls_in(s)
+            for s in _ast.walk(node) if isinstance(s, _ast.stmt)
+        )
+    )
+    # the precise simple statements, not an enclosing try/with that merely
+    # contains them somewhere in its walk
+    found = _find_stmt(
+        fn.body,
+        lambda s: isinstance(s, _ast.Assign)
+        and "put_parity_objects" in _calls_in(s),
+    )
+    assert found is not None, "composite path no longer PUTs parity?"
+    body, i = found
+    parity_stmt = body.pop(i)
+    idx = _find_stmt(
+        fn.body,
+        lambda s: isinstance(s, _ast.Expr)
+        and "write_fat_index" in _calls_in(s),
+    )
+    assert idx is not None
+    idx_body, j = idx
+    idx_body.insert(j + 1, parity_stmt)
+
+    mutated = _ast.unparse(_ast.fix_missing_locations(tree))
+    fired = [
+        v for v in lint_source(mutated, path)
+        if v.rule == "ORD01" and not v.suppressed
+    ]
+    assert fired, "ORD01 missed parity-after-fat-index on the composite path"
+
+
+# ---------------------------------------------------------------------------
 # The merged tree is clean (the tier-1 gate) and the CLI contract holds
 # ---------------------------------------------------------------------------
 
@@ -382,6 +631,166 @@ def test_cli_selftest():
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "selftest OK" in proc.stdout
+
+
+def test_cli_sarif_output(tmp_path):
+    bad = tmp_path / "seeded_exc01.py"
+    bad.write_text(
+        next(r for r in ALL_RULES if r.RULE_ID == "EXC01").POSITIVE
+    )
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "tools.shuffle_lint",
+            "--format", "sarif", str(bad),
+        ],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "shuffle-lint"
+    declared = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert declared == {r.RULE_ID for r in ALL_RULES}
+    fired = {r["ruleId"] for r in run["results"] if "suppressions" not in r}
+    assert "EXC01" in fired
+    loc = run["results"][0]["locations"][0]["physicalLocation"]
+    assert loc["region"]["startLine"] >= 1
+
+
+def test_cli_sarif_carries_suppression_justification(tmp_path):
+    src = (
+        "try:\n"
+        "    pass\n"
+        "except Exception:  # shuffle-lint: disable=EXC01 "
+        "reason=fixture justification\n"
+        "    pass\n"
+    )
+    bad = tmp_path / "suppressed.py"
+    bad.write_text(src)
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "tools.shuffle_lint",
+            "--format", "sarif", str(bad),
+        ],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    suppressed = [
+        r for r in doc["runs"][0]["results"] if "suppressions" in r
+    ]
+    assert suppressed, "suppressed finding missing from SARIF output"
+    assert suppressed[0]["suppressions"][0]["justification"] == (
+        "fixture justification"
+    )
+
+
+def test_cli_changed_only_filters_to_git_diff(tmp_path):
+    """--changed-only scopes REPORTING to git-changed files while the scan
+    stays whole-tree; in a scratch repo with one clean and one dirty file,
+    only the dirty file's findings surface."""
+    # pyproject.toml anchors find_project_root at the scratch repo, so the
+    # git diff runs THERE and not in whatever repo hosts the test run
+    (tmp_path / "pyproject.toml").write_text("")
+    clean = tmp_path / "committed_clean.py"
+    clean.write_text(
+        next(r for r in ALL_RULES if r.RULE_ID == "EXC01").POSITIVE
+    )
+    git = lambda *args: subprocess.run(  # noqa: E731
+        ["git", *args], cwd=tmp_path, capture_output=True, text=True,
+        timeout=30, check=True,
+    )
+    git("init", "-q")
+    git("-c", "user.email=t@t", "-c", "user.name=t", "add", ".")
+    git("-c", "user.email=t@t", "-c", "user.name=t", "commit", "-qm", "x")
+    dirty = tmp_path / "uncommitted_dirty.py"
+    dirty.write_text(
+        next(r for r in ALL_RULES if r.RULE_ID == "THR01").POSITIVE
+    )
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "tools.shuffle_lint",
+            "--changed-only", "--format", "json", str(tmp_path),
+        ],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120,
+        env={**os.environ, "PYTHONPATH": REPO_ROOT},
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    reported_paths = {os.path.basename(v["path"]) for v in doc["violations"]}
+    assert reported_paths == {"uncommitted_dirty.py"}, reported_paths
+
+
+def test_cli_changed_only_in_monorepo_subdir(tmp_path):
+    """Project root a SUBDIRECTORY of the git toplevel: `git diff
+    --name-only` prints toplevel-relative paths, so a naive join onto the
+    project root would miss every tracked change and green-light the
+    gate."""
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "pyproject.toml").write_text("")
+    tracked = proj / "tracked.py"
+    tracked.write_text("x = 1\n")
+    git = lambda *args: subprocess.run(  # noqa: E731
+        ["git", *args], cwd=tmp_path, capture_output=True, text=True,
+        timeout=30, check=True,
+    )
+    git("init", "-q")
+    git("-c", "user.email=t@t", "-c", "user.name=t", "add", ".")
+    git("-c", "user.email=t@t", "-c", "user.name=t", "commit", "-qm", "x")
+    tracked.write_text(  # MODIFY the tracked file with a violation
+        next(r for r in ALL_RULES if r.RULE_ID == "EXC01").POSITIVE
+    )
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "tools.shuffle_lint",
+            "--changed-only", "--format", "json", str(proj),
+        ],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120,
+        env={**os.environ, "PYTHONPATH": REPO_ROOT},
+    )
+    assert proc.returncode == 1, (
+        "tracked change in a monorepo subdir was filtered out "
+        "(vacuously green gate):\n" + proc.stdout + proc.stderr
+    )
+    doc = json.loads(proc.stdout)
+    assert {os.path.basename(v["path"]) for v in doc["violations"]} == {
+        "tracked.py"
+    }
+
+
+def test_cli_changed_only_outside_git_is_an_error(tmp_path):
+    (tmp_path / "pyproject.toml").write_text("")
+    lone = tmp_path / "lone.py"
+    lone.write_text("x = 1\n")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "tools.shuffle_lint",
+            "--changed-only", str(lone),
+        ],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120,
+        env={
+            **os.environ,
+            "PYTHONPATH": REPO_ROOT,
+            # make sure the scratch dir is not inside some enclosing repo
+            "GIT_CEILING_DIRECTORIES": str(tmp_path),
+        },
+    )
+    # a vacuously green gate is worse than a loud one: no git ⇒ exit 2
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "git" in proc.stderr.lower()
+
+
+def test_cli_dump_wire_doc_matches_registry():
+    from s3shuffle_tpu.wire.schema import render_wire_doc
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.shuffle_lint", "--dump-wire-doc"],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout == render_wire_doc()
 
 
 # ---------------------------------------------------------------------------

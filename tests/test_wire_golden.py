@@ -1,0 +1,229 @@
+"""Golden wire-fixture corpus: historical blobs decode forever.
+
+Every blob under ``tests/fixtures/wire/`` is a frozen byte string of one
+wire-struct version (see ``gen_fixtures.py`` there). These tests pin:
+
+- **back-compat permanently**: the CURRENT readers decode every historical
+  version (snapshot v1/v2, fat index v1, trailer-less index) — a reader
+  "cleanup" that drops an old branch fails here even though every writer
+  round-trip still passes (WIRE01's static guard is the lint-time half);
+- **writer stability**: today's writers reproduce the current-version blobs
+  byte-for-byte, so an accidental wire change (field reorder, dtype drift)
+  is a diff against checked-in bytes, not a silent skew;
+- **registry honesty**: a synthetic schema-registry edit without a
+  ``SHUFFLE_FORMAT_VERSION`` bump trips WIRE01 on the real tree, and the
+  README's generated wire-format appendix matches the registry.
+"""
+
+import os
+import re
+
+import numpy as np
+import pytest
+
+from s3shuffle_tpu.coding.parity import (
+    ParityGeometry,
+    parse_parity_header,
+    split_index_geometry,
+)
+from s3shuffle_tpu.metadata.fat_index import FatIndex
+from s3shuffle_tpu.metadata.snapshot import MapOutputSnapshot
+from s3shuffle_tpu.wire.schema import WIRE_STRUCTS, render_wire_doc
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO_ROOT, "tests", "fixtures", "wire")
+
+
+def blob(name: str) -> bytes:
+    with open(os.path.join(FIXTURES, name), "rb") as f:
+        return f.read()
+
+
+def words_of(data: bytes) -> np.ndarray:
+    return np.frombuffer(data, dtype=">i8").astype(np.int64)
+
+
+# ---------------------------------------------------------------------------
+# Snapshots: v1 and v2 decode forever, v3 is the current writer's output
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("version", [1, 2, 3])
+def test_snapshot_golden_decodes(version):
+    snap = MapOutputSnapshot.from_bytes(blob(f"snapshot_v{version}.bin"))
+    assert snap.shuffle_id == 3
+    assert snap.epoch == 2
+    assert snap.num_partitions() == 4
+    assert snap.registered_map_ids() == [7, 9]
+    by_map = {s.map_id: s for _i, s in snap.entries}
+    assert list(by_map[7].sizes) == [10, 20, 30, 40]
+    assert list(by_map[9].sizes) == [11, 21, 31, 41]
+    if version == 1:  # pre-composite rows default to the classic layout
+        assert by_map[9].composite_group == -1
+        assert by_map[9].base_offset == 0
+    else:
+        assert by_map[9].composite_group == 5
+        assert by_map[9].base_offset == 100
+    # parity_segments arrived in v3; older rows default to uncoded
+    assert by_map[9].parity_segments == (2 if version == 3 else 0)
+
+
+def test_snapshot_writer_matches_current_golden():
+    snap = MapOutputSnapshot.from_bytes(blob("snapshot_v3.bin"))
+    assert snap.to_bytes() == blob("snapshot_v3.bin")
+
+
+# ---------------------------------------------------------------------------
+# Fat index: v1 decodes forever, v2 is the current writer's output
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("version", [1, 2])
+def test_fat_index_golden_decodes(version):
+    fat = FatIndex.from_bytes(blob(f"fat_index_v{version}.bin"))
+    assert (fat.shuffle_id, fat.group_id, fat.num_partitions) == (3, 11, 4)
+    assert sorted(fat.members) == [20, 21]
+    assert fat.has_checksums
+    m = fat.member(21)
+    assert (m.map_index, m.base_offset, m.total_bytes) == (1, 100, 64)
+    assert list(m.offsets) == [0, 16, 32, 48, 64]
+    assert list(m.checksums) == [201, 202, 203, 204]
+    if version == 1:  # pre-coding blobs carry no geometry
+        assert fat.parity is None
+    else:
+        assert fat.parity == ParityGeometry(2, 4, 32, 164)
+
+
+def test_fat_index_writer_matches_current_golden():
+    fat = FatIndex.from_bytes(blob("fat_index_v2.bin"))
+    assert fat.to_bytes() == blob("fat_index_v2.bin")
+
+
+# ---------------------------------------------------------------------------
+# Per-map index (+ geometry trailer), checksum sidecar, parity header
+# ---------------------------------------------------------------------------
+
+
+def test_index_plain_golden_decodes():
+    offsets, geometry = split_index_geometry(words_of(blob("index_plain_v1.bin")))
+    assert list(offsets) == [0, 10, 30, 60, 100]
+    assert geometry is None
+
+
+def test_index_geometry_trailer_golden_decodes():
+    # the PR-10 bug class: these four words must NEVER reach offset
+    # consumers — split_index_geometry peels them off by magic
+    offsets, geometry = split_index_geometry(words_of(blob("index_geom_v4.bin")))
+    assert list(offsets) == [0, 10, 30, 60, 100]
+    assert geometry == ParityGeometry(2, 4, 32, 100)
+
+
+def test_checksum_golden_decodes():
+    assert list(words_of(blob("checksum_v1.bin"))) == [101, 102, 103, 104]
+
+
+def test_parity_header_golden_decodes():
+    data = blob("parity_header_v1.bin")
+    geometry = parse_parity_header(data)
+    assert geometry == ParityGeometry(2, 4, 32, 100)
+    header = words_of(data[:64])
+    assert (int(header[2]), int(header[3])) == (3, 1)  # shuffle_id, seg
+    assert data[64:] == b"\xaa" * 32  # payload untouched past the header
+
+
+def test_parity_header_truncated_raises():
+    with pytest.raises(ValueError, match="too short"):
+        parse_parity_header(blob("parity_header_v1.bin")[:40])
+
+
+# ---------------------------------------------------------------------------
+# Registry honesty: WIRE01 negative fixture + generated doc sync
+# ---------------------------------------------------------------------------
+
+
+def _lint_real_module(rel_path, model):
+    from tools.shuffle_lint.core import lint_source
+
+    path = os.path.join(REPO_ROOT, rel_path)
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    return [
+        v for v in lint_source(source, path, model=model)
+        if v.rule == "WIRE01" and not v.suppressed
+    ]
+
+
+def test_registry_edit_without_version_bump_trips_wire01():
+    """The embedded negative fixture of the acceptance criteria: bump a
+    struct's registry entry (new wire version / new current_format) WITHOUT
+    touching version.py, and WIRE01 must flag the implementing module."""
+    import copy
+
+    from tools.shuffle_lint.core import ProjectModel
+
+    model = ProjectModel.load(REPO_ROOT)
+    assert model.wire_structs and model.shuffle_format_version is not None
+    assert _lint_real_module("s3shuffle_tpu/metadata/fat_index.py", model) == []
+
+    edited = copy.deepcopy(model)
+    entry = edited.wire_structs["fat_index"]
+    entry["constants"]["_VERSION"] = 3  # pretend the registry moved to v3
+    entry["read_versions"] = [1, 2, 3]
+    entry["current_version"] = 3
+    entry["current_format"] = model.shuffle_format_version + 1  # no bump
+    found = _lint_real_module("s3shuffle_tpu/metadata/fat_index.py", edited)
+    messages = "\n".join(v.message for v in found)
+    assert "_VERSION is 2" in messages  # code/registry constant skew
+    assert "SHUFFLE_FORMAT_VERSION" in messages  # missing version.py bump
+
+
+def test_deleted_wire_structs_binding_trips_wire01():
+    """The other silent-disable direction: stripping a module's
+    ``_WIRE_STRUCTS`` claim must not turn WIRE01 off for its structs —
+    the project-level hook cross-checks the registry's ``module`` field."""
+    from tools.shuffle_lint.core import ProjectModel, lint_source
+
+    model = ProjectModel.load(REPO_ROOT)
+    path = os.path.join(REPO_ROOT, "s3shuffle_tpu", "metadata", "fat_index.py")
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    stripped = "\n".join(
+        line for line in source.splitlines()
+        if not line.startswith("_WIRE_STRUCTS")
+    )
+    assert stripped != source
+    fired = [
+        v for v in lint_source(stripped, path, model=model)
+        if v.rule == "WIRE01" and not v.suppressed
+    ]
+    assert fired and "does not claim" in fired[0].message
+
+
+def test_registry_current_format_within_version_py():
+    from s3shuffle_tpu.version import SHUFFLE_FORMAT_VERSION
+    from s3shuffle_tpu.wire.schema import max_current_format
+
+    assert max_current_format() <= SHUFFLE_FORMAT_VERSION
+
+
+def test_readme_wire_appendix_matches_registry():
+    """README embeds render_wire_doc() between wire-doc markers; the
+    --dump-wire-doc CLI regenerates it, this pins it can't drift."""
+    with open(os.path.join(REPO_ROOT, "README.md"), encoding="utf-8") as f:
+        readme = f.read()
+    m = re.search(
+        r"<!-- wire-doc:begin -->\n(.*?)<!-- wire-doc:end -->",
+        readme,
+        re.DOTALL,
+    )
+    assert m, "README.md is missing the wire-doc markers"
+    assert m.group(1).strip() == render_wire_doc().strip(), (
+        "README wire-format appendix drifted from the schema registry — "
+        "regenerate with: python -m tools.shuffle_lint --dump-wire-doc"
+    )
+
+
+def test_every_registered_struct_has_layout_doc():
+    for name, spec in WIRE_STRUCTS.items():
+        assert spec["doc"] and spec["layout"], name
+        assert spec["since_format"] <= spec["current_format"], name

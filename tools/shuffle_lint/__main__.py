@@ -3,7 +3,10 @@
     python -m tools.shuffle_lint                      # lint [tool.shuffle_lint] paths
     python -m tools.shuffle_lint s3shuffle_tpu        # lint explicit paths
     python -m tools.shuffle_lint --format json ...    # machine-readable output
+    python -m tools.shuffle_lint --format sarif ...   # SARIF 2.1.0 (CI upload)
+    python -m tools.shuffle_lint --changed-only       # report only git-changed files
     python -m tools.shuffle_lint --selftest           # rule fixtures smoke check
+    python -m tools.shuffle_lint --dump-wire-doc      # README wire-format appendix
 
 Exit codes: 0 = clean (suppressed findings allowed), 1 = unsuppressed
 violations, 2 = usage / internal error.
@@ -13,8 +16,9 @@ from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
-from typing import List, Optional
+from typing import List, Optional, Set
 
 from tools.shuffle_lint.core import (
     ProjectModel,
@@ -39,6 +43,18 @@ def _selftest() -> int:
         config_fields={"buffer_size", "root_dir"},
         config_methods={"log_values", "from_dict", "from_env", "scheme"},
         metric_names={"read_prefetch_wait_seconds": "histogram"},
+        metric_labels={"read_prefetch_wait_seconds": ()},
+        wire_structs={
+            "demo": {
+                "module": "<fixture>",
+                "constants": {"_MAGIC": 7, "_VERSION": 2},
+                "read_versions": [1, 2],
+                "current_version": 2,
+                "since_format": 1,
+                "current_format": 1,
+            }
+        },
+        shuffle_format_version=1,
     )
     failures: List[str] = []
     for rule in ALL_RULES:
@@ -67,6 +83,106 @@ def _selftest() -> int:
     return 0
 
 
+def _changed_files(root: str) -> Optional[Set[str]]:
+    """Absolute paths of files git considers changed vs HEAD (worktree +
+    index + untracked). None when git itself fails — the caller must treat
+    that as an error, not as "nothing changed" (a vacuously green gate)."""
+    import os
+
+    def run_git(args):
+        try:
+            proc = subprocess.run(
+                args, cwd=root, capture_output=True, text=True, timeout=30,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        return proc.stdout if proc.returncode == 0 else None
+
+    # `git diff --name-only` prints TOPLEVEL-relative paths no matter the
+    # cwd; in a monorepo where the project root is a subdirectory, joining
+    # them onto `root` would miss every tracked change (a vacuously green
+    # gate). `ls-files --others` is cwd-relative, so it joins onto `root`.
+    toplevel = run_git(["git", "rev-parse", "--show-toplevel"])
+    if toplevel is None:
+        return None
+    changed: Set[str] = set()
+    for args, base in (
+        (["git", "diff", "--name-only", "HEAD", "--"], toplevel.strip()),
+        (["git", "ls-files", "--others", "--exclude-standard"], root),
+    ):
+        out = run_git(args)
+        if out is None:
+            return None
+        changed.update(
+            os.path.realpath(os.path.join(base, line))
+            for line in out.splitlines()
+            if line.strip()
+        )
+    return changed
+
+
+def _render_sarif(violations: List[Violation], root: str) -> str:
+    """SARIF 2.1.0 — one run, one result per finding. Suppressed findings
+    are carried with their inline justification (SARIF viewers hide them by
+    default but the reason survives into the CI artifact)."""
+    import os
+
+    from tools.shuffle_lint.rules import ALL_RULES
+
+    def uri(path: str) -> str:
+        rel = os.path.relpath(os.path.realpath(path), os.path.realpath(root))
+        return rel.replace(os.sep, "/") if not rel.startswith("..") else path
+
+    results = []
+    for v in violations:
+        result = {
+            "ruleId": v.rule,
+            "level": "error",
+            "message": {"text": v.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": uri(v.path)},
+                        "region": {
+                            "startLine": max(v.line, 1),
+                            "startColumn": max(v.col, 1),
+                        },
+                    }
+                }
+            ],
+        }
+        if v.suppressed:
+            result["suppressions"] = [
+                {"kind": "inSource", "justification": v.reason}
+            ]
+        results.append(result)
+    doc = {
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                   "master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "shuffle-lint",
+                        "informationUri":
+                            "https://github.com/s3shuffle-tpu/s3shuffle-tpu",
+                        "rules": [
+                            {
+                                "id": r.RULE_ID,
+                                "shortDescription": {"text": r.DESCRIPTION},
+                            }
+                            for r in ALL_RULES
+                        ],
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }
+    return json.dumps(doc, indent=2)
+
+
 def _render_text(violations: List[Violation]) -> str:
     lines = [v.format() for v in violations if not v.suppressed]
     suppressed = [v for v in violations if v.suppressed]
@@ -92,12 +208,25 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("paths", nargs="*",
                     help="files/directories to lint (default: [tool.shuffle_lint] "
                          "paths from pyproject.toml, else s3shuffle_tpu)")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--format", choices=("text", "json", "sarif"), default="text")
     ap.add_argument("--selftest", action="store_true",
                     help="verify every rule against its embedded fixtures")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="report findings only for files git sees as changed "
+                         "vs HEAD (worktree, index, untracked); the whole "
+                         "tree is still scanned so call-graph rules keep "
+                         "their interprocedural view")
+    ap.add_argument("--dump-wire-doc", action="store_true",
+                    help="print the README wire-format appendix generated "
+                         "from s3shuffle_tpu/wire/schema.py and exit")
     args = ap.parse_args(argv)
     if args.selftest:
         return _selftest()
+    if args.dump_wire_doc:
+        from s3shuffle_tpu.wire.schema import render_wire_doc
+
+        print(render_wire_doc(), end="")
+        return 0
     import os
 
     root = find_project_root(args.paths[0] if args.paths else ".")
@@ -121,7 +250,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
         return 2
     violations = lint_paths(files, project_root=root)
-    if args.format == "json":
+    if args.changed_only:
+        changed = _changed_files(root)
+        if changed is None:
+            print(
+                "shuffle-lint: --changed-only needs a working git "
+                "checkout (git diff against HEAD failed)",
+                file=sys.stderr,
+            )
+            return 2
+        violations = [
+            v for v in violations if os.path.realpath(v.path) in changed
+        ]
+    if args.format == "sarif":
+        print(_render_sarif(violations, root))
+    elif args.format == "json":
         print(
             json.dumps(
                 {

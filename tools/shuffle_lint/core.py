@@ -1,4 +1,4 @@
-"""shuffle-lint engine: project model, suppression parsing, file runner.
+"""shuffle-lint engine: project model, call graph, suppressions, runners.
 
 The rules themselves live one-per-module under :mod:`tools.shuffle_lint.rules`
 (see that package's ``__init__`` for the registry). This module owns
@@ -8,8 +8,17 @@ everything rule-agnostic:
   suppression state;
 - :class:`ProjectModel` — the project invariants rules check against
   (declared config knobs parsed from ``s3shuffle_tpu/config.py``, known
-  metric names parsed from ``s3shuffle_tpu/metrics/names.py``), loaded by
-  **AST parsing only** — the linter never imports the code under analysis;
+  metric names + label sets from ``s3shuffle_tpu/metrics/names.py``, the
+  wire-struct registry from ``s3shuffle_tpu/wire/schema.py``, and
+  ``SHUFFLE_FORMAT_VERSION`` from ``version.py``), loaded by **AST parsing
+  only** — the linter never imports the code under analysis;
+- :class:`ProjectGraph` — the call-graph-aware layer: every scanned file's
+  AST plus per-function summaries ("does this function, transitively, reach
+  a storage op?") computed by fixed point over name-resolved call edges.
+  Per-file rules reach it via ``ctx.project`` (LK01's interprocedural mode,
+  ORD01's same-module call expansion); rules may also export a
+  ``check_project(project)`` hook that runs ONCE over the whole scanned set
+  (CFG01's dead-knob detection);
 - suppression comments: ``# shuffle-lint: disable=RULE[,RULE2] reason=...``
   on the flagged line (or the line directly above it) downgrades matching
   violations to *suppressed* — still collected, reported in the budget, but
@@ -49,6 +58,54 @@ STORAGE_OPS = frozenset(
         "remove_root",
     }
 )
+
+#: receivers that are local-filesystem/stdlib namespaces, not storage
+#: backends — ``os.path.exists`` under a build lock is not a ranged GET.
+LOCAL_FS_RECEIVERS = frozenset({"os", "path", "shutil", "tempfile", "Path"})
+
+#: method names that shadow ubiquitous stdlib objects (executors, queues,
+#: threads, futures, files). An attribute call on a receiver other than
+#: ``self``/``cls`` with one of these names is NOT resolved through the
+#: project call graph: ``pool.submit`` / ``old.shutdown`` almost always
+#: target ``concurrent.futures``, and a same-named project method that
+#: happens to reach storage (the cluster drivers' ``shutdown``) would
+#: otherwise flood every unrelated call site with false edges. ``self.``
+#: calls still resolve — a class's own storage-reaching ``shutdown`` helper
+#: called under its own lock is exactly what the graph exists to catch.
+STDLIB_SHADOW_METHODS = frozenset(
+    {
+        "shutdown",
+        "submit",
+        "join",
+        "start",
+        "put",
+        "get",
+        "result",
+        "cancel",
+        "set",
+        "clear",
+        "close",
+        "write",
+        "flush",
+        "acquire",
+        "release",
+        "wait",
+        "notify",
+        "notify_all",
+    }
+)
+
+
+def is_shadowed_method_call(node: ast.AST) -> bool:
+    """``<recv>.<name>(...)`` where recv is not self/cls and name shadows a
+    stdlib-object method — excluded from call-graph resolution (see
+    :data:`STDLIB_SHADOW_METHODS`)."""
+    if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+        return False
+    if node.func.attr not in STDLIB_SHADOW_METHODS:
+        return False
+    receiver = node.func.value
+    return not (isinstance(receiver, ast.Name) and receiver.id in ("self", "cls"))
 
 _SUPPRESS_RE = re.compile(
     r"#\s*shuffle-lint:\s*disable=(?P<rules>[A-Z0-9,\s]+?)"
@@ -97,6 +154,16 @@ class ProjectModel:
     config_fields: Set[str] = field(default_factory=set)
     config_methods: Set[str] = field(default_factory=set)
     metric_names: Dict[str, str] = field(default_factory=dict)  # name -> kind
+    #: metric name -> declared label-key tuple (``()`` for unlabeled) —
+    #: MET01's label-set half; empty dict = label checking inert
+    metric_labels: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
+    #: config field -> declaration line in config.py (dead-knob reporting)
+    config_field_lines: Dict[str, int] = field(default_factory=dict)
+    #: wire-struct registry (s3shuffle_tpu/wire/schema.py WIRE_STRUCTS) —
+    #: WIRE01's single source of truth; empty dict = rule inert
+    wire_structs: dict = field(default_factory=dict)
+    #: version.py SHUFFLE_FORMAT_VERSION (None = unknown)
+    shuffle_format_version: Optional[int] = None
 
     @property
     def config_attrs(self) -> Set[str]:
@@ -107,10 +174,16 @@ class ProjectModel:
         model = cls()
         config_py = os.path.join(project_root, "s3shuffle_tpu", "config.py")
         names_py = os.path.join(project_root, "s3shuffle_tpu", "metrics", "names.py")
+        schema_py = os.path.join(project_root, "s3shuffle_tpu", "wire", "schema.py")
+        version_py = os.path.join(project_root, "s3shuffle_tpu", "version.py")
         if os.path.exists(config_py):
             model._load_config_fields(config_py)
         if os.path.exists(names_py):
             model._load_metric_names(names_py)
+        if os.path.exists(schema_py):
+            model._load_wire_structs(schema_py)
+        if os.path.exists(version_py):
+            model._load_format_version(version_py)
         return model
 
     def _load_config_fields(self, path: str) -> None:
@@ -122,28 +195,56 @@ class ProjectModel:
                         stmt.target, ast.Name
                     ):
                         self.config_fields.add(stmt.target.id)
+                        self.config_field_lines[stmt.target.id] = stmt.lineno
                     elif isinstance(
                         stmt, (ast.FunctionDef, ast.AsyncFunctionDef)
                     ):
                         self.config_methods.add(stmt.name)
 
     def _load_metric_names(self, path: str) -> None:
+        table = _literal_table(path, "KNOWN_METRICS")
+        if table is None:
+            return
+        self.metric_names = {name: spec[0] for name, spec in table.items()}
+        self.metric_labels = {
+            name: tuple(spec[1]) for name, spec in table.items()
+        }
+
+    def _load_wire_structs(self, path: str) -> None:
+        table = _literal_table(path, "WIRE_STRUCTS")
+        if table is not None:
+            self.wire_structs = table
+
+    def _load_format_version(self, path: str) -> None:
         tree = ast.parse(_read(path), filename=path)
         for node in ast.walk(tree):
-            targets: List[ast.expr] = []
             if isinstance(node, ast.Assign):
-                targets = node.targets
-            elif isinstance(node, ast.AnnAssign) and node.value is not None:
-                targets = [node.target]
-            else:
-                continue
-            for target in targets:
-                if isinstance(target, ast.Name) and target.id == "KNOWN_METRICS":
-                    table = ast.literal_eval(node.value)
-                    self.metric_names = {
-                        name: spec[0] for name, spec in table.items()
-                    }
-                    return
+                for target in node.targets:
+                    if (
+                        isinstance(target, ast.Name)
+                        and target.id == "SHUFFLE_FORMAT_VERSION"
+                        and isinstance(node.value, ast.Constant)
+                        and isinstance(node.value.value, int)
+                    ):
+                        self.shuffle_format_version = node.value.value
+                        return
+
+
+def _literal_table(path: str, name: str) -> Optional[dict]:
+    """Module-level ``NAME = {pure literal}`` from a file, via AST only."""
+    tree = ast.parse(_read(path), filename=path)
+    for node in ast.walk(tree):
+        targets: List[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        else:
+            continue
+        for target in targets:
+            if isinstance(target, ast.Name) and target.id == name:
+                return ast.literal_eval(node.value)
+    return None
 
 
 @dataclass
@@ -154,6 +255,9 @@ class FileContext:
     source: str
     tree: ast.Module
     model: ProjectModel
+    #: whole-scan call-graph layer (None only for legacy direct callers —
+    #: lint_source/lint_paths always provide one)
+    project: Optional["ProjectGraph"] = None
 
     def __post_init__(self) -> None:
         # parent links let rules walk ancestors (loop/function enclosures)
@@ -166,6 +270,140 @@ class FileContext:
         while cur is not None:
             yield cur
             cur = getattr(cur, "_sl_parent", None)
+
+
+# ---------------------------------------------------------------------------
+# Call-graph layer
+# ---------------------------------------------------------------------------
+
+
+def _terminal(node: ast.expr) -> Optional[str]:
+    if isinstance(node, ast.Call):
+        return _terminal(node.func)
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def walk_function_body(fn: ast.AST) -> Iterable[ast.AST]:
+    """Walk one function's *synchronous* body: nested ``def``/``class``
+    bodies are skipped (they run later and are separate graph nodes), but
+    ``lambda`` bodies are included — the tree's retry idiom passes lambdas
+    that execute inline (``retry_call(lambda: helper.write_…)``)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def is_storage_call(node: ast.AST) -> bool:
+    """``<recv>.<op>(...)`` where op is a storage op and recv is not a
+    local-filesystem namespace."""
+    if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+        return False
+    if node.func.attr not in STORAGE_OPS:
+        return False
+    return _terminal(node.func.value) not in LOCAL_FS_RECEIVERS
+
+
+@dataclass
+class FuncInfo:
+    """Summary node for one function/method definition."""
+
+    path: str
+    name: str
+    node: ast.AST
+    direct_storage: bool
+    callees: Set[str]
+    reaches_storage: bool = False
+    #: one example callee name on a storage-reaching path (diagnostics)
+    via: Optional[str] = None
+
+
+class ProjectGraph:
+    """All scanned files' ASTs + per-function storage-reachability summaries.
+
+    Call edges are resolved by *terminal name* — ``self._reopen()`` and
+    ``mod._reopen()`` both resolve to every definition named ``_reopen``.
+    To keep that coarse resolution from flooding rules with false
+    positives, a NAME only counts as storage-reaching when **every**
+    definition of it in the scanned set reaches storage (a unique helper is
+    checked exactly; a common name like ``close`` with mixed definitions is
+    conservatively trusted). Same-file definitions are preferred when a
+    rule asks with a path."""
+
+    def __init__(self, files: Sequence[Tuple[str, str, ast.Module]],
+                 model: Optional[ProjectModel] = None):
+        self.model = model or ProjectModel()
+        self.trees: Dict[str, ast.Module] = {}
+        self.sources: Dict[str, str] = {}
+        self.funcs: List[FuncInfo] = []
+        self.by_name: Dict[str, List[FuncInfo]] = {}
+        self.by_file: Dict[str, Dict[str, List[FuncInfo]]] = {}
+        for path, source, tree in files:
+            self.trees[path] = tree
+            self.sources[path] = source
+            for node in ast.walk(tree):
+                if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                direct = False
+                callees: Set[str] = set()
+                for sub in walk_function_body(node):
+                    if isinstance(sub, ast.Call):
+                        if is_storage_call(sub):
+                            direct = True
+                        if is_shadowed_method_call(sub):
+                            continue  # pool.submit / old.shutdown: stdlib
+                        name = _terminal(sub.func)
+                        if name is not None:
+                            callees.add(name)
+                info = FuncInfo(path, node.name, node, direct, callees)
+                self.funcs.append(info)
+                self.by_name.setdefault(node.name, []).append(info)
+                self.by_file.setdefault(path, {}).setdefault(node.name, []).append(info)
+        self._fixed_point()
+
+    def _fixed_point(self) -> None:
+        for f in self.funcs:
+            f.reaches_storage = f.direct_storage
+        changed = True
+        while changed:
+            changed = False
+            reaching_names = {
+                name
+                for name, defs in self.by_name.items()
+                if defs and all(d.reaches_storage for d in defs)
+            }
+            for f in self.funcs:
+                if f.reaches_storage:
+                    continue
+                hit = next(iter(f.callees & reaching_names), None)
+                if hit is not None:
+                    f.reaches_storage = True
+                    f.via = hit
+                    changed = True
+
+    def local_defs(self, path: str, name: str) -> List[FuncInfo]:
+        return self.by_file.get(path, {}).get(name, [])
+
+    def storage_reaching_call(self, name: str, path: str) -> Optional[str]:
+        """Does a call to ``name`` (made from ``path``) transitively reach a
+        storage op? Returns a short reason string, or None. Same-file
+        definitions take precedence; otherwise EVERY scanned definition of
+        the name must reach (ambiguity never flags)."""
+        local = self.local_defs(path, name)
+        defs = local if local else self.by_name.get(name, [])
+        if not defs or not all(d.reaches_storage for d in defs):
+            return None
+        d = defs[0]
+        if d.direct_storage:
+            return f"{name}() performs storage I/O directly"
+        return f"{name}() reaches storage I/O via {d.via}()"
 
 
 def _read(path: str) -> str:
@@ -314,8 +552,11 @@ def lint_source(
     model: Optional[ProjectModel] = None,
     rules: Optional[Sequence] = None,
     skipped_rules: Iterable[str] = (),
+    project: Optional[ProjectGraph] = None,
 ) -> List[Violation]:
-    """Lint one source string (unit tests and fixtures drive this)."""
+    """Lint one source string (unit tests and fixtures drive this). Builds
+    a single-file project graph when none is supplied, so graph-aware rules
+    run the same code path as a whole-tree scan."""
     from tools.shuffle_lint.rules import ALL_RULES
 
     try:
@@ -325,10 +566,22 @@ def lint_source(
             Violation("SYN00", path, e.lineno or 0, e.offset or 0,
                       f"syntax error: {e.msg}")
         ]
-    ctx = FileContext(path, source, tree, model or ProjectModel())
+    model = model or ProjectModel()
+    if project is None:
+        project = ProjectGraph([(path, source, tree)], model)
+    ctx = FileContext(path, source, tree, model, project)
+    active = list(rules if rules is not None else ALL_RULES)
     violations: List[Violation] = []
-    for rule in rules if rules is not None else ALL_RULES:
+    for rule in active:
         violations.extend(rule.check(ctx))
+    # single-file runs get the project hooks too (dead-knob detection is
+    # self-gating on scan breadth; see cfg01.check_project)
+    for rule in active:
+        check_project = getattr(rule, "check_project", None)
+        if check_project is not None:
+            violations.extend(
+                v for v in check_project(project) if v.path == path
+            )
     violations = apply_suppressions(
         violations, parse_suppressions(source), path, skipped_rules
     )
@@ -352,14 +605,43 @@ def lint_paths(
         r for r in (rules if rules is not None else ALL_RULES)
         if r.RULE_ID not in skip
     ]
+    # parse every file first: per-file rules and the project-level hooks
+    # must see the SAME graph (and each file parses exactly once)
+    parsed: List[Tuple[str, str, ast.Module]] = []
     out: List[Violation] = []
     for file_path in iter_python_files(paths):
+        source = _read(file_path)
+        try:
+            parsed.append((file_path, source, ast.parse(source, filename=file_path)))
+        except SyntaxError as e:
+            out.append(
+                Violation("SYN00", file_path, e.lineno or 0, e.offset or 0,
+                          f"syntax error: {e.msg}")
+            )
+    project = ProjectGraph(parsed, model)
+    by_path: Dict[str, List[Violation]] = {}
+    for file_path, source, tree in parsed:
+        ctx = FileContext(file_path, source, tree, model, project)
+        file_violations: List[Violation] = []
+        for rule in active:
+            file_violations.extend(rule.check(ctx))
+        by_path[file_path] = file_violations
+    for rule in active:
+        check_project = getattr(rule, "check_project", None)
+        if check_project is None:
+            continue
+        for v in check_project(project):
+            # project findings attach to their file so ITS inline
+            # suppressions (with reasons) can cover them
+            by_path.setdefault(v.path, []).append(v)
+    for file_path, source, _tree in parsed:
         out.extend(
-            lint_source(
-                _read(file_path), file_path, model=model, rules=active,
-                skipped_rules=skip,
+            apply_suppressions(
+                by_path.get(file_path, []), parse_suppressions(source),
+                file_path, skip,
             )
         )
+    out.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
     return out
 
 

@@ -2,8 +2,10 @@
 
 A rule module exports ``RULE_ID``, ``DESCRIPTION``, ``check(ctx)``, and a
 ``POSITIVE``/``NEGATIVE`` fixture pair (the seeded-violation source the
-selftest and unit tests drive). To add a rule: create the module, add it to
-``ALL_RULES``, document it in the README rule table.
+selftest and unit tests drive); it may additionally export
+``check_project(project)`` for whole-scan checks (CFG01's dead-knob
+detection). To add a rule: create the module, add it to ``ALL_RULES``,
+document it in the README rule table.
 """
 
 from tools.shuffle_lint.rules import (  # noqa: F401  (registry import)
@@ -13,10 +15,12 @@ from tools.shuffle_lint.rules import (  # noqa: F401  (registry import)
     imp01,
     lk01,
     met01,
+    ord01,
     thr01,
+    wire01,
 )
 
 #: every active rule, in rule-id order
-ALL_RULES = (cfg01, cw01, exc01, imp01, lk01, met01, thr01)
+ALL_RULES = (cfg01, cw01, exc01, imp01, lk01, met01, ord01, thr01, wire01)
 
 __all__ = ["ALL_RULES"]

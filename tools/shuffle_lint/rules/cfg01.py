@@ -12,14 +12,25 @@ receivers — a bare ``config`` / ``cfg`` name, names ending ``_config`` /
 ``_cfg``, or any ``<x>.config`` / ``<x>._config`` chain — are checked against
 the fields and methods parsed from ``s3shuffle_tpu/config.py``'s AST. The
 rule is inert when the project model is absent (fixture runs inject one).
+
+The *dead-knob* half runs project-wide (``check_project``): a field declared
+in ``ShuffleConfig`` that no scanned package file ever reads — not as an
+attribute on any receiver, not via a string-literal ``getattr``, and not as
+a string key (the tuner-ladder idiom) — is an operator-facing promise the
+code silently ignores, the worst kind of knob drift. Intentionally reserved
+knobs take the standard mandatory-reason suppression ON the declaration line
+in config.py (``# shuffle-lint: disable=CFG01 reason=...``). The check only
+arms on scans broad enough to prove absence (config.py plus at least
+:data:`_DEAD_KNOB_MIN_FILES` package files), so single-file runs never
+produce vacuous "dead" findings.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import List, Optional
+from typing import List, Optional, Set
 
-from tools.shuffle_lint.core import FileContext, Violation
+from tools.shuffle_lint.core import FileContext, ProjectGraph, Violation
 
 RULE_ID = "CFG01"
 DESCRIPTION = "config-knob reference not declared in s3shuffle_tpu/config.py"
@@ -100,4 +111,58 @@ def check(ctx: FileContext) -> List[Violation]:
                     "with a default + comment, or fix the name)",
                 )
             )
+    return out
+
+
+#: minimum non-config package files in the scan before declared-but-unread
+#: detection arms (absence is only provable on a broad scan)
+_DEAD_KNOB_MIN_FILES = 10
+
+_CONFIG_SUFFIX = "s3shuffle_tpu/config.py"
+
+
+def check_project(project: ProjectGraph) -> List[Violation]:
+    """Dead-knob detection: ShuffleConfig fields no scanned file reads."""
+    model = project.model
+    if not model.config_fields or not model.config_field_lines:
+        return []
+    config_path = next(
+        (
+            p for p in project.trees
+            if p.replace("\\", "/").endswith(_CONFIG_SUFFIX)
+        ),
+        None,
+    )
+    others = [p for p in project.trees if p != config_path]
+    if config_path is None or len(others) < _DEAD_KNOB_MIN_FILES:
+        return []
+    fields = set(model.config_fields)
+    used: Set[str] = set()
+    for path in others:
+        for node in ast.walk(project.trees[path]):
+            if isinstance(node, ast.Attribute) and node.attr in fields:
+                # generous on purpose: ANY receiver counts as a read — a
+                # dead knob is one referenced NOWHERE, and false "alive"
+                # beats false "dead" for a gate
+                used.add(node.attr)
+            elif (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and node.value in fields
+            ):
+                # string reference: getattr literals, tuner ladders keyed
+                # by knob name, from_dict/env alias tables
+                used.add(node.value)
+    out: List[Violation] = []
+    for knob in sorted(fields - used):
+        out.append(
+            Violation(
+                RULE_ID, config_path, model.config_field_lines.get(knob, 0), 0,
+                f"config knob {knob!r} is declared in ShuffleConfig but "
+                "never read anywhere in the scanned package (a dead knob "
+                "silently ignores the operator; wire it up, delete it, or "
+                "mark it reserved with `# shuffle-lint: disable=CFG01 "
+                "reason=...` on the declaration)",
+            )
+        )
     return out

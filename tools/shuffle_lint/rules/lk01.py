@@ -9,13 +9,20 @@ plane, behind its backoff sleeps too). The prefetch plane's whole design —
 pull source items and run prefills OUTSIDE the main condition lock — exists
 to uphold this.
 
-Detection is lexical: a call whose method name is in
-:data:`~tools.shuffle_lint.core.STORAGE_OPS`, written inside the body of a
-``with <lock>:`` where the lock expression either was assigned a
+Detection has two layers. The *lexical* layer: a call whose method name is
+in :data:`~tools.shuffle_lint.core.STORAGE_OPS`, written inside the body of
+a ``with <lock>:`` where the lock expression either was assigned a
 ``threading.*`` primitive in this module or has a lock-shaped name. Nested
 ``def``/``lambda`` bodies are skipped (they run later, not under the lock).
-Intentional cases (e.g. ``BlockStream.read``'s cursor-serialization) carry an
-inline suppression with a reason.
+The *interprocedural* layer (the ``_RetryingReader._reopen`` bug class —
+a helper that opens a fresh ranged reader, called under the swap lock):
+every OTHER call under the lock is resolved through the project call graph
+(:class:`~tools.shuffle_lint.core.ProjectGraph`); a callee that
+transitively reaches a storage op — same-file definitions preferred,
+cross-file only when every definition of the name reaches storage — is
+flagged too. Intentional cases (e.g. ``BlockStream.read``'s
+cursor-serialization, the composite aggregator's per-group append lock)
+carry an inline suppression with a reason.
 """
 
 from __future__ import annotations
@@ -23,7 +30,13 @@ from __future__ import annotations
 import ast
 from typing import List
 
-from tools.shuffle_lint.core import STORAGE_OPS, FileContext, Violation
+from tools.shuffle_lint.core import (
+    LOCAL_FS_RECEIVERS as _LOCAL_FS_RECEIVERS,
+    STORAGE_OPS,
+    FileContext,
+    Violation,
+    is_shadowed_method_call,
+)
 from tools.shuffle_lint.rules.common import (
     collect_sync_assignments,
     is_lockish,
@@ -33,10 +46,6 @@ from tools.shuffle_lint.rules.common import (
 
 RULE_ID = "LK01"
 DESCRIPTION = "storage-backend call while holding a threading lock"
-
-#: receivers that are local-filesystem/stdlib namespaces, not storage
-#: backends — ``os.path.exists`` under a build lock is not a ranged GET.
-_LOCAL_FS_RECEIVERS = frozenset({"os", "path", "shutil", "tempfile", "Path"})
 
 POSITIVE = '''
 import threading
@@ -95,20 +104,42 @@ def check(ctx: FileContext) -> List[Violation]:
             continue
         lock_name = terminal_name(lock_expr) or "<lock>"
         for sub in walk_same_scope(node.body):
-            if not (isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute)):
+            if not isinstance(sub, ast.Call):
                 continue
-            op = sub.func.attr
-            if op not in STORAGE_OPS:
-                continue
-            receiver = terminal_name(sub.func.value) or "?"
-            if receiver in _LOCAL_FS_RECEIVERS:
-                continue
-            out.append(
-                Violation(
-                    RULE_ID, ctx.path, sub.lineno, sub.col_offset,
-                    f"storage op {receiver}.{op}(...) under `with {lock_name}:` "
-                    "(store-latency I/O convoys every sibling on this lock; "
-                    "move the call outside and swap results in under the lock)",
+            if isinstance(sub.func, ast.Attribute) and sub.func.attr in STORAGE_OPS:
+                op = sub.func.attr
+                receiver = terminal_name(sub.func.value) or "?"
+                if receiver in _LOCAL_FS_RECEIVERS:
+                    continue
+                out.append(
+                    Violation(
+                        RULE_ID, ctx.path, sub.lineno, sub.col_offset,
+                        f"storage op {receiver}.{op}(...) under `with {lock_name}:` "
+                        "(store-latency I/O convoys every sibling on this lock; "
+                        "move the call outside and swap results in under the lock)",
+                    )
                 )
-            )
+                continue
+            # interprocedural layer: a callee that transitively reaches a
+            # storage op holds the lock across the store round-trip just
+            # the same (the _RetryingReader._reopen bug class)
+            if ctx.project is None:
+                continue
+            if is_shadowed_method_call(sub):
+                continue  # pool.submit / old.shutdown: stdlib object, not
+                # a project helper — name-resolution would be spurious
+            callee = terminal_name(sub.func)
+            if callee is None or callee in STORAGE_OPS:
+                continue
+            reason = ctx.project.storage_reaching_call(callee, ctx.path)
+            if reason is not None:
+                out.append(
+                    Violation(
+                        RULE_ID, ctx.path, sub.lineno, sub.col_offset,
+                        f"call {callee}(...) under `with {lock_name}:` "
+                        f"transitively performs storage I/O ({reason}) — "
+                        "store-latency work under a lock convoys every "
+                        "sibling; hoist the I/O outside the lock",
+                    )
+                )
     return out

@@ -11,9 +11,15 @@ dispatch on kind.
 
 Detection: ``*REGISTRY.counter/gauge/histogram("name", ...)`` call sites —
 the first argument must be a string literal, present in ``KNOWN_METRICS``,
-with a matching kind. The rule is inert when the project model has no metric
-table (fixture runs inject one); the registry/names modules themselves are
-skipped.
+with a matching kind. The *label-set* half closes the drift gap names alone
+left open: the registration site's ``labelnames=`` tuple must equal the
+declared label keys exactly, and every ``<instrument>.labels(...)`` call
+site (resolved through this module's instrument assignments) must pass
+keyword arguments whose key set equals the declaration — a renamed or
+missing label key used to pass lint silently and only explode (or worse,
+mis-aggregate) at scrape time. The rule is inert when the project model has
+no metric table (fixture runs inject one); the registry/names modules
+themselves are skipped.
 """
 
 from __future__ import annotations
@@ -50,14 +56,28 @@ _KINDS = {"counter", "gauge", "histogram"}
 _SKIP_SUFFIXES = ("metrics/registry.py", "metrics/names.py")
 
 
+def _literal_str_seq(node: ast.expr):
+    """``("a", "b")`` / ``["a", "b"]`` -> tuple of strings, else None."""
+    if isinstance(node, (ast.Tuple, ast.List)) and all(
+        isinstance(e, ast.Constant) and isinstance(e.value, str)
+        for e in node.elts
+    ):
+        return tuple(e.value for e in node.elts)
+    return None
+
+
 def check(ctx: FileContext) -> List[Violation]:
     known = ctx.model.metric_names
     if not known:  # no project model: rule is inert
         return []
+    known_labels = ctx.model.metric_labels
     norm = ctx.path.replace("\\", "/")
     if norm.endswith(_SKIP_SUFFIXES):
         return []
     out: List[Violation] = []
+    #: instrument variable (terminal assignment name) -> metric name, for
+    #: the .labels() call-site check below
+    instruments = {}
     for node in ast.walk(ctx.tree):
         if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
             continue
@@ -88,12 +108,84 @@ def check(ctx: FileContext) -> List[Violation]:
                     "trace_report selftest and docs derive from that table)",
                 )
             )
-        elif known[name] != kind:
+            continue
+        if known[name] != kind:
             out.append(
                 Violation(
                     RULE_ID, ctx.path, node.lineno, node.col_offset,
                     f"metric {name!r} registered as {kind} but declared as "
                     f"{known[name]} in s3shuffle_tpu/metrics/names.py",
+                )
+            )
+        # record the instrument variable for call-site label checking
+        parent = getattr(node, "_sl_parent", None)
+        if isinstance(parent, ast.Assign):
+            for target in parent.targets:
+                tname = terminal_name(target)
+                if tname is not None:
+                    instruments[tname] = name
+        # registration-site label set must equal the declaration exactly
+        if name not in known_labels:
+            continue
+        declared = tuple(known_labels[name])
+        labelnames_kw = next(
+            (kw.value for kw in node.keywords if kw.arg == "labelnames"), None
+        )
+        registered = (
+            () if labelnames_kw is None else _literal_str_seq(labelnames_kw)
+        )
+        if registered is None:
+            out.append(
+                Violation(
+                    RULE_ID, ctx.path, node.lineno, node.col_offset,
+                    f"metric {name!r}: labelnames= must be a literal "
+                    "tuple/list of strings so the declared label set "
+                    "(metrics/names.py) can be checked against it",
+                )
+            )
+        elif tuple(registered) != declared:
+            out.append(
+                Violation(
+                    RULE_ID, ctx.path, node.lineno, node.col_offset,
+                    f"metric {name!r} registered with labelnames "
+                    f"{tuple(registered)!r} but metrics/names.py declares "
+                    f"{declared!r} — label-key drift breaks every consumer "
+                    "that keys on the declared set",
+                )
+            )
+    # .labels() call sites: keyword keys must equal the declared label set
+    for node in ast.walk(ctx.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "labels"
+        ):
+            continue
+        recv = terminal_name(node.func.value)
+        metric = instruments.get(recv)
+        if metric is None or metric not in known_labels:
+            continue
+        declared_set = set(known_labels[metric])
+        if node.args:
+            out.append(
+                Violation(
+                    RULE_ID, ctx.path, node.lineno, node.col_offset,
+                    f"metric {metric!r}: .labels() must use keyword "
+                    "arguments (positional labels cannot be checked "
+                    "against the declared label set)",
+                )
+            )
+            continue
+        used = {kw.arg for kw in node.keywords if kw.arg is not None}
+        if any(kw.arg is None for kw in node.keywords):
+            continue  # **kwargs splat: not statically checkable
+        if used != declared_set:
+            out.append(
+                Violation(
+                    RULE_ID, ctx.path, node.lineno, node.col_offset,
+                    f"metric {metric!r}: .labels({', '.join(sorted(used))}) "
+                    f"does not match the declared label set "
+                    f"{tuple(known_labels[metric])!r} from metrics/names.py",
                 )
             )
     return out

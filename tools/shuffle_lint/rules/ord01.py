@@ -1,0 +1,287 @@
+"""ORD01 — the index write is the commit point; nothing commits after it.
+
+The invariant (write/map_output_writer.py, write/composite_commit.py,
+write/single_spill.py, write/compactor.py — all four commit paths): a map
+output's sidecars land in the order **parity → checksum → data-close →
+index LAST**. The index (or fat-index) PUT is the commit point — the
+instant readers may resolve the output — so any store work for the same
+commit AFTER it (a parity PUT, a checksum PUT, the data sink's final
+flush-close, a fresh create) is a torn-commit window: a crash between the
+index and the late op leaves a *visible* object whose bytes or sidecars
+are not all there (PR 10's loss guarantee and PR 3's re-drive contract
+both assume committed ⇒ complete).
+
+Detection is call-graph-aware (the core ProjectGraph): each function's
+statement tree is linearized into a partial order of recognized commit ops
+— ``put_parity_objects`` (parity), ``write_checksums`` (checksum),
+``create_block``/``create`` (data create), ``<sink|stream>.close()``
+(data close), ``write_partition_lengths``/``write_fat_index`` (index) —
+with same-module callees inlined at their call site (lambda arguments
+included: the retry idiom wraps the actual PUT in a lambda). Branch arms
+are parallel (no order between then/else), exception handlers and finally
+blocks do NOT inherit the try body's commit point (a failed index write's
+cleanup close is abort, not a protocol breach), and a callee that contains
+its own index op is treated as an atomic sub-commit (sealing group A then
+group B is two commit sequences, not one violation).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from tools.shuffle_lint.core import FileContext, Violation
+from tools.shuffle_lint.rules.common import terminal_name
+
+RULE_ID = "ORD01"
+DESCRIPTION = "store op ordered after the index write (the commit point)"
+
+#: recognized commit ops by terminal callee name
+_CATEGORIES = {
+    "write_partition_lengths": "index",
+    "write_fat_index": "index",
+    "write_checksums": "checksum",
+    "put_parity_objects": "parity",
+    "create_block": "data-create",
+    "create": "data-create",
+}
+#: ``<recv>.close()`` receivers that are data-object sinks
+_DATA_SINK_RECEIVERS = frozenset({"sink", "_sink", "stream", "_stream"})
+
+_MAX_INLINE_DEPTH = 6
+
+POSITIVE = '''
+def commit(helper, dispatcher, block, geometry, payloads, lengths):
+    # BUG: the index is the commit point — parity PUT after it leaves a
+    # window where a crash yields a committed object with missing parity
+    helper.write_partition_lengths(3, 7, lengths, parity=geometry)
+    put_parity_objects(dispatcher, block, geometry, payloads)
+'''
+
+NEGATIVE = '''
+def commit(helper, dispatcher, block, geometry, payloads, lengths, stream):
+    stream.close()
+    put_parity_objects(dispatcher, block, geometry, payloads)
+    helper.write_checksums(3, 7, lengths)
+    helper.write_partition_lengths(3, 7, lengths, parity=geometry)
+
+
+def abort(helper, dispatcher, block, lengths, stream):
+    try:
+        helper.write_partition_lengths(3, 7, lengths)
+    except OSError:
+        stream.close()   # cleanup after a FAILED commit is abort, not a breach
+        raise
+'''
+
+
+def _op_of(call: ast.Call) -> Optional[Tuple[str, str]]:
+    """(category, label) of one recognized commit op, else None."""
+    name = terminal_name(call.func)
+    if name in _CATEGORIES:
+        return _CATEGORIES[name], f"{name}(...)"
+    if (
+        name == "close"
+        and isinstance(call.func, ast.Attribute)
+        and terminal_name(call.func.value) in _DATA_SINK_RECEIVERS
+    ):
+        recv = terminal_name(call.func.value)
+        return "data-close", f"{recv}.close()"
+    return None
+
+
+class _Analyzer:
+    """Linearizes one function (with same-module inlining) and flags
+    recognized non-index ops ordered after an index op."""
+
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        self.violations: List[Violation] = []
+        #: function node -> whether its expansion contains an index op
+        self._has_index_memo: Dict[ast.AST, bool] = {}
+        #: function node id -> flattened op sequence (line-agnostic) — one
+        #: expansion per callee, ever; without this, mutually-recursive
+        #: helpers re-expand at every call site and the analysis goes
+        #: exponential in _MAX_INLINE_DEPTH
+        self._ops_memo: Dict[int, List[Tuple[str, str, int]]] = {}
+        #: callee expansions currently on the stack (recursion cycle guard)
+        self._expanding: set = set()
+
+    # -- callee resolution (same module only) ---------------------------
+    def _local_callee(self, call: ast.Call) -> Optional[ast.AST]:
+        name = terminal_name(call.func)
+        if name is None or name in _CATEGORIES or name == "close":
+            return None
+        project = self.ctx.project
+        if project is None:
+            return None
+        defs = project.local_defs(self.ctx.path, name)
+        return defs[0].node if len(defs) == 1 else None
+
+    def _expansion_has_index(self, fn: ast.AST, depth: int = 0) -> bool:
+        if fn in self._has_index_memo:
+            return self._has_index_memo[fn]
+        self._has_index_memo[fn] = False  # cycle guard
+        result = False
+        if depth <= _MAX_INLINE_DEPTH:
+            from tools.shuffle_lint.core import walk_function_body
+
+            for sub in walk_function_body(fn):
+                if not isinstance(sub, ast.Call):
+                    continue
+                op = _op_of(sub)
+                if op is not None and op[0] == "index":
+                    result = True
+                    break
+                callee = self._local_callee(sub)
+                if callee is not None and self._expansion_has_index(
+                    callee, depth + 1
+                ):
+                    result = True
+                    break
+        self._has_index_memo[fn] = result
+        return result
+
+    # -- linearization --------------------------------------------------
+    def _stmt_ops(self, stmt: ast.stmt, depth: int) -> List[Tuple[str, str, int]]:
+        """Recognized ops inside ONE statement's expressions, in source
+        order, with same-module calls inlined (lambdas included, nested
+        defs excluded — they run later)."""
+        ops: List[Tuple[str, str, int]] = []
+        stack: List[ast.AST] = [stmt]
+        calls: List[ast.Call] = []
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            if isinstance(node, ast.Call):
+                calls.append(node)
+            stack.extend(ast.iter_child_nodes(node))
+        calls.sort(key=lambda c: (c.lineno, c.col_offset))
+        for call in calls:
+            op = _op_of(call)
+            if op is not None:
+                ops.append((op[0], op[1], call.lineno))
+                continue
+            callee = self._local_callee(call)
+            if callee is None or depth >= _MAX_INLINE_DEPTH:
+                continue
+            if self._expansion_has_index(callee):
+                # atomic sub-commit: contributes its own commit point but
+                # none of its internal ops (checked standalone)
+                name = terminal_name(call.func) or "?"
+                ops.append(("index", f"{name}(...) [sub-commit]", call.lineno))
+            else:
+                ops.extend(self._callee_ops(callee, depth + 1, call.lineno))
+        return ops
+
+    def _callee_ops(self, fn: ast.AST, depth: int, at_line: int):
+        """Flatten a non-index callee's ops to the call site's line (the
+        violation should point at the caller's statement). Expansions are
+        memoized per callee (a recursive cycle contributes nothing)."""
+        key = id(fn)
+        seq = self._ops_memo.get(key)
+        if seq is None:
+            if key in self._expanding:
+                return []
+            self._expanding.add(key)
+            try:
+                seq = []
+                self._walk_block(fn.body, [], depth, collect=seq)  # type: ignore[attr-defined]
+            finally:
+                self._expanding.discard(key)
+            self._ops_memo[key] = seq
+        return [(cat, label, at_line) for cat, label, _ln in seq]
+
+    def _flag(self, cat: str, label: str, line: int, index_label: str) -> None:
+        self.violations.append(
+            Violation(
+                RULE_ID, self.ctx.path, line, 0,
+                f"{cat} op {label} is ordered after the commit point "
+                f"({index_label}) — the index write must be the LAST store "
+                "op of a commit (a crash in between leaves a visible but "
+                "incomplete output)",
+            )
+        )
+
+    def _walk_block(
+        self,
+        stmts: List[ast.stmt],
+        seen_index: List[str],
+        depth: int,
+        collect: Optional[List[Tuple[str, str, int]]] = None,
+    ) -> List[str]:
+        """Walk a statement sequence threading the set of commit points
+        already passed; returns the (possibly grown) seen list. With
+        ``collect`` set, ops are gathered instead of checked (callee
+        flattening)."""
+        for stmt in stmts:
+            if isinstance(stmt, ast.Try):
+                body_seen = self._walk_block(stmt.body, list(seen_index), depth, collect)
+                # handlers/finally do NOT inherit the body's commit point:
+                # the op that raised did not complete, so cleanup there is
+                # abort-path work, not post-commit store traffic
+                handler_seen: List[str] = []
+                for handler in stmt.handlers:
+                    handler_seen += self._walk_block(
+                        handler.body, list(seen_index), depth, collect
+                    )
+                else_seen = self._walk_block(stmt.orelse, list(body_seen), depth, collect)
+                final_seen = self._walk_block(
+                    stmt.finalbody, list(seen_index), depth, collect
+                )
+                merged = dict.fromkeys(
+                    body_seen + handler_seen + else_seen + final_seen
+                )
+                seen_index = list(merged)
+                continue
+            if isinstance(stmt, ast.If):
+                # the test expression runs first
+                seen_index = self._expr_step(stmt.test, seen_index, depth, collect)
+                then_seen = self._walk_block(stmt.body, list(seen_index), depth, collect)
+                else_seen = self._walk_block(stmt.orelse, list(seen_index), depth, collect)
+                seen_index = list(dict.fromkeys(then_seen + else_seen))
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                seen_index = self._expr_step(stmt.iter, seen_index, depth, collect)
+                seen_index = self._walk_block(stmt.body, seen_index, depth, collect)
+                seen_index = self._walk_block(stmt.orelse, seen_index, depth, collect)
+                continue
+            if isinstance(stmt, ast.While):
+                seen_index = self._expr_step(stmt.test, seen_index, depth, collect)
+                seen_index = self._walk_block(stmt.body, seen_index, depth, collect)
+                seen_index = self._walk_block(stmt.orelse, seen_index, depth, collect)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    seen_index = self._expr_step(
+                        item.context_expr, seen_index, depth, collect
+                    )
+                seen_index = self._walk_block(stmt.body, seen_index, depth, collect)
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue  # nested scope: runs later
+            seen_index = self._expr_step(stmt, seen_index, depth, collect)
+        return seen_index
+
+    def _expr_step(self, node, seen_index: List[str], depth: int, collect):
+        for cat, label, line in self._stmt_ops(node, depth):
+            if collect is not None:
+                collect.append((cat, label, line))
+                continue
+            if cat == "index":
+                seen_index = seen_index + [label]
+            elif seen_index:
+                self._flag(cat, label, line, seen_index[-1])
+        return seen_index
+
+    # -- entry ----------------------------------------------------------
+    def run(self) -> List[Violation]:
+        for node in ast.walk(self.ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk_block(node.body, [], 0)
+        return self.violations
+
+
+def check(ctx: FileContext) -> List[Violation]:
+    return _Analyzer(ctx).run()

@@ -1,0 +1,262 @@
+"""WIRE01 — wire-struct implementations must match the schema registry.
+
+The invariant: ``s3shuffle_tpu/wire/schema.py`` is the single declarative
+source of truth for every on-wire struct (store-object blobs, object-name
+grammars, versioned RPC payloads). A module that implements one declares it
+in a module-level ``_WIRE_STRUCTS`` tuple, and this rule cross-checks the
+module's AST against the registry:
+
+- every registry constant (magic words, version numbers, header word
+  counts, payload field counts, name-grammar patterns) must be assigned at
+  module level with EXACTLY the registered value — so changing a wire shape
+  on either side alone (the code, or the registry) is a lint failure, not a
+  silent skew (the PR-10 geometry-trailer-parsed-as-offsets bug was this
+  drift class);
+- every historical ``read_versions`` entry must still have a version guard
+  in the module (a comparison of a version-ish name against that literal) —
+  deleting a back-compat reader branch fails lint even though every test
+  blob still decodes;
+- the struct's ``current_format`` may not exceed
+  ``version.SHUFFLE_FORMAT_VERSION`` — registering a new struct version
+  REQUIRES bumping version.py (mixed-version jobs must fail the startup
+  handshake, not mis-parse).
+
+The golden-bytes corpus (``tests/fixtures/wire/``) is the dynamic
+complement: blobs of every historical version must decode forever.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Union
+
+from tools.shuffle_lint.core import FileContext, Violation
+
+RULE_ID = "WIRE01"
+DESCRIPTION = "wire-struct implementation drifted from s3shuffle_tpu/wire/schema.py"
+
+#: fixture model: one struct "demo" with _MAGIC=7, _VERSION=2,
+#: read_versions [1, 2], current_format 1 (see tests/test_shuffle_lint.py)
+POSITIVE = '''
+_WIRE_STRUCTS = ("demo",)
+
+_MAGIC = 7
+_VERSION = 3   # BUG: wire shape bumped without a registry + format update
+
+
+def from_bytes(words):
+    version = int(words[1])
+    if version == 1:
+        return "v1"
+    return "v2"
+'''
+
+NEGATIVE = '''
+_WIRE_STRUCTS = ("demo",)
+
+_MAGIC = 7
+_VERSION = 2
+
+
+def from_bytes(words):
+    version = int(words[1])
+    if version == 1:
+        return "v1"
+    return "v2"
+'''
+
+_MISSING = object()
+
+
+def _module_constants(tree: ast.Module) -> Dict[str, Union[int, str]]:
+    """Module-level ``NAME = <int|str|re.compile(str)>`` assignments."""
+    out: Dict[str, Union[int, str]] = {}
+    for stmt in tree.body:
+        targets: List[ast.expr] = []
+        value: Optional[ast.expr] = None
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        if value is None:
+            continue
+        resolved: object = _MISSING
+        if isinstance(value, ast.Constant) and isinstance(value.value, (int, str)):
+            resolved = value.value
+        elif (
+            isinstance(value, ast.Call)
+            and isinstance(value.func, ast.Attribute)
+            and value.func.attr == "compile"
+            and value.args
+            and isinstance(value.args[0], ast.Constant)
+            and isinstance(value.args[0].value, str)
+        ):
+            resolved = value.args[0].value  # re.compile(pattern) -> pattern
+        if resolved is _MISSING:
+            continue
+        for target in targets:
+            if isinstance(target, ast.Name):
+                out[target.id] = resolved  # type: ignore[assignment]
+    return out
+
+
+def _claimed_structs(tree: ast.Module):
+    """The module's ``_WIRE_STRUCTS`` tuple (None when it claims nothing)."""
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign):
+            for target in stmt.targets:
+                if isinstance(target, ast.Name) and target.id == "_WIRE_STRUCTS":
+                    try:
+                        value = ast.literal_eval(stmt.value)
+                    except ValueError:
+                        return None
+                    if isinstance(value, (tuple, list)) and all(
+                        isinstance(x, str) for x in value
+                    ):
+                        return (stmt.lineno, tuple(value))
+    return None
+
+
+def _guarded_versions(tree: ast.Module) -> set:
+    """Integer literals compared against a version-ish name anywhere in the
+    module — the back-compat reader branches."""
+    guarded = set()
+
+    def versionish(expr: ast.expr) -> bool:
+        name = None
+        if isinstance(expr, ast.Name):
+            name = expr.id
+        elif isinstance(expr, ast.Attribute):
+            name = expr.attr
+        elif isinstance(expr, ast.Call):
+            return any(versionish(a) for a in expr.args)
+        return name is not None and "version" in name.lower()
+
+    def literals(expr: ast.expr):
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, int):
+            yield expr.value
+        elif isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            for elt in expr.elts:
+                yield from literals(elt)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        sides = [node.left, *node.comparators]
+        if any(versionish(s) for s in sides):
+            for s in sides:
+                guarded.update(literals(s))
+    return guarded
+
+
+def check_project(project) -> List[Violation]:
+    """The unclaimed-struct hole: every registry struct whose implementing
+    module is IN this scan must be claimed by that module's
+    ``_WIRE_STRUCTS`` tuple — otherwise deleting (or typo'ing) the binding
+    silently disables every per-file WIRE01 check for the struct, which is
+    exactly the silent-skew failure the rule exists to prevent."""
+    registry = project.model.wire_structs
+    if not registry:
+        return []
+    out: List[Violation] = []
+    for sname, entry in registry.items():
+        module = entry.get("module")
+        if not module:
+            continue
+        path = next(
+            (
+                p for p in project.trees
+                if p.replace("\\", "/").endswith(module)
+            ),
+            None,
+        )
+        if path is None:
+            continue  # module outside this scan: absence not provable
+        claim = _claimed_structs(project.trees[path])
+        if claim is None or sname not in claim[1]:
+            out.append(
+                Violation(
+                    RULE_ID, path, claim[0] if claim else 1, 0,
+                    f"schema registry declares wire struct {sname!r} as "
+                    f"implemented by this module, but its _WIRE_STRUCTS "
+                    "tuple does not claim it — an unclaimed struct gets NO "
+                    "constant/version-guard/format checks (restore the "
+                    "binding, or move the struct's registry entry)",
+                )
+            )
+    return out
+
+
+def check(ctx: FileContext) -> List[Violation]:
+    registry = ctx.model.wire_structs
+    if not registry:  # no project model: rule is inert
+        return []
+    claim = _claimed_structs(ctx.tree)
+    if claim is None:
+        return []
+    line, names = claim
+    consts = _module_constants(ctx.tree)
+    guarded = _guarded_versions(ctx.tree)
+    out: List[Violation] = []
+    for sname in names:
+        entry = registry.get(sname)
+        if entry is None:
+            out.append(
+                Violation(
+                    RULE_ID, ctx.path, line, 0,
+                    f"module claims wire struct {sname!r} which is not "
+                    "declared in s3shuffle_tpu/wire/schema.py (declare it "
+                    "there — the registry is the single source of truth)",
+                )
+            )
+            continue
+        for cname, expected in entry.get("constants", {}).items():
+            actual = consts.get(cname, _MISSING)
+            if actual is _MISSING:
+                out.append(
+                    Violation(
+                        RULE_ID, ctx.path, line, 0,
+                        f"wire struct {sname!r}: module-level constant "
+                        f"{cname} = {expected!r} required by the schema "
+                        "registry is missing",
+                    )
+                )
+            elif actual != expected:
+                out.append(
+                    Violation(
+                        RULE_ID, ctx.path, line, 0,
+                        f"wire struct {sname!r}: {cname} is {actual!r} but "
+                        f"the schema registry declares {expected!r} — a wire "
+                        "shape change needs a registry update, a "
+                        "SHUFFLE_FORMAT_VERSION bump, AND a back-compat "
+                        "reader for the old shape",
+                    )
+                )
+        current = entry.get("current_version")
+        for v in entry.get("read_versions", []):
+            if v == current:
+                continue  # the writer's own version, guarded via its constant
+            if v not in guarded:
+                out.append(
+                    Violation(
+                        RULE_ID, ctx.path, line, 0,
+                        f"wire struct {sname!r}: no reader guard for "
+                        f"historical wire v{v} (the registry says v{v} blobs "
+                        "must decode forever — a version comparison against "
+                        f"the literal {v} is required)",
+                    )
+                )
+        fmt = entry.get("current_format")
+        sfv = ctx.model.shuffle_format_version
+        if fmt is not None and sfv is not None and fmt > sfv:
+            out.append(
+                Violation(
+                    RULE_ID, ctx.path, line, 0,
+                    f"wire struct {sname!r}: registry declares "
+                    f"current_format {fmt} but version.py "
+                    f"SHUFFLE_FORMAT_VERSION is {sfv} — bump version.py so "
+                    "mixed-version jobs fail the startup handshake instead "
+                    "of mis-parsing",
+                )
+            )
+    return out
