@@ -1564,6 +1564,188 @@ def coded_read_gain(
     }
 
 
+def _elastic_agent_main(coordinator, cfg_dict, worker_id, heartbeat_s):
+    """WorkerAgent entry for the elasticity probe's fleet (module-level:
+    spawn pickles the target by name). Fast heartbeats — the probe runs a
+    tight worker lease, and a healthy worker must never be falsely reaped."""
+    from s3shuffle_tpu.config import ShuffleConfig
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+    from s3shuffle_tpu.worker import WorkerAgent
+
+    Dispatcher.reset()
+    WorkerAgent(
+        tuple(coordinator), config=ShuffleConfig(**cfg_dict), worker_id=worker_id
+    ).run_forever(poll_interval=0.01, heartbeat_s=heartbeat_s)
+
+
+def elasticity_gain(
+    n_records: int = 800_000,
+    n_maps: int = 8,
+    n_workers: int = 3,
+    lease_s: float = 1.0,
+    rounds: int = 2,
+):
+    """Elastic-fleet probe: wall-clock inflation of a distributed sort under
+    churn — one worker SIGKILLed mid-job (lease reap + requeue + membership
+    expiry + a replacement joining) and one gracefully drained — against the
+    SAME fleet undisturbed. Byte identity between the churn and no-churn
+    outputs is asserted; the interesting number is how bounded the
+    inflation stays (the kill costs ~one lease of detection latency plus
+    the re-run, the drain should cost ~nothing)."""
+    import dataclasses
+    import multiprocessing as mp
+    import tempfile
+    import threading
+
+    from s3shuffle_tpu.batch import RecordBatch
+    from s3shuffle_tpu.cluster import DistributedDriver
+    from s3shuffle_tpu.config import ShuffleConfig
+    from s3shuffle_tpu.metrics import registry as mreg
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+
+    root = tempfile.mkdtemp(prefix="bench-elastic-")
+    driver = None
+    workers: dict = {}
+    ctx = mp.get_context("spawn")
+    stop = threading.Event()
+    churner = None
+    try:
+        Dispatcher.reset()
+        cfg = ShuffleConfig(
+            root_dir=f"file://{root}/store", app_id="bench-elastic",
+            codec="zlib", worker_lease_s=lease_s, composite_commit_maps=2,
+        )
+        rng = random.Random(61)
+        records = [(rng.randbytes(8), rng.randbytes(24)) for _ in range(n_records)]
+        batches = [
+            RecordBatch.from_records(records[i::n_maps]) for i in range(n_maps)
+        ]
+        driver = DistributedDriver(cfg)
+        cfg_dict = dataclasses.asdict(cfg)
+
+        def spawn(wid):
+            p = ctx.Process(
+                target=_elastic_agent_main,
+                args=(list(driver.coordinator_address), cfg_dict, wid,
+                      max(0.1, lease_s / 5)),
+                daemon=True,
+            )
+            p.start()
+            workers[wid] = p
+
+        for i in range(n_workers):
+            spawn(f"w{i}")
+
+        def job():
+            t0 = time.perf_counter()
+            out = driver.run_sort_shuffle(batches, num_partitions=4)
+            return time.perf_counter() - t0, [b.to_records() for b in out]
+
+        # no-churn baseline (best of `rounds`, fleet warm after round 1)
+        walls, baseline_out = [], None
+        for _ in range(max(1, rounds)):
+            wall, out = job()
+            walls.append(wall)
+            baseline_out = out
+        baseline_wall = min(walls)
+
+        # churn round: kill one worker caught holding a task, drain another,
+        # spawn a replacement — all while the job runs
+        q = driver.server.task_queue
+        churn_stats = {"kills": 0, "drains": 0}
+        # the id the churn job will use — read BEFORE the thread starts:
+        # run_sort_shuffle claims the id as its first step, so reading it
+        # inside the thread races the job and can name a future shuffle
+        churn_prefix = f"shuffle{driver._next_shuffle_id}-"
+
+        def churn():
+            deadline = time.monotonic() + 30.0
+            prefix = churn_prefix
+            while time.monotonic() < deadline and not stop.is_set():
+                with q._lock:
+                    job_live = any(s.startswith(prefix) for s in q._stages)
+                    holders = {
+                        r["worker"]
+                        for stage, st in q._stages.items()
+                        if stage.startswith(prefix)
+                        for r in st["running"].values()
+                    }
+                live = [w for w, p in workers.items() if p.is_alive()]
+                # planned preemption first: drain one idle worker the
+                # moment the job is underway (should cost ~nothing)
+                if job_live and not churn_stats["drains"] and len(live) > 2:
+                    spare = next((w for w in live if w not in holders), None)
+                    if spare is not None and driver.drain_workers([spare]):
+                        churn_stats["drains"] += 1
+                # then the unplanned one: SIGKILL a worker caught holding
+                # a task, and start a replacement to restore capacity
+                victim = next(
+                    (w for w in live if w in holders and w not in ("",)), None
+                )
+                if victim is not None and churn_stats["drains"]:
+                    workers[victim].kill()
+                    churn_stats["kills"] += 1
+                    spawn(f"r{churn_stats['kills']}")
+                    return
+                time.sleep(0.001)
+
+        requeues_before = mreg.read_counter_total("task_requeues_total")
+        churner = threading.Thread(target=churn, daemon=True)
+        churner.start()
+        churn_wall, churn_out = job()
+        stop.set()
+        churner.join(timeout=10)
+        assert churn_out == baseline_out, "output diverged under churn"
+        requeues = mreg.read_counter_total("task_requeues_total") - requeues_before
+        return {
+            "elasticity_wall_inflation": round(churn_wall / baseline_wall, 2),
+            "elasticity_baseline_wall_s": round(baseline_wall, 3),
+            "elasticity_churn_wall_s": round(churn_wall, 3),
+            "elasticity_kills": churn_stats["kills"],
+            "elasticity_drains": churn_stats["drains"],
+            "elasticity_requeues": int(requeues),
+            "elasticity_worker_lease_s": lease_s,
+            "elasticity_workers": n_workers,
+        }
+    except Exception as e:  # never fail the bench over this row
+        return {"elasticity_error": str(e)[:160]}
+    finally:
+        # the churner must die FIRST: on the failure path it is still
+        # killing workers and spawn()-ing into `workers`, which would
+        # mutate the dict under the join loop below
+        stop.set()
+        if churner is not None:
+            churner.join(timeout=10)
+        try:
+            if driver is not None:
+                driver.shutdown()
+        except Exception:
+            pass
+        for p in list(workers.values()):
+            p.join(timeout=10)
+            if p.is_alive():
+                p.terminate()
+        import shutil
+
+        shutil.rmtree(root, ignore_errors=True)
+        Dispatcher.reset()
+
+
+def elastic_fleet_knobs():
+    """The elastic-fleet knobs the headline runs used (ShuffleConfig
+    defaults) — recorded so BENCH rounds stay comparable when a default
+    moves."""
+    from s3shuffle_tpu.config import ShuffleConfig
+
+    cfg = ShuffleConfig()
+    return {
+        "elastic_fleet": {
+            "worker_lease_s": cfg.worker_lease_s,
+            "drain_on_sigterm": cfg.drain_on_sigterm,
+        }
+    }
+
+
 def coded_plane_knobs():
     """The coding-plane knobs the headline runs used (ShuffleConfig
     defaults) — recorded so BENCH rounds stay comparable when a default
@@ -2212,10 +2394,12 @@ def main():
         **coded_read_gain(),
         **device_codec_gain(),
         **autotune_gain(),
+        **elasticity_gain(),
         **tracker_scaling(),
         **transfer_plane_knobs(),
         **scan_planner_knobs(),
         **coded_plane_knobs(),
+        **elastic_fleet_knobs(),
         **composite_plane_knobs(),
         **device_codec_knobs(),
         **autotune_knobs(),

@@ -260,6 +260,14 @@ class DistributedDriver:
 
         self.helper = ShuffleHelper(self.dispatcher)
         self._next_shuffle_id = 0
+        # the worker-silence lease is an operator knob now (worker_lease_s);
+        # the attribute stays assignable for tests/tools that tighten it
+        self.task_lease_s = float(config.worker_lease_s)
+        # per-shuffle recovery state: staged inputs + dependency descriptor
+        # (to recompute a lost map), recovery round counter (attempt-unique
+        # recompute ids), and a per-map attempt budget (loss loops bound)
+        self._job_state: dict = {}
+        self._recovering = False
 
     @property
     def coordinator_address(self) -> Tuple[str, int]:
@@ -269,29 +277,196 @@ class DistributedDriver:
     def _scratch(self, shuffle_id: int, name: str) -> str:
         return f"{self.config.root_dir}_stage/{self.config.app_id}/{shuffle_id}/{name}"
 
-    #: worker-silence lease: the stage-wait loop re-queues tasks whose worker
+    #: worker-silence lease: the fleet reap re-queues tasks whose worker
     #: sent no heartbeat for this long (crash/kill detection — WorkerAgent
     #: beats every ~5s, so a LONG task on a healthy worker is never reaped).
     #: Re-execution is idempotent (task outputs are store objects keyed by
     #: task identity, index-is-commit), and stale zombie reports are refused
-    #: by the lease-holder check in the task queue.
+    #: by the lease-holder check in the task queue. The instance value comes
+    #: from ``ShuffleConfig.worker_lease_s``; this class default keeps older
+    #: callers working.
     task_lease_s = 30.0
 
-    def _wait_stage(self, stage_id: str, poll: float = 0.02) -> dict:
+    def _reap_fleet(self) -> None:
+        """One fleet-reap beat: expire silent task leases across EVERY live
+        stage (not just the one being waited on — the old per-stage reap
+        missed a worker dying while holding another stage's task), then
+        expire silent fleet MEMBERSHIPS and run the per-death handling
+        (cross-stage requeue + lost-output recovery) for each newly dead
+        worker. Runs during stage waits AND between stages."""
+        self.server.task_queue.reap_expired_all(self.task_lease_s)
+        for worker_id in self.server.membership.expire_silent(self.task_lease_s):
+            self._on_worker_lost(worker_id)
+
+    def _wait_stage(self, stage_id: str, poll: float = 0.02, on_failed=None) -> dict:
         import time
 
         last_reap = time.monotonic()
         while True:
             status = self.server.task_queue.stage_status(stage_id)
             if status["failed"]:
-                raise RuntimeError(f"stage {stage_id} failed: {status['failed']}")
+                # ``on_failed`` (the recovery hook) may consume failures by
+                # re-queueing the tasks; anything it cannot handle is fatal
+                if on_failed is None or not on_failed(dict(status["failed"])):
+                    raise RuntimeError(
+                        f"stage {stage_id} failed: {status['failed']}"
+                    )
+                continue
             if not status["pending"] and not status["running"]:
                 return status["done"]
             now = time.monotonic()
             if now - last_reap > min(5.0, self.task_lease_s / 4):
                 last_reap = now
-                self.server.task_queue.reap_expired(stage_id, self.task_lease_s)
+                self._reap_fleet()
             time.sleep(poll)
+
+    # -- elastic fleet -------------------------------------------------
+    def drain_workers(self, worker_ids=None) -> List[str]:
+        """Request a graceful drain of ``worker_ids`` (default: every live
+        worker): each stops taking tasks at its next poll, seals open
+        composite groups, flushes deferred reports and stats, and
+        deregisters. Returns the ids actually flagged."""
+        membership = self.server.membership
+        targets = (
+            list(worker_ids) if worker_ids is not None
+            else membership.live_workers()
+        )
+        return [w for w in targets if membership.request_drain(w)]
+
+    def _on_worker_lost(self, worker_id: str) -> None:
+        """Per-death handling, run exactly once per membership expiry:
+        requeue the dead worker's in-flight tasks across every stage (its
+        uncommitted attempts are invalidated by the lease-holder commit
+        fence), then probe its COMMITTED map outputs for objects that died
+        with it and plan recompute-vs-reconstruct recovery."""
+        requeued = self.server.task_queue.requeue_lost_all(worker_id)
+        if requeued:
+            logger.warning(
+                "worker %s expired; requeued %d in-flight task(s)",
+                worker_id, requeued,
+            )
+        by_shuffle: dict = {}
+        for stage_id, task_id in self.server.task_queue.tasks_done_by(worker_id):
+            if not stage_id.startswith("shuffle") or "-map" not in stage_id:
+                continue
+            try:
+                sid = int(stage_id[len("shuffle"):].split("-", 1)[0])
+            except ValueError:
+                continue
+            by_shuffle.setdefault(sid, set()).add(int(task_id))
+        for sid, map_indices in by_shuffle.items():
+            self._recover_shuffle_losses(sid, map_indices=map_indices)
+
+    def _recover_shuffle_losses(self, shuffle_id: int, map_indices=None) -> bool:
+        """Probe for lost committed map outputs and recover them. Maps the
+        planner routes to "reconstruct" need no driver action (reduce
+        scans heal through the coded plane's degraded reads); "recompute"
+        maps re-run from their staged inputs in a recovery stage, with
+        attempt-unique ids ABOVE every prior attempt so the tracker's
+        latest-attempt dedupe picks the fresh output. Returns True iff
+        any loss was found (and recovery was planned)."""
+        state = self._job_state.get(shuffle_id)
+        if state is None or self._recovering:
+            return False
+        from s3shuffle_tpu.metadata.service import TaskQueue
+        from s3shuffle_tpu.recovery import RecoveryPlanner, probe_lost_maps
+
+        try:
+            losses = probe_lost_maps(
+                self.dispatcher, self.server.tracker, shuffle_id,
+                map_indices=map_indices,
+            )
+        except KeyError:
+            return False  # shuffle already unregistered
+        if not losses:
+            return False
+        planner = RecoveryPlanner(stripe_k=self.config.parity_stripe_k)
+        try:
+            stats = self.server.tracker.get_shuffle_stats(shuffle_id)
+        except Exception as e:
+            # evidence is optional — the planner has a structural default
+            logger.debug("no shuffle stats for recovery costing: %s", e)
+            stats = None
+        budget = state["recovery_attempts"]
+        recompute = []
+        for lost in losses:
+            if budget.get(lost.map_index, 0) >= TaskQueue.MAX_ATTEMPTS:
+                continue  # out of budget: the reduce failure will surface it
+            if planner.decide(lost, stats) == "recompute":
+                budget[lost.map_index] = budget.get(lost.map_index, 0) + 1
+                recompute.append(lost)
+        if not recompute:
+            return True
+        state["recovery_round"] += 1
+        rec_round = state["recovery_round"]
+        rec_stage = stage_id_for(shuffle_id, f"maprec{rec_round}")
+        logger.warning(
+            "recomputing %d lost map output(s) of shuffle %d (round %d): %s",
+            len(recompute), shuffle_id, rec_round,
+            [lost.map_index for lost in recompute],
+        )
+        self.server.task_queue.submit_stage(
+            rec_stage,
+            [
+                {
+                    "task_id": lost.map_index, "kind": "map",
+                    "shuffle_id": shuffle_id, "map_id": lost.map_index,
+                    "dep": state["desc"],
+                    "input_path": state["input_paths"][lost.map_index],
+                    # recompute attempts must outrank every original attempt
+                    # AND every prior recompute of THIS map (latest-attempt
+                    # dedupe keys on map_id). The base scales with the
+                    # per-map recovery count — bounded by MAX_ATTEMPTS^2 —
+                    # never the shared round counter, whose growth on large
+                    # jobs could push map_id past ATTEMPT_STRIDE into the
+                    # next logical map's id space.
+                    "_attempt_base": (
+                        TaskQueue.MAX_ATTEMPTS * budget[lost.map_index]
+                    ),
+                }
+                for lost in recompute
+            ],
+        )
+        self._recovering = True
+        try:
+            self._wait_stage(rec_stage)
+        finally:
+            self._recovering = False
+            self.server.task_queue.drop_stage(rec_stage)
+        # re-seal the shuffle at the new epoch so fresh scans see the
+        # recomputed attempts without a tracker round-trip; already-running
+        # reduce attempts fall back to the live tracker on their retry
+        publish_snapshot(self.server.tracker, self.config, shuffle_id)
+        return True
+
+    def _handle_reduce_failures(
+        self, shuffle_id: int, reduce_stage: str, failed: dict
+    ) -> bool:
+        """Recovery hook for the reduce wait: failures carrying the
+        MapOutputLost marker re-probe the shuffle, plan recovery, and
+        re-queue the reduce task (bounded by the shared attempt budget).
+        Any other failure, an exhausted budget, or a probe that finds NO
+        loss stays fatal — retrying a task that just proved its inputs
+        unreadable, without anything having been recovered, would burn the
+        whole attempt budget on identical failures."""
+        from s3shuffle_tpu.recovery import MAP_OUTPUT_LOST_MARKER
+
+        if not all(MAP_OUTPUT_LOST_MARKER in str(e) for e in failed.values()):
+            return False
+        recovered = self._recover_shuffle_losses(shuffle_id)
+        state = self._job_state.get(shuffle_id)
+        if not recovered and not (state and state["recovery_round"] > 0):
+            # nothing is lost and nothing was ever recovered: the retry
+            # would re-fail identically — stay fatal. (A clean probe AFTER
+            # a recovery round is the benign race — the task failed while
+            # the recompute was landing — and retries.)
+            return False
+        return all(
+            self.server.task_queue.retry_failed(
+                reduce_stage, task_id, reason="map_output_lost"
+            )
+            for task_id in failed
+        )
 
     def run_sort_shuffle(self, input_batches, num_partitions: int):
         """Distributed range-partitioned sort (the terasort shape): stages
@@ -329,7 +504,34 @@ class DistributedDriver:
             write_input_object(self.dispatcher.backend, path, batch)
             input_paths.append(path)
 
+        # recovery state: everything a recompute of any one map needs,
+        # kept for the job's lifetime (inputs stay staged in the store)
+        self._job_state[shuffle_id] = {
+            "desc": desc, "input_paths": list(input_paths),
+            "recovery_round": 0, "recovery_attempts": {},
+        }
         map_stage = stage_id_for(shuffle_id, "map")
+        reduce_stage = stage_id_for(shuffle_id, "reduce")
+        try:
+            return self._run_sort_stages(
+                shuffle_id, dep, desc, input_paths, map_stage, reduce_stage
+            )
+        finally:
+            # teardown on EVERY exit: a failed job's stages must not stay
+            # in the queue — the fleet-level reap iterates ALL stages, so a
+            # leaked stage's tasks would be requeued and re-executed during
+            # later jobs, and its _job_state could spawn recovery stages
+            # for a shuffle nobody is waiting on
+            self.server.task_queue.drop_stage(map_stage)
+            self.server.task_queue.drop_stage(reduce_stage)
+            self._job_state.pop(shuffle_id, None)
+
+    def _run_sort_stages(
+        self, shuffle_id, dep, desc, input_paths, map_stage, reduce_stage
+    ):
+        from s3shuffle_tpu.batch import RecordBatch
+        from s3shuffle_tpu.worker import read_input_batches
+
         self.server.task_queue.submit_stage(
             map_stage,
             [
@@ -339,6 +541,10 @@ class DistributedDriver:
             ],
         )
         self._wait_stage(map_stage)
+        # between-stage fleet beat: a worker dying right after its last map
+        # poll is detected HERE (membership expiry + cross-stage requeue +
+        # lost-output recovery), not first deep into the reduce wait
+        self._reap_fleet()
         # Orphan sweep (VERDICT r4 ask #7): a map worker that died mid-write
         # never registered, so its attempt-unique objects are invisible to
         # the tracker but still occupy the store; reclaim them as soon as
@@ -374,7 +580,6 @@ class DistributedDriver:
         snap_epoch = publish_snapshot(self.server.tracker, self.config, shuffle_id)
 
         out_paths = [self._scratch(shuffle_id, f"output_{r}") for r in range(dep.num_partitions)]
-        reduce_stage = stage_id_for(shuffle_id, "reduce")
         self.server.task_queue.submit_stage(
             reduce_stage,
             [
@@ -384,7 +589,12 @@ class DistributedDriver:
                 for r, p in enumerate(out_paths)
             ],
         )
-        done = self._wait_stage(reduce_stage)
+        done = self._wait_stage(
+            reduce_stage,
+            on_failed=lambda failed: self._handle_reduce_failures(
+                shuffle_id, reduce_stage, failed
+            ),
+        )
 
         out = []
         for r, base in enumerate(out_paths):
@@ -394,8 +604,6 @@ class DistributedDriver:
             path = result.get("path", base)
             batches = read_input_batches(self.dispatcher.backend, path)
             out.append(batches[0] if batches else RecordBatch.empty())
-        self.server.task_queue.drop_stage(map_stage)
-        self.server.task_queue.drop_stage(reduce_stage)
         return out
 
     # ------------------------------------------------------------------

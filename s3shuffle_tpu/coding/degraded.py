@@ -444,17 +444,23 @@ class SpeculativeFetcher:
     the requested budget covers the whole range (the buffer is then complete
     — the abandoned primary GET can never corrupt a later cursor read).
 
-    The threshold is the configured quantile of the live
-    ``read_prefetch_fill_seconds`` histogram, resolved once per scan and
-    only once at least :data:`MIN_FILL_SAMPLES` fills have been observed —
-    cold processes never speculate on noise."""
+    The threshold is SIZE-AWARE: the configured quantile is taken from the
+    ``read_prefetch_fill_class_seconds`` series matching the prefill's
+    size class (read/prefetch.py buckets every observed fill the same
+    way), resolved once per (scan, class) and only once that class has at
+    least :data:`MIN_FILL_SAMPLES` samples — cold processes and unseen
+    size classes never speculate on noise. The raw un-classed quantile the
+    plane shipped with armed spurious races on healthy LARGE coalesced
+    segments: a 64 MiB fill judged against a p99 dominated by small-block
+    fills always looks like a straggler."""
 
     def __init__(self, recovery: DegradedReader, quantile: float, width: int = 4):
         self.recovery = recovery
         self.quantile = float(quantile)
         self.width = max(1, int(width))
-        self._threshold: Optional[float] = None
-        self._resolved = False
+        #: size-class label -> resolved threshold (None = never speculate
+        #: for that class this scan)
+        self._thresholds: Dict[str, Optional[float]] = {}
 
     def eligible(self, stream, bsize: int) -> bool:
         data_block = getattr(stream, "data_block", None)
@@ -462,17 +468,26 @@ class SpeculativeFetcher:
             return False
         return self.recovery.speculation_viable(data_block)
 
-    def threshold_s(self) -> Optional[float]:
-        if not self._resolved:
-            self._resolved = True
+    def threshold_s(self, bsize: int = 0) -> Optional[float]:
+        """The race-arming threshold for a prefill of ``bsize`` bytes —
+        the quantile of ITS size class's observed fill latencies."""
+        from s3shuffle_tpu.read.prefetch import fill_size_class
+
+        cls = fill_size_class(int(bsize))
+        if cls not in self._thresholds:
+            threshold = None
             if 0.0 < self.quantile < 1.0 and _metrics.enabled():
-                hist = _metrics.REGISTRY.histogram("read_prefetch_fill_seconds")
-                snap = hist.read()
+                hist = _metrics.REGISTRY.histogram(
+                    "read_prefetch_fill_class_seconds",
+                    labelnames=("size_class",),
+                )
+                snap = hist.labels(size_class=cls).read()
                 if snap.count >= MIN_FILL_SAMPLES:
                     value = snap.percentile(self.quantile)
                     if value > 0.0:
-                        self._threshold = value
-        return self._threshold
+                        threshold = value
+            self._thresholds[cls] = threshold
+        return self._thresholds[cls]
 
     def prefill(self, stream, bsize: int, primary):
         """Run ``primary`` (the normal prefill) with a reconstruction race
@@ -485,7 +500,7 @@ class SpeculativeFetcher:
         when stragglers are sustained), and primary-won fills should
         observe ``primary_exec_s`` — the GET's own execution time, pool
         queue wait excluded — for the same reason."""
-        threshold = self.threshold_s()
+        threshold = self.threshold_s(bsize)
         if threshold is None:
             return primary(), False, None
         started = threading.Event()

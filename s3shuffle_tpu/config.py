@@ -176,6 +176,22 @@ class ShuffleConfig:
     # lookups locally (zero tracker round-trips). false = every lookup is a
     # live RPC (the pre-snapshot behavior).
     metadata_snapshots: bool = True
+    # --- elastic fleet (TPU-first addition; the reference's decommission /
+    # fallback-storage mode covers planned executor removal only — this is
+    # the membership/lease layer that also survives UNPLANNED preemption) ---
+    # worker-silence lease: a worker that sent no heartbeat/poll for this
+    # long is declared expired — its membership drops, its in-flight tasks
+    # requeue across EVERY live stage, and its uncommitted attempts are
+    # invalidated (the lease-holder commit fence refuses them). The
+    # WorkerAgent heartbeats every ~5 s, so keep this comfortably larger
+    # than the heartbeat interval.
+    worker_lease_s: float = 30.0
+    # SIGTERM triggers a graceful drain (stop taking tasks, seal open
+    # composite groups, flush parity + deferred reports, push stats,
+    # deregister) instead of the default die-mid-task behavior — the
+    # spot/preemption notice path. false = legacy SIGTERM (process death,
+    # lease reaping recovers).
+    drain_on_sigterm: bool = True
     # --- online autotuner (TPU-first addition; the reference's only adaptive
     # element is the prefetch thread-count hill climb) ---
     # master switch for the closed-loop knob controllers (tuning/): a
@@ -277,6 +293,8 @@ class ShuffleConfig:
             raise ValueError("autotune_interval_s must be >= 0")
         if self.metadata_shards < 1 or self.metadata_batch_max < 1:
             raise ValueError("metadata_shards / metadata_batch_max must be >= 1")
+        if self.worker_lease_s <= 0:
+            raise ValueError("worker_lease_s must be > 0")
         if self.metadata_shard_endpoints < 0:
             raise ValueError("metadata_shard_endpoints must be >= 0")
         algo = self.checksum_algorithm.upper()

@@ -42,6 +42,23 @@ _C_RPC = _metrics.REGISTRY.counter(
     "client shard connection",
     labelnames=("method", "shard"),
 )
+_C_MEMBERSHIP = _metrics.REGISTRY.counter(
+    "worker_membership_events_total",
+    "Fleet membership transitions recorded by the control plane "
+    "(join / drain / leave / expire)",
+    labelnames=("event",),
+)
+_C_REQUEUE = _metrics.REGISTRY.counter(
+    "task_requeues_total",
+    "Tasks returned to the pending queue after their attempt was "
+    "invalidated, by trigger",
+    labelnames=("reason",),
+)
+_H_DRAIN = _metrics.REGISTRY.histogram(
+    "worker_drain_seconds",
+    "Wall clock a departing worker spent in its graceful drain (seal + "
+    "flush + deregister), as reported at deregistration",
+)
 
 _LEN = struct.Struct("<I")
 _MAX_FRAME = 64 << 20
@@ -108,6 +125,165 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     return bytes(buf)
 
 
+class WorkerMembership:
+    """First-class fleet membership table — the control plane's view of
+    which workers exist, which are draining, and which went silent.
+
+    Before this table, worker liveness lived only implicitly in the
+    TaskQueue's heartbeat timestamps and was consulted one stage at a time.
+    Membership promotes it to join / drain / leave / expire EVENTS so the
+    driver can react to fleet changes (requeue a dead worker's tasks across
+    every live stage, plan lost-output recovery) and operators can watch
+    churn (``worker_membership_events_total{event}``).
+
+    States: ``active`` → (``draining`` →) ``left`` on a graceful
+    deregistration, or → ``expired`` when :meth:`expire_silent` finds the
+    worker past the ``worker_lease_s`` silence lease. A worker that shows
+    up again after leaving/expiring simply re-joins (autoscaling restarts
+    reuse ids). All timestamps are ``time.monotonic()``.
+    """
+
+    #: bounded event log (ring) — enough for dashboards/tests, never a leak
+    EVENTS_MAX = 1024
+    #: table cap: unique-id churn (autoscaling replacements get fresh ids)
+    #: leaves one departed entry per worker, so a long-lived coordinator
+    #: would otherwise grow the table — and every expire_silent beat plus
+    #: every q_membership payload — without bound. Past the cap, departed
+    #: entries are pruned oldest-first; live workers are never pruned.
+    WORKERS_MAX = 4096
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._workers: dict = {}  # worker_id -> {state, joined_at, last_seen}
+        self._events: List[dict] = []
+
+    def _prune_departed(self) -> None:
+        """Under the lock: drop oldest departed entries beyond the cap."""
+        excess = len(self._workers) - self.WORKERS_MAX
+        if excess <= 0:
+            return
+        departed = sorted(
+            (
+                w for w, e in self._workers.items()
+                if e["state"] in ("left", "expired")
+            ),
+            key=lambda w: self._workers[w]["last_seen"],
+        )
+        for w in departed[:excess]:
+            del self._workers[w]
+
+    def _emit(self, worker_id: str, event: str) -> None:
+        """Under the lock: record one membership transition."""
+        self._events.append(
+            {"worker": worker_id, "event": event, "at": time.monotonic()}
+        )
+        if len(self._events) > self.EVENTS_MAX:
+            del self._events[: len(self._events) - self.EVENTS_MAX]
+        if _metrics.enabled():
+            _C_MEMBERSHIP.labels(event=event).inc()
+
+    def observe(self, worker_id: str) -> None:
+        """A liveness signal (poll/heartbeat/explicit registration): joins
+        unknown or previously departed workers, refreshes the lease of
+        known ones. Draining workers stay draining — a drain request is
+        sticky until the worker deregisters."""
+        now = time.monotonic()
+        with self._lock:
+            entry = self._workers.get(worker_id)
+            if entry is None or entry["state"] in ("left", "expired"):
+                self._workers[worker_id] = {
+                    "state": "active", "joined_at": now, "last_seen": now,
+                }
+                self._emit(worker_id, "join")
+                self._prune_departed()
+            else:
+                entry["last_seen"] = now
+
+    def refresh(self, worker_id: str) -> None:
+        """Lease refresh ONLY — a heartbeat proves an existing member is
+        alive but must never resurrect one that already left or expired:
+        a drained worker's last in-flight heartbeat can land AFTER its
+        deregistration, and re-joining it would strand a phantom 'active'
+        entry until the lease reaps it (spurious join+expire events plus a
+        needless lost-output probe). Re-joins ride the active paths
+        (``q_register_worker`` / ``q_take_task``) instead."""
+        now = time.monotonic()
+        with self._lock:
+            entry = self._workers.get(worker_id)
+            if entry is not None and entry["state"] in ("active", "draining"):
+                entry["last_seen"] = now
+
+    def request_drain(self, worker_id: str) -> bool:
+        """Flag a worker for graceful drain: its next ``take_task`` poll
+        answers ``{"action": "drain"}`` instead of a task. True iff the
+        worker is live and was not already draining."""
+        with self._lock:
+            entry = self._workers.get(worker_id)
+            if entry is None or entry["state"] != "active":
+                return False
+            entry["state"] = "draining"
+            self._emit(worker_id, "drain")
+            return True
+
+    def is_draining(self, worker_id: str) -> bool:
+        with self._lock:
+            entry = self._workers.get(worker_id)
+            return entry is not None and entry["state"] == "draining"
+
+    def deregister(self, worker_id: str, drain_seconds: Optional[float] = None) -> None:
+        """Graceful departure (the drain protocol's last step). The worker
+        reports how long its drain took; the coordinator owns the
+        histogram so fleet-wide drain latency aggregates in one place."""
+        with self._lock:
+            entry = self._workers.get(worker_id)
+            if entry is None or entry["state"] in ("left", "expired"):
+                return
+            entry["state"] = "left"
+            self._emit(worker_id, "leave")
+        if drain_seconds is not None and _metrics.enabled():
+            _H_DRAIN.observe(max(0.0, float(drain_seconds)))
+
+    def expire_silent(self, lease_s: float) -> List[str]:
+        """Expire every live worker silent past ``lease_s``; returns the
+        NEWLY expired ids so the caller (the driver's fleet reap) can
+        requeue their tasks and plan recovery exactly once per death."""
+        now = time.monotonic()
+        expired: List[str] = []
+        with self._lock:
+            for worker_id, entry in self._workers.items():
+                if entry["state"] in ("active", "draining") and (
+                    now - entry["last_seen"] > lease_s
+                ):
+                    entry["state"] = "expired"
+                    self._emit(worker_id, "expire")
+                    expired.append(worker_id)
+        return expired
+
+    def live_workers(self) -> List[str]:
+        with self._lock:
+            return sorted(
+                w for w, e in self._workers.items()
+                if e["state"] in ("active", "draining")
+            )
+
+    def state_of(self, worker_id: str) -> Optional[str]:
+        with self._lock:
+            entry = self._workers.get(worker_id)
+            return None if entry is None else entry["state"]
+
+    def snapshot(self) -> dict:
+        """JSON-safe table + event log (the ``q_membership`` RPC)."""
+        with self._lock:
+            return {
+                "workers": {
+                    w: {"state": e["state"], "joined_at": e["joined_at"],
+                        "last_seen": e["last_seen"]}
+                    for w, e in self._workers.items()
+                },
+                "events": [dict(ev) for ev in self._events],
+            }
+
+
 class TaskQueue:
     """Coordinator-side stage/task queue for distributed execution.
 
@@ -153,8 +329,10 @@ class TaskQueue:
                 "pending": list(reversed(tasks)),  # pop() serves FIFO
                 "running": {},  # task_id -> {worker, task, taken_at}
                 "done": {},  # task_id -> result
+                "done_by": {},  # task_id -> worker_id that committed it
                 "failed": {},  # task_id -> error string
                 "attempts": {},  # task_id -> count handed out
+                "tasks": {t["task_id"]: t for t in tasks},  # for retry_failed
             }
 
     def heartbeat(self, worker_id: str) -> None:
@@ -230,6 +408,7 @@ class TaskQueue:
             st = self._stages[stage_id]
             st["running"].pop(task_id, None)
             st["done"][task_id] = result
+            st["done_by"][task_id] = worker_id
             return True
 
     def fail_task(self, stage_id: str, task_id, error: str, worker_id=None) -> bool:
@@ -251,9 +430,11 @@ class TaskQueue:
                 "failed": dict(st["failed"]),
             }
 
-    def _requeue_or_fail(self, st, tid, entry, why: str) -> bool:
+    def _requeue_or_fail(self, st, tid, entry, why: str, reason: str) -> bool:
         """Under the lock: return a reaped task to pending, or fail it once
-        it has exhausted MAX_ATTEMPTS. True = requeued."""
+        it has exhausted MAX_ATTEMPTS. True = requeued. ``reason`` labels
+        ``task_requeues_total`` — the drain protocol's zero-requeue claim
+        is asserted against this counter."""
         attempts = st["attempts"].get(tid, 1)
         if attempts >= self.MAX_ATTEMPTS:
             st["failed"][tid] = (
@@ -263,6 +444,8 @@ class TaskQueue:
         else:
             st["pending"].append(entry["task"])
             requeued = True
+            if _metrics.enabled():
+                _C_REQUEUE.labels(reason=reason).inc()
         logger.warning(
             "task %s %s on worker %s (attempt %d) — %s",
             tid, why, entry["worker"], attempts,
@@ -270,20 +453,37 @@ class TaskQueue:
         )
         return requeued
 
+    def _requeue_lost_locked(self, st, worker_id: str) -> int:
+        lost = [
+            tid for tid, r in st["running"].items() if r["worker"] == worker_id
+        ]
+        n = 0
+        for tid in lost:
+            entry = st["running"].pop(tid)
+            if self._requeue_or_fail(
+                st, tid, entry, "worker reported lost", reason="worker_lost"
+            ):
+                n += 1
+        return n
+
     def requeue_lost(self, stage_id: str, worker_id: str) -> int:
         """Re-queue tasks a dead worker was running (explicit observation of
         a death). Honors the MAX_ATTEMPTS cap. Returns the count requeued."""
         with self._lock:
-            st = self._stages[stage_id]
-            lost = [
-                tid for tid, r in st["running"].items() if r["worker"] == worker_id
-            ]
-            n = 0
-            for tid in lost:
-                entry = st["running"].pop(tid)
-                if self._requeue_or_fail(st, tid, entry, "worker reported lost"):
-                    n += 1
-            return n
+            return self._requeue_lost_locked(self._stages[stage_id], worker_id)
+
+    def requeue_lost_all(self, worker_id: str) -> int:
+        """Fleet-level death handling: re-queue the dead worker's in-flight
+        tasks across EVERY live stage in one pass — the membership-expiry
+        hook. The per-stage ``reap_expired`` only ever ran for the stage
+        the driver was actively waiting on, so a worker dying while
+        holding a task of any OTHER stage went undetected until that
+        stage was next waited (or forever)."""
+        with self._lock:
+            return sum(
+                self._requeue_lost_locked(st, worker_id)
+                for st in self._stages.values()
+            )
 
     def reap_expired(self, stage_id: str, lease_s: float) -> int:
         """Re-queue running tasks whose WORKER went silent for ``lease_s``
@@ -297,16 +497,76 @@ class TaskQueue:
         reaped = 0
         with self._lock:
             st = self._stages[stage_id]
-            for tid in [
-                t for t, r in st["running"].items()
-                if now - max(
-                    r["taken_at"], self._heartbeats.get(r["worker"], 0.0)
-                ) > lease_s
-            ]:
-                entry = st["running"].pop(tid)
-                if self._requeue_or_fail(st, tid, entry, "lease expired"):
-                    reaped += 1
+            reaped = self._reap_expired_locked(st, lease_s, now)
         return reaped
+
+    def _reap_expired_locked(self, st, lease_s: float, now: float) -> int:
+        reaped = 0
+        for tid in [
+            t for t, r in st["running"].items()
+            if now - max(
+                r["taken_at"], self._heartbeats.get(r["worker"], 0.0)
+            ) > lease_s
+        ]:
+            entry = st["running"].pop(tid)
+            if self._requeue_or_fail(
+                st, tid, entry, "lease expired", reason="lease_expired"
+            ):
+                reaped += 1
+        return reaped
+
+    def reap_expired_all(self, lease_s: float) -> int:
+        """Reap silent-worker leases across EVERY live stage (the fleet-reap
+        cadence fix): the driver's wait loop used to reap only the stage it
+        was waiting on, so a worker dying after its last poll of some
+        OTHER live stage left that stage's task running forever."""
+        import time as _time
+
+        now = _time.monotonic()
+        with self._lock:
+            return sum(
+                self._reap_expired_locked(st, lease_s, now)
+                for st in self._stages.values()
+            )
+
+    def retry_failed(self, stage_id: str, task_id, reason: str = "recovery") -> bool:
+        """Move one FAILED task back to pending — the driver's recovery
+        path (a reduce task that failed on a lost map output gets another
+        attempt once the map is recomputed or its parity coverage is
+        confirmed). Bounded by the same MAX_ATTEMPTS budget as lease
+        reaping; False when the task is not failed or out of attempts."""
+        with self._lock:
+            st = self._stages.get(stage_id)
+            if st is None or task_id not in st["failed"]:
+                return False
+            if st["attempts"].get(task_id, 0) >= self.MAX_ATTEMPTS:
+                return False
+            task = st["tasks"].get(task_id)
+            if task is None:
+                return False
+            st["failed"].pop(task_id)
+            st["pending"].append(task)
+            if _metrics.enabled():
+                _C_REQUEUE.labels(reason=reason).inc()
+            return True
+
+    def tasks_done_by(self, worker_id: str) -> List[Tuple[str, Any]]:
+        """``(stage_id, task_id)`` of every task this worker COMMITTED —
+        the recovery planner's starting point when a worker dies: these
+        are the outputs that may have died with it (fallback/local
+        storage modes) and need a recompute-vs-reconstruct decision."""
+        with self._lock:
+            return [
+                (stage_id, tid)
+                for stage_id, st in self._stages.items()
+                for tid, w in st["done_by"].items()
+                if w == worker_id
+            ]
+
+    @property
+    def stopping(self) -> bool:
+        with self._lock:
+            return self._stopping
 
     def drop_stage(self, stage_id: str) -> None:
         with self._lock:
@@ -367,12 +627,21 @@ class _Handler(socketserver.BaseRequestHandler):
 
     def _dispatch_queue(self, req: Any):
         queue: TaskQueue = self.server.task_queue  # type: ignore[attr-defined]
+        membership: WorkerMembership = self.server.membership  # type: ignore[attr-defined]
         method = req.get("method")
         a = req.get("args", [])
         if method == "q_submit_stage":
             return queue.submit_stage(str(a[0]), list(a[1]))
         if method == "q_take_task":
-            return queue.take_task(str(a[0]))
+            worker_id = str(a[0])
+            membership.observe(worker_id)
+            # a drain-flagged worker gets no new work — but fleet shutdown
+            # (stop_workers) still wins, so a drained-but-lingering agent
+            # can never outlive the job
+            if membership.is_draining(worker_id) and not queue.stopping:
+                queue.heartbeat(worker_id)  # drain is liveness too
+                return {"action": "drain"}
+            return queue.take_task(worker_id)
         if method == "q_complete_task":
             w = a[3] if len(a) > 3 and a[3] is not None else None
             on_accept = None
@@ -415,7 +684,27 @@ class _Handler(socketserver.BaseRequestHandler):
             w = a[3] if len(a) > 3 and a[3] is not None else None
             return queue.fail_task(str(a[0]), a[1], str(a[2]), w)
         if method == "q_heartbeat":
+            # refresh, never (re-)join: a departed worker's in-flight
+            # heartbeat must not resurrect its membership entry
+            membership.refresh(str(a[0]))
             return queue.heartbeat(str(a[0]))
+        if method == "q_register_worker":
+            # explicit join (WorkerAgent startup): the membership event
+            # fires even before the first poll, so joins are observable
+            membership.observe(str(a[0]))
+            return queue.heartbeat(str(a[0]))
+        if method == "q_request_drain":
+            return membership.request_drain(str(a[0]))
+        if method == "q_deregister_worker":
+            drain_s = float(a[1]) if len(a) > 1 and a[1] is not None else None
+            return membership.deregister(str(a[0]), drain_s)
+        if method == "q_membership":
+            return membership.snapshot()
+        if method == "q_reap_expired_all":
+            return queue.reap_expired_all(float(a[0]))
+        if method == "q_retry_failed":
+            reason = str(a[2]) if len(a) > 2 else "recovery"
+            return queue.retry_failed(str(a[0]), a[1], reason)
         if method == "q_can_commit":
             return queue.can_commit(str(a[0]), a[1], str(a[2]))
         if method == "q_stage_status":
@@ -596,6 +885,7 @@ class MetadataServer:
 
         self.tracker = tracker or ShardedMapOutputTracker(max(1, int(shards)))
         self.task_queue = TaskQueue()
+        self.membership = WorkerMembership()
         self.snapshots = SnapshotCache()
         self._server = _Server((host, port), _Handler)
         self._shard_servers = [
@@ -604,6 +894,7 @@ class MetadataServer:
         for srv in self._all_servers():
             srv.tracker = self.tracker  # type: ignore[attr-defined]
             srv.task_queue = self.task_queue  # type: ignore[attr-defined]
+            srv.membership = self.membership  # type: ignore[attr-defined]
             srv.snapshots = self.snapshots  # type: ignore[attr-defined]
             srv.shard_addresses = []  # type: ignore[attr-defined]
         addrs = [srv.server_address[:2] for srv in self._shard_servers]
@@ -918,5 +1209,30 @@ class RemoteMapOutputTracker:
     def reap_expired(self, stage_id: str, lease_s: float) -> int:
         return self._call("q_reap_expired", stage_id, lease_s)
 
+    def reap_expired_all(self, lease_s: float) -> int:
+        return self._call("q_reap_expired_all", lease_s)
+
+    def retry_failed(self, stage_id: str, task_id, reason: str = "recovery") -> bool:
+        return bool(self._call("q_retry_failed", stage_id, task_id, reason))
+
     def stop_workers(self) -> None:
         self._call("q_stop_workers")
+
+    # -- fleet membership (elastic worker fleet) -----------------------
+    def register_worker(self, worker_id: str) -> None:
+        """Explicit membership join (WorkerAgent startup)."""
+        self._call("q_register_worker", worker_id)
+
+    def request_drain(self, worker_id: str) -> bool:
+        """Flag one worker for graceful drain; it learns at its next poll."""
+        return bool(self._call("q_request_drain", worker_id))
+
+    def deregister_worker(
+        self, worker_id: str, drain_seconds: Optional[float] = None
+    ) -> None:
+        """Graceful leave, reporting how long the drain took."""
+        self._call("q_deregister_worker", worker_id, drain_seconds)
+
+    def membership(self) -> dict:
+        """The coordinator's membership table + bounded event log."""
+        return self._call("q_membership")

@@ -50,6 +50,7 @@ KNOWN_METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     # --- read plane: adaptive prefetch (read/prefetch.py) ---
     "read_prefetch_wait_seconds": ("histogram", ()),
     "read_prefetch_fill_seconds": ("histogram", ()),
+    "read_prefetch_fill_class_seconds": ("histogram", ("size_class",)),
     "read_prefetch_threads": ("gauge", ()),
     "read_prefetch_thread_moves_total": ("counter", ("direction",)),
     # --- read plane: chunked concurrent ranged GETs (read/chunked_fetch.py) ---
@@ -91,6 +92,12 @@ KNOWN_METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "tune_decisions_total": ("counter", ("knob", "direction")),
     "tune_knob_value": ("gauge", ("knob",)),
     "tune_controller_seconds": ("histogram", ()),
+    # --- elastic fleet: membership / drain / task requeues / recovery
+    # (metadata/service.py, s3shuffle_tpu/recovery.py) ---
+    "worker_membership_events_total": ("counter", ("event",)),
+    "task_requeues_total": ("counter", ("reason",)),
+    "worker_drain_seconds": ("histogram", ()),
+    "recovery_decisions_total": ("counter", ("choice",)),
     # --- coding plane: k-of-n parity + degraded reads
     # (coding/parity.py, coding/degraded.py) ---
     "shuffle_parity_encode_seconds": ("histogram", ()),

@@ -49,6 +49,28 @@ _H_FILL = _metrics.REGISTRY.histogram(
     "read_prefetch_fill_seconds",
     "Background prefill latency per block (the actual store GET)",
 )
+_H_FILL_CLASS = _metrics.REGISTRY.histogram(
+    "read_prefetch_fill_class_seconds",
+    "Background prefill latency per block, bucketed by requested size "
+    "class — the size-aware speculation threshold's evidence (a healthy "
+    "64 MiB coalesced segment must be judged against 64 MiB peers, not a "
+    "quantile dominated by 100 KiB fills)",
+    labelnames=("size_class",),
+)
+
+#: size-class edges for ``read_prefetch_fill_class_seconds`` — coarse on
+#: purpose: enough resolution to separate "small block" from "large
+#: coalesced segment" regimes without fragmenting the sample counts the
+#: quantiles need (MIN_FILL_SAMPLES per class before a threshold arms)
+_SIZE_CLASS_EDGES = ((1 << 20, "le1m"), (8 << 20, "le8m"), (64 << 20, "le64m"))
+
+
+def fill_size_class(nbytes: int) -> str:
+    """The size-class label for one prefill's requested byte budget."""
+    for edge, label in _SIZE_CLASS_EDGES:
+        if nbytes <= edge:
+            return label
+    return "gt64m"
 _G_THREADS = _metrics.REGISTRY.gauge(
     "read_prefetch_threads", "Live ThreadPredictor thread-count decision"
 )
@@ -310,9 +332,15 @@ class BufferedPrefetchIterator:
                 # excluded) — either would ratchet the quantile upward
                 # during sustained straggler episodes
                 if _metrics.enabled() and not speculation_won:
-                    _H_FILL.observe(
+                    fill_s = (
                         primary_exec_s if primary_exec_s is not None else dt / 1e9
                     )
+                    _H_FILL.observe(fill_s)
+                    # same sample, size-classed: the speculation threshold
+                    # reads the class matching its prefill's budget
+                    _H_FILL_CLASS.labels(
+                        size_class=fill_size_class(bsize)
+                    ).observe(fill_s)
                 prefetched = PrefetchedBlockStream(block, stream, buffer, self._release_budget(len(buffer), bsize))
                 with self._lock:
                     self._stat_prefetch_ns += dt

@@ -1,0 +1,231 @@
+"""Recompute-vs-reconstruct recovery for committed map outputs lost with
+their worker.
+
+The elastic-fleet composition point: when a worker dies, its *in-flight*
+tasks are requeued by the lease machinery (metadata/service.py) — but a
+COMMITTED map whose objects vanished with the worker (fallback/local
+storage modes, a decommissioned node's disk, an availability-zone loss)
+has two valid recoveries with very different costs, the trade "Leveraging
+Coding Techniques for Speeding up Distributed Computing" (PAPERS.md)
+formalizes:
+
+- **reconstruct**: leave the tracker alone and let the coded plane's
+  degraded reads (coding/degraded.py, PR 10) rebuild the lost bytes from
+  parity sidecars on demand. Costs ~``lost_bytes`` of extra GETs spread
+  across the reduce scans; zero re-execution. Only *determined* when the
+  parity geometry covers full-object loss (``m >= k``) and the index
+  sidecar survived (it carries the geometry trailer).
+- **recompute**: re-run the map task from its staged input (the driver
+  keeps input objects for the job's lifetime) and re-register the fresh
+  attempt. Costs one map task of CPU + write bytes; always available.
+
+:class:`RecoveryPlanner` makes that call per lost map from *observed*
+evidence — the coordinator-aggregated ShuffleStats (bytes/latency the
+fleet actually saw, the same reports the autotuner's controllers consume)
+— and falls back to recompute automatically whenever parity is
+underdetermined. Decisions are metered (``recovery_decisions_total{choice}``)
+so the trace report's Fleet digest shows what the job actually did.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import List, Optional
+
+from s3shuffle_tpu.metrics import registry as _metrics
+
+logger = logging.getLogger("s3shuffle_tpu.recovery")
+
+_C_DECISIONS = _metrics.REGISTRY.counter(
+    "recovery_decisions_total",
+    "Lost-map recovery decisions taken by the driver, by chosen strategy",
+    labelnames=("choice",),
+)
+
+#: prefix every loss-shaped task failure carries so the driver can tell a
+#: recoverable fetch failure from a genuine task bug (Spark's FetchFailed
+#: vs ExceptionFailure split). Workers attach it (worker.MapOutputLostError);
+#: the driver greps failure strings for it.
+MAP_OUTPUT_LOST_MARKER = "MapOutputLost"
+
+
+@dataclasses.dataclass
+class LostMap:
+    """One committed map output whose store objects are (partially) gone."""
+
+    shuffle_id: int
+    map_id: int  # attempt-unique id, as registered
+    map_index: int  # logical position (the task id to recompute)
+    lost_bytes: int
+    parity_segments: int  # m recorded at commit (0 = uncoded)
+    composite_group: int  # -1 = singleton layout
+    index_present: bool  # geometry lives in the index trailer / fat index
+
+
+def decision_evidence(stats: Optional[dict]) -> dict:
+    """Extract the bytes/latency evidence a decision needs from one
+    coordinator-side ShuffleStats report (``get_shuffle_stats``). Missing
+    or zero fields come back as 0.0 — the planner treats absent evidence
+    as "no opinion" and uses the structural default."""
+    stats = stats or {}
+
+    def rate(byte_key: str, sec_key: str) -> float:
+        b, s = float(stats.get(byte_key) or 0.0), float(stats.get(sec_key) or 0.0)
+        return b / s if b > 0 and s > 0 else 0.0
+
+    map_tasks = float(stats.get("map_tasks") or 0.0)
+    write_s = float(stats.get("write_seconds") or 0.0)
+    return {
+        # observed reduce-side fill throughput — what reconstruction's
+        # extra parity GETs will run at
+        "read_bytes_per_s": rate("bytes_read", "read_prefetch_seconds"),
+        # observed map-side commit throughput — what a recompute pays
+        "write_bytes_per_s": rate("bytes_written", "write_seconds"),
+        # mean observed map-task wall (serialize+encode+PUT, the whole
+        # commit) — the floor cost of one recompute
+        "map_task_wall_s": write_s / map_tasks if map_tasks > 0 else 0.0,
+    }
+
+
+class RecoveryPlanner:
+    """Costed recompute-vs-reconstruct decisions over observed evidence.
+
+    Structure first, cost second: reconstruction is only *eligible* when
+    the parity geometry determines full-object loss (``m >= k``) and the
+    index survived; otherwise the answer is recompute regardless of cost
+    (the automatic fallback the coded plane's loss envelope demands).
+    Among eligible options the planner compares
+
+    - ``reconstruct_cost ~ RECONSTRUCT_OVERHEAD * lost_bytes /
+      read_bytes_per_s`` — the parity slices total ~the lost payload, but
+      they arrive as per-stripe-group ranged GETs on reduce tasks'
+      critical paths plus a GF decode, hence the overhead factor; against
+    - ``recompute_cost ~ max(map_task_wall_s, lost_bytes / write_bytes_per_s)
+      + lost_bytes / read_bytes_per_s`` (re-run the map AND re-read the
+      staged input; the re-read term uses the read rate as a stand-in).
+
+    With no evidence at all the planner prefers reconstruct — it has no
+    re-execution side effects and never burns a task attempt.
+    """
+
+    #: degraded reads pay per-group round trips + GF decode over the same
+    #: byte volume a plain read would move — a conservative 2x
+    RECONSTRUCT_OVERHEAD = 2.0
+
+    def __init__(self, stripe_k: int = 1):
+        self.stripe_k = max(1, int(stripe_k))
+
+    def decide(self, lost: LostMap, stats: Optional[dict] = None) -> str:
+        """``"reconstruct"`` or ``"recompute"`` for one lost map."""
+        choice = self._decide(lost, stats)
+        if _metrics.enabled():
+            _C_DECISIONS.labels(choice=choice).inc()
+        logger.warning(
+            "recovery decision for shuffle %d map %d (map_index %d, %d bytes "
+            "lost, m=%d/k=%d): %s",
+            lost.shuffle_id, lost.map_id, lost.map_index, lost.lost_bytes,
+            lost.parity_segments, self.stripe_k, choice,
+        )
+        return choice
+
+    def _decide(self, lost: LostMap, stats: Optional[dict]) -> str:
+        # structural gate: full-object loss is determined only when the
+        # parity count covers the stripe width AND the geometry survived
+        if lost.parity_segments < self.stripe_k or lost.parity_segments <= 0:
+            return "recompute"
+        if not lost.index_present:
+            # the geometry trailer died with the index — nothing to decode
+            return "recompute"
+        ev = decision_evidence(stats)
+        read_rate = ev["read_bytes_per_s"]
+        if read_rate <= 0.0:
+            return "reconstruct"  # no evidence: prefer the side-effect-free path
+        reconstruct_cost = self.RECONSTRUCT_OVERHEAD * lost.lost_bytes / read_rate
+        write_rate = ev["write_bytes_per_s"]
+        recompute_cost = ev["map_task_wall_s"]
+        if write_rate > 0.0:
+            recompute_cost = max(recompute_cost, lost.lost_bytes / write_rate)
+        recompute_cost += lost.lost_bytes / read_rate  # staged-input re-read
+        return "reconstruct" if reconstruct_cost <= recompute_cost else "recompute"
+
+
+def probe_lost_maps(
+    dispatcher, tracker, shuffle_id: int, map_indices=None
+) -> List[LostMap]:
+    """Probe the store for committed map outputs whose objects are GONE.
+
+    ``tracker`` must be the coordinator's in-process tracker (the driver
+    owns it); ``map_indices`` narrows the probe to the dead worker's
+    committed maps when known, else every registered map is probed. The
+    status cache is cleared first — a cached HEAD must not mask a loss.
+    """
+    from s3shuffle_tpu.block_ids import (
+        ShuffleCompositeDataBlockId,
+        ShuffleCompositeParityBlockId,
+        ShuffleDataBlockId,
+        ShuffleFatIndexBlockId,
+        ShuffleIndexBlockId,
+        ShuffleParityBlockId,
+    )
+
+    def _exists(block) -> bool:
+        try:
+            return bool(dispatcher.backend.exists(dispatcher.get_path(block)))
+        except OSError:
+            # the probe feeds DESTRUCTIVE recovery (recompute re-runs maps,
+            # burning per-map budget) — a transient store error must read
+            # as "assume present", never as a fleet-wide loss verdict
+            return True
+
+    dispatcher.clear_status_cache()
+    wanted = None if map_indices is None else {int(m) for m in map_indices}
+    lost: List[LostMap] = []
+    for map_index, status in tracker.deduped_statuses(shuffle_id):
+        if wanted is not None and map_index not in wanted:
+            continue
+        if status.composite_group >= 0:
+            data_block = ShuffleCompositeDataBlockId(
+                shuffle_id, status.composite_group
+            )
+            index_block = ShuffleFatIndexBlockId(
+                shuffle_id, status.composite_group
+            )
+        else:
+            data_block = ShuffleDataBlockId(shuffle_id, status.map_id)
+            index_block = ShuffleIndexBlockId(shuffle_id, status.map_id)
+        data_ok = _exists(data_block)
+        index_ok = _exists(index_block)
+        # a committed output is LOST when either half is gone: reduce
+        # scans need the index (offsets/geometry) as much as the data —
+        # an index dying alone (partial node loss) is just as unreadable
+        if data_ok and index_ok:
+            continue
+        # the parity sidecars may have died WITH the data (same node's
+        # fallback storage) — what reconstruction can actually use is the
+        # SURVIVING count, so probe it; the planner's structural gate then
+        # routes underdetermined losses to recompute instead of letting
+        # reduce tasks burn their attempts on parity GETs that 404
+        committed_m = int(getattr(status, "parity_segments", 0))
+        surviving_m = 0
+        for seg in range(committed_m):
+            if status.composite_group >= 0:
+                par_block = ShuffleCompositeParityBlockId(
+                    shuffle_id, status.composite_group, seg
+                )
+            else:
+                par_block = ShuffleParityBlockId(shuffle_id, status.map_id, seg)
+            if _exists(par_block):
+                surviving_m += 1
+        lost.append(
+            LostMap(
+                shuffle_id=shuffle_id,
+                map_id=int(status.map_id),
+                map_index=int(map_index),
+                lost_bytes=int(sum(int(n) for n in status.sizes)),
+                parity_segments=surviving_m,
+                composite_group=int(status.composite_group),
+                index_present=index_ok,
+            )
+        )
+    return lost

@@ -124,6 +124,14 @@ class StaleAttemptError(RuntimeError):
     attempt owns the task now — abandon quietly, touch nothing shared."""
 
 
+class MapOutputLostError(RuntimeError):
+    """A reduce scan failed on a COMMITTED map output that is gone or
+    unreadable even after a live-tracker retry — the FetchFailed analog.
+    The message carries :data:`s3shuffle_tpu.recovery.MAP_OUTPUT_LOST_MARKER`
+    so the driver can route the failure to the recompute-vs-reconstruct
+    recovery layer instead of failing the stage."""
+
+
 class WorkerAgent:
     def __init__(
         self,
@@ -180,6 +188,10 @@ class WorkerAgent:
         # exactly this crash-loop), and dying on a transient refusal defeats
         # the pull-based fleet design. A format MISMATCH still raises
         # immediately — that is a deployment error, not a race.
+        self._stopped = False
+        #: set by the SIGTERM handler / a drain RPC response: the poll loop
+        #: drains at the next task boundary (never mid-task)
+        self._drain_requested = False
         deadline = time.monotonic() + float(
             os.environ.get("S3SHUFFLE_WORKER_CONNECT_TIMEOUT_S", "60")
         )
@@ -193,6 +205,16 @@ class WorkerAgent:
                     raise
                 time.sleep(delay)
                 delay = min(delay * 2, 2.0)
+        # explicit membership join: the fleet sees this worker the moment it
+        # is ready to serve, not at its first poll. Best-effort — an older
+        # coordinator without the membership table still serves tasks.
+        try:
+            self.client.register_worker(self.worker_id)
+        except Exception as e:
+            logger.debug(
+                "worker %s: membership registration skipped: %s",
+                self.worker_id, e,
+            )
 
     # -- task kinds ----------------------------------------------------
     def _commit_allowed(self, stage_id: str, task: dict) -> bool:
@@ -223,7 +245,11 @@ class WorkerAgent:
         from s3shuffle_tpu.batch import RecordBatch
 
         batches = read_input_batches(self.manager.dispatcher.backend, task["input_path"])
-        attempt = int(task.get("_attempt", 1))
+        # ``_attempt_base`` (driver recovery stages) lifts a recompute's
+        # attempt numbers above every attempt of the ORIGINAL stage, so the
+        # tracker's latest-attempt dedupe (largest map_id wins) always
+        # resolves the fresh output over a lost one's stale registration
+        attempt = int(task.get("_attempt", 1)) + int(task.get("_attempt_base", 0))
         logical_index = int(task["map_id"])
         map_id = logical_index * self.ATTEMPT_STRIDE + (attempt - 1)
         # map_index rides separately from the attempt-unique map_id so range
@@ -323,8 +349,7 @@ class WorkerAgent:
             self.meta.detach(shuffle_id)
         handle = self.manager.register_shuffle(shuffle_id, dep)
         rid = int(task["reduce_id"])
-        reader = self.manager.get_reader(handle, rid, rid + 1)
-        batches = reader.read_result_batches()
+        batches = self._read_reduce_batches(handle, shuffle_id, rid)
         from s3shuffle_tpu.batch import RecordBatch, write_frame
 
         merged = RecordBatch.concat(batches)
@@ -338,9 +363,98 @@ class WorkerAgent:
             write_frame(sink, merged)
         return {"records": int(merged.n), "path": out_path}
 
+    def _read_reduce_batches(self, handle, shuffle_id: int, rid: int):
+        """The reduce scan, tolerant of a producer worker dying mid-job.
+
+        A dead producer's COMMITTED objects stay readable (they live in
+        the store, not on the worker) and partial losses route through the
+        coded plane's degraded reads transparently. What surfaces here is
+        the terminal case — a committed output gone/unreadable beyond
+        parity's envelope (``ChecksumError`` / ``FileNotFoundError``; the
+        transient-weather class was already healed by the retry layer
+        below). One retry runs on the LIVE tracker with every cache
+        purged: driver-side recovery may have recomputed a fresh attempt
+        this task's sealed snapshot cannot see. Still failing, the task
+        raises :class:`MapOutputLostError` so the driver's recovery layer
+        gets the loss instead of a generic stage failure."""
+        from s3shuffle_tpu.read import ChecksumError
+
+        try:
+            reader = self.manager.get_reader(handle, rid, rid + 1)
+            return reader.read_result_batches()
+        except (ChecksumError, FileNotFoundError) as e:
+            logger.warning(
+                "worker %s: reduce %d of shuffle %d hit a lost/unreadable "
+                "map output (%s); retrying once on the live tracker",
+                self.worker_id, rid, shuffle_id, e,
+            )
+            self.meta.detach(shuffle_id)
+            self.manager.purge_caches(shuffle_id)
+            self.manager.dispatcher.clear_status_cache()
+            try:
+                reader = self.manager.get_reader(handle, rid, rid + 1)
+                return reader.read_result_batches()
+            except (ChecksumError, FileNotFoundError) as e2:
+                from s3shuffle_tpu.recovery import MAP_OUTPUT_LOST_MARKER
+
+                raise MapOutputLostError(
+                    f"{MAP_OUTPUT_LOST_MARKER}(shuffle={shuffle_id}): "
+                    f"{type(e2).__name__}: {e2}"
+                ) from e2
+
     KINDS = {"map": _run_map, "reduce": _run_reduce}
 
     # -- lifecycle ------------------------------------------------------
+    def request_drain(self) -> None:
+        """Signal-safe graceful-drain request (the SIGTERM handler): only
+        sets a flag — the poll loop drains at the next task boundary, so a
+        running task always completes and reports before the worker goes."""
+        self._drain_requested = True
+
+    def drain(self) -> float:
+        """The drain protocol: stop taking tasks (the caller already did —
+        this runs instead of a task), seal every open composite group
+        (which flushes parity sidecars and releases the deferred
+        completion reports riding the seal callbacks), push the stats
+        outbox, then deregister from the fleet membership table with the
+        measured drain wall. A planned preemption through this path loses
+        zero records and triggers zero requeues — the worker holds no
+        lease when it departs. Returns the drain seconds."""
+        t0 = time.monotonic()
+        agg = self.manager.composite
+        if agg is not None:
+            try:
+                sealed = agg.drain()
+                if sealed:
+                    logger.info(
+                        "worker %s drain sealed %d open composite group(s)",
+                        self.worker_id, sealed,
+                    )
+            except Exception:
+                # seal failures already failed their member tasks loudly
+                # via on_group_abort — the drain itself must still finish
+                logger.exception(
+                    "worker %s: drain-path composite seal failed", self.worker_id
+                )
+        self._push_task_stats()
+        drain_s = time.monotonic() - t0
+        # stop the heartbeat loop BEFORE deregistering so no fresh beat is
+        # issued for a worker the membership table just recorded as left
+        # (the coordinator side is also refresh-only for heartbeats)
+        self._stopped = True
+        try:
+            self.client.deregister_worker(self.worker_id, drain_s)
+        except Exception:
+            logger.warning(
+                "worker %s: deregistration failed (membership will expire "
+                "the lease instead)", self.worker_id, exc_info=True,
+            )
+        logger.info(
+            "worker %s drained in %.3fs after %d tasks",
+            self.worker_id, drain_s, self.tasks_run,
+        )
+        return drain_s
+
     def close(self) -> None:
         """Release the coordinator connection (and stop the heartbeat loop
         if one is running). In-process/test usage must call this — a leaked
@@ -457,9 +571,18 @@ class WorkerAgent:
 
     # -- loop ----------------------------------------------------------
     def run_once(self) -> str:
-        """Poll for one task. Returns the action taken: run|wait|stop."""
+        """Poll for one task. Returns the action taken: run|wait|stop|drain."""
+        if self._drain_requested:
+            # SIGTERM (or an explicit local request) between tasks: drain
+            # without another poll — the coordinator may already be gone
+            self.drain()
+            return "drain"
         resp = self.client.take_task(self.worker_id)
         action = resp.get("action")
+        if action == "drain":
+            # the coordinator flagged this worker for graceful removal
+            self.drain()
+            return "drain"
         if action != "run":
             # queue dry (or shutdown): this IS the commit barrier for any
             # open composite group — seal and report the deferred members
@@ -657,6 +780,23 @@ class WorkerAgent:
                         "worker %s stopping after %d tasks",
                         self.worker_id, self.tasks_run,
                     )
+                    # fleet shutdown: record the graceful leave (no drain
+                    # wall — run_once already sealed the commit barrier);
+                    # heartbeats stop first so none lands post-deregistration
+                    self._stopped = True
+                    try:
+                        self.client.deregister_worker(self.worker_id)
+                    except Exception:
+                        logger.debug(
+                            "worker %s: stop-path deregistration skipped",
+                            self.worker_id, exc_info=True,
+                        )
+                    return self.tasks_run
+                if action == "drain":
+                    logger.info(
+                        "worker %s drained and leaving after %d tasks",
+                        self.worker_id, self.tasks_run,
+                    )
                     return self.tasks_run
                 if action == "wait":
                     time.sleep(poll_interval)
@@ -774,6 +914,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = ap.parse_args(argv)
     host, port = args.coordinator.rsplit(":", 1)
     agent = WorkerAgent((host, int(port)), worker_id=args.worker_id)
+    if agent.config.drain_on_sigterm:
+        import signal
+
+        # the preemption-notice path: SIGTERM = "you have a moment" — drain
+        # at the next task boundary instead of dying mid-task (SIGKILL
+        # still exercises the lease-reap recovery, by design)
+        signal.signal(
+            signal.SIGTERM, lambda _signum, _frame: agent.request_drain()
+        )
     metrics = None
     if args.metrics_port:
         try:
@@ -782,6 +931,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             logger.warning("metrics endpoint disabled: %s", e)
     try:
         agent.run_forever(args.poll_interval)
+        # a worker that exits CLEANLY vouches for its own commit protocol:
+        # any env-installed witness (S3SHUFFLE_PROTOCOL_WITNESS=1) must be
+        # violation-free or the exit code says so — the kill-soak's
+        # per-worker protocol check
+        from s3shuffle_tpu.utils import protowitness
+
+        for witness in protowitness.drain_installed():
+            witness.assert_clean()
     finally:
         if metrics is not None:
             metrics.stop()

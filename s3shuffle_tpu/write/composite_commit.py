@@ -542,18 +542,29 @@ class CompositeCommitAggregator:
                 self._note_seal_end(shuffle_id)
             self._await_seals(shuffle_id)
 
-    def flush_all(self) -> None:
+    def flush_all(self) -> int:
+        """Seal EVERY open group (commit barrier / shutdown). Returns the
+        number sealed."""
         with self._lock:
             groups = list(self._groups.values())
             self._groups = {}
             for g in groups:  # pop→detach gap: see flush_shuffle
                 self._note_seal_begin(g.shuffle_id)
         try:
-            self._finish_each(groups)
+            return self._finish_each(groups)
         finally:
             for g in groups:
                 self._note_seal_end(g.shuffle_id)
             self._await_seals(None)
+
+    def drain(self) -> int:
+        """The graceful-drain seal barrier (WorkerAgent.drain): a departing
+        worker seals every open group so NO committed member leaves with
+        it unsealed — parity sidecars flush and the fat index (the commit
+        point) lands LAST, the same ORD01-proven ordering as any other
+        seal; the drain-seal mutation test pins the ordering from THIS
+        entry point. Returns the number of groups sealed on the way out."""
+        return self.flush_all()
 
     def abort_shuffle(self, shuffle_id: int) -> None:
         """Drop this shuffle's open group WITHOUT sealing (shuffle

@@ -1,0 +1,45 @@
+"""Tier-1 wiring for the elastic-fleet bench probe: the probe must run a
+real DistributedDriver fleet, survive a mid-job SIGKILL plus a graceful
+drain (byte identity asserted inside the probe), and report a BOUNDED
+wall-clock inflation with the fields that make BENCH rounds comparable."""
+
+import bench
+
+from s3shuffle_tpu.metrics import registry as mreg
+
+
+def test_elasticity_probe_bounded_inflation_and_fields():
+    mreg.REGISTRY.reset_values()
+    mreg.enable()
+    try:
+        out = bench.elasticity_gain(
+            n_records=12_000, n_maps=6, n_workers=3, lease_s=1.5, rounds=1
+        )
+    finally:
+        mreg.disable()
+        mreg.REGISTRY.reset_values()
+    assert "elasticity_error" not in out, out
+    # churn actually happened: at least the kill-or-drain pair fired
+    assert out["elasticity_kills"] + out["elasticity_drains"] >= 1, out
+    # bounded inflation: a kill costs ~one lease of detection + the re-run;
+    # the bound is generous because tier-1 hosts are small and loaded
+    assert 0 < out["elasticity_wall_inflation"] < 20.0, out
+    for field in (
+        "elasticity_baseline_wall_s",
+        "elasticity_churn_wall_s",
+        "elasticity_requeues",
+        "elasticity_worker_lease_s",
+        "elasticity_workers",
+    ):
+        assert field in out, field
+
+
+def test_bench_json_records_elastic_fleet_knobs():
+    out = bench.elastic_fleet_knobs()
+    from s3shuffle_tpu.config import ShuffleConfig
+
+    cfg = ShuffleConfig()
+    assert out["elastic_fleet"] == {
+        "worker_lease_s": cfg.worker_lease_s,
+        "drain_on_sigterm": cfg.drain_on_sigterm,
+    }

@@ -1,0 +1,986 @@
+"""Elastic worker fleet: lease-driven membership, graceful drain,
+zombie-attempt invalidation, and recompute-vs-reconstruct recovery.
+
+Layered like the plane itself:
+
+- **membership/queue units** — join/drain/leave/expire events, fleet-level
+  (cross-stage) lease reaping, bounded failed-task retry;
+- **agent drain** — real WorkerAgent + MetadataServer over TCP: a drained
+  worker seals its open composite group, reports every deferred member,
+  pushes stats, deregisters — zero records lost, zero requeues;
+- **zombie hardening** — a reaped-but-alive attempt's late commit is
+  refused AND its partial objects (data/index/checksum/parity) are swept,
+  on both the singleton and composite paths;
+- **recovery** — the planner's structural gate (m < loss ⇒ recompute) and
+  costed decisions, plus a full DistributedDriver job that loses a worker
+  AND its committed output mid-job and completes via recompute;
+- **size-aware speculation** — mixed segment sizes no longer arm spurious
+  parity races on healthy large fills.
+"""
+
+import random
+import time
+
+import pytest
+
+from s3shuffle_tpu.config import ShuffleConfig
+from s3shuffle_tpu.metadata.service import (
+    MetadataServer,
+    RemoteMapOutputTracker,
+    TaskQueue,
+    WorkerMembership,
+    stage_id_for,
+)
+from s3shuffle_tpu.metrics import registry as mreg
+
+
+@pytest.fixture
+def metrics_on():
+    mreg.REGISTRY.reset_values()
+    mreg.enable()
+    yield mreg.REGISTRY
+    mreg.disable()
+    mreg.REGISTRY.reset_values()
+
+
+def _counter_total(registry, name, **labels):
+    snap = registry.snapshot(compact=True)
+    total = 0.0
+    for s in snap.get(name, {}).get("series", []):
+        if all(s.get("labels", {}).get(k) == v for k, v in labels.items()):
+            total += float(s.get("value", 0))
+    return total
+
+
+# ---------------------------------------------------------------------------
+# Membership table
+# ---------------------------------------------------------------------------
+
+
+def test_membership_lifecycle_events(metrics_on):
+    m = WorkerMembership()
+    m.observe("w0")
+    m.observe("w0")  # refresh, no second join
+    assert m.state_of("w0") == "active"
+    assert m.request_drain("w0") is True
+    assert m.request_drain("w0") is False  # already draining
+    assert m.is_draining("w0")
+    m.observe("w0")  # a draining worker's liveness must NOT undo the drain
+    assert m.is_draining("w0")
+    m.deregister("w0", drain_seconds=0.25)
+    assert m.state_of("w0") == "left"
+    m.deregister("w0")  # idempotent
+    # a departed worker can come back (autoscaling reuses ids)
+    m.observe("w0")
+    assert m.state_of("w0") == "active"
+    events = [e["event"] for e in m.snapshot()["events"]]
+    assert events == ["join", "drain", "leave", "join"]
+    assert _counter_total(metrics_on, "worker_membership_events_total", event="join") == 2
+    assert _counter_total(metrics_on, "worker_membership_events_total", event="drain") == 1
+    assert _counter_total(metrics_on, "worker_membership_events_total", event="leave") == 1
+    # the drain wall landed in the coordinator-side histogram
+    snap = metrics_on.snapshot(compact=True)
+    assert snap["worker_drain_seconds"]["series"][0]["count"] == 1
+
+
+def test_heartbeat_refresh_never_resurrects_departed_worker():
+    """A heartbeat is a liveness signal, not a join request: ``refresh``
+    (the ``q_heartbeat`` path) keeps an active/draining lease fresh but
+    must NOT re-join a worker that already left or expired — a drained
+    worker's last in-flight heartbeat landing after its deregistration
+    would otherwise strand a phantom 'active' entry until the lease
+    reaped it (spurious join+expire, a needless lost-output probe)."""
+    m = WorkerMembership()
+    m.refresh("unknown")  # refresh of a never-joined worker: no join
+    assert m.state_of("unknown") is None
+    m.observe("w0")
+    m.deregister("w0")
+    m.refresh("w0")  # the late heartbeat
+    assert m.state_of("w0") == "left"
+    m.observe("w1")
+    assert m.expire_silent(lease_s=0.0) == ["w1"]
+    m.refresh("w1")  # expired workers stay expired under heartbeats too
+    assert m.state_of("w1") == "expired"
+    # ... but refresh DOES keep a live lease fresh: w2 beat recently
+    # enough that a generous lease never expires it
+    m.observe("w2")
+    m.refresh("w2")
+    assert m.expire_silent(lease_s=60.0) == []
+    assert m.state_of("w2") == "active"
+    events = [e["event"] for e in m.snapshot()["events"]]
+    assert events == ["join", "leave", "join", "expire", "join"]
+
+
+def test_membership_table_bounded_under_unique_id_churn():
+    """Autoscaling churn with fresh ids (the bench's ``spawn(f"r{n}")``
+    pattern) leaves one departed entry per worker — the table must prune
+    oldest-departed past WORKERS_MAX so a long-lived coordinator's reap
+    beat and q_membership payload stay bounded. Live workers are never
+    pruned, even when departed churn exceeds the cap."""
+    m = WorkerMembership()
+    m.WORKERS_MAX = 8
+    m.observe("keep0")
+    m.observe("keep1")
+    for n in range(50):
+        wid = f"r{n}"
+        m.observe(wid)
+        m.deregister(wid)
+    assert len(m.snapshot()["workers"]) <= m.WORKERS_MAX
+    assert m.state_of("keep0") == "active"
+    assert m.state_of("keep1") == "active"
+    assert m.state_of("r0") is None  # oldest departed pruned first
+    assert m.state_of("r49") == "left"  # freshest departed retained
+
+
+def test_membership_expiry_is_edge_triggered():
+    m = WorkerMembership()
+    m.observe("w0")
+    m.observe("w1")
+    m.deregister("w1")  # left workers never expire
+    assert m.expire_silent(lease_s=60.0) == []
+    assert m.expire_silent(lease_s=0.0) == ["w0"]
+    assert m.expire_silent(lease_s=0.0) == []  # newly-expired ONCE
+    assert m.state_of("w0") == "expired"
+    assert m.live_workers() == []
+    m.observe("w0")  # rejoin after expiry
+    assert m.state_of("w0") == "active"
+
+
+def test_draining_worker_gets_drain_action_not_tasks(tmp_path):
+    server = MetadataServer().start()
+    client = RemoteMapOutputTracker(server.address)
+    try:
+        client.register_worker("w0")
+        assert server.membership.state_of("w0") == "active"
+        server.task_queue.submit_stage("s", [{"task_id": 0, "kind": "noop"}])
+        assert client.request_drain("w0") is True
+        resp = client.take_task("w0")
+        assert resp == {"action": "drain"}
+        # the task is still there for live workers
+        assert client.take_task("w1")["action"] == "run"
+        # fleet shutdown overrides drain: a lingering drained agent stops
+        server.task_queue.stop_workers()
+        assert client.take_task("w0")["action"] == "stop"
+    finally:
+        client.close()
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Fleet-level reaping (the per-stage reap cadence bugfix)
+# ---------------------------------------------------------------------------
+
+
+def test_reap_expired_all_catches_other_stages_tasks(metrics_on):
+    """Pre-fix, the driver reaped ONLY the stage its wait loop sat on — a
+    worker dying while holding another live stage's task was never
+    detected. reap_expired_all covers every stage in one beat."""
+    q = TaskQueue()
+    q.submit_stage("shuffle0-map", [{"task_id": 0, "kind": "noop"}])
+    q.submit_stage("shuffle0-reduce", [{"task_id": 1, "kind": "noop"}])
+    assert q.take_task("doomed")["task"]["task_id"] == 0
+    # the old cadence: waiting on the REDUCE stage reaps nothing of map's
+    assert q.reap_expired("shuffle0-reduce", lease_s=0.0) == 0
+    assert q.stage_status("shuffle0-map")["running"] == 1
+    # the fleet beat catches it
+    assert q.reap_expired_all(lease_s=0.0) == 1
+    st = q.stage_status("shuffle0-map")
+    assert st["pending"] == 1 and st["running"] == 0
+    assert _counter_total(metrics_on, "task_requeues_total", reason="lease_expired") == 1
+
+
+def test_requeue_lost_all_spans_stages_and_meters(metrics_on):
+    q = TaskQueue()
+    q.submit_stage("a", [{"task_id": 0, "kind": "noop"}])
+    q.submit_stage("b", [{"task_id": 1, "kind": "noop"}])
+    q.take_task("dead")
+    q.take_task("dead")
+    assert q.requeue_lost_all("dead") == 2
+    assert q.stage_status("a")["pending"] == 1
+    assert q.stage_status("b")["pending"] == 1
+    assert _counter_total(metrics_on, "task_requeues_total", reason="worker_lost") == 2
+
+
+def test_retry_failed_is_bounded_and_tracked():
+    q = TaskQueue()
+    q.submit_stage("s", [{"task_id": 0, "kind": "noop"}])
+    assert q.retry_failed("s", 0) is False  # not failed yet
+    q.take_task("w")
+    q.fail_task("s", 0, "MapOutputLost(shuffle=0): gone", worker_id="w")
+    assert q.retry_failed("s", 0, reason="map_output_lost") is True
+    t = q.take_task("w")
+    assert t["task"]["task_id"] == 0 and t["task"]["_attempt"] == 2
+    q.fail_task("s", 0, "again", worker_id="w")
+    q.retry_failed("s", 0)
+    q.take_task("w")  # attempt 3 == MAX_ATTEMPTS
+    q.fail_task("s", 0, "again", worker_id="w")
+    assert q.retry_failed("s", 0) is False  # budget exhausted
+    assert q.retry_failed("missing-stage", 0) is False
+
+
+def test_tasks_done_by_records_committing_worker():
+    q = TaskQueue()
+    q.submit_stage("shuffle7-map", [{"task_id": i, "kind": "noop"} for i in range(2)])
+    t = q.take_task("w0")
+    q.complete_task("shuffle7-map", t["task"]["task_id"], {}, worker_id="w0")
+    t = q.take_task("w1")
+    q.complete_task("shuffle7-map", t["task"]["task_id"], {}, worker_id="w1")
+    assert q.tasks_done_by("w0") == [("shuffle7-map", 0)]
+    assert q.tasks_done_by("w1") == [("shuffle7-map", 1)]
+    assert q.tasks_done_by("w2") == []
+
+
+# ---------------------------------------------------------------------------
+# Agent-level drain (real agent + server over TCP)
+# ---------------------------------------------------------------------------
+
+
+def _stage_map_inputs(server, dispatcher, shuffle_id, parts, scratch):
+    """Register a shuffle and stage its inputs; returns the map tasks."""
+    from s3shuffle_tpu.batch import RecordBatch
+    from s3shuffle_tpu.dependency import HashPartitioner, ShuffleDependency
+    from s3shuffle_tpu.serializer import ColumnarKVSerializer
+    from s3shuffle_tpu.worker import dep_to_descriptor, write_input_object
+
+    dep = ShuffleDependency(
+        shuffle_id=shuffle_id, partitioner=HashPartitioner(2),
+        serializer=ColumnarKVSerializer(),
+    )
+    desc = dep_to_descriptor(dep)
+    server.tracker.register_shuffle(shuffle_id, dep.num_partitions)
+    tasks = []
+    for m, records in enumerate(parts):
+        path = f"{scratch}/input_{m}"
+        write_input_object(dispatcher.backend, path, RecordBatch.from_records(records))
+        tasks.append(
+            {"task_id": m, "kind": "map", "shuffle_id": shuffle_id,
+             "map_id": m, "dep": desc, "input_path": path}
+        )
+    return tasks
+
+
+def test_drain_seals_open_group_reports_members_zero_requeues(tmp_path, metrics_on):
+    """THE drain contract: a worker with an OPEN composite group (deferred
+    completion report) that is asked to drain seals the group, flushes the
+    deferred report (registration rides it), deregisters — and the stage
+    completes with ZERO task requeues and zero records lost."""
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+    from s3shuffle_tpu.worker import WorkerAgent
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/store", app_id="drain",
+        composite_commit_maps=4, composite_flush_ms=0,  # nothing seals early
+    )
+    server = MetadataServer().start()
+    agent = None
+    try:
+        agent = WorkerAgent(server.address, config=cfg, worker_id="w-drain")
+        rng = random.Random(5)
+        parts = [[(rng.randbytes(6), rng.randbytes(12)) for _ in range(50)]]
+        tasks = _stage_map_inputs(
+            server, agent.manager.dispatcher, 0, parts, f"file://{tmp_path}/stage"
+        )
+        stage = stage_id_for(0, "map")
+        server.task_queue.submit_stage(stage, tasks)
+        assert agent.run_once() == "run"
+        # the report is DEFERRED: the group (1 of 4 members) is still open
+        st = server.task_queue.stage_status(stage)
+        assert st["running"] == 1 and not st["done"]
+        assert agent._pending_composite
+        # coordinator flags the drain; the agent discovers it at its poll
+        assert server.membership.request_drain("w-drain") is True
+        assert agent.run_once() == "drain"
+        # sealed + reported + registered: zero records lost
+        st = server.task_queue.stage_status(stage)
+        assert st["done"] and not st["running"] and not st["failed"]
+        assert not agent._pending_composite
+        assert server.tracker.registered_map_ids(0)
+        assert server.membership.state_of("w-drain") == "left"
+        # zero requeues, and the drain wall was observed
+        snap = metrics_on.snapshot(compact=True)
+        assert "task_requeues_total" not in snap or _counter_total(
+            metrics_on, "task_requeues_total"
+        ) == 0
+        assert snap["worker_drain_seconds"]["series"][0]["count"] == 1
+    finally:
+        if agent is not None:
+            agent.close()
+        server.stop()
+        Dispatcher.reset()
+
+
+def test_sigterm_style_local_drain_request(tmp_path):
+    """The SIGTERM handler only sets a flag; the loop drains at the next
+    task boundary WITHOUT polling the coordinator for more work."""
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+    from s3shuffle_tpu.worker import WorkerAgent
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(root_dir=f"file://{tmp_path}/store", app_id="sig")
+    server = MetadataServer().start()
+    agent = None
+    try:
+        agent = WorkerAgent(server.address, config=cfg, worker_id="w-sig")
+        server.task_queue.submit_stage("s", [{"task_id": 0, "kind": "noop"}])
+        agent.request_drain()
+        assert agent.run_once() == "drain"
+        # the queued task was never taken — it is another worker's now
+        assert server.task_queue.stage_status("s")["pending"] == 1
+        assert server.membership.state_of("w-sig") == "left"
+    finally:
+        if agent is not None:
+            agent.close()
+        server.stop()
+        Dispatcher.reset()
+
+
+# ---------------------------------------------------------------------------
+# Zombie-attempt hardening: late commits refused, partial objects swept
+# ---------------------------------------------------------------------------
+
+
+def _reap_between_fence_and_commit(agent, server):
+    """Patch the agent so its commit fence PASSES but its lease is reaped
+    immediately after — the exact zombie window: objects get written, the
+    completion report must be refused, the sweep must run."""
+    real = agent._commit_allowed
+
+    def fence(stage_id, task):
+        ok = real(stage_id, task)
+        server.task_queue.reap_expired(stage_id, 0.0)
+        return ok
+
+    agent._commit_allowed = fence
+
+
+def test_zombie_singleton_attempt_swept_including_parity(tmp_path, metrics_on):
+    from s3shuffle_tpu.block_ids import (
+        ShuffleChecksumBlockId,
+        ShuffleDataBlockId,
+        ShuffleIndexBlockId,
+        ShuffleParityBlockId,
+    )
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+    from s3shuffle_tpu.worker import WorkerAgent
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/store", app_id="zmb",
+        parity_segments=1, parity_stripe_k=1, parity_chunk_bytes=2048,
+    )
+    server = MetadataServer().start()
+    zombie = live = None
+    try:
+        zombie = WorkerAgent(server.address, config=cfg, worker_id="zombie")
+        live = WorkerAgent(server.address, config=cfg, worker_id="live")
+        rng = random.Random(9)
+        parts = [[(rng.randbytes(6), rng.randbytes(12)) for _ in range(200)]]
+        tasks = _stage_map_inputs(
+            server, zombie.manager.dispatcher, 0, parts, f"file://{tmp_path}/stage"
+        )
+        stage = stage_id_for(0, "map")
+        server.task_queue.submit_stage(stage, tasks)
+        _reap_between_fence_and_commit(zombie, server)
+        assert zombie.run_once() == "run"
+        # late commit refused: nothing registered, nothing done, and the
+        # zombie cannot re-authorize either
+        assert server.tracker.registered_map_ids(0) == []
+        st = server.task_queue.stage_status(stage)
+        assert not st["done"] and st["pending"] == 1
+        assert server.task_queue.can_commit(stage, 0, "zombie") is False
+        # every partial object of attempt 1 (map_id = 0*1000+0) was swept —
+        # data, index, checksum AND the parity sidecar
+        d = zombie.manager.dispatcher
+        for block in (
+            ShuffleDataBlockId(0, 0),
+            ShuffleIndexBlockId(0, 0),
+            ShuffleChecksumBlockId(0, 0, algorithm=cfg.checksum_algorithm),
+            ShuffleParityBlockId(0, 0, 0),
+        ):
+            assert not d.backend.exists(d.get_path(block)), block.name
+        # the replacement attempt wins cleanly
+        assert live.run_once() == "run"
+        winners = server.tracker.registered_map_ids(0)
+        assert winners == [1]  # logical 0, attempt 2 -> 0*1000 + 1
+        assert server.task_queue.stage_status(stage)["done"]
+    finally:
+        for a in (zombie, live):
+            if a is not None:
+                a.close()
+        server.stop()
+        Dispatcher.reset()
+
+
+def test_zombie_composite_member_never_registers_shared_object_survives(tmp_path):
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+    from s3shuffle_tpu.worker import WorkerAgent
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/store", app_id="zmbc",
+        composite_commit_maps=4, composite_flush_ms=0,
+    )
+    server = MetadataServer().start()
+    zombie = live = None
+    try:
+        zombie = WorkerAgent(server.address, config=cfg, worker_id="zombie")
+        live = WorkerAgent(server.address, config=cfg, worker_id="live")
+        rng = random.Random(10)
+        parts = [[(rng.randbytes(6), rng.randbytes(12)) for _ in range(100)]]
+        tasks = _stage_map_inputs(
+            server, zombie.manager.dispatcher, 0, parts, f"file://{tmp_path}/stage"
+        )
+        stage = stage_id_for(0, "map")
+        server.task_queue.submit_stage(stage, tasks)
+        _reap_between_fence_and_commit(zombie, server)
+        assert zombie.run_once() == "run"  # deferred: group still open
+        # sealing the zombie's group (its drain path) PUTs the shared
+        # composite object, then the deferred report is refused — the
+        # shared object must NOT be deleted (it is not attempt-private)
+        zombie.drain()
+        assert server.tracker.registered_map_ids(0) == []
+        d = zombie.manager.dispatcher
+        comp = d.list_composite_groups(0)
+        assert comp, "zombie's sealed composite object should still exist"
+        st = server.task_queue.stage_status(stage)
+        assert not st["done"] and st["pending"] == 1
+        # the live worker re-runs and its attempt wins
+        assert live.run_once() == "run"
+        live.drain()
+        assert server.tracker.registered_map_ids(0) == [1]
+        assert server.task_queue.stage_status(stage)["done"]
+    finally:
+        for a in (zombie, live):
+            if a is not None:
+                a.close()
+        server.stop()
+        Dispatcher.reset()
+
+
+# ---------------------------------------------------------------------------
+# Recovery decision layer
+# ---------------------------------------------------------------------------
+
+
+def _lost(nbytes=1 << 20, m=1, group=-1, index=True, k_dummy=0):
+    from s3shuffle_tpu.recovery import LostMap
+
+    return LostMap(
+        shuffle_id=0, map_id=0, map_index=0, lost_bytes=nbytes,
+        parity_segments=m, composite_group=group, index_present=index,
+    )
+
+
+def test_planner_structural_gates(metrics_on):
+    from s3shuffle_tpu.recovery import RecoveryPlanner
+
+    p = RecoveryPlanner(stripe_k=2)
+    # parity underdetermined (m < k): recompute, regardless of evidence
+    assert p.decide(_lost(m=1)) == "recompute"
+    # uncoded: recompute
+    assert p.decide(_lost(m=0)) == "recompute"
+    # geometry died with the index: recompute
+    assert p.decide(_lost(m=2, index=False)) == "recompute"
+    # determined + no evidence: reconstruct (side-effect free default)
+    assert p.decide(_lost(m=2)) == "reconstruct"
+    assert _counter_total(metrics_on, "recovery_decisions_total", choice="recompute") == 3
+    assert _counter_total(metrics_on, "recovery_decisions_total", choice="reconstruct") == 1
+
+
+def test_planner_costed_decisions_follow_observed_evidence():
+    from s3shuffle_tpu.recovery import RecoveryPlanner
+
+    p = RecoveryPlanner(stripe_k=1)
+    mb = 1 << 20
+    # fast reads, slow map tasks: reconstruction is cheap -> reconstruct
+    fast_reads = {
+        "bytes_read": 100 * mb, "read_prefetch_seconds": 1.0,  # 100 MB/s
+        "bytes_written": 10 * mb, "write_seconds": 10.0,  # 1 MB/s writes
+        "map_tasks": 2,  # 5 s per map task
+    }
+    assert p.decide(_lost(nbytes=mb, m=1), fast_reads) == "reconstruct"
+    # reads crawl while map tasks are trivial: recompute wins
+    slow_reads = {
+        "bytes_read": 1 * mb, "read_prefetch_seconds": 60.0,
+        "bytes_written": 100 * mb, "write_seconds": 0.5,
+        "map_tasks": 100,  # 5 ms per map task
+    }
+    assert p.decide(_lost(nbytes=mb, m=1), slow_reads) == "recompute"
+
+
+def test_probe_lost_maps_singleton_and_composite(tmp_path):
+    from s3shuffle_tpu.block_ids import ShuffleDataBlockId, ShuffleIndexBlockId
+    from s3shuffle_tpu.recovery import probe_lost_maps
+    from s3shuffle_tpu.shuffle import ShuffleContext
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+    from s3shuffle_tpu.dependency import HashPartitioner, ShuffleDependency
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/probe", app_id="probe",
+        parity_segments=1, parity_stripe_k=1, parity_chunk_bytes=2048,
+    )
+    with ShuffleContext(config=cfg, num_workers=2) as ctx:
+        rng = random.Random(11)
+        records = [(rng.randbytes(6), rng.randbytes(18)) for _ in range(600)]
+        sid = next(ctx._next_shuffle_id)
+        dep = ShuffleDependency(sid, HashPartitioner(2))
+        handle = ctx.manager.register_shuffle(sid, dep)
+        for mid in range(3):
+            w = ctx.manager.get_writer(handle, mid)
+            w.write(records[mid * 200:(mid + 1) * 200])
+            w.stop(success=True)
+        d = ctx.manager.dispatcher
+        tracker = ctx.manager.tracker
+        assert probe_lost_maps(d, tracker, sid) == []
+        # lose map 1's data object (index survives -> geometry available)
+        d.backend.delete(d.get_path(ShuffleDataBlockId(sid, 1)))
+        lost = probe_lost_maps(d, tracker, sid)
+        assert [(x.map_index, x.index_present, x.parity_segments) for x in lost] == [
+            (1, True, 1)
+        ]
+        assert lost[0].lost_bytes > 0
+        # lose map 2's index too: index_present goes False
+        d.backend.delete(d.get_path(ShuffleDataBlockId(sid, 2)))
+        d.backend.delete(d.get_path(ShuffleIndexBlockId(sid, 2)))
+        lost = probe_lost_maps(d, tracker, sid)
+        assert {(x.map_index, x.index_present) for x in lost} == {
+            (1, True), (2, False)
+        }
+        # narrowing to the dead worker's maps narrows the probe
+        assert [x.map_index for x in probe_lost_maps(d, tracker, sid, [2])] == [2]
+    Dispatcher.reset()
+
+
+def test_probe_counts_only_surviving_parity(tmp_path):
+    """Data AND parity dying together (the fallback-storage / dead-disk
+    shape) must not report the COMMITTED parity count: the probe HEADs
+    each sidecar and reports what reconstruction can actually use, so the
+    planner's structural gate routes the underdetermined loss to
+    recompute instead of letting reduce tasks burn attempts on parity
+    GETs that 404."""
+    from s3shuffle_tpu.block_ids import ShuffleDataBlockId, ShuffleParityBlockId
+    from s3shuffle_tpu.recovery import RecoveryPlanner, probe_lost_maps
+    from s3shuffle_tpu.shuffle import ShuffleContext
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+    from s3shuffle_tpu.dependency import HashPartitioner, ShuffleDependency
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/parloss", app_id="parloss",
+        parity_segments=1, parity_stripe_k=1, parity_chunk_bytes=2048,
+    )
+    with ShuffleContext(config=cfg, num_workers=2) as ctx:
+        rng = random.Random(13)
+        records = [(rng.randbytes(6), rng.randbytes(18)) for _ in range(200)]
+        sid = next(ctx._next_shuffle_id)
+        dep = ShuffleDependency(sid, HashPartitioner(2))
+        handle = ctx.manager.register_shuffle(sid, dep)
+        w = ctx.manager.get_writer(handle, 0)
+        w.write(records)
+        w.stop(success=True)
+        d = ctx.manager.dispatcher
+        d.backend.delete(d.get_path(ShuffleDataBlockId(sid, 0)))
+        d.backend.delete(d.get_path(ShuffleParityBlockId(sid, 0, 0)))
+        (lost,) = probe_lost_maps(d, ctx.manager.tracker, sid)
+        assert lost.parity_segments == 0  # committed m=1, surviving m=0
+        assert lost.index_present
+        planner = RecoveryPlanner(stripe_k=1)
+        assert planner.decide(lost) == "recompute"
+    Dispatcher.reset()
+
+
+def test_probe_detects_index_only_loss_and_survives_store_errors(tmp_path):
+    """Two probe edges: (1) an index dying ALONE (data survives) is still
+    a loss — reduce scans need the offsets/geometry as much as the bytes,
+    and index_present=False routes it to recompute; (2) a transient store
+    error during the existence probe must read as 'assume present' — the
+    probe feeds destructive recovery, so a brief outage coinciding with a
+    worker death must not recompute the entire healthy shuffle."""
+    from s3shuffle_tpu.block_ids import ShuffleIndexBlockId
+    from s3shuffle_tpu.recovery import probe_lost_maps
+    from s3shuffle_tpu.shuffle import ShuffleContext
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+    from s3shuffle_tpu.dependency import HashPartitioner, ShuffleDependency
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(root_dir=f"file://{tmp_path}/idxloss", app_id="idxloss")
+    with ShuffleContext(config=cfg, num_workers=2) as ctx:
+        rng = random.Random(17)
+        records = [(rng.randbytes(6), rng.randbytes(18)) for _ in range(200)]
+        sid = next(ctx._next_shuffle_id)
+        dep = ShuffleDependency(sid, HashPartitioner(2))
+        handle = ctx.manager.register_shuffle(sid, dep)
+        w = ctx.manager.get_writer(handle, 0)
+        w.write(records)
+        w.stop(success=True)
+        d = ctx.manager.dispatcher
+        d.backend.delete(d.get_path(ShuffleIndexBlockId(sid, 0)))
+        (lost,) = probe_lost_maps(d, ctx.manager.tracker, sid)
+        assert lost.map_index == 0 and lost.index_present is False
+        # store outage: every exists() raises — probe must report NOTHING
+        orig_exists = d.backend.exists
+        d.backend.exists = lambda path: (_ for _ in ()).throw(OSError("outage"))
+        try:
+            assert probe_lost_maps(d, ctx.manager.tracker, sid) == []
+        finally:
+            d.backend.exists = orig_exists
+    Dispatcher.reset()
+
+
+def test_reduce_failure_with_no_loss_and_no_recovery_is_fatal(tmp_path):
+    """A MapOutputLost-marked reduce failure whose probe finds no loss —
+    and with no recovery round ever run — must NOT be retried: the retry
+    would re-fail identically and burn the shared attempt budget. After a
+    recovery round the same clean probe is the benign race (the task
+    failed while the recompute was landing) and DOES retry."""
+    from s3shuffle_tpu.cluster import DistributedDriver
+    from s3shuffle_tpu.recovery import MAP_OUTPUT_LOST_MARKER
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(root_dir=f"file://{tmp_path}/fatal", app_id="fatal")
+    driver = DistributedDriver(cfg)
+    try:
+        sid = 0
+        driver.server.tracker.register_shuffle(sid, 2)
+        driver._job_state[sid] = {
+            "desc": {}, "input_paths": [], "recovery_round": 0,
+            "recovery_attempts": {},
+        }
+        stage = stage_id_for(sid, "reduce")
+        q = driver.server.task_queue
+        q.submit_stage(stage, [{"task_id": 0, "kind": "reduce"}])
+        q.take_task("w0")
+        q.fail_task(stage, 0, f"{MAP_OUTPUT_LOST_MARKER}(shuffle=0): gone", "w0")
+        failed = dict(q.stage_status(stage)["failed"])
+        # round 0, nothing lost, nothing recovered -> fatal (no retry)
+        assert driver._handle_reduce_failures(sid, stage, failed) is False
+        assert q.stage_status(stage)["failed"]  # still failed
+        # after a recovery round, the same clean probe retries the task
+        driver._job_state[sid]["recovery_round"] = 1
+        assert driver._handle_reduce_failures(sid, stage, failed) is True
+        assert not q.stage_status(stage)["failed"]
+    finally:
+        driver.shutdown()
+    Dispatcher.reset()
+
+
+# ---------------------------------------------------------------------------
+# Size-aware speculation threshold (coded follow-on)
+# ---------------------------------------------------------------------------
+
+
+def _prime_fill_class(cls: str, seconds: float, n: int):
+    hist = mreg.REGISTRY.histogram(
+        "read_prefetch_fill_class_seconds", labelnames=("size_class",)
+    )
+    for _ in range(n):
+        hist.labels(size_class=cls).observe(seconds)
+
+
+def test_speculation_threshold_is_size_class_aware(metrics_on):
+    """Mixed segment sizes: many fast SMALL fills must not set the bar a
+    healthy LARGE coalesced segment is judged by — the raw fill-seconds
+    quantile armed a parity race on every large fill."""
+    from s3shuffle_tpu.coding.degraded import DegradedReader, SpeculativeFetcher
+
+    _prime_fill_class("le1m", 0.01, 20)     # small blocks: ~10 ms
+    _prime_fill_class("le64m", 0.5, 12)     # healthy large segments: ~500 ms
+    fetcher = SpeculativeFetcher(DegradedReader(None), quantile=0.9)
+    small = fetcher.threshold_s(256 * 1024)
+    large = fetcher.threshold_s(32 << 20)
+    assert small is not None and small <= 0.05
+    assert large is not None and large >= 0.4, (
+        f"large-segment threshold {large} still reflects small-fill latencies"
+    )
+    # an unseen size class has no evidence: never speculate on noise
+    assert fetcher.threshold_s(128 << 20) is None
+
+
+def test_healthy_large_fill_no_longer_races(metrics_on):
+    """Regression for the spurious race: a 0.2 s large-segment fill — slow
+    by small-block standards, normal for its size class — must complete on
+    the primary path with ZERO speculative reads."""
+    from s3shuffle_tpu.coding.degraded import DegradedReader, SpeculativeFetcher
+
+    _prime_fill_class("le1m", 0.01, 20)
+    _prime_fill_class("le64m", 0.5, 12)
+
+    class _Stream:
+        data_block = None
+        max_bytes = 32 << 20
+
+    recovery = DegradedReader(None)
+    fetcher = SpeculativeFetcher(recovery, quantile=0.9)
+
+    def primary():
+        time.sleep(0.2)
+        return b"payload"
+
+    out, won, exec_s = fetcher.prefill(_Stream(), 32 << 20, primary)
+    assert out == b"payload" and won is False and exec_s is not None
+    assert _counter_total(metrics_on, "shuffle_parity_speculative_reads_total") == 0
+
+
+def test_small_class_still_arms_races(metrics_on):
+    """The size-aware threshold must not LOSE the straggler win: a small
+    fill that blows past its own class's quantile still races."""
+    from s3shuffle_tpu.coding import degraded as dg
+
+    _prime_fill_class("le1m", 0.01, 20)
+
+    class _Block:
+        name = "shuffle_0_0.data"
+
+    class _Stream:
+        data_block = _Block()
+        max_bytes = 256 * 1024
+        start_offset = 0
+        end_offset = 8
+
+    class _Recovery:
+        def speculation_viable(self, _b):
+            return True
+
+        def reconstruct(self, _b, _s, _e, reason):
+            return b"rebuilt!"
+
+    fetcher = dg.SpeculativeFetcher(_Recovery(), quantile=0.9)
+    assert fetcher.eligible(_Stream(), 256 * 1024)
+
+    def straggling_primary():
+        time.sleep(0.6)
+        return b"late"
+
+    out, won, _ = fetcher.prefill(_Stream(), 256 * 1024, straggling_primary)
+    assert out == b"rebuilt!" and won is True
+    assert _counter_total(metrics_on, "shuffle_parity_speculative_reads_total") == 1
+    time.sleep(0.7)  # drain the abandoned primary off the shared pool
+
+
+def test_failed_job_tears_down_stages_and_recovery_state(tmp_path, monkeypatch):
+    """A job that DIES (stage failure raises out of run_sort_shuffle) must
+    still drop its stages and recovery state: the fleet-level reap
+    iterates ALL stages, so a leaked failed stage's tasks would be
+    requeued into later jobs, and leaked _job_state could spawn recovery
+    stages for a shuffle nobody waits on."""
+    from s3shuffle_tpu.batch import RecordBatch
+    from s3shuffle_tpu.cluster import DistributedDriver
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(root_dir=f"file://{tmp_path}/fail", app_id="failjob")
+    driver = DistributedDriver(cfg)
+    try:
+        # the realistic failure shape: the map-stage wait raises after the
+        # stage was submitted (task exhausted MAX_ATTEMPTS)
+        def doomed_wait(stage_id, poll=0.02, on_failed=None):
+            raise RuntimeError(f"stage {stage_id} failed: simulated")
+
+        monkeypatch.setattr(driver, "_wait_stage", doomed_wait)
+        batch = RecordBatch.from_records([(b"k1", b"v1"), (b"k2", b"v2")])
+        with pytest.raises(RuntimeError, match="simulated"):
+            driver.run_sort_shuffle([batch], num_partitions=2)
+        assert driver._job_state == {}
+        with driver.server.task_queue._lock:
+            assert driver.server.task_queue._stages == {}
+    finally:
+        driver.shutdown()
+    Dispatcher.reset()
+
+
+# ---------------------------------------------------------------------------
+# Driver-level recovery e2e: worker dies, its committed output dies with it
+# ---------------------------------------------------------------------------
+
+
+def _agent_main(coordinator, cfg_dict, worker_id, heartbeat_s=0.5):
+    from s3shuffle_tpu.config import ShuffleConfig
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+    from s3shuffle_tpu.worker import WorkerAgent
+
+    Dispatcher.reset()
+    agent = WorkerAgent(
+        tuple(coordinator), config=ShuffleConfig(**cfg_dict), worker_id=worker_id
+    )
+    agent.run_forever(poll_interval=0.01, heartbeat_s=heartbeat_s)
+
+
+def test_recompute_recovers_output_lost_with_its_worker(tmp_path, metrics_on):
+    """The decommission-without-fallback scenario: a worker is killed AFTER
+    committing a map, and its data object vanishes with it (local/fallback
+    storage). No parity ⇒ the planner must fall back to RECOMPUTE: the
+    driver re-runs the map from its staged input, the failed reduce
+    attempts retry, the job completes with full results."""
+    import dataclasses
+    import multiprocessing as mp
+
+    from s3shuffle_tpu.batch import RecordBatch
+    from s3shuffle_tpu import cluster as cluster_mod
+    from s3shuffle_tpu.block_ids import ShuffleDataBlockId
+    from s3shuffle_tpu.cluster import DistributedDriver
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/store", app_id="rec-test", codec="zlib",
+        worker_lease_s=2.0,
+    )
+    rng = random.Random(21)
+    recs = [(rng.randbytes(8), rng.randbytes(24)) for _ in range(2000)]
+    batches = [RecordBatch.from_records(recs[i::2]) for i in range(2)]
+
+    driver = DistributedDriver(cfg)
+    assert driver.task_lease_s == 2.0  # the worker_lease_s knob is live
+    ctx = mp.get_context("spawn")
+    workers = {
+        wid: ctx.Process(
+            target=_agent_main,
+            args=(list(driver.coordinator_address), dataclasses.asdict(cfg), wid),
+            daemon=True,
+        )
+        for wid in ("w0", "w1")
+    }
+    for w in workers.values():
+        w.start()
+
+    sid = driver._next_shuffle_id
+    sabotaged = {}
+    real_publish = cluster_mod.publish_snapshot
+
+    def sabotage_then_publish(tracker, config, shuffle_id):
+        # runs at the map-stage epoch barrier, exactly once: kill a worker
+        # that committed a map and delete that map's data object — the
+        # "outputs died with the worker" loss the recovery layer exists for
+        if not sabotaged:
+            committed = driver.server.task_queue.tasks_done_by("w0")
+            victim_wid = "w0" if committed else "w1"
+            committed = committed or driver.server.task_queue.tasks_done_by("w1")
+            assert committed, "no worker committed a map task"
+            logical = int(committed[0][1])
+            workers[victim_wid].kill()
+            for map_index, status in tracker.deduped_statuses(shuffle_id):
+                if map_index == logical:
+                    path = driver.dispatcher.get_path(
+                        ShuffleDataBlockId(shuffle_id, status.map_id)
+                    )
+                    driver.dispatcher.backend.delete(path)
+                    sabotaged.update(map_index=logical, worker=victim_wid)
+            assert sabotaged, "victim's committed map not found in tracker"
+        return real_publish(tracker, config, shuffle_id)
+
+    cluster_mod.publish_snapshot = sabotage_then_publish
+    try:
+        out = driver.run_sort_shuffle(batches, num_partitions=3)
+        assert sum(b.n for b in out) == 2000
+        got = [kv for b in out for kv in b.to_records()]
+        assert sorted(got) == sorted(recs)
+        assert sabotaged, "sabotage never ran"
+        # the planner chose recompute (uncoded loss is underdetermined)
+        assert _counter_total(
+            metrics_on, "recovery_decisions_total", choice="recompute"
+        ) >= 1
+        # the dead worker's membership expires at the next fleet beat once
+        # its lease runs out (the failure-driven recovery above may have
+        # healed the job before the 2 s silence lease elapsed)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            driver._reap_fleet()
+            events = [
+                e for e in driver.server.membership.snapshot()["events"]
+                if e["worker"] == sabotaged["worker"]
+            ]
+            if any(e["event"] == "expire" for e in events):
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError(f"no expire event for {sabotaged['worker']}")
+    finally:
+        cluster_mod.publish_snapshot = real_publish
+        driver.shutdown()
+        for w in workers.values():
+            w.join(timeout=10)
+            if w.is_alive():
+                w.terminate()
+
+
+def test_reconstruct_decision_leaves_parity_covered_loss_to_degraded_reads(
+    tmp_path, metrics_on
+):
+    """With parity covering full-object loss (k=1, m=1), the planner's
+    answer for the same scenario is RECONSTRUCT: no recovery stage runs,
+    and the reduce scans heal through the coded plane transparently."""
+    import dataclasses
+    import multiprocessing as mp
+
+    from s3shuffle_tpu.batch import RecordBatch
+    from s3shuffle_tpu import cluster as cluster_mod
+    from s3shuffle_tpu.block_ids import ShuffleDataBlockId
+    from s3shuffle_tpu.cluster import DistributedDriver
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/store", app_id="rcn-test", codec="zlib",
+        worker_lease_s=2.0,
+        parity_segments=1, parity_stripe_k=1, parity_chunk_bytes=4096,
+    )
+    rng = random.Random(23)
+    recs = [(rng.randbytes(8), rng.randbytes(24)) for _ in range(2000)]
+    batches = [RecordBatch.from_records(recs[i::2]) for i in range(2)]
+
+    driver = DistributedDriver(cfg)
+    ctx = mp.get_context("spawn")
+    workers = {
+        wid: ctx.Process(
+            target=_agent_main,
+            args=(list(driver.coordinator_address), dataclasses.asdict(cfg), wid),
+            daemon=True,
+        )
+        for wid in ("w0", "w1")
+    }
+    for w in workers.values():
+        w.start()
+
+    sabotaged = {}
+    real_publish = cluster_mod.publish_snapshot
+
+    def sabotage_then_publish(tracker, config, shuffle_id):
+        if not sabotaged:
+            committed = driver.server.task_queue.tasks_done_by("w0")
+            victim_wid = "w0" if committed else "w1"
+            committed = committed or driver.server.task_queue.tasks_done_by("w1")
+            assert committed
+            logical = int(committed[0][1])
+            workers[victim_wid].kill()
+            for map_index, status in tracker.deduped_statuses(shuffle_id):
+                if map_index == logical and status.composite_group < 0:
+                    driver.dispatcher.backend.delete(
+                        driver.dispatcher.get_path(
+                            ShuffleDataBlockId(shuffle_id, status.map_id)
+                        )
+                    )
+                    sabotaged.update(map_index=logical, worker=victim_wid)
+        return real_publish(tracker, config, shuffle_id)
+
+    cluster_mod.publish_snapshot = sabotage_then_publish
+    try:
+        out = driver.run_sort_shuffle(batches, num_partitions=3)
+        got = [kv for b in out for kv in b.to_records()]
+        assert sorted(got) == sorted(recs)
+        assert sabotaged, "sabotage never ran"
+        # no recompute stage ran for this shuffle: reconstruct was chosen
+        # when the death was detected, or the loss simply healed in-scan
+        recompute = _counter_total(
+            metrics_on, "recovery_decisions_total", choice="recompute"
+        )
+        assert recompute == 0, "parity-covered loss must not recompute"
+    finally:
+        cluster_mod.publish_snapshot = real_publish
+        driver.shutdown()
+        for w in workers.values():
+            w.join(timeout=10)
+            if w.is_alive():
+                w.terminate()

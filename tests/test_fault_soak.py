@@ -236,6 +236,324 @@ def test_fault_soak_object_loss_mode(tmp_path, metrics_on, k, m):
         assert LocalBackend().list_prefix(f"file://{tmp_path}/loss") == []
 
 
+# ---------------------------------------------------------------------------
+# Worker-kill soak (elastic fleet): losing workers mid-job — planned drains
+# AND SIGKILLs — must complete byte-identical with zero job failures
+# ---------------------------------------------------------------------------
+
+
+def _fleet_agent_main(coordinator, cfg_dict, worker_id):
+    """Module-level worker main (spawn-picklable) with the runtime protocol
+    witness armed: a surviving worker that exits cleanly vouches for its
+    commit protocol — any violation turns into a nonzero exit code."""
+    import os
+
+    os.environ["S3SHUFFLE_PROTOCOL_WITNESS"] = "1"
+    from s3shuffle_tpu.config import ShuffleConfig as _Cfg
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher as _Disp
+    from s3shuffle_tpu.utils import protowitness as _pw
+    from s3shuffle_tpu.worker import WorkerAgent as _Agent
+
+    _Disp.reset()
+    agent = _Agent(
+        tuple(coordinator), config=_Cfg(**cfg_dict), worker_id=worker_id
+    )
+    agent.run_forever(poll_interval=0.01, heartbeat_s=0.3)
+    for witness in _pw.drain_installed():
+        witness.assert_clean()
+
+
+def _fleet_records(n=6000, seed=52):
+    import random as _random
+
+    rng = _random.Random(seed)
+    return [(rng.randbytes(8), rng.randbytes(24)) for _ in range(n)]
+
+
+def _fleet_batches(records, n_maps):
+    from s3shuffle_tpu.batch import RecordBatch
+
+    return [RecordBatch.from_records(records[i::n_maps]) for i in range(n_maps)]
+
+
+def _spawn_fleet(driver, cfg, worker_ids):
+    import dataclasses
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    workers = {}
+    for wid in worker_ids:
+        p = ctx.Process(
+            target=_fleet_agent_main,
+            args=(list(driver.coordinator_address), dataclasses.asdict(cfg), wid),
+            daemon=True,
+        )
+        p.start()
+        workers[wid] = p
+    return workers
+
+
+def _job_output(driver, batches, num_partitions=4):
+    out = driver.run_sort_shuffle(batches, num_partitions=num_partitions)
+    return [b.to_records() for b in out]
+
+
+def _assert_zero_shuffle_residual(driver, shuffle_ids):
+    """After explicit teardown, no shuffle object survives in the store
+    (the ``_stage`` scratch prefix is the driver-owned input/output area,
+    reclaimed at shutdown)."""
+    from s3shuffle_tpu.storage.local import LocalBackend
+
+    for sid in shuffle_ids:
+        driver.server.tracker.unregister_shuffle(sid)
+        driver.dispatcher.remove_shuffle(sid)
+    root = driver.config.root_dir
+    residual = [
+        st.path
+        for st in LocalBackend().list_prefix(root)
+        if "_stage" not in st.path
+    ]
+    assert residual == [], f"residual shuffle objects: {residual}"
+
+
+def test_worker_drain_soak_zero_records_zero_requeues(tmp_path, metrics_on):
+    """Graceful drain mid-job: the drained worker seals, reports, and
+    leaves — the job completes byte-identical to the no-churn run with
+    ZERO task requeues (asserted on the new counter) and the drain wall
+    observed in ``worker_drain_seconds``."""
+    import threading
+    import time as _time
+
+    from s3shuffle_tpu.cluster import DistributedDriver
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/store", app_id="drain-soak", codec="zlib",
+        worker_lease_s=5.0, composite_commit_maps=2,
+    )
+    records = _fleet_records()
+    batches = _fleet_batches(records, n_maps=6)
+    driver = DistributedDriver(cfg)
+    workers = _spawn_fleet(driver, cfg, ["w0", "w1", "w2"])
+    drained = {}
+    try:
+        baseline = _job_output(driver, batches)
+
+        def drain_one_mid_job():
+            # drain the first worker seen to COMMIT a task of shuffle 1
+            deadline = _time.monotonic() + 30.0
+            while _time.monotonic() < deadline and not drained:
+                for wid in workers:
+                    if any(
+                        stage.startswith("shuffle1-")
+                        for stage, _t in driver.server.task_queue.tasks_done_by(wid)
+                    ):
+                        if driver.drain_workers([wid]):
+                            drained["wid"] = wid
+                        return
+                _time.sleep(0.005)
+
+        mreg.REGISTRY.reset_values()  # churn-run counters only
+        watcher = threading.Thread(target=drain_one_mid_job, daemon=True)
+        watcher.start()
+        churn = _job_output(driver, batches)
+        watcher.join(timeout=35)
+        assert drained, "no worker committed a task to drain"
+        assert churn == baseline  # byte-identical output
+        snap = metrics_on.snapshot(compact=True)
+        requeues = sum(
+            s["value"]
+            for s in snap.get("task_requeues_total", {}).get("series", [])
+        )
+        assert requeues == 0, f"graceful drain caused requeues: {requeues}"
+        assert snap["worker_drain_seconds"]["series"][0]["count"] >= 1
+        membership = driver.server.membership
+        assert membership.state_of(drained["wid"]) == "left"
+        events = [
+            e["event"]
+            for e in membership.snapshot()["events"]
+            if e["worker"] == drained["wid"]
+        ]
+        assert "drain" in events and "leave" in events
+        # the drained worker exited by itself, witness-clean
+        workers[drained["wid"]].join(timeout=10)
+        assert workers[drained["wid"]].exitcode == 0
+        _assert_zero_shuffle_residual(driver, [0, 1])
+    finally:
+        driver.shutdown()
+        for p in workers.values():
+            p.join(timeout=10)
+            if p.is_alive():
+                p.terminate()
+
+
+def test_worker_kill_fast_deterministic(tmp_path, metrics_on):
+    """Tier-1 kill mode: SIGKILL one of three workers mid-job (preferably
+    while it RUNS a task, so the lease reap demonstrably fires) — the job
+    completes byte-identical with zero failures, survivors exit
+    witness-clean, and teardown leaves zero residual objects."""
+    import threading
+    import time as _time
+
+    from s3shuffle_tpu.cluster import DistributedDriver
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/store", app_id="kill-soak", codec="zlib",
+        worker_lease_s=2.0, composite_commit_maps=2,
+    )
+    records = _fleet_records(seed=53)
+    batches = _fleet_batches(records, n_maps=6)
+    driver = DistributedDriver(cfg)
+    workers = _spawn_fleet(driver, cfg, ["w0", "w1", "w2"])
+    killed = {}
+    try:
+        baseline = _job_output(driver, batches)
+        q = driver.server.task_queue
+
+        def kill_one_mid_job():
+            # catch any worker red-handed (running a task) and SIGKILL it;
+            # a quiet fleet past the deadline gets an arbitrary kill so
+            # the soak still exercises death-during-job
+            deadline = _time.monotonic() + 20.0
+            while _time.monotonic() < deadline:
+                with q._lock:
+                    holders = {
+                        r["worker"]
+                        for stage, st in q._stages.items()
+                        if stage.startswith("shuffle1-")
+                        for r in st["running"].values()
+                    }
+                victim = next((w for w in workers if w in holders), None)
+                if victim is not None:
+                    workers[victim].kill()
+                    killed.update(wid=victim, held_task=True)
+                    return
+                _time.sleep(0.001)
+            victim = next(iter(workers))
+            workers[victim].kill()
+            killed.update(wid=victim, held_task=False)
+
+        mreg.REGISTRY.reset_values()
+        killer = threading.Thread(target=kill_one_mid_job, daemon=True)
+        killer.start()
+        churn = _job_output(driver, batches)
+        killer.join(timeout=25)
+        assert killed, "nothing was killed"
+        assert churn == baseline  # byte-identical despite the kill
+        if killed["held_task"]:
+            snap = metrics_on.snapshot(compact=True)
+            requeues = sum(
+                s["value"]
+                for s in snap.get("task_requeues_total", {}).get("series", [])
+            )
+            assert requeues >= 1, "a killed lease-holder must cause a requeue"
+        # survivors drain out witness-clean at shutdown
+        survivors = [w for w in workers if w != killed["wid"]]
+        _assert_zero_shuffle_residual(driver, [0, 1])
+        driver.shutdown()
+        for wid in survivors:
+            workers[wid].join(timeout=10)
+            assert workers[wid].exitcode == 0, (
+                f"survivor {wid} exited {workers[wid].exitcode} "
+                "(protocol witness violation?)"
+            )
+    finally:
+        driver.shutdown()
+        for p in workers.values():
+            p.join(timeout=10)
+            if p.is_alive():
+                p.terminate()
+
+
+@pytest.mark.slow
+def test_worker_churn_soak_kill_minus_n(tmp_path, metrics_on):
+    """The full kill-minus-N churn soak: random SIGKILLs AND planned drains
+    every ~1.2 s with replacement workers joining, across two back-to-back
+    shuffles — every run must stay byte-identical to the churn-free
+    baseline, with zero job failures, witness-clean surviving workers,
+    and zero residual objects."""
+    import random as _random
+    import threading
+    import time as _time
+
+    from s3shuffle_tpu.cluster import DistributedDriver
+
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/store", app_id="churn-soak", codec="zlib",
+        worker_lease_s=2.0, composite_commit_maps=2,
+    )
+    records = _fleet_records(n=120_000, seed=54)
+    batches = _fleet_batches(records, n_maps=8)
+    driver = DistributedDriver(cfg)
+    workers = _spawn_fleet(driver, cfg, [f"w{i}" for i in range(4)])
+    stop_churn = threading.Event()
+    stats = {"kills": 0, "drains": 0, "spawned": 0}
+    rng = _random.Random(99)
+
+    def churn_loop():
+        while not stop_churn.wait(0.3):
+            live = [w for w, p in workers.items() if p.is_alive()]
+            if len(live) <= 2:
+                pass  # never churn the fleet below 2 workers
+            elif rng.random() < 0.6:
+                victim = rng.choice(live)
+                workers[victim].kill()
+                stats["kills"] += 1
+            else:
+                victim = rng.choice(live)
+                if driver.drain_workers([victim]):
+                    stats["drains"] += 1
+            # keep capacity: one replacement per beat if we are short
+            live_n = sum(1 for p in workers.values() if p.is_alive())
+            if live_n < 4:
+                wid = f"r{stats['spawned']}"
+                stats["spawned"] += 1
+                workers.update(_spawn_fleet(driver, cfg, [wid]))
+
+    try:
+        baseline = _job_output(driver, batches)
+        mreg.REGISTRY.reset_values()
+        churner = threading.Thread(target=churn_loop, daemon=True)
+        churner.start()
+        # keep running the same job under sustained churn until the fleet
+        # demonstrably lost workers both ways (bounded: 10 rounds)
+        rounds = 0
+        while rounds < 10 and (
+            stats["kills"] < 2 or stats["kills"] + stats["drains"] < 3
+        ):
+            assert _job_output(driver, batches) == baseline, (
+                f"output diverged under churn (round {rounds}, {stats})"
+            )
+            rounds += 1
+        stop_churn.set()
+        churner.join(timeout=10)
+        assert stats["kills"] >= 1, f"churn never killed a worker: {stats}"
+        assert stats["kills"] + stats["drains"] >= 2, f"not enough churn: {stats}"
+        events = [e["event"] for e in driver.server.membership.snapshot()["events"]]
+        assert "join" in events
+        _assert_zero_shuffle_residual(driver, list(range(driver._next_shuffle_id)))
+        # shut the fleet down; every surviving worker must exit clean
+        # (witness-armed) — only SIGKILLed processes may die nonzero
+        driver.shutdown()
+        for wid, p in workers.items():
+            p.join(timeout=15)
+            if p.is_alive():
+                p.terminate()
+            else:
+                assert p.exitcode in (0, -9), (
+                    f"worker {wid} exited {p.exitcode}"
+                )
+    finally:
+        stop_churn.set()
+        driver.shutdown()
+        for p in workers.values():
+            p.join(timeout=10)
+            if p.is_alive():
+                p.terminate()
+
+
 def test_fault_soak_weather_is_seeded_deterministic(tmp_path):
     # Same seeds + same op sequence ⇒ same fault pattern: the soak is
     # reproducible, not a flake generator. Serial op replay (no thread

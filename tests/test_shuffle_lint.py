@@ -580,6 +580,53 @@ def test_ord01_flags_parity_put_after_fat_index_in_composite_path():
     assert fired, "ORD01 missed parity-after-fat-index on the composite path"
 
 
+def test_ord01_covers_the_drain_seal_entry_point():
+    """Elastic-fleet drain path: ``CompositeCommitAggregator.drain`` is the
+    graceful-preemption seal barrier (WorkerAgent.drain). Its expansion
+    seals groups — i.e. contains the fat-index commit point as an atomic
+    sub-commit — so ORD01 must flag any store op a future edit appends
+    AFTER the seal (e.g. a late parity flush: a crash in that window
+    leaves a committed group with fresh-but-uncovered parity). Proven by
+    mutation: append ``put_parity_objects(...)`` after the drain's
+    ``flush_all`` call and lint must fire; the file as written stays
+    clean."""
+    import ast as _ast
+
+    path = os.path.join(PKG, "write", "composite_commit.py")
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    clean = [
+        v for v in lint_source(source, path)
+        if v.rule == "ORD01" and not v.suppressed
+    ]
+    assert clean == [], "\n".join(v.format() for v in clean)
+
+    tree = _ast.parse(source)
+    drain = next(
+        (
+            node for node in _ast.walk(tree)
+            if isinstance(node, _ast.FunctionDef) and node.name == "drain"
+        ),
+        None,
+    )
+    assert drain is not None, "the aggregator lost its drain() entry point"
+    assert any(
+        "flush_all" in _calls_in(s)
+        for s in _ast.walk(drain) if isinstance(s, _ast.stmt)
+    ), "drain() no longer seals via flush_all"
+    # the mutation: a parity PUT appended after the drain's seal barrier
+    late = _ast.parse(
+        "put_parity_objects(self.dispatcher, block, geometry, payloads)"
+    ).body[0]
+    drain.body.append(late)
+    mutated = _ast.unparse(_ast.fix_missing_locations(tree))
+    fired = [
+        v for v in lint_source(mutated, path)
+        if v.rule == "ORD01" and not v.suppressed
+    ]
+    assert fired, "ORD01 missed a store op appended after the drain seal barrier"
+
+
 # ---------------------------------------------------------------------------
 # The merged tree is clean (the tier-1 gate) and the CLI contract holds
 # ---------------------------------------------------------------------------

@@ -312,6 +312,38 @@ def _coding_plane_line(snapshot: dict) -> Optional[str]:
     return "Coding plane: " + "; ".join(parts)
 
 
+def _fleet_line(snapshot: dict) -> Optional[str]:
+    """One-line elastic-fleet digest: membership churn (joins / drains /
+    leaves / expiries), task requeues by trigger, graceful-drain wall, and
+    how lost committed outputs were recovered (recompute vs reconstruct)."""
+    events = _counter_total(snapshot, "worker_membership_events_total")
+    requeues = _counter_total(snapshot, "task_requeues_total")
+    decisions = _counter_total(snapshot, "recovery_decisions_total")
+    drains = snapshot.get("worker_drain_seconds", {}).get("series", [])
+    drain_count = sum(int(s.get("count", 0)) for s in drains)
+    if events <= 0 and requeues <= 0 and decisions <= 0 and drain_count <= 0:
+        return None
+
+    def by_label(name: str, key: str) -> str:
+        rows: Dict[str, float] = {}
+        for s in snapshot.get(name, {}).get("series", []):
+            label = s.get("labels", {}).get(key, "?")
+            rows[label] = rows.get(label, 0.0) + float(s.get("value", 0))
+        return ", ".join(f"{v:g} {k}" for k, v in sorted(rows.items()))
+
+    parts = []
+    if events > 0:
+        parts.append(f"{events:g} membership events ({by_label('worker_membership_events_total', 'event')})")
+    if requeues > 0:
+        parts.append(f"{requeues:g} task requeues ({by_label('task_requeues_total', 'reason')})")
+    if drain_count > 0:
+        drain_s = sum(float(s.get("sum", 0.0)) for s in drains)
+        parts.append(f"{drain_count} graceful drains ({_fmt_seconds(drain_s)} total)")
+    if decisions > 0:
+        parts.append(f"{decisions:g} recovery decisions ({by_label('recovery_decisions_total', 'choice')})")
+    return "Fleet: " + "; ".join(parts)
+
+
 def _tuning_line(snapshot: dict) -> Optional[str]:
     """One-line autotuner digest: controller decisions by outcome, the live
     rung of every tuned knob, and the closed loop's own overhead."""
@@ -406,6 +438,7 @@ def render_metrics_snapshot(
         _coding_plane_line(snapshot),
         _codec_line(snapshot),
         _tuning_line(snapshot),
+        _fleet_line(snapshot),
         _control_plane_line(snapshot, reduce_tasks=reduce_tasks),
     ):
         if line:
@@ -525,11 +558,13 @@ def _synthetic_snapshot() -> dict:
     _SAMPLE_LABELS = {"scheme": "file", "op": "read", "direction": "up",
                       "codec": "native", "method": "register_map_outputs",
                       "shard": "0", "source": "snapshot", "reason": "orphan",
-                      "knob": "fetch_parallelism"}
+                      "knob": "fetch_parallelism", "event": "join",
+                      "choice": "reconstruct", "size_class": "le1m"}
     _ALT_LABELS = {"scheme": "s3", "op": "open", "direction": "down",
                    "codec": "zlib", "method": "get_map_sizes_by_ranges",
                    "shard": "1", "source": "rpc", "reason": "generation",
-                   "knob": "upload_queue_bytes"}
+                   "knob": "upload_queue_bytes", "event": "expire",
+                   "choice": "recompute", "size_class": "gt64m"}
     snapshot: Dict[str, dict] = {}
     for name, (kind, labelnames) in sorted(KNOWN_METRICS.items()):
         series_list = []
@@ -641,6 +676,16 @@ def _selftest() -> int:
         "controller overhead 3.08s",
     ):
         assert needle in text, f"tuning line missing {needle!r}:\n{text}"
+    # the fleet digest renders from the synthetic membership/requeue/
+    # recovery series (two 7-value series per labeled counter → 14;
+    # the drain histogram contributes 100 drains over a 3.08s sum)
+    for needle in (
+        "Fleet: 14 membership events (7 expire, 7 join)",
+        "14 task requeues (7 generation, 7 orphan)",
+        "100 graceful drains (3.08s total)",
+        "14 recovery decisions (7 recompute, 7 reconstruct)",
+    ):
+        assert needle in text, f"fleet line missing {needle!r}:\n{text}"
     # the control-plane digest: two meta_rpc_total series of 7 → 14 RPCs over
     # 4 reduce tasks; lookup sources 7 snapshot + 7 rpc → 50% hit ratio
     for needle in (
