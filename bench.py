@@ -665,11 +665,28 @@ def device_kernel_rates(timeout_s: int = 150, attempts: int = 3):
                 out = json.loads(r.stdout.strip().splitlines()[-1])
                 if "tpu_probe_error" not in out:
                     try:
+                        # per-metric merge: a probe may succeed overall while
+                        # individual metrics come back as `<name>_error`
+                        # (timing jitter, partial tunnel) — those must not
+                        # erase the cache's last GOOD number for that metric
+                        try:
+                            with open(TPU_CACHE_PATH) as f:
+                                cached = json.load(f)
+                        except (OSError, ValueError):
+                            cached = {}
+                        good = {
+                            k: v for k, v in out.items()
+                            if not k.endswith("_error")
+                        }
+                        cached = {
+                            k: v for k, v in cached.items()
+                            if k != "measured_at_utc" and not k.endswith("_error")
+                        }
                         with open(TPU_CACHE_PATH, "w") as f:
                             json.dump(
                                 {"measured_at_utc": time.strftime(
                                     "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
-                                ), **out},
+                                ), **cached, **good},
                                 f,
                             )
                     except OSError:
@@ -1875,6 +1892,307 @@ def composite_write_gain(
 
 
 # ---------------------------------------------------------------------------
+# Columnar record plane: columnar_gain probe
+# ---------------------------------------------------------------------------
+
+
+def _columnar_gain_records(n_records: int, n_maps: int):
+    """Typed aggregation workload: i64 keys (structured order-preserving
+    pack) with two int64 value columns (sum + count). Returned as plain
+    record tuples so BOTH cells feed the identical input through their
+    writers — only the serializer (and with it the whole plane) differs."""
+    import numpy as np
+
+    from s3shuffle_tpu.structured import KeyCodec, make_batch
+
+    codec = KeyCodec("i64")
+    rng = np.random.default_rng(2024)
+    per_map = n_records // n_maps
+    parts = []
+    for _m in range(n_maps):
+        keys = rng.integers(0, max(1, n_records // 8), size=per_map)
+        vals = rng.integers(0, 1000, size=per_map)
+        batch = make_batch(
+            codec, [keys], [vals, np.ones(per_map, dtype=np.int64)]
+        )
+        parts.append(batch.to_records())
+    return parts
+
+
+def columnar_gain(
+    n_records: int = 240_000,
+    n_maps: int = 4,
+    n_parts: int = 8,
+    workers: int = 4,
+    repeats: int = 4,
+    multiworker: bool = True,
+    mw_factor: int = 4,
+):
+    """Record-plane probe (ISSUE 13 acceptance): the same typed workload
+    through the fully-columnar plane (ColumnarKVSerializer column frames +
+    vectorized partition/sort/combine) vs the per-record scalar plane
+    (PickleBatchSerializer → per-record hash/route/dict-combine/sort — the
+    reference's per-record iterator shape). Byte identity is asserted in
+    EVERY cell: all outputs must agree record-for-record.
+
+    Headline ``columnar_gain`` is the single-worker
+    ``aggregate_records_per_s`` ratio on the BENCH aggregate shape — the
+    range-sort aggregate through DistributedDriver + a persistent
+    WorkerAgent process, the exact machinery behind the r05 baseline — and
+    the 4-worker cells report wall scaling (``single / (workers * multi)``)
+    plus the CPU-based superlinearity ratio against r05's 0.302. The
+    in-process aggregation cells (interleaved reps, drift-cancelling
+    best-of) record the map-side-combine ratio as ``columnar_agg_gain``;
+    with ``multiworker=False`` (the tier-1 smoke) they also stand in for
+    the headline."""
+    from s3shuffle_tpu.colagg import ColumnarAggregator
+    from s3shuffle_tpu.config import ShuffleConfig
+    from s3shuffle_tpu.dependency import BytesHashPartitioner, ShuffleDependency
+    from s3shuffle_tpu.serializer import (
+        ColumnarKVSerializer,
+        PickleBatchSerializer,
+    )
+    from s3shuffle_tpu.shuffle import ShuffleContext
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+
+    parts = _columnar_gain_records(n_records, n_maps)
+    total = sum(len(p) for p in parts)
+    # mw_factor-times the data for the agent cells: the columnar plane
+    # clears the ratio-cell size so fast the walls sit on the driver's
+    # stage-poll granularity, and the efficiency ratio would measure the
+    # control-plane floor instead of record-plane scaling
+    mw_parts = (
+        _columnar_gain_records(n_records * mw_factor, n_maps * 2)
+        if multiworker
+        else []
+    )
+
+    def serializer_for(columnar: bool):
+        return ColumnarKVSerializer() if columnar else PickleBatchSerializer()
+
+    def aggregator():
+        return ColumnarAggregator(("sum", "sum"))
+
+    def canonical(out_parts):
+        return sorted(
+            (bytes(k), bytes(v)) for p in out_parts for k, v in p
+        )
+
+    def run_ratio_cells():
+        """Best-of-N walls for the in-process single-worker cells, reps
+        INTERLEAVED columnar/scalar (the run_comparison methodology) so
+        process-wide drift — page cache, frequency scaling, a noisy
+        neighbor — cancels out of the ratio instead of penalizing
+        whichever plane happened to run in the slow window."""
+        Dispatcher.reset()
+        root = tempfile.mkdtemp(prefix="s3shuffle-bench-colgain-1w-")
+        cfg = ShuffleConfig(
+            root_dir=f"file://{root}", app_id="colgain-1w", codec="none",
+        )
+        best = {True: float("inf"), False: float("inf")}
+        outs = {}
+        try:
+            with ShuffleContext(config=cfg, num_workers=1) as ctx:
+
+                def one(columnar: bool) -> float:
+                    t0 = time.perf_counter()
+                    got = ctx.run_shuffle(
+                        parts,
+                        partitioner=BytesHashPartitioner(n_parts),
+                        aggregator=aggregator(),
+                        map_side_combine=True,
+                        serializer=serializer_for(columnar),
+                    )
+                    outs[columnar] = canonical(got)
+                    return time.perf_counter() - t0
+
+                for columnar in (True, False):  # warmup, untimed
+                    one(columnar)
+                # long best-of window: the columnar wall is short enough
+                # that host-phase drift (CPU steal, frequency) dominates a
+                # short window — more interleaved pairs let best-of catch a
+                # clean phase for BOTH planes (smoke runs keep repeats low)
+                ratio_reps = repeats if repeats <= 1 else max(repeats, 8)
+                for _r in range(ratio_reps):
+                    for columnar in (True, False):
+                        best[columnar] = min(best[columnar], one(columnar))
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+        return best[True], outs[True], best[False], outs[False]
+
+    def run_agents(columnar: bool, n_workers: int):
+        """One persistent-agent cell: DistributedDriver + WorkerAgent
+        PROCESSES (the machinery behind BENCH_r05's 0.302 baseline — agents
+        spin up once, so best-of-N walls exclude spawn cost) running the
+        range-sort aggregate over the same typed batches, with the wire
+        serializer flipping the whole record plane columnar ↔ scalar.
+        Returns (best wall, canonical output, worker CPU seconds) — the CPU
+        term via RUSAGE_CHILDREN deltas (the aggregate_multiworker
+        technique), the rig-independent superlinearity signal behind the
+        r05 baseline (wall scaling on a saturated small host measures the
+        core count, not the plane)."""
+        import dataclasses
+        import multiprocessing as mp
+        import resource
+        import threading
+
+        from s3shuffle_tpu.batch import RecordBatch
+        from s3shuffle_tpu.cluster import DistributedDriver
+
+        Dispatcher.reset()
+        root = tempfile.mkdtemp(prefix="s3shuffle-bench-colgain-mw-")
+        cfg = ShuffleConfig(
+            root_dir=f"file://{root}", app_id=f"colgain-mw-{n_workers}",
+            codec="none",
+        )
+        driver = DistributedDriver(cfg)
+        ctx = mp.get_context("spawn")
+        procs = [
+            ctx.Process(
+                target=_bench_agent_main,
+                args=(
+                    list(driver.coordinator_address),
+                    dataclasses.asdict(cfg),
+                    f"colgain-{i}",
+                ),
+                daemon=True,
+            )
+            for i in range(n_workers)
+        ]
+        batches = [RecordBatch.from_records(p) for p in mw_parts]
+        ru0 = resource.getrusage(resource.RUSAGE_CHILDREN)
+        cpu0 = ru0.ru_utime + ru0.ru_stime
+        for p in procs:
+            p.start()
+        best, out = float("inf"), None
+        # more reps than the single-worker cells: agent-cell walls sit near
+        # the task-queue poll-interval noise floor, and best-of-N is the
+        # probe's only defense (agents persist, so reps are cheap)
+        reps = max(repeats, 4)
+        try:
+            for r in range(reps + 1):  # +1 warmup (agent spin-up)
+                result: dict = {}
+
+                def attempt():
+                    try:
+                        result["out"] = driver.run_sort_shuffle(
+                            batches, num_partitions=n_parts,
+                            serializer=serializer_for(columnar),
+                        )
+                    except BaseException as e:
+                        result["err"] = e
+
+                t0 = time.perf_counter()
+                t = threading.Thread(target=attempt, daemon=True)
+                t.start()
+                t.join(timeout=300)
+                dt = time.perf_counter() - t0
+                if t.is_alive():
+                    dead = sum(0 if p.is_alive() else 1 for p in procs)
+                    raise RuntimeError(
+                        f"columnar_gain cell stalled >300s "
+                        f"({dead}/{len(procs)} agents dead)"
+                    )
+                if "err" in result:
+                    raise result["err"]
+                if r:
+                    best = min(best, dt)
+                out = sorted(
+                    (bytes(k), bytes(v))
+                    for b in result["out"]
+                    for k, v in b.iter_records()
+                )
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                p.join(timeout=10)  # reap → RUSAGE_CHILDREN sees their CPU
+            driver.shutdown()
+            shutil.rmtree(root, ignore_errors=True)
+        ru1 = resource.getrusage(resource.RUSAGE_CHILDREN)
+        return best, out, (ru1.ru_utime + ru1.ru_stime) - cpu0
+
+    try:
+        col_wall, col_out, scl_wall, scl_out = run_ratio_cells()
+        assert col_out == scl_out, "columnar output diverged from scalar"
+        assert len(col_out) > 0
+        rec = {
+            # smoke-mode stand-in; the multiworker block below replaces it
+            # with the BENCH-aggregate-shape ratio
+            "columnar_gain": round(scl_wall / col_wall, 2),
+            "columnar_agg_gain": round(scl_wall / col_wall, 2),
+            "columnar_agg_records_per_s": round(total / col_wall),
+            "scalar_agg_records_per_s": round(total / scl_wall),
+            "columnar_agg_1w_wall_s": round(col_wall, 3),
+            "scalar_agg_1w_wall_s": round(scl_wall, 3),
+            "columnar_gain_records": total,
+            "columnar_gain_partitions": n_parts,
+            "columnar_gain_baseline_r05": 0.302,
+        }
+        if multiworker:
+            # sorted-input truth for the sort-shaped multi-worker cells
+            sort_truth = sorted(
+                (bytes(k), bytes(v)) for p in mw_parts for k, v in p
+            )
+            c1, o1, c1_cpu = run_agents(True, 1)
+            cw, ow, cw_cpu = run_agents(True, workers)
+            s1, so1, s1_cpu = run_agents(False, 1)
+            sw, sow, sw_cpu = run_agents(False, workers)
+            for cell in (o1, ow, so1, sow):
+                assert cell == sort_truth, "multi-worker cell output diverged"
+            mw_total = sum(len(p) for p in mw_parts)
+            rec.update({
+                # the acceptance headline: single-worker records/s on the
+                # BENCH aggregate shape (sort aggregate through the real
+                # driver/agent machinery), columnar plane vs scalar plane
+                "columnar_gain": round(s1 / c1, 2),
+                "columnar_records_per_s": round(mw_total / c1),
+                "scalar_records_per_s": round(mw_total / s1),
+                "columnar_gain_workers": workers,
+                "columnar_scaling_efficiency": round(c1 / (workers * cw), 3),
+                "scalar_scaling_efficiency": round(s1 / (workers * sw), 3),
+                # CPU-based superlinearity (the r05 evidence was CPU: 11.9
+                # aggregate CPU-s vs 4.2 single = 0.35): single-worker CPU
+                # over N-worker aggregate CPU on the SAME data — 1.0 means
+                # distribution added zero per-record cost, independent of
+                # how many cores the rig can actually run workers on
+                "columnar_cpu_scaling_efficiency": round(
+                    c1_cpu / max(cw_cpu, 1e-9), 3
+                ),
+                "scalar_cpu_scaling_efficiency": round(
+                    s1_cpu / max(sw_cpu, 1e-9), 3
+                ),
+                "columnar_mw_wall_s": round(cw, 3),
+                "scalar_mw_wall_s": round(sw, 3),
+                "columnar_1w_agent_wall_s": round(c1, 3),
+                "scalar_1w_agent_wall_s": round(s1, 3),
+                "columnar_mw_cpu_s": round(cw_cpu, 2),
+                "scalar_mw_cpu_s": round(sw_cpu, 2),
+            })
+        return rec
+    except Exception as e:  # never fail the bench over this row
+        return {"columnar_gain_error": str(e)[:120]}
+    finally:
+        Dispatcher.reset()
+
+
+def record_plane_knobs():
+    """The record-plane knobs the headline runs used (ShuffleConfig
+    defaults) — recorded so BENCH rounds stay comparable when a default
+    moves."""
+    from s3shuffle_tpu.config import ShuffleConfig
+
+    cfg = ShuffleConfig()
+    return {
+        "record_plane": {
+            "columnar": cfg.columnar,
+            "columnar_batch_rows": cfg.columnar_batch_rows,
+            "autotune_profile_path": cfg.autotune_profile_path,
+        }
+    }
+
+
+# ---------------------------------------------------------------------------
 # Online autotuner: scenario bench matrix
 # ---------------------------------------------------------------------------
 
@@ -2391,12 +2709,14 @@ def main():
         **pipelined_commit_gain(),
         **coalesced_read_gain(),
         **composite_write_gain(),
+        **columnar_gain(),
         **coded_read_gain(),
         **device_codec_gain(),
         **autotune_gain(),
         **elasticity_gain(),
         **tracker_scaling(),
         **transfer_plane_knobs(),
+        **record_plane_knobs(),
         **scan_planner_knobs(),
         **coded_plane_knobs(),
         **elastic_fleet_knobs(),

@@ -93,6 +93,24 @@ class RecordBatch:
         return RecordBatch(_EMPTY_I32, _EMPTY_I32, _EMPTY_U8, _EMPTY_U8)
 
     @staticmethod
+    def from_fixed(
+        n: int, kw: int, vw: int, keys: np.ndarray, values: np.ndarray
+    ) -> "RecordBatch":
+        """Uniform-width batch with the width caches PRE-SEEDED — the shape
+        typed packs (structured.make_batch) and parsed column frames arrive
+        in. Seeding ``_kw``/``_vw`` up front means no downstream consumer
+        ever pays the O(n) uniformity re-scan before taking a fixed-stride
+        fast path."""
+        out = RecordBatch(
+            np.full(n, kw, dtype=np.int32),
+            np.full(n, vw, dtype=np.int32),
+            keys,
+            values,
+        )
+        out._kw, out._vw = kw, vw
+        return out
+
+    @staticmethod
     def from_records(records: Sequence[Tuple[bytes, bytes]]) -> "RecordBatch":
         n = len(records)
         if n == 0:
@@ -180,14 +198,7 @@ class RecordBatch:
                         if vw
                         else np.empty(0, dtype=np.uint8)
                     )
-                    out = RecordBatch(
-                        np.full(n, kw, dtype=np.int32),
-                        np.full(n, vw, dtype=np.int32),
-                        keys,
-                        values,
-                    )
-                    out._kw, out._vw = kw, vw
-                    return out
+                    return RecordBatch.from_fixed(n, kw, vw, keys, values)
             except Exception:  # pragma: no cover - fall back to concat path
                 logger.debug(
                     "fixed-width gather fast path failed; using concat path",

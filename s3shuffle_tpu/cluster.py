@@ -468,10 +468,14 @@ class DistributedDriver:
             for task_id in failed
         )
 
-    def run_sort_shuffle(self, input_batches, num_partitions: int):
+    def run_sort_shuffle(self, input_batches, num_partitions: int, serializer=None):
         """Distributed range-partitioned sort (the terasort shape): stages
         input to the store, runs map+reduce stages on whatever workers are
-        connected, returns the sorted output RecordBatches."""
+        connected, returns the sorted output RecordBatches. ``serializer``
+        overrides the wire serializer (default: the columnar plane) — it
+        must have a registry name (serializer.get_serializer) so workers can
+        reconstruct it from the JSON task descriptor; the record-plane bench
+        uses this to drive the scalar path through identical machinery."""
         from s3shuffle_tpu.batch import RecordBatch
         from s3shuffle_tpu.dependency import RangePartitioner, natural_key, range_bounds
         from s3shuffle_tpu.serializer import ColumnarKVSerializer
@@ -491,7 +495,7 @@ class DistributedDriver:
         dep = ShuffleDependency(
             shuffle_id=shuffle_id,
             partitioner=RangePartitioner(range_bounds(sample, num_partitions)),
-            serializer=ColumnarKVSerializer(),
+            serializer=serializer if serializer is not None else ColumnarKVSerializer(),
             key_ordering=natural_key,
         )
         desc = dep_to_descriptor(dep)

@@ -135,6 +135,20 @@ class ShuffleConfig:
     # parity reconstruction and take whichever finishes first. 0 disables
     # speculation (loss reconstruction stays active regardless).
     speculative_read_quantile: float = 0.99
+    # --- columnar record plane (TPU-first addition; the reference moves
+    # records through per-record JVM serializer streams — SURVEY.md §3.2) ---
+    # 1 = columnar serializers emit the self-describing COLUMN-FRAME wire
+    # (colframe.py: per-column dtype/width table, fixed-width columns ship no
+    # per-row lengths, one-pass zero-copy reduce-side deserialize). 0 = emit
+    # the legacy frame wire, op-for-op byte-identical to the pre-format-5
+    # data objects (the coalesce_gap_bytes=0 contract). Readers auto-detect
+    # per frame, so this only steers the write side.
+    columnar: int = 1
+    # rows per columnar chunk on the map write path (partition/route/frame
+    # granularity); joins CommitTuner's ladder when autotune is on. Inert at
+    # columnar=0: the legacy plane keeps its fixed pre-format-5 chunking so
+    # the byte-identity contract holds at ANY knob value.
+    columnar_batch_rows: int = 65536
     # in-memory budget for key-ordered reduce output before the batch sorter
     # spills sorted columnar runs (analog of Spark's ExternalSorter memory)
     sorter_spill_bytes: int = 256 * MiB
@@ -207,6 +221,12 @@ class ShuffleConfig:
     # controller cooldown: each knob moves at most once per this interval
     # (cost samples keep accumulating between moves)
     autotune_interval_s: float = 0.25
+    # persisted warm-start profile: when set (and autotune is on), tuner rung
+    # tables load from this JSON sidecar at dispatcher construction and are
+    # dumped back at manager stop, so a process restart resumes from the
+    # learned landscape instead of re-paying the exploration burn-in. ""
+    # (the default) disables persistence entirely.
+    autotune_profile_path: str = ""
     # --- caches ---
     cache_partition_lengths: bool = True
     cache_checksums: bool = True
@@ -291,6 +311,10 @@ class ShuffleConfig:
             raise ValueError("encode_inflight_batches must be >= 0")
         if self.autotune_interval_s < 0:
             raise ValueError("autotune_interval_s must be >= 0")
+        if self.columnar not in (0, 1):
+            raise ValueError("columnar must be 0 or 1")
+        if self.columnar_batch_rows < 1:
+            raise ValueError("columnar_batch_rows must be >= 1")
         if self.metadata_shards < 1 or self.metadata_batch_max < 1:
             raise ValueError("metadata_shards / metadata_batch_max must be >= 1")
         if self.worker_lease_s <= 0:

@@ -322,5 +322,9 @@ class ShuffleManager:
             remaining = list(self._registered.keys())
         for shuffle_id in remaining:
             self.unregister_shuffle(shuffle_id)
+        # persist the autotuner's learned rung tables so the next process
+        # warm-starts instead of re-paying the exploration burn-in (no-op
+        # unless autotune_profile_path is configured)
+        self.dispatcher.save_tuner_profile()
         if self.config.cleanup:
             self.dispatcher.remove_root()

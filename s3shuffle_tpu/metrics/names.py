@@ -65,6 +65,13 @@ KNOWN_METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     # --- read plane: checksum validation (read/checksum_stream.py) ---
     "read_checksum_validate_seconds": ("histogram", ()),
     "read_checksum_failures_total": ("counter", ()),
+    # --- record plane: columnar frames + vectorized partitioning
+    # (serializer.py — the writers/reader feed them through its
+    # count_*/observe_* hooks) ---
+    "record_frames_total": ("counter", ("format", "plane")),
+    "record_rows_total": ("counter", ("plane",)),
+    "record_fallback_rows_total": ("counter", ("site",)),
+    "record_partition_seconds": ("histogram", ()),
     # --- write plane: spill/commit/serialize (write/*.py) ---
     "write_spill_seconds": ("histogram", ()),
     "write_spill_bytes_total": ("counter", ()),

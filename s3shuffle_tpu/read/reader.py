@@ -254,7 +254,7 @@ class ShuffleReader:
         if self.dep.serializer.supports_batches:
             if self.dep.aggregator is None:
                 return self._read_batched()
-            if getattr(self.dep.aggregator, "supports_columnar", False):
+            if self.dep.aggregator.supports_columnar:
                 return self._read_columnar_agg()
 
         import itertools
@@ -336,18 +336,22 @@ class ShuffleReader:
         chunk N+1 after draining chunk N) — an early-stopping caller never
         over-counts; at most the final, partially-consumed chunk goes
         uncounted."""
+        from s3shuffle_tpu.serializer import count_fallback_rows
+
         pending = 0
         for prefetched in prefetcher:
             stream = self._wrapped_stream(prefetched)
             try:
                 for chunk in self.dep.serializer.new_chunk_read_stream(stream):  # type: ignore[arg-type]
                     self.metrics.records_read += pending
+                    count_fallback_rows("read", pending)
                     pending = len(chunk)
                     yield chunk
             finally:
                 stream.close()
                 prefetched.close()
         self.metrics.records_read += pending
+        count_fallback_rows("read", pending)
         self._finish_read(prefetcher)
 
     # ------------------------------------------------------------------
@@ -357,12 +361,15 @@ class ShuffleReader:
     # ------------------------------------------------------------------
     def read_batches(self):
         """Yield RecordBatches (no aggregation/ordering applied)."""
+        from s3shuffle_tpu.serializer import count_plane_rows
+
         prefetcher = self._make_prefetcher()
         for prefetched in prefetcher:
             stream = self._wrapped_stream(prefetched)
             try:
                 for batch in self.dep.serializer.new_batch_read_stream(stream):
                     self.metrics.records_read += batch.n
+                    count_plane_rows("read", batch.n)
                     yield batch
             finally:
                 stream.close()
@@ -382,12 +389,14 @@ class ShuffleReader:
             yield from self._fed_batch_sorter().sorted_records()
             return
         # custom key function: per-record external sort over batch records
+        # (batch-wise insertion: byte accounting comes from the batch's own
+        # nbytes instead of a per-record getsizeof walk)
         sorter = ExternalSorter(
             key_func=key_ordering,
             spill_bytes=self.dispatcher.config.sorter_spill_bytes,
         )
         for batch in self.read_batches():
-            sorter.insert_all(batch.iter_records())
+            sorter.insert_batch(batch)
         yield from sorter.sorted_iterator()
 
     def _reduced_batches(self):
@@ -418,7 +427,7 @@ class ShuffleReader:
             spill_bytes=self.dispatcher.config.sorter_spill_bytes,
         )
         for batch in self._reduced_batches():
-            sorter.insert_all(batch.iter_records())
+            sorter.insert_batch(batch)
         yield from sorter.sorted_iterator()
 
     def _fed_batch_sorter(self):
@@ -455,7 +464,7 @@ class ShuffleReader:
         if not self.dep.serializer.supports_batches:
             return fallback()
         if self.dep.aggregator is not None:
-            if getattr(self.dep.aggregator, "supports_columnar", False) and (
+            if self.dep.aggregator.supports_columnar and (
                 self.dep.key_ordering is None or self.dep.key_ordering is natural_key
             ):
                 return list(self._reduced_batches())

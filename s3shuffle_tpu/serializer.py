@@ -13,11 +13,80 @@ from __future__ import annotations
 
 import pickle
 import struct
-from typing import Any, BinaryIO, Iterable, Iterator, Tuple
+import time
+from typing import Any, BinaryIO, Iterable, Iterator, Optional, Tuple
 
+from s3shuffle_tpu.metrics import registry as _metrics
 from s3shuffle_tpu.utils.io import read_fully as _read_fully
 
 _U32 = struct.Struct("<I")
+
+# Record-plane instruments (trace_report's "Record plane" digest): frames
+# moved by wire format and side, rows through the batch plane, and rows that
+# fell back to a per-record scalar route (untyped payloads, non-batch
+# serializers). Frame-granular — never touched per record.
+_C_FRAMES = _metrics.REGISTRY.counter(
+    "record_frames_total",
+    "Columnar record frames moved, by wire format and plane side",
+    labelnames=("format", "plane"),
+)
+_C_ROWS = _metrics.REGISTRY.counter(
+    "record_rows_total",
+    "Records moved through the VECTORIZED columnar routes, by plane side "
+    "(counted at the route, not the frame — rows a scalar route pushes "
+    "through columnar frames land only in record_fallback_rows_total)",
+    labelnames=("plane",),
+)
+_C_FALLBACK = _metrics.REGISTRY.counter(
+    "record_fallback_rows_total",
+    "Records that took a per-record scalar route instead of the vectorized "
+    "columnar plane",
+    labelnames=("site",),
+)
+_H_PARTITION = _metrics.REGISTRY.histogram(
+    "record_partition_seconds",
+    "Vectorized partition-assignment + stable-group pass latency per "
+    "columnar chunk (map side)",
+)
+
+
+def _count_frame(column: bool, plane: str) -> None:
+    """One frame's worth of wire-format accounting (no-op when metrics are
+    disabled). Frames only — rows are counted once, at the ROUTE that moved
+    them (:func:`count_plane_rows` / :func:`count_fallback_rows`), so a
+    scalar route emitting columnar frames never double-counts."""
+    if _metrics.enabled():
+        _C_FRAMES.labels(
+            format="column" if column else "legacy", plane=plane
+        ).inc()
+
+
+def count_plane_rows(plane: str, rows: int) -> None:
+    """Vectorized-route accounting hook (batch granularity): the map
+    writers' partition/route pass and the reader's batch consumers."""
+    if rows and _metrics.enabled():
+        _C_ROWS.labels(plane=plane).inc(rows)
+
+
+def observe_partition_pass(t0_ns: int, rows: int) -> None:
+    """Map-writer hook: one vectorized partition/group pass finished.
+    ``t0_ns`` is the writer's ``perf_counter_ns`` taken iff metrics were
+    enabled (0 skips); ``rows`` feeds the write-plane row counter (pass 0
+    for passes whose rows were already counted, e.g. spill-time re-grouping
+    of buffered batches)."""
+    if t0_ns:
+        _H_PARTITION.observe((time.perf_counter_ns() - t0_ns) / 1e9)
+        count_plane_rows("write", rows)
+
+
+def count_fallback_rows(site: str, rows: int) -> None:
+    """Per-record-route accounting hook for the write/read planes (chunk
+    granularity — callers never invoke this per record). Disjoint from
+    ``record_rows_total`` by construction — every row lands in exactly one
+    of the two — so the digest's vectorized share is
+    ``rows / (rows + fallback)`` exactly."""
+    if rows and _metrics.enabled():
+        _C_FALLBACK.labels(site=site).inc(rows)
 
 
 class Serializer:
@@ -37,6 +106,14 @@ class Serializer:
     def new_batch_read_stream(self, source: BinaryIO):
         """Yield RecordBatches (only when ``supports_batches``)."""
         raise NotImplementedError(f"{self.name} does not support batch reads")
+
+    def resolve_for_write(self, cfg) -> "Serializer":
+        """The map-writer seam: return the serializer to WRITE with under
+        ``cfg`` (the reader auto-detects, so only the write side consults
+        config). Base: the serializer itself. ColumnarKVSerializer resolves
+        its frame format from ``cfg.columnar`` here when the caller left it
+        unpinned."""
+        return self
 
     def new_chunk_read_stream(self, source: BinaryIO) -> Iterator[list]:
         """Yield LISTS of (key, value) records. The read plane consumes this
@@ -211,28 +288,49 @@ class BytesKVSerializer(Serializer):
 # ----------------------------------------------------------------------------
 
 
+#: default rows buffered per frame by the columnar writer's per-record path
+#: (shared with the task-descriptor round-trip, which only ships
+#: non-default values)
+DEFAULT_BATCH_RECORDS = 8192
+
+
 class _ColumnarKVWriter(RecordWriter):
-    def __init__(self, sink: BinaryIO, batch_records: int):
+    def __init__(self, sink: BinaryIO, batch_records: int, column_frames: bool):
         self._sink = sink
         self._pending: list = []
         self._batch_records = batch_records
+        self._column_frames = column_frames
 
     def write(self, key: Any, value: Any) -> None:
         self._pending.append((bytes(key), bytes(value)))
         if len(self._pending) >= self._batch_records:
             self.flush()
 
-    def write_batch(self, batch) -> None:
-        from s3shuffle_tpu.batch import write_frame
+    def _emit(self, batch) -> None:
+        if batch.n == 0:
+            return
+        if self._column_frames:
+            from s3shuffle_tpu.colframe import write_column_frame
 
+            # report what actually landed on the wire — the writer falls
+            # back to legacy framing for degenerate shapes
+            wrote_column = write_column_frame(self._sink, batch)
+        else:
+            from s3shuffle_tpu.batch import write_frame
+
+            write_frame(self._sink, batch)
+            wrote_column = False
+        _count_frame(wrote_column, "write")
+
+    def write_batch(self, batch) -> None:
         self.flush()
-        write_frame(self._sink, batch)
+        self._emit(batch)
 
     def flush(self) -> None:
         if self._pending:
-            from s3shuffle_tpu.batch import RecordBatch, write_frame
+            from s3shuffle_tpu.batch import RecordBatch
 
-            write_frame(self._sink, RecordBatch.from_records(self._pending))
+            self._emit(RecordBatch.from_records(self._pending))
             self._pending = []
 
     def close(self) -> None:
@@ -240,31 +338,66 @@ class _ColumnarKVWriter(RecordWriter):
 
 
 class ColumnarKVSerializer(Serializer):
-    """Byte-KV records in columnar frames
-    (``[u32 len][u32 n][klens][vlens][keys][values]`` —
-    :mod:`s3shuffle_tpu.batch`). Self-delimiting ⇒ relocatable; columnar ⇒ the
-    whole write/read/partition/sort path is vectorized numpy instead of
-    per-record Python (the reference's per-record JVM iterators would be the
-    wrong design here — SURVEY.md §3.2/3.3 hot loops)."""
+    """Byte-KV records in columnar frames. Self-delimiting ⇒ relocatable;
+    columnar ⇒ the whole write/read/partition/sort path is vectorized numpy
+    instead of per-record Python (the reference's per-record JVM iterators
+    would be the wrong design here — SURVEY.md §3.2/3.3 hot loops).
+
+    Two wire framings (read side auto-detects per frame):
+
+    - **column frames** (:mod:`s3shuffle_tpu.colframe`): self-describing
+      per-column dtype/width table; fixed-width columns ship no per-row
+      lengths and deserialize into columns in one zero-copy pass;
+    - **legacy frames** (:mod:`s3shuffle_tpu.batch`,
+      ``[u32 len][u32 n][klens][vlens][keys][values]``) — the pre-format-5
+      wire.
+
+    ``column_frames=None`` (the default) defers the choice to the managed
+    write seam, which resolves it from ``ShuffleConfig.columnar``
+    (:meth:`resolve_for_write`); unmanaged direct use stays on the legacy
+    wire, byte-stable. ``columnar=0`` is therefore op-for-op byte-identical
+    to the pre-column-frame wire everywhere."""
 
     name = "bytes-kv-columnar"
     relocatable = True
     supports_batches = True
 
-    def __init__(self, batch_records: int = 8192):
+    def __init__(
+        self,
+        batch_records: int = DEFAULT_BATCH_RECORDS,
+        column_frames: Optional[bool] = None,
+    ):
         self.batch_records = batch_records
+        self.column_frames = column_frames
+
+    def resolve_for_write(self, cfg) -> "ColumnarKVSerializer":
+        if self.column_frames is not None:
+            return self
+        return ColumnarKVSerializer(
+            self.batch_records, bool(getattr(cfg, "columnar", 0))
+        )
 
     def new_write_stream(self, sink: BinaryIO) -> RecordWriter:
-        return _ColumnarKVWriter(sink, self.batch_records)
+        return _ColumnarKVWriter(sink, self.batch_records, bool(self.column_frames))
 
     def new_read_stream(self, source: BinaryIO) -> Iterator[Tuple[bytes, bytes]]:
         for batch in self.new_batch_read_stream(source):
             yield from batch.iter_records()
 
     def new_batch_read_stream(self, source: BinaryIO):
-        from s3shuffle_tpu.batch import read_frames
+        from s3shuffle_tpu.colframe import read_frames_auto
 
-        return read_frames(source)
+        return read_frames_auto(
+            source,
+            on_frame=lambda column, _b: _count_frame(column, "read"),
+        )
+
+    def new_chunk_read_stream(self, source: BinaryIO) -> Iterator[list]:
+        """One frame = one chunk: the whole frame decodes column-at-a-time
+        and expands to records once, instead of the base class re-chunking a
+        per-record generator."""
+        for batch in self.new_batch_read_stream(source):
+            yield batch.to_records()
 
 
 def get_serializer(name: str) -> Serializer:

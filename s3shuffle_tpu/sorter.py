@@ -85,6 +85,29 @@ class ExternalSorter:
                 ):
                     self._spill()
 
+    def insert_batch(self, batch) -> None:
+        """Insert a columnar RecordBatch's records in one pass: the byte
+        estimate comes from the batch's own ``nbytes`` (plus a flat per-tuple
+        object overhead) instead of the per-record ``getsizeof`` sampling
+        walk — on batch-fed sorts (read/reader.py fallback ordering paths)
+        the estimation walk was pure overhead on data whose size is already
+        known exactly."""
+        from s3shuffle_tpu.utils import gc_paused
+
+        n = batch.n
+        if n == 0:
+            return
+        with gc_paused:  # bulk acyclic build — cf. insert_all
+            self._records.extend(batch.iter_records())
+        # ~3 PyObject headers + tuple slots per record beyond the raw bytes
+        self._bytes += batch.nbytes + 120 * n
+        self._tick += n
+        if (
+            self._bytes >= self._spill_bytes
+            or len(self._records) >= self._spill_threshold
+        ):
+            self._spill()
+
     @property
     def memory_bytes(self) -> int:
         """Estimated bytes currently held in memory (pre-spill)."""

@@ -87,6 +87,16 @@ class Dispatcher:
 
             self.scan_tuner = ScanTuner(config)
             self.commit_tuner = CommitTuner(config)
+            if config.autotune_profile_path:
+                # warm start: adopt a prior process's learned rung tables so
+                # this one skips the exploration burn-in (tuning/profile.py;
+                # best-effort — a missing/torn profile is a cold start)
+                from s3shuffle_tpu.tuning import profile as _tune_profile
+
+                _tune_profile.load_into(
+                    config.autotune_profile_path,
+                    self.scan_tuner, self.commit_tuner,
+                )
         config.log_values()
         logger.info(
             "dispatcher: scheme=%s app_id=%s rename=%s",
@@ -140,6 +150,21 @@ class Dispatcher:
 
     def on_reinitialize(self, callback: Callable[[], None]) -> None:
         self._reinit_callbacks.append(callback)
+
+    def save_tuner_profile(self) -> None:
+        """Dump the live tuner rung tables to ``autotune_profile_path`` (the
+        warm-start sidecar). No-op unless autotune AND a path are configured;
+        called by ``ShuffleManager.stop()`` — best-effort, never raises."""
+        if (
+            not self.config.autotune_profile_path
+            or (self.scan_tuner is None and self.commit_tuner is None)
+        ):
+            return
+        from s3shuffle_tpu.tuning import profile as _tune_profile
+
+        _tune_profile.save_profile(
+            self.config.autotune_profile_path, self.scan_tuner, self.commit_tuner
+        )
 
     # ------------------------------------------------------------------
     # Path layout

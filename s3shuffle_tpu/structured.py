@@ -312,12 +312,10 @@ def make_batch(
     else:
         values = np.empty(0, dtype=np.uint8)
         vw = 0
-    return RecordBatch(
-        np.full(n, codec.width, dtype=np.int32),
-        np.full(n, vw, dtype=np.int32),
-        keys,
-        values,
-    )
+    # from_fixed seeds the width caches, so the typed batch takes every
+    # fixed-stride fast path (and ships lens-free column frames on the wire)
+    # without any downstream uniformity scan
+    return RecordBatch.from_fixed(n, codec.width, vw, keys, values)
 
 
 def split_batch(batch: RecordBatch, n_parts: int) -> List[RecordBatch]:

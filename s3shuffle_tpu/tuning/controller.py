@@ -137,6 +137,37 @@ class Controller:
         _C_DECISIONS.labels(knob=self.knob, direction=direction).inc()
         _G_KNOB.labels(knob=self.knob).set(self.current)
 
+    # ------------------------------------------------------------------
+    def export_state(self) -> Dict:
+        """JSON-serializable warm-start state: the current rung plus every
+        measured rung total (the landscape evidence a fresh process would
+        otherwise re-pay the exploration burn-in to learn)."""
+        return {
+            "value": self.current,
+            "totals": {str(v): t for v, t in self._totals.items()},
+        }
+
+    def restore_state(self, state: Dict) -> None:
+        """Adopt a previously exported state. Rungs that no longer exist on
+        this controller's ladder (clamps moved, static value changed) are
+        dropped silently — a stale profile can only be less informed, never
+        out-of-range. The in-flight ring and cooldown clock are NOT restored:
+        they are process-local by definition."""
+        for key, total in dict(state.get("totals", {})).items():
+            try:
+                rung, cost = int(key), float(total)
+            except (TypeError, ValueError):
+                continue
+            if rung in self.ladder:
+                self._totals[rung] = cost
+        value = state.get("value")
+        if isinstance(value, (int, float)) and int(value) in self.ladder:
+            self._i = self.ladder.index(int(value))
+        if self.knob and _metrics.enabled():
+            # surface the restored rung immediately — the gauge would
+            # otherwise be stale/absent until the first full decision ring
+            _G_KNOB.labels(knob=self.knob).set(self.current)
+
     def add_measurement_and_predict(self, cost: float) -> int:
         """Feed one cost sample (lower is better); returns the rung to use."""
         self._ring.append(cost)

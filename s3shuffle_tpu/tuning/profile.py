@@ -1,0 +1,92 @@
+"""Persisted autotuner warm-start profiles.
+
+The closed-loop controllers (PR 9) learn a cost landscape per process — and
+forget it at exit, so every restart re-pays the exploration burn-in (each
+knob probes both neighbors before it can hold a rung). With
+``autotune_profile_path`` set, the dispatcher loads the rung tables from a
+small JSON sidecar at construction and the manager dumps them back at stop:
+a restarted process STARTS at the learned rungs with the measured neighbor
+totals already in place, so its first decisions are evidence-driven instead
+of exploratory.
+
+The profile is advisory state, never a correctness surface: a missing,
+torn, or stale file degrades to the cold-start behavior (logged at WARNING,
+never raised), and rungs that no longer exist on the current ladder (clamps
+or static values changed between runs) are dropped on restore. Writes are
+atomic (tmp + rename) so a crash mid-dump can't tear the previous profile.
+Off by default (``autotune_profile_path=""``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+from typing import Dict, Optional
+
+logger = logging.getLogger("s3shuffle_tpu.tuning")
+
+PROFILE_VERSION = 1
+
+
+def save_profile(path: str, scan_tuner=None, commit_tuner=None) -> bool:
+    """Dump both tuners' rung tables to ``path`` (atomic). Returns False —
+    with a WARNING — on any I/O failure; the live tuners are unaffected."""
+    doc: Dict = {"version": PROFILE_VERSION, "tuners": {}}
+    if scan_tuner is not None:
+        doc["tuners"]["scan"] = scan_tuner.export_profile()
+    if commit_tuner is not None:
+        doc["tuners"]["commit"] = commit_tuner.export_profile()
+    try:
+        parent = os.path.dirname(os.path.abspath(path))
+        fd, tmp = tempfile.mkstemp(prefix=".autotune-profile-", dir=parent)
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(doc, f, sort_keys=True)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+    except OSError as e:
+        logger.warning("autotune profile dump to %s failed: %s", path, e)
+        return False
+    return True
+
+
+def load_profile(path: str) -> Optional[Dict]:
+    """Read a profile document, or None (with a WARNING for anything other
+    than the file simply not existing yet — first run is not an error)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError) as e:
+        logger.warning("autotune profile at %s unreadable: %s", path, e)
+        return None
+    if not isinstance(doc, dict) or doc.get("version") != PROFILE_VERSION:
+        logger.warning(
+            "autotune profile at %s has unsupported shape/version %r",
+            path, doc.get("version") if isinstance(doc, dict) else type(doc),
+        )
+        return None
+    return doc
+
+
+def load_into(path: str, scan_tuner=None, commit_tuner=None) -> bool:
+    """Load ``path`` and restore it into the given tuners. Returns True when
+    a profile was found and applied."""
+    doc = load_profile(path)
+    if doc is None:
+        return False
+    tuners = doc.get("tuners", {})
+    if scan_tuner is not None and isinstance(tuners.get("scan"), dict):
+        scan_tuner.restore_profile(tuners["scan"])
+    if commit_tuner is not None and isinstance(tuners.get("commit"), dict):
+        commit_tuner.restore_profile(tuners["commit"])
+    logger.info("autotune warm-start profile loaded from %s", path)
+    return True

@@ -159,6 +159,31 @@ class _BaseTuner:
         with self._lock:
             return {k.field: k.controller.current for k in self._knobs}
 
+    # ------------------------------------------------------------------
+    def export_profile(self) -> Dict[str, Dict]:
+        """Warm-start snapshot: per-knob controller state (see
+        :meth:`Controller.export_state`)."""
+        with self._lock:
+            return {k.field: k.controller.export_state() for k in self._knobs}
+
+    def restore_profile(self, profile: Dict[str, Dict]) -> None:
+        """Adopt a saved profile; knobs absent from it (or no longer tuned
+        under the current config) are untouched. Apply hooks run for knobs
+        whose rung moved, so bound side effects (codec windows) see the
+        restored value."""
+        applies = []
+        with self._lock:
+            for knob in self._knobs:
+                state = profile.get(knob.field)
+                if not isinstance(state, dict):
+                    continue
+                before = knob.controller.current
+                knob.controller.restore_state(state)
+                if knob.controller.current != before and knob.apply is not None:
+                    applies.append((knob.apply, knob.controller.current))
+        for apply, value in applies:
+            apply(value)
+
     def _observe_cost(self, cost: float) -> None:
         """Feed one cost sample to the ACTIVE knob's controller; rotate the
         active knob whenever its controller completes a decision."""
@@ -283,6 +308,7 @@ class CommitTuner(_BaseTuner):
         "composite_commit_maps": (2, 128),
         "composite_flush_bytes": (4 * MiB, 256 * MiB),
         "encode_inflight_batches": (1, 8),
+        "columnar_batch_rows": (8192, 1 << 18),
     }
 
     def __init__(self, cfg):
@@ -309,6 +335,8 @@ class CommitTuner(_BaseTuner):
                 "encode_inflight_batches", cfg.encode_inflight_batches,
                 dense_head=True, apply=self._apply_encode_window,
             )
+        if cfg.columnar and cfg.columnar_batch_rows > 1:  # 0 = legacy plane
+            add("columnar_batch_rows", cfg.columnar_batch_rows)
         super().__init__(cfg, knobs)
         self._signals = _SignalDelta(
             histograms=("write_upload_queue_wait_seconds",),
@@ -345,6 +373,12 @@ class CommitTuner(_BaseTuner):
         if static <= 0:  # plane disabled by the operator: never re-enable
             return static
         return self.value("upload_queue_bytes", static)
+
+    def columnar_batch_rows(self, static: int) -> int:
+        """Write-path chunk-rows consult (map writers' ``_chunk_rows``)."""
+        if static <= 1:  # degenerate static: never overrule
+            return static
+        return self.value("columnar_batch_rows", static)
 
     def seal_thresholds(self, static_members: int, static_bytes: int) -> Tuple[int, int]:
         """Composite seal-point consult: (member-count cap, byte cap)."""

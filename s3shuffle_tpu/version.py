@@ -14,10 +14,13 @@ __version__ = "0.1.0"
 #: _stable_key_hash fast-path rewrite → 2; r7's composite commit layout —
 #: fat indexes, snapshot wire v2, registration composite coordinates → 3;
 #: r10's coded shuffle plane — parity sidecars, index geometry trailer,
-#: fat-index v2 header, snapshot wire v3, registration parity field → 4).
+#: fat-index v2 header, snapshot wire v3, registration parity field → 4;
+#: r13's columnar record plane — the column-frame data wire is the default
+#: framing of columnar serializers (columnar=0 restores the format-4
+#: frames byte-identically) → 5).
 #: Driver and all workers of one job must run the same value; re-reading
 #: kept shuffle data (cleanup=False) across versions is unsupported.
-SHUFFLE_FORMAT_VERSION = 4
+SHUFFLE_FORMAT_VERSION = 5
 
 BUILD_INFO = {
     "name": "s3shuffle_tpu",

@@ -187,6 +187,41 @@ WIRE_STRUCTS = {
             "parity payload bytes (not int64-aligned)",
         ],
     },
+    "column_frame": {
+        "title": "Columnar record frame (`S3COLFRM`)",
+        "kind": "data-object framing",
+        "module": "s3shuffle_tpu/colframe.py",
+        "constants": {
+            "COLFRAME_MAGIC": 0x5333434F4C46524D,
+            "_WIRE_VERSION": 1,
+            "HEADER_WORDS": 5,
+            "COLUMN_WORDS": 3,
+        },
+        "read_versions": [1],
+        "current_version": 1,
+        "since_format": 5,
+        "current_format": 5,
+        "doc": "Self-describing typed framing of columnar record batches "
+               "inside shuffle data objects (written when `columnar=1`, the "
+               "default). The per-column dtype/width/byte-count table lets "
+               "the reduce side deserialize a whole frame into columns in "
+               "one zero-copy pass; fixed-width columns ship no per-row "
+               "lengths. Readers auto-detect per frame (magic in the first "
+               "payload word), so legacy frames interleave freely; "
+               "`columnar=0` emits only the legacy framing, byte-identical "
+               "to format-4 data objects.",
+        "layout": [
+            "outer envelope: `[u32le payload_len]` (self-delimiting -> "
+            "concatenatable/relocatable, same as legacy frames)",
+            "header (5 words): magic `S3COLFRM`, wire version, schema word "
+            "(app tag; 0 = untyped bytes-KV), n rows, n columns (2: keys, "
+            "values)",
+            "per column (3 words): dtype (1 = fixed-width, 2 = varlen), "
+            "fixed row width (0 when varlen), column payload bytes",
+            "column payloads back-to-back: fixed -> `n*width` raw bytes; "
+            "varlen -> `n` i32-LE row lengths then the concatenated bytes",
+        ],
+    },
     "rpc_register": {
         "title": "Registration RPC payloads",
         "kind": "rpc (length-prefixed JSON)",

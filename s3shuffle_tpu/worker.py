@@ -39,8 +39,6 @@ import numpy as np
 
 from s3shuffle_tpu.metadata.map_output import STORE_LOCATION
 from s3shuffle_tpu.metadata.service import RemoteMapOutputTracker
-from s3shuffle_tpu.serializer import ColumnarKVSerializer
-
 logger = logging.getLogger("s3shuffle_tpu.worker")
 
 
@@ -60,11 +58,25 @@ def dep_to_descriptor(dep: ShuffleDependency) -> dict:
         part = {"kind": "hash", "num_partitions": p.num_partitions}
     else:
         raise ValueError(f"partitioner {type(p).__name__} has no JSON descriptor")
-    return {
+    from s3shuffle_tpu.serializer import DEFAULT_BATCH_RECORDS, ColumnarKVSerializer
+
+    desc = {
         "partitioner": part,
         "sort": dep.key_ordering is not None,
-        "serializer": "columnar",
+        # serializer by registry name (serializer.get_serializer); historical
+        # descriptors carried the literal "columnar", which the registry
+        # still resolves
+        "serializer": dep.serializer.name,
     }
+    if isinstance(dep.serializer, ColumnarKVSerializer):
+        # constructor state must survive the descriptor round-trip: a driver
+        # that PINNED the frame wire (column_frames is not None) must not
+        # have workers silently re-resolve it from their own config
+        if dep.serializer.column_frames is not None:
+            desc["serializer_column_frames"] = bool(dep.serializer.column_frames)
+        if dep.serializer.batch_records != DEFAULT_BATCH_RECORDS:
+            desc["serializer_batch_records"] = int(dep.serializer.batch_records)
+    return desc
 
 
 def dep_from_descriptor(shuffle_id: int, desc: dict) -> ShuffleDependency:
@@ -76,10 +88,18 @@ def dep_from_descriptor(shuffle_id: int, desc: dict) -> ShuffleDependency:
         partitioner = HashPartitioner(int(part_desc["num_partitions"]))
     else:
         raise ValueError(f"unknown partitioner kind {part_desc['kind']!r}")
+    from s3shuffle_tpu.serializer import ColumnarKVSerializer, get_serializer
+
+    serializer = get_serializer(desc.get("serializer", "columnar"))
+    if isinstance(serializer, ColumnarKVSerializer):
+        if "serializer_column_frames" in desc:
+            serializer.column_frames = bool(desc["serializer_column_frames"])
+        if "serializer_batch_records" in desc:
+            serializer.batch_records = int(desc["serializer_batch_records"])
     return ShuffleDependency(
         shuffle_id=shuffle_id,
         partitioner=partitioner,
-        serializer=ColumnarKVSerializer(),
+        serializer=serializer,
         key_ordering=natural_key if desc.get("sort") else None,
     )
 

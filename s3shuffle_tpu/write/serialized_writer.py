@@ -58,12 +58,17 @@ class SerializedSortMapWriter(MapWriterBase):
     # ------------------------------------------------------------------
     def write(self, records: Iterable[Tuple]) -> None:
         from s3shuffle_tpu.batch import iter_record_batches
+        from s3shuffle_tpu.serializer import observe_partition_pass
 
         partitioner = self.dep.partitioner
-        for batch in iter_record_batches(records):
+        for batch in iter_record_batches(
+            records, chunk_records=self._chunk_rows()
+        ):
             if batch.n == 0:
                 continue
+            t0 = time.perf_counter_ns() if _metrics.enabled() else 0
             pids = partitioner.partition_batch(batch)
+            observe_partition_pass(t0, batch.n)
             self._batches.append(batch)
             self._pids.append(np.asarray(pids))
             self._buffered += batch.nbytes + pids.nbytes
@@ -76,6 +81,7 @@ class SerializedSortMapWriter(MapWriterBase):
         """One argsort over everything buffered → (grouped batch, partition
         bounds). Clears the buffer."""
         from s3shuffle_tpu.batch import RecordBatch, split_by_partition
+        from s3shuffle_tpu.serializer import observe_partition_pass
 
         big = RecordBatch.concat(self._batches)
         pids = (
@@ -84,7 +90,12 @@ class SerializedSortMapWriter(MapWriterBase):
         self._batches = []
         self._pids = []
         self._buffered = 0
-        return split_by_partition(big, pids, self.dep.num_partitions)
+        t0 = time.perf_counter_ns() if _metrics.enabled() else 0
+        out = split_by_partition(big, pids, self.dep.num_partitions)
+        # rows=0: these rows were already counted at their write() pass —
+        # this is the spill/commit-time re-grouping of the same buffer
+        observe_partition_pass(t0, 0)
+        return out
 
     def _emit_partition(self, sink, rows) -> None:
         """Serialize one partition's rows through serializer→codec into
@@ -99,7 +110,7 @@ class SerializedSortMapWriter(MapWriterBase):
         else:
             codec_stream = None
             target = sink
-        w = self.dep.serializer.new_write_stream(target)
+        w = self.serializer.new_write_stream(target)
         w.write_batch(rows)
         w.close()
         if codec_stream is not None:

@@ -164,6 +164,10 @@ class MapWriterBase:
         self.codec = codec
         self.on_commit = on_commit
         cfg = output_writer.dispatcher.config
+        # The record-plane write seam: a columnar serializer left unpinned
+        # resolves its frame wire (column vs legacy) from cfg.columnar HERE —
+        # the read side auto-detects, so only writers consult config.
+        self.serializer = self.dep.serializer.resolve_for_write(cfg)
         self.spill_memory_budget = spill_memory_budget or cfg.max_buffer_size_task
         self._spill_file: Optional[str] = None
         self._spill_fd = None
@@ -257,6 +261,24 @@ class MapWriterBase:
             _H_SPILL.observe((time.perf_counter_ns() - start_ns) / 1e9)
             _C_SPILL_BYTES.inc(nbytes)
 
+    def _chunk_rows(self) -> int:
+        """Rows per columnar chunk on the write path (``columnar_batch_rows``
+        — partition/route/frame granularity), consulted through the write
+        tuner when autotune is on; the static config value otherwise.
+        ``columnar=0`` pins the pre-format-5 chunking unconditionally — the
+        knob must not be able to move legacy frame boundaries, or the
+        byte-identity contract would only hold at the default value."""
+        from s3shuffle_tpu.batch import DEFAULT_CHUNK_RECORDS
+
+        cfg = self.output_writer.dispatcher.config
+        if not cfg.columnar:
+            return DEFAULT_CHUNK_RECORDS
+        static = cfg.columnar_batch_rows
+        tuner = getattr(self.output_writer.dispatcher, "commit_tuner", None)
+        if tuner is None:
+            return static
+        return tuner.columnar_batch_rows(static)
+
     def _cleanup_spill(self) -> None:
         if self._spill_fd is not None:
             self._spill_fd.close()
@@ -287,7 +309,7 @@ class ShuffleMapWriter(MapWriterBase):
         fused = self._fused_checksum_factory()
         self._pipelines = [
             _PartitionPipeline(
-                self.dep.serializer, self.codec,
+                self.serializer, self.codec,
                 fused() if fused is not None else None,
             )
             for _ in range(self.dep.num_partitions)
@@ -300,11 +322,11 @@ class ShuffleMapWriter(MapWriterBase):
         from s3shuffle_tpu.batch import RecordBatch
 
         dep = self.dep
-        if dep.serializer.supports_batches:
+        if self.serializer.supports_batches:
             if not dep.map_side_combine:
                 self._write_batched(records)
                 return
-            if getattr(dep.aggregator, "supports_columnar", False):
+            if dep.aggregator is not None and dep.aggregator.supports_columnar:
                 # Vectorized map-side combine: the whole map task's input —
                 # across every write() call (production workers write one
                 # batch per call) — flows through one bounded-memory
@@ -319,7 +341,8 @@ class ShuffleMapWriter(MapWriterBase):
                     )
                 # _records_written counts at the commit drain (post-combine
                 # rows, matching the per-record combine route's semantics)
-                for chunk in iter_record_batches(records):
+                rows = self._chunk_rows()
+                for chunk in iter_record_batches(records, chunk_records=rows):
                     self._combine_reducer.add(chunk)
                 return
         if isinstance(records, RecordBatch):
@@ -356,6 +379,10 @@ class ShuffleMapWriter(MapWriterBase):
                 for k, v in chunk:
                     pipelines[partitioner(k)].record_writer.write(k, v)
             n += len(chunk)
+            if _metrics.enabled():
+                from s3shuffle_tpu.serializer import count_fallback_rows
+
+                count_fallback_rows("write", len(chunk))
             # amortize the O(num_partitions) budget scan across write()
             # calls: incremental callers writing tiny batches must not pay
             # a full-pipeline scan per call
@@ -372,17 +399,22 @@ class ShuffleMapWriter(MapWriterBase):
         per (chunk × partition) through each pipeline."""
         from s3shuffle_tpu.batch import iter_record_batches
 
-        self._write_batches(iter_record_batches(records))
+        self._write_batches(
+            iter_record_batches(records, chunk_records=self._chunk_rows())
+        )
 
     def _write_batches(self, batches) -> None:
         from s3shuffle_tpu.batch import split_by_partition
+        from s3shuffle_tpu.serializer import observe_partition_pass
 
         dep = self.dep
         for batch in batches:
             if batch.n == 0:
                 continue
+            t0 = time.perf_counter_ns() if _metrics.enabled() else 0
             pids = dep.partitioner.partition_batch(batch)
             grouped, bounds = split_by_partition(batch, pids, dep.num_partitions)
+            observe_partition_pass(t0, batch.n)
             for pid in range(dep.num_partitions):
                 lo, hi = int(bounds[pid]), int(bounds[pid + 1])
                 if hi > lo:

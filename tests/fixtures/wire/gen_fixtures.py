@@ -117,6 +117,38 @@ def checksum_v1() -> bytes:
     return be([101, 102, 103, 104])
 
 
+def colframe_fixed_v1() -> bytes:
+    # one column frame of 3 fixed-width records (4-byte keys, 2-byte
+    # values) — envelope + header + column table + raw column payloads
+    import io
+
+    from s3shuffle_tpu.batch import RecordBatch
+    from s3shuffle_tpu.colframe import write_column_frame
+
+    batch = RecordBatch.from_fixed(
+        3, 4, 2,
+        np.frombuffer(b"AAAABBBBCCCC", dtype=np.uint8),
+        np.frombuffer(b"aabbcc", dtype=np.uint8),
+    )
+    buf = io.BytesIO()
+    write_column_frame(buf, batch)
+    return buf.getvalue()
+
+
+def colframe_varlen_v1() -> bytes:
+    # ragged keys AND values — both columns take the varlen encoding
+    # (i32-LE lengths then concatenated bytes)
+    import io
+
+    from s3shuffle_tpu.batch import RecordBatch
+    from s3shuffle_tpu.colframe import write_column_frame
+
+    batch = RecordBatch.from_records([(b"k", b"vv"), (b"key2", b""), (b"k3", b"v3v3")])
+    buf = io.BytesIO()
+    write_column_frame(buf, batch)
+    return buf.getvalue()
+
+
 def parity_header_v1() -> bytes:
     from s3shuffle_tpu.block_ids import ShuffleDataBlockId
     from s3shuffle_tpu.coding.parity import ParityGeometry, parity_header
@@ -137,6 +169,8 @@ BLOBS = {
     "index_geom_v4.bin": index_geom_v4,
     "checksum_v1.bin": checksum_v1,
     "parity_header_v1.bin": parity_header_v1,
+    "colframe_fixed_v1.bin": colframe_fixed_v1,
+    "colframe_varlen_v1.bin": colframe_varlen_v1,
 }
 
 

@@ -1,0 +1,526 @@
+"""Columnar record plane: column-frame wire, vectorized partition flow,
+``columnar=0`` regression gates, and the autotuner warm-start profile.
+
+The plane's contract (the ``gap=0``/``parity=0`` pattern): ``columnar=0``
+reproduces the pre-format-5 wire op-for-op AND byte-for-byte — the column
+frame only changes how bytes inside data objects are framed, never which
+store ops run. ``columnar=1`` (the default) must agree with it on the
+record level for every shape: fixed/ragged keys and values, empty
+partitions, single-record tails, any batch size or partition count.
+"""
+
+import io
+import json
+import os
+import random
+
+import numpy as np
+import pytest
+
+from conftest import RecordingBackend
+
+from s3shuffle_tpu import colframe
+from s3shuffle_tpu.batch import RecordBatch, write_frame
+from s3shuffle_tpu.config import ShuffleConfig
+from s3shuffle_tpu.batch import split_by_partition
+from s3shuffle_tpu.dependency import BytesHashPartitioner, ShuffleDependency
+from s3shuffle_tpu.manager import ShuffleManager
+from s3shuffle_tpu.metrics import registry as mreg
+from s3shuffle_tpu.serializer import ColumnarKVSerializer, get_serializer
+from s3shuffle_tpu.shuffle import ShuffleContext
+from s3shuffle_tpu.storage.dispatcher import Dispatcher
+from s3shuffle_tpu.storage.local import LocalBackend
+
+
+@pytest.fixture()
+def metrics_on():
+    mreg.REGISTRY.reset_values()
+    mreg.enable()
+    yield mreg.REGISTRY
+    mreg.disable()
+    mreg.REGISTRY.reset_values()
+
+
+# ---------------------------------------------------------------------------
+# Wire-level properties
+# ---------------------------------------------------------------------------
+
+
+def _random_batch(rng, n, kw, vw):
+    """kw/vw: fixed width, or None for ragged lengths (0..12)."""
+    records = []
+    for _ in range(n):
+        klen = kw if kw is not None else rng.randrange(0, 13)
+        vlen = vw if vw is not None else rng.randrange(0, 13)
+        records.append((rng.randbytes(klen), rng.randbytes(vlen)))
+    return RecordBatch.from_records(records)
+
+
+@pytest.mark.parametrize("kw,vw", [(8, 8), (10, 90), (4, 0), (0, 3), (None, None), (8, None), (None, 8)])
+@pytest.mark.parametrize("n", [1, 7, 4096])
+def test_column_frame_roundtrip_property(kw, vw, n):
+    rng = random.Random(hash((kw, vw, n)) & 0xFFFF)
+    batch = _random_batch(rng, n, kw, vw)
+    buf = io.BytesIO()
+    colframe.write_column_frame(buf, batch)
+    buf.seek(0)
+    out = list(colframe.read_frames_auto(buf))
+    assert len(out) == 1
+    got = out[0]
+    assert got.n == batch.n
+    assert got.to_records() == batch.to_records()
+    # fixed-width columns must come back with the width caches pre-seeded
+    # (empty keys/values are uniform width 0 too)
+    if kw is not None:
+        assert got._kw == kw
+    if vw is not None:
+        assert got._vw == vw
+
+
+def test_column_and_legacy_frames_interleave_and_concatenate():
+    rng = random.Random(11)
+    a = _random_batch(rng, 100, 8, 8)
+    b = _random_batch(rng, 50, None, None)
+    buf = io.BytesIO()
+    colframe.write_column_frame(buf, a)
+    write_frame(buf, b)
+    colframe.write_column_frame(buf, b)
+    # relocatability: concatenation of two streams parses as their records'
+    # concatenation
+    double = buf.getvalue() * 2
+    out = list(colframe.read_frames_auto(io.BytesIO(double)))
+    want = (a.to_records() + b.to_records() + b.to_records()) * 2
+    assert [r for x in out for r in x.to_records()] == want
+
+
+def test_empty_batch_emits_nothing():
+    buf = io.BytesIO()
+    colframe.write_column_frame(buf, RecordBatch.empty())
+    assert buf.getvalue() == b""
+
+
+def test_degenerate_empty_row_batches_round_trip_via_legacy_fallback():
+    """A batch of all-empty keys AND values beyond EMPTY_ROW_CAP has no
+    payload byte to bound its row count, so the writer must route it through
+    the legacy framing — the plane never writes a frame its own reader
+    refuses."""
+    n = colframe.EMPTY_ROW_CAP + 1
+    batch = RecordBatch.from_fixed(
+        n, 0, 0, np.empty(0, dtype=np.uint8), np.empty(0, dtype=np.uint8)
+    )
+    buf = io.BytesIO()
+    colframe.write_column_frame(buf, batch)
+    data = buf.getvalue()
+    assert not colframe.is_column_frame_payload(data[4:])  # legacy framing
+    buf.seek(0)
+    out = list(colframe.read_frames_auto(buf))
+    assert sum(b.n for b in out) == n
+    # under the cap the column framing is used and parses back
+    small = RecordBatch.from_fixed(
+        5, 0, 0, np.empty(0, dtype=np.uint8), np.empty(0, dtype=np.uint8)
+    )
+    buf2 = io.BytesIO()
+    colframe.write_column_frame(buf2, small)
+    assert colframe.is_column_frame_payload(buf2.getvalue()[4:])
+    assert next(colframe.read_frames_auto(io.BytesIO(buf2.getvalue()))).n == 5
+
+
+def test_dep_descriptor_round_trips_pinned_serializer_state():
+    """A driver-pinned frame wire (column_frames) and batch size must
+    survive the JSON task descriptor to the workers — silent re-resolution
+    from worker config would flip the wire the driver asked for."""
+    from s3shuffle_tpu.dependency import HashPartitioner
+    from s3shuffle_tpu.worker import dep_from_descriptor, dep_to_descriptor
+
+    for pinned, rows in ((False, 4096), (True, 8192), (None, 8192)):
+        dep = ShuffleDependency(
+            shuffle_id=7,
+            partitioner=HashPartitioner(4),
+            serializer=ColumnarKVSerializer(
+                batch_records=rows, column_frames=pinned
+            ),
+        )
+        back = dep_from_descriptor(7, dep_to_descriptor(dep)).serializer
+        assert back.column_frames == pinned
+        assert back.batch_records == rows
+    # non-columnar serializers round-trip by name alone
+    dep = ShuffleDependency(
+        shuffle_id=7, partitioner=HashPartitioner(4),
+        serializer=get_serializer("pickle"),
+    )
+    assert dep_from_descriptor(7, dep_to_descriptor(dep)).serializer.name == "pickle"
+
+
+def test_serializer_modes_and_auto_detect():
+    records = [(b"key%d" % i, b"v" * (i % 5)) for i in range(100)]
+    column = ColumnarKVSerializer(column_frames=True)
+    legacy = ColumnarKVSerializer(column_frames=False)
+    unpinned = ColumnarKVSerializer()
+    col_bytes, leg_bytes = column.dumps(records), legacy.dumps(records)
+    assert col_bytes != leg_bytes
+    # unmanaged (unpinned) writes stay on the legacy wire, byte-stable
+    assert unpinned.dumps(records) == leg_bytes
+    # EVERY mode's reader decodes EITHER wire (per-frame auto-detect)
+    for reader in (column, legacy, unpinned):
+        for data in (col_bytes, leg_bytes, col_bytes + leg_bytes):
+            got = list(reader.loads(data))
+            want = records * (2 if data == col_bytes + leg_bytes else 1)
+            assert got == want
+    # resolve_for_write honors cfg.columnar; pinned serializers are immune
+    assert unpinned.resolve_for_write(ShuffleConfig(columnar=1)).column_frames is True
+    assert unpinned.resolve_for_write(ShuffleConfig(columnar=0)).column_frames is False
+    assert legacy.resolve_for_write(ShuffleConfig(columnar=1)) is legacy
+    # name registry
+    assert get_serializer("columnar").supports_batches
+
+
+def test_chunk_read_stream_is_frame_granular():
+    s = ColumnarKVSerializer(column_frames=True, batch_records=8)
+    records = [(b"%04d" % i, b"x") for i in range(20)]
+    chunks = list(s.new_chunk_read_stream(io.BytesIO(s.dumps(records))))
+    assert [len(c) for c in chunks] == [8, 8, 4]
+    assert [r for c in chunks for r in c] == records
+
+
+# ---------------------------------------------------------------------------
+# Seeded end-to-end property: map → shuffle → reduce, columnar vs scalar
+# ---------------------------------------------------------------------------
+
+_SHAPES = [
+    # (key width | None=ragged, value width | None=ragged)
+    (8, 8),
+    (10, 90),
+    (None, None),
+    (8, None),
+    (4, 0),
+]
+
+
+def _run_ctx_shuffle(tmp_path, tag, columnar, parts, n_parts, serializer):
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/{tag}", app_id=tag, codec="none",
+        columnar=columnar,
+    )
+    with ShuffleContext(config=cfg, num_workers=2) as ctx:
+        out = ctx.run_shuffle(
+            parts,
+            partitioner=BytesHashPartitioner(n_parts),
+            serializer=serializer,
+        )
+    return [sorted(p) for p in out]
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_shuffle_property_columnar_vs_scalar(tmp_path, seed):
+    """Record-multiset equality per OUTPUT PARTITION across the full matrix:
+    column-frame wire vs legacy wire vs the per-record bytes-kv serializer,
+    over fixed/ragged shapes × batch sizes (incl. empty partitions and
+    single-record tails) × partition counts."""
+    rng = random.Random(seed)
+    kw, vw = _SHAPES[seed % len(_SHAPES)]
+    n_parts = rng.choice([1, 3, 8])
+    sizes = rng.choice([[0, 1, 257], [5, 0, 0, 4096 + 1], [64, 64]])
+    parts = [
+        _random_batch(rng, n, kw, vw).to_records() for n in sizes
+    ]
+    columnar = _run_ctx_shuffle(
+        tmp_path, f"c{seed}", 1, parts, n_parts, ColumnarKVSerializer()
+    )
+    legacy = _run_ctx_shuffle(
+        tmp_path, f"l{seed}", 0, parts, n_parts, ColumnarKVSerializer()
+    )
+    scalar = _run_ctx_shuffle(
+        tmp_path, f"s{seed}", 1, parts, n_parts, get_serializer("bytes-kv")
+    )
+    assert columnar == legacy == scalar
+    assert sum(len(p) for p in columnar) == sum(sizes)
+
+
+def test_typed_agg_shuffle_columnar_matches_scalar(tmp_path):
+    """structured typed packs (i64 keys, narrow value dtypes) through the
+    aggregating path: the fully-columnar plane and the per-record fallback
+    (pickle serializer → dict combine) must agree bit-for-bit."""
+    from s3shuffle_tpu.colagg import ColumnarAggregator
+    from s3shuffle_tpu.serializer import PickleBatchSerializer
+    from s3shuffle_tpu.structured import KeyCodec, make_batch, values_matrix
+
+    codec = KeyCodec("i64")
+    rng = random.Random(5)
+    keys = [rng.randrange(-50, 50) for _ in range(4000)]
+    vals = [rng.randrange(0, 100) for _ in range(4000)]
+    batch = make_batch(codec, [np.array(keys)], [np.array(vals), np.ones(4000, dtype=np.int64)], val_dtypes=("i4", "i2"))
+    assert batch._kw == 8  # typed packs pre-seed the width caches
+
+    def run(tag, serializer, inputs):
+        Dispatcher.reset()
+        cfg = ShuffleConfig(root_dir=f"file://{tmp_path}/{tag}", app_id=tag, codec="none")
+        with ShuffleContext(config=cfg, num_workers=2) as ctx:
+            out = ctx.run_shuffle(
+                inputs,
+                partitioner=BytesHashPartitioner(4),
+                aggregator=ColumnarAggregator(("sum", "sum"), val_dtypes=("i4", "i2")),
+                map_side_combine=True,
+                serializer=serializer,
+            )
+        return sorted(kv for p in out for kv in p)
+
+    col = run("col", ColumnarKVSerializer(), [batch])
+    scl = run("scl", PickleBatchSerializer(), [batch.to_records()])
+    assert col == scl
+    # decode and sanity-check one aggregate against the plain-python truth
+    truth = {}
+    for k, v in zip(keys, vals):
+        s, c = truth.get(k, (0, 0))
+        truth[k] = (s + v, c + 1)
+    got = {}
+    for kb, vb in col:
+        (k,) = codec.unpack(np.frombuffer(kb, dtype=np.uint8), 1)
+        row = np.frombuffer(vb, dtype="<i8")
+        got[int(k[0])] = (int(row[0]), int(row[1]))
+    assert got == truth
+
+
+# ---------------------------------------------------------------------------
+# columnar=0 regression gate on the shared RecordingBackend
+# ---------------------------------------------------------------------------
+
+
+def _manager_roundtrip(tmp_path, tag, columnar, parts_records, n_parts, **extra):
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/{tag}", app_id=tag, codec="none",
+        columnar=columnar, cleanup=False, **extra,
+    )
+    d = Dispatcher(cfg)
+    rec = RecordingBackend(LocalBackend())
+    d.backend = rec
+    manager = ShuffleManager(dispatcher=d)
+    dep = ShuffleDependency(
+        shuffle_id=0,
+        partitioner=BytesHashPartitioner(n_parts),
+        serializer=ColumnarKVSerializer(),
+    )
+    handle = manager.register_shuffle(0, dep)
+    for map_id, records in enumerate(parts_records):
+        w = manager.get_writer(handle, map_id)
+        w.write(RecordBatch.from_records(records))
+        w.stop(success=True)
+    out = []
+    for pid in range(n_parts):
+        out.append(sorted(manager.get_reader(handle, pid, pid + 1).read()))
+    ops = [(op, p.rsplit("/", 1)[-1]) for op, p in rec.ops]
+    return out, ops, d
+
+
+def test_columnar_zero_is_op_for_op_and_byte_identical(tmp_path):
+    """``columnar=0`` issues the exact op multiset of ``columnar=1`` (the
+    plane adds ZERO store ops either way) and its data/index blobs are
+    byte-equal to the pre-column-frame wire, reconstructed here frame by
+    frame from the public legacy writer."""
+    from s3shuffle_tpu.block_ids import ShuffleDataBlockId, ShuffleIndexBlockId
+
+    rng = random.Random(17)
+    n_parts = 3
+    parts_records = [
+        [(rng.randbytes(8), rng.randbytes(24)) for _ in range(500)],
+        [(rng.randbytes(8), rng.randbytes(24)) for _ in range(257)],
+    ]
+    out0, ops0, d0 = _manager_roundtrip(tmp_path, "off", 0, parts_records, n_parts)
+    out1, ops1, d1 = _manager_roundtrip(tmp_path, "on", 1, parts_records, n_parts)
+    assert out0 == out1  # record-identical output
+    assert sorted(ops0) == sorted(ops1)  # zero new store ops
+
+    # columnar_batch_rows must be INERT at columnar=0 (the legacy plane
+    # keeps its fixed pre-format-5 chunking at ANY knob value): a tiny
+    # chunk setting must reproduce the same legacy blobs byte-for-byte
+    from s3shuffle_tpu.block_ids import ShuffleDataBlockId as _DataId
+
+    _outk, _opsk, dk = _manager_roundtrip(
+        tmp_path, "offknob", 0, parts_records, n_parts, columnar_batch_rows=100
+    )
+    for map_id in range(len(parts_records)):
+        assert dk.backend.read_all(dk.get_path(_DataId(0, map_id))) == \
+            d0.backend.read_all(d0.get_path(_DataId(0, map_id)))
+
+    # pre-PR wire reconstruction: one legacy frame per (chunk × partition),
+    # partitions concatenated in id order — byte-equal to the columnar=0 blob
+    for map_id, records in enumerate(parts_records):
+        batch = RecordBatch.from_records(records)
+        pids = BytesHashPartitioner(n_parts).partition_batch(batch)
+        grouped, bounds = split_by_partition(batch, pids, n_parts)
+        expected = io.BytesIO()
+        lengths = []
+        for pid in range(n_parts):
+            start = expected.tell()
+            sl = grouped.slice_rows(int(bounds[pid]), int(bounds[pid + 1]))
+            if sl.n:
+                write_frame(expected, sl)
+            lengths.append(expected.tell() - start)
+        blob = d0.backend.read_all(d0.get_path(ShuffleDataBlockId(0, map_id)))
+        assert blob == expected.getvalue()
+        index = d0.backend.read_all(d0.get_path(ShuffleIndexBlockId(0, map_id)))
+        want_index = np.ascontiguousarray(
+            np.cumsum([0] + lengths), dtype=">i8"
+        ).tobytes()
+        assert index == want_index
+        # and columnar=1 wrote COLUMN frames into the same object name
+        blob1 = d1.backend.read_all(d1.get_path(ShuffleDataBlockId(0, map_id)))
+        assert blob1 != blob
+        assert colframe.is_column_frame_payload(blob1[4:])
+
+
+def test_record_plane_metrics_and_digest(tmp_path, metrics_on):
+    """The new record_* families light up on a columnar shuffle, the scalar
+    path feeds the fallback counter, and trace_report renders the Record
+    plane digest from a live snapshot."""
+    from s3shuffle_tpu.serializer import PickleBatchSerializer
+    from tools.trace_report import _record_plane_line
+
+    rng = random.Random(3)
+    parts = [[(rng.randbytes(8), rng.randbytes(8)) for _ in range(200)]]
+    _run_ctx_shuffle(tmp_path, "m1", 1, parts, 2, ColumnarKVSerializer())
+    _run_ctx_shuffle(tmp_path, "m2", 1, parts, 2, PickleBatchSerializer())
+    snap = metrics_on.snapshot(compact=True)
+
+    def total(name, **labels):
+        return sum(
+            s.get("value", 0)
+            for s in snap.get(name, {}).get("series", [])
+            if all(s.get("labels", {}).get(k) == v for k, v in labels.items())
+        )
+
+    assert total("record_rows_total", plane="write") == 200
+    assert total("record_rows_total", plane="read") == 200
+    assert total("record_frames_total", format="column") >= 2
+    assert total("record_frames_total", format="legacy") == 0
+    # the pickle run is pure fallback on both sides
+    assert total("record_fallback_rows_total", site="write") == 200
+    assert total("record_fallback_rows_total", site="read") == 200
+    part = snap.get("record_partition_seconds", {}).get("series", [])
+    assert sum(s.get("count", 0) for s in part) >= 1
+    line = _record_plane_line(snap)
+    assert line is not None and line.startswith("Record plane:")
+    assert "fallback" in line and "% column" in line
+
+
+# ---------------------------------------------------------------------------
+# columnar_batch_rows: tuner ladder + write-path consult
+# ---------------------------------------------------------------------------
+
+
+def test_commit_tuner_owns_columnar_batch_rows():
+    from s3shuffle_tpu.tuning import CommitTuner
+
+    on = CommitTuner(ShuffleConfig(autotune=True))
+    assert on.columnar_batch_rows(65536) == 65536  # starts at the static rung
+    assert "columnar_batch_rows" in on.overrides()
+    # plane off → the knob is not tuned and the static value passes through
+    off = CommitTuner(ShuffleConfig(autotune=True, columnar=0))
+    assert "columnar_batch_rows" not in off.overrides()
+    assert off.columnar_batch_rows(65536) == 65536
+    # moves stay within the clamps across a convergence run
+    lo, hi = CommitTuner.CLAMPS["columnar_batch_rows"]
+    rng = random.Random(9)
+    for _ in range(300):
+        on._observe_cost(rng.random())
+    assert lo <= on.columnar_batch_rows(65536) <= hi
+
+
+def test_writer_consults_tuned_chunk_rows(tmp_path):
+    """The map writer's chunk size follows the tuner's live rung."""
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/t", app_id="t", autotune=True,
+        columnar_batch_rows=16384,
+    )
+    d = Dispatcher(cfg)
+    manager = ShuffleManager(dispatcher=d)
+    dep = ShuffleDependency(
+        shuffle_id=0, partitioner=BytesHashPartitioner(2),
+        serializer=ColumnarKVSerializer(),
+    )
+    handle = manager.register_shuffle(0, dep)
+    w = manager.get_writer(handle, 0)
+    assert w._chunk_rows() == 16384
+    # pin the tuner's rung and observe the consult move with it
+    knob = next(
+        k for k in d.commit_tuner._knobs if k.field == "columnar_batch_rows"
+    )
+    knob.controller._i = knob.controller.ladder.index(32768)
+    assert w._chunk_rows() == 32768
+    manager.stop()
+
+
+# ---------------------------------------------------------------------------
+# Autotuner warm-start profile
+# ---------------------------------------------------------------------------
+
+
+def test_profile_round_trip_unit(tmp_path):
+    from s3shuffle_tpu.tuning import CommitTuner, ScanTuner
+    from s3shuffle_tpu.tuning import profile as prof
+
+    cfg = ShuffleConfig(autotune=True)
+    scan, commit = ScanTuner(cfg), CommitTuner(cfg)
+    for i in range(25):
+        scan.observe_scan(0.05 + (i % 4) * 0.01, 1 << 20)
+        commit.observe_commit(0.02 + (i % 3) * 0.01, 1 << 20)
+    path = str(tmp_path / "profile.json")
+    assert prof.save_profile(path, scan, commit)
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["version"] == 1 and set(doc["tuners"]) == {"scan", "commit"}
+
+    scan2, commit2 = ScanTuner(cfg), CommitTuner(cfg)
+    assert prof.load_into(path, scan2, commit2)
+    assert scan2.export_profile() == scan.export_profile()
+    assert commit2.export_profile() == commit.export_profile()
+    assert scan2.overrides() == scan.overrides()
+
+    # stale rungs (clamps/static moved between runs) are dropped, not adopted
+    narrow = ScanTuner(ShuffleConfig(autotune=True, fetch_parallelism=0))
+    prof.load_into(path, narrow, None)  # must not raise
+    assert "fetch_parallelism" not in narrow.overrides()
+
+    # torn/garbage files degrade to a cold start
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert prof.load_profile(str(bad)) is None
+    assert prof.load_profile(str(tmp_path / "missing.json")) is None
+
+
+def test_profile_dispatcher_and_manager_wiring(tmp_path):
+    """manager.stop() dumps the sidecar; a fresh dispatcher with the same
+    path warm-starts its tuners from it. Off (no path) writes nothing."""
+    from s3shuffle_tpu.tuning import profile as prof
+
+    path = str(tmp_path / "warm.json")
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/a", app_id="a", autotune=True,
+        autotune_profile_path=path,
+    )
+    d = Dispatcher(cfg)
+    for i in range(25):
+        d.scan_tuner.observe_scan(0.05 + (i % 4) * 0.01, 1 << 20)
+    learned = d.scan_tuner.export_profile()
+    ShuffleManager(dispatcher=d).stop()
+    assert os.path.exists(path)
+
+    Dispatcher.reset()
+    cfg2 = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/b", app_id="b", autotune=True,
+        autotune_profile_path=path,
+    )
+    d2 = Dispatcher(cfg2)
+    assert d2.scan_tuner.export_profile() == learned
+
+    # path unset (the default): no sidecar appears anywhere
+    Dispatcher.reset()
+    cfg3 = ShuffleConfig(root_dir=f"file://{tmp_path}/c", app_id="c", autotune=True)
+    d3 = Dispatcher(cfg3)
+    ShuffleManager(dispatcher=d3).stop()
+    assert list(tmp_path.glob("*.json")) == [tmp_path / "warm.json"]
+    Dispatcher.reset()
+    assert prof.load_profile(path) is not None

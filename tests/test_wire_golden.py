@@ -137,6 +137,75 @@ def test_parity_header_truncated_raises():
 
 
 # ---------------------------------------------------------------------------
+# Column frames (format 5): fixed + varlen blobs decode forever, and the
+# current writer reproduces them byte-for-byte
+# ---------------------------------------------------------------------------
+
+
+def test_colframe_fixed_golden_decodes():
+    import struct
+
+    from s3shuffle_tpu.colframe import (
+        COLFRAME_MAGIC,
+        DTYPE_FIXED,
+        is_column_frame_payload,
+        parse_column_frame,
+    )
+
+    data = blob("colframe_fixed_v1.bin")
+    (payload_len,) = struct.unpack_from("<I", data, 0)
+    payload = data[4 : 4 + payload_len]
+    assert len(payload) == payload_len
+    assert is_column_frame_payload(payload)
+    head = words_of(payload[:40])
+    assert (int(head[0]), int(head[1]), int(head[2])) == (COLFRAME_MAGIC, 1, 0)
+    frame = parse_column_frame(payload)
+    assert frame.columns == ((DTYPE_FIXED, 4, 12), (DTYPE_FIXED, 2, 6))
+    b = frame.batch
+    assert (b.n, b._kw, b._vw) == (3, 4, 2)  # width caches pre-seeded
+    assert b.to_records() == [(b"AAAA", b"aa"), (b"BBBB", b"bb"), (b"CCCC", b"cc")]
+
+
+def test_colframe_varlen_golden_decodes():
+    from s3shuffle_tpu.colframe import DTYPE_VARLEN, parse_column_frame
+
+    data = blob("colframe_varlen_v1.bin")
+    frame = parse_column_frame(data[4:])
+    assert all(c[0] == DTYPE_VARLEN for c in frame.columns)
+    assert frame.batch.to_records() == [
+        (b"k", b"vv"), (b"key2", b""), (b"k3", b"v3v3")
+    ]
+
+
+@pytest.mark.parametrize("name", ["colframe_fixed_v1", "colframe_varlen_v1"])
+def test_colframe_writer_matches_current_golden(name):
+    import io
+
+    from s3shuffle_tpu.colframe import parse_column_frame, write_column_frame
+
+    data = blob(f"{name}.bin")
+    batch = parse_column_frame(data[4:]).batch
+    buf = io.BytesIO()
+    write_column_frame(buf, batch)
+    assert buf.getvalue() == data
+
+
+def test_colframe_truncated_and_corrupt_raise():
+    from s3shuffle_tpu.colframe import parse_column_frame
+
+    data = blob("colframe_fixed_v1.bin")
+    payload = data[4:]
+    with pytest.raises(IOError, match="truncated"):
+        parse_column_frame(payload[:32])
+    with pytest.raises(IOError, match="length mismatch"):
+        parse_column_frame(payload[:-2])
+    bad = bytearray(payload)
+    bad[15] ^= 0x40  # flip the wire-version word
+    with pytest.raises(IOError, match="wire version"):
+        parse_column_frame(bytes(bad))
+
+
+# ---------------------------------------------------------------------------
 # Registry honesty: WIRE01 negative fixture + generated doc sync
 # ---------------------------------------------------------------------------
 

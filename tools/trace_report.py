@@ -219,6 +219,41 @@ def _scan_planner_line(snapshot: dict) -> Optional[str]:
     return line
 
 
+def _record_plane_line(snapshot: dict) -> Optional[str]:
+    """One-line record-plane digest: rows moved through the columnar plane
+    per side, frames by wire format, the vectorized partition pass's
+    throughput, and how many rows fell back to per-record scalar routes."""
+    by_plane: Dict[str, float] = {}
+    for s in snapshot.get("record_rows_total", {}).get("series", []):
+        p = s.get("labels", {}).get("plane", "?")
+        by_plane[p] = by_plane.get(p, 0.0) + float(s.get("value", 0))
+    rows_w = by_plane.get("write", 0.0)
+    rows_r = by_plane.get("read", 0.0)
+    frames = _counter_total(snapshot, "record_frames_total")
+    fallback = _counter_total(snapshot, "record_fallback_rows_total")
+    if rows_w <= 0 and rows_r <= 0 and frames <= 0 and fallback <= 0:
+        return None
+    line = f"Record plane: {rows_w:g} rows written / {rows_r:g} read"
+    if frames > 0:
+        column = sum(
+            float(s.get("value", 0))
+            for s in snapshot.get("record_frames_total", {}).get("series", [])
+            if s.get("labels", {}).get("format") == "column"
+        )
+        line += f", {frames:g} frames ({100.0 * column / frames:.2f}% column)"
+    part = snapshot.get("record_partition_seconds", {}).get("series", [])
+    part_s = sum(float(s.get("sum", 0.0)) for s in part)
+    if part_s > 0 and rows_w > 0:
+        line += f"; partition {rows_w / part_s / 1e6:.1f}M rows/s"
+    if fallback > 0:
+        total = rows_w + rows_r + fallback
+        line += (
+            f"; {fallback:g} fallback rows "
+            f"({100.0 * (total - fallback) / total:.2f}% vectorized)"
+        )
+    return line
+
+
 def _write_plane_line(snapshot: dict) -> Optional[str]:
     """One-line write-plane digest: PUTs the composite commit plane issued
     vs what the one-object-per-map layout would have issued, the group
@@ -433,6 +468,7 @@ def render_metrics_snapshot(
         out.append("Counters:")
         out.append(_table(("counter", "value"), counter_rows))
     for line in (
+        _record_plane_line(snapshot),
         _scan_planner_line(snapshot),
         _write_plane_line(snapshot),
         _coding_plane_line(snapshot),
@@ -559,12 +595,14 @@ def _synthetic_snapshot() -> dict:
                       "codec": "native", "method": "register_map_outputs",
                       "shard": "0", "source": "snapshot", "reason": "orphan",
                       "knob": "fetch_parallelism", "event": "join",
-                      "choice": "reconstruct", "size_class": "le1m"}
+                      "choice": "reconstruct", "size_class": "le1m",
+                      "format": "column", "plane": "write", "site": "write"}
     _ALT_LABELS = {"scheme": "s3", "op": "open", "direction": "down",
                    "codec": "zlib", "method": "get_map_sizes_by_ranges",
                    "shard": "1", "source": "rpc", "reason": "generation",
                    "knob": "upload_queue_bytes", "event": "expire",
-                   "choice": "recompute", "size_class": "gt64m"}
+                   "choice": "recompute", "size_class": "gt64m",
+                   "format": "legacy", "plane": "read", "site": "read"}
     snapshot: Dict[str, dict] = {}
     for name, (kind, labelnames) in sorted(KNOWN_METRICS.items()):
         series_list = []
@@ -630,6 +668,15 @@ def _selftest() -> int:
     # multi-series rendering: BOTH label rows of a labeled metric appear
     for needle in ("op=read", "op=open"):
         assert needle in text, f"multi-series row missing {needle!r}:\n{text}"
+    # the record-plane digest renders from the synthetic record_* series
+    # (rows 7 write / 7 read; frames 7 column + 7 legacy → 50% column;
+    # fallback 7+7=14 → vectorized share (7+7)/(7+7+14) = 50%)
+    for needle in (
+        "Record plane: 7 rows written / 7 read",
+        "14 frames (50.00% column)",
+        "14 fallback rows (50.00% vectorized)",
+    ):
+        assert needle in text, f"record-plane line missing {needle!r}:\n{text}"
     # the scan-planner digest renders from the synthetic planner counters
     # (7 segments + 7 saved GETs, 1 MiB waste over 2 MiB read = 50%)
     for needle in ("Scan planner:", "7 GETs saved", "(14 → 7)", "50.00% of bytes read"):
