@@ -947,6 +947,50 @@ def _device_kernel_rates_impl():
                 f"timing jitter (t{N1}={t1:.3f}s, t{N2}={t2:.3f}s)"
             )
 
+        # fused decode+CRC: the read pipeline's actual launch — decode planes
+        # AND each block's right-aligned literal-plane CRC remainder in one
+        # dispatch (the validation certificate's device half; ops/tlz.py
+        # _decode_fused_math). Lands in bench_tpu_last_good.json via the
+        # per-metric merge like every other kernel rate.
+        crc_fn_dec = raw_crc_graph_fn(POLY_CRC32C, L, B)
+        n_lits_arr = (n_groups - n_match.astype(np.int64)
+                      - n_split.astype(np.int64)).astype(np.int32)
+        dnl = jax.device_put(n_lits_arr)
+
+        def dec_fused_loop(length):
+            looped = jax.jit(
+                lambda m, c, sp, o, k, l, nl: jax.lax.scan(
+                    lambda carry, _: (
+                        carry ^ jnp.uint8(1),
+                        (lambda dr: (dr[0][:, ::997], dr[1]))(
+                            tlz._decode_fused_math(
+                                m, c, sp, o, k, carry, nl, n_groups, crc_fn_dec
+                            )
+                        ),
+                    ),
+                    l,
+                    None,
+                    length=length,
+                )[1]
+            )
+            r = looped(dm, dc, ds, do, dk, dl, dnl)
+            jax.tree_util.tree_map(lambda x: x.block_until_ready(), r)  # compile
+            t0 = time.perf_counter()
+            r = looped(dm, dc, ds, do, dk, dl, dnl)
+            jax.tree_util.tree_map(lambda x: x.block_until_ready(), r)
+            return time.perf_counter() - t0
+
+        t1 = dec_fused_loop(N1)
+        t2 = dec_fused_loop(N2)
+        if t2 - t1 > 1e-6:
+            out["tpu_tlz_decode_fused_mb_s"] = round(
+                (N2 - N1) * B * L / 1e6 / (t2 - t1), 1
+            )
+        else:
+            out["tpu_tlz_decode_fused_mb_s_error"] = (
+                f"timing jitter (t{N1}={t1:.3f}s, t{N2}={t2:.3f}s)"
+            )
+
         # decode correctness on-device: matches the staged input exactly
         d = np.asarray(tlz._decode_kernel(n_groups)(dm, dc, ds, do, dk, dl))
         if not (d == batch).all():
@@ -1360,6 +1404,166 @@ def device_codec_knobs():
         "device_codec_plane": {
             "codec_batch_blocks": cfg.codec_batch_blocks,
             "encode_inflight_batches": cfg.encode_inflight_batches,
+        }
+    }
+
+
+def device_decode_gain(
+    n_blocks: int = 48,
+    block_size: int = 64 * 1024,
+    batch_frames: int = 4,
+    inflight: int = 3,
+    decode_ms: float = 6.0,
+    get_ms: float = 4.0,
+    deser_ms: float = 3.5,
+):
+    """Read-decode-pipeline probe (the read-side mirror of
+    :func:`device_codec_gain`): with the async decode window on — the
+    consumer deserializes chunk N and pulls the next GET's bytes while the
+    shared decode thread works on chunk N+1 — the pipelined read wall must
+    land strictly below the GET + decode + deserialize stage-time sum.
+
+    Runs the REAL host TLZ decoder over a terasort-shaped framed stream
+    (chipless rigs and CI measure the same overlap machinery the chip uses)
+    with injected per-stage latencies: ``get_ms`` per ranged-GET-sized
+    source read, ``decode_ms`` per decode batch (the device dispatch
+    round-trip stand-in, on top of the real decompression work), and
+    ``deser_ms`` per consumed chunk. Byte identity between the pipelined and
+    synchronous decoded outputs (and the original payload) is asserted in
+    every cell, not assumed."""
+    import io as _io
+
+    from s3shuffle_tpu.batch import RecordBatch, write_frame
+    from s3shuffle_tpu.codec.framing import CodecInputStream
+    from s3shuffle_tpu.codec.tpu import TpuCodec
+
+    rng = random.Random(78)
+    filler = [rng.randbytes(VALUE_BYTES) for _ in range(64)]
+    need = n_blocks * block_size
+    recs = [
+        (rng.randbytes(KEY_BYTES), filler[rng.randrange(64)])
+        for _ in range(need // (KEY_BYTES + VALUE_BYTES + 8) + 100)
+    ]
+    buf = _io.BytesIO()
+    write_frame(buf, RecordBatch.from_records(recs))
+    payload = buf.getvalue()
+    if len(payload) < need:
+        payload = payload * (need // len(payload) + 1)
+    payload = payload[:need]
+
+    class SlowDecodeCodec(TpuCodec):
+        """Real host TLZ decode + ``decode_ms`` of injected launch latency
+        per batch (the chip dispatch round-trip stand-in)."""
+
+        def decompress_blocks(self, blocks):
+            time.sleep(decode_ms / 1e3)
+            return super().decompress_blocks(blocks)
+
+    class SlowSource(_io.RawIOBase):
+        """Injected per-call GET latency: serves at most ``chunk`` bytes per
+        read with ``get_ms`` of sleep each (the ranged-GET stand-in)."""
+
+        def __init__(self, data: bytes, chunk: int):
+            self._data = data
+            self._pos = 0
+            self._chunk = chunk
+
+        def readable(self):
+            return True
+
+        def read(self, n: int = -1) -> bytes:
+            if self._pos >= len(self._data):
+                return b""
+            time.sleep(get_ms / 1e3)
+            n = self._chunk if n is None or n < 0 else min(n, self._chunk)
+            out = self._data[self._pos : self._pos + n]
+            self._pos += len(out)
+            return out
+
+    def make_codec(window: int):
+        return SlowDecodeCodec(
+            block_size=block_size, use_device=False,
+            decode_batch_frames=batch_frames,
+            decode_inflight_batches=window,
+        )
+
+    deser_chunk = batch_frames * block_size
+    n_batches = (n_blocks + batch_frames - 1) // batch_frames
+    try:
+        framed = TpuCodec(block_size=block_size, use_device=False).compress_bytes(
+            payload
+        )
+        # one injected GET per decode batch, regardless of the payload's
+        # compression ratio — the stage geometry (GET+deserialize ≈ decode)
+        # stays fixed across rigs and payload shapes
+        src_chunk = (len(framed) + n_batches - 1) // n_batches
+
+        # decode stage alone (injected launch latency + real decompression,
+        # synchronous, no GET/deserialize injection) — one term of the sum
+        # the pipeline must beat
+        decode_s = float("inf")
+        for _rep in range(2):
+            t0 = time.perf_counter()
+            ref = CodecInputStream(make_codec(0), _io.BytesIO(framed)).read()
+            decode_s = min(decode_s, time.perf_counter() - t0)
+        if ref != payload:
+            return {"device_decode_error": "decoded stream != payload"}
+        n_gets = (len(framed) + src_chunk - 1) // src_chunk
+        n_deser = (len(payload) + deser_chunk - 1) // deser_chunk
+
+        def run(window: int):
+            src = SlowSource(framed, src_chunk)
+            stream = CodecInputStream(make_codec(window), src)
+            got = []
+            t0 = time.perf_counter()
+            while True:
+                chunk = stream.read(deser_chunk)
+                if not chunk:
+                    break
+                time.sleep(deser_ms / 1e3)  # deserialize stand-in
+                got.append(chunk)
+            wall = time.perf_counter() - t0
+            stream.close()
+            return wall, b"".join(got)
+
+        sync_wall, got_sync = run(0)
+        pipe_wall, got_pipe = run(inflight)
+        if not (got_sync == got_pipe == payload):
+            return {"device_decode_error": "pipelined decode differs from sync"}
+    except Exception as e:  # never fail the bench over this row
+        return {"device_decode_error": str(e)[:120]}
+    get_s = n_gets * get_ms / 1e3
+    deser_s = n_deser * deser_ms / 1e3
+    stage_sum = get_s + decode_s + deser_s
+    return {
+        "device_decode_speedup": round(stage_sum / pipe_wall, 2),
+        "device_decode_pipelined_wall_s": round(pipe_wall, 3),
+        "device_decode_sync_wall_s": round(sync_wall, 3),
+        "device_decode_stage_sum_s": round(stage_sum, 3),
+        "device_decode_wall_below_stage_sum": bool(pipe_wall < stage_sum),
+        "device_decode_byte_identity": True,
+        "device_decode_decode_stage_s": round(decode_s, 3),
+        "device_decode_get_stage_s": round(get_s, 3),
+        "device_decode_deser_stage_s": round(deser_s, 3),
+        "device_decode_blocks": n_blocks,
+        "device_decode_block_bytes": block_size,
+        "device_decode_batch_frames": batch_frames,
+        "device_decode_inflight": inflight,
+        "device_decode_decode_ms": decode_ms,
+        "device_decode_get_latency_ms": get_ms,
+        "device_decode_deser_ms": deser_ms,
+    }
+
+
+def device_decode_knobs():
+    """Knob record for BENCH-round comparability (like device_codec_plane)."""
+    from s3shuffle_tpu.config import ShuffleConfig
+
+    cfg = ShuffleConfig()
+    return {
+        "decode_pipeline": {
+            "decode_batch_frames": cfg.decode_batch_frames,
+            "decode_inflight_batches": cfg.decode_inflight_batches,
         }
     }
 
@@ -2712,6 +2916,7 @@ def main():
         **columnar_gain(),
         **coded_read_gain(),
         **device_codec_gain(),
+        **device_decode_gain(),
         **autotune_gain(),
         **elasticity_gain(),
         **tracker_scaling(),
@@ -2722,6 +2927,7 @@ def main():
         **elastic_fleet_knobs(),
         **composite_plane_knobs(),
         **device_codec_knobs(),
+        **device_decode_knobs(),
         **autotune_knobs(),
         **load_calibration(),
         **device_kernel_rates(),

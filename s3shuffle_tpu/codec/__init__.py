@@ -31,6 +31,8 @@ def get_codec(
     codec_batch_blocks: int | None = None,
     tpu_host_fallback: bool = False,
     encode_inflight_batches: int | None = None,
+    decode_batch_frames: int | None = None,
+    decode_inflight_batches: int | None = None,
 ) -> "FrameCodec | None":
     """Resolve a codec by config name. ``none`` → None (raw bytes, no framing,
     still concatenatable). ``auto`` → native if built, else zlib.
@@ -38,7 +40,21 @@ def get_codec(
     256 KiB for the TPU codec (ratio improves with block length; its match
     window is a separate 64 KiB distance cap). ``codec_batch_blocks`` sizes
     the device round-trip batch and ``encode_inflight_batches`` the async
-    encode window for the tpu codec."""
+    encode window for the tpu codec. ``decode_batch_frames`` /
+    ``decode_inflight_batches`` are stamped onto ANY codec (CodecInputStream
+    reads them live — they size read-side frame batching and the async
+    decode window; the ScanTuner retunes the instance attributes online)."""
+
+    def _stamp(codec: "FrameCodec | None") -> "FrameCodec | None":
+        if codec is not None:
+            if decode_batch_frames is not None:
+                codec.decode_batch_frames = max(1, int(decode_batch_frames))
+            if decode_inflight_batches is not None:
+                codec.decode_inflight_batches = max(
+                    0, int(decode_inflight_batches)
+                )
+        return codec
+
     name = (name or "none").lower()
     if name in ("none", "raw", "off"):
         return None
@@ -49,7 +65,7 @@ def get_codec(
         try:
             from s3shuffle_tpu.codec.native import NativeLZCodec
 
-            return NativeLZCodec(**bs)
+            return _stamp(NativeLZCodec(**bs))
         except Exception:
             logging.getLogger("s3shuffle_tpu.codec").debug(
                 "codec=auto: native unavailable, selecting zlib", exc_info=True
@@ -58,19 +74,19 @@ def get_codec(
     if name == "zlib":
         from s3shuffle_tpu.codec.cpu import ZlibCodec
 
-        return ZlibCodec(level=level, **bs)
+        return _stamp(ZlibCodec(level=level, **bs))
     if name == "zstd":
         from s3shuffle_tpu.codec.cpu import ZstdCodec
 
-        return ZstdCodec(level=level, **bs)
+        return _stamp(ZstdCodec(level=level, **bs))
     if name == "native":
         from s3shuffle_tpu.codec.native import NativeLZCodec
 
-        return NativeLZCodec(**bs)
+        return _stamp(NativeLZCodec(**bs))
     if name == "lz4":
         from s3shuffle_tpu.codec.native import NativeLZ4Codec
 
-        return NativeLZ4Codec(**bs)
+        return _stamp(NativeLZ4Codec(**bs))
     if name == "tpu":
         from s3shuffle_tpu.codec.tpu import TpuCodec
 
@@ -78,7 +94,7 @@ def get_codec(
             bs["batch_blocks"] = codec_batch_blocks
         if encode_inflight_batches is not None:
             bs["encode_inflight_batches"] = encode_inflight_batches
-        return TpuCodec(host_encode_fallback=tpu_host_fallback, **bs)
+        return _stamp(TpuCodec(host_encode_fallback=tpu_host_fallback, **bs))
     raise ValueError(f"Unknown codec: {name}")
 
 

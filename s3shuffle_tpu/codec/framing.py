@@ -60,6 +60,14 @@ class FrameCodec:
 
     name = "abstract"
     codec_id = 0
+    #: read-plane knobs, stamped per instance from config by ``get_codec``
+    #: (the class defaults reproduce the historical behavior): frames read
+    #: ahead and decoded per batch (None → CodecInputStream.BATCH_FRAMES),
+    #: and the bounded async decode window (<= 1 = synchronous decode on the
+    #: consumer thread). CodecInputStream reads both LIVE per batch, so the
+    #: ScanTuner's online retunes apply mid-stream.
+    decode_batch_frames: int | None = None
+    decode_inflight_batches: int = 0
 
     def __init__(self, block_size: int = 64 * 1024):
         if block_size <= 0:
@@ -120,6 +128,16 @@ class FrameCodec:
             self.frame_from(raw, comp) for raw, comp in zip(blocks, compressed)
         )
 
+    def wants_async_decode(self) -> bool:
+        """True when CodecInputStream should run this codec's batch decode on
+        the shared decode thread (bounded by ``decode_inflight_batches``).
+        Only batch-capable codecs qualify — per-frame codecs gain nothing
+        from a one-frame window."""
+        return (
+            int(getattr(self, "decode_inflight_batches", 0)) > 1
+            and type(self).decompress_blocks is not FrameCodec.decompress_blocks
+        )
+
     def compress_stream(self, sink: BinaryIO) -> "CodecOutputStream":
         return CodecOutputStream(self, sink)
 
@@ -157,6 +175,25 @@ _C_FRAMES = _metrics.REGISTRY.counter(
     "codec_frames_total", "Frames emitted by codec output streams"
 )
 
+_H_DECODE_BATCH = _metrics.REGISTRY.histogram(
+    "codec_decode_batch_seconds",
+    "Batch decompress call latency (device launch + host parse/staging)",
+)
+_C_DECODE_BYTES = _metrics.REGISTRY.counter(
+    "codec_decode_bytes_total",
+    "Decoded (uncompressed) bytes out of batch decompress calls",
+)
+_G_DECODE_INFLIGHT = _metrics.REGISTRY.gauge(
+    "codec_decode_inflight",
+    "Decode batches in flight between sources and their consumers "
+    "(async batch mode, summed across streams)",
+)
+_C_FUSED_VALIDATED = _metrics.REGISTRY.counter(
+    "codec_fused_crc_validated_total",
+    "Frames whose stored-byte CRC certificate came fused from the decode "
+    "launch (the checksum stream's host hashing pass was skipped)",
+)
+
 #: process-wide single-thread encode executor: the device is one resource,
 #: so batches from every stream serialize through one worker — which also
 #: makes future completion order == submission order (the streams' ordered
@@ -175,6 +212,33 @@ def _get_encode_executor() -> ThreadPoolExecutor:
                 max_workers=1, thread_name_prefix="s3shuffle-encode"
             )
         return _encode_executor
+
+
+#: process-wide DECODE executor — the read plane's mirror of the encode
+#: worker. Unlike the encode side it is NOT single-threaded: N concurrent
+#: reduce tasks each run their own stream, and funneling every CPU-codec
+#: batch through one worker would cap aggregate decode throughput at one
+#: core (the pre-pipeline path decoded on each consumer thread in
+#: parallel). Per-stream ordering needs no single worker — each stream
+#: harvests its own FIFO future deque in submission order — and the tlz
+#: staging planes are per-thread, so a small pool just keeps a few staging
+#: sets. Device launches serialize inside XLA regardless of pool width.
+_decode_executor_lock = threading.Lock()
+_decode_executor: Optional[ThreadPoolExecutor] = None
+
+
+def _get_decode_executor() -> ThreadPoolExecutor:
+    global _decode_executor
+    with _decode_executor_lock:
+        if _decode_executor is None:
+            import os
+
+            # shuffle-lint: disable=THR01 reason=process-wide decode pool shared by every codec input stream for the process lifetime; concurrent.futures joins idle workers at interpreter exit
+            _decode_executor = ThreadPoolExecutor(
+                max_workers=min(4, os.cpu_count() or 2),
+                thread_name_prefix="s3shuffle-decode",
+            )
+        return _decode_executor
 
 
 class CodecOutputStream(io.RawIOBase):
@@ -419,11 +483,37 @@ class CodecOutputStream(io.RawIOBase):
 class CodecInputStream(io.RawIOBase):
     """Reads frames from ``source`` and serves decompressed bytes. Any codec's
     frames are accepted (the decoder dispatches on codec_id), so readers can
-    decode data written by a different configured codec."""
+    decode data written by a different configured codec.
+
+    **Async batch mode** (``codec.decode_inflight_batches > 1`` and the codec
+    answers ``wants_async_decode()``): frame batches are handed to the
+    process-wide decode thread and a bounded window of decode futures rides
+    between the source and the consumer — the consumer deserializes chunk N
+    and pulls chunk N+2's compressed frames (the next coalesced-segment GET's
+    bytes) while the decode thread works on chunk N+1. Harvests are
+    order-preserving (single worker + FIFO), decode failures re-raise on the
+    consumer's next read, and each in-flight batch's decoded bytes are
+    RESERVED against the scan's ``max_buffer_size_task`` budget (``budget``)
+    so N concurrent reduce tasks never exceed their provisioned memory — the
+    window shrinks instead of waiting when the budget is full. ≤ 1 keeps
+    every decode synchronous on the consumer thread (today's behavior).
+
+    **Fused validation**: when the codec can certify frames' stored-byte CRCs
+    from its decode launch (``wants_fused_decode_validation``) and the source
+    is a :class:`~s3shuffle_tpu.read.checksum_stream.ChecksumValidationStream`
+    whose algorithm has a combinable CRC form, the stream arms the source's
+    deferred mode and certifies each decoded frame itself — the checksum
+    layer's host hashing pass is skipped for fused frames, with
+    ``ChecksumError`` classification identical to streaming validation
+    (decode errors resolve pending certification FIRST, so corruption still
+    surfaces as the checksum mismatch it is)."""
 
     #: Frames read ahead and decoded per batch — one native/device call
     #: instead of one per frame. Bounds extra buffering to
-    #: ``BATCH_FRAMES * block_size`` decoded bytes per stream.
+    #: ``BATCH_FRAMES * block_size`` decoded bytes per stream. The
+    #: ``decode_batch_frames`` codec attribute (config knob) overrides this,
+    #: read LIVE per batch so online retunes apply mid-stream; <= 1
+    #: reproduces the per-frame decode path exactly.
     BATCH_FRAMES = 32
     #: Source refill granularity: compressed bytes are pulled through the
     #: stream stack below (prefetch → checksum) in pieces this big instead of
@@ -431,25 +521,67 @@ class CodecInputStream(io.RawIOBase):
     #: ~20x fewer, bigger chunks.
     SRC_CHUNK = 1 << 20
 
-    def __init__(self, codec: FrameCodec | None, source: BinaryIO):
+    def __init__(self, codec: FrameCodec | None, source: BinaryIO, budget=None):
         self._codec = codec
         self._source = source
         self._current = b""
         self._pos = 0
         self._eof = False
-        self._decoded: deque = deque()
+        self._decoded: deque = deque()  # (chunk, reserved_budget_bytes)
         self._rbuf = b""
         self._rpos = 0
         # Read-ahead only pays off for codecs with a batch decompress path.
-        self._batch_frames = (
-            self.BATCH_FRAMES
-            if codec is not None
+        self._batch_capable = (
+            codec is not None
             and type(codec).decompress_blocks is not FrameCodec.decompress_blocks
-            else 1
         )
+        self._budget = budget  # try_reserve/release_reserved surface
+        self._inflight: deque = deque()  # (future, reserved_budget_bytes)
+        self._pending_frame = None  # codec-switch leftover seeding the next run
+        self._src_eof = False
+        self._wants_async = getattr(codec, "wants_async_decode", None)
+        # fused-validation handshake: arm the source's deferred mode only
+        # when the codec can actually hand back fused stored-byte CRCs
+        self._certify = None
+        self._fused_poly = None
+        wants_fused = getattr(codec, "wants_fused_decode_validation", None)
+        defer = getattr(source, "defer_validation", None)
+        poly = getattr(source, "fused_poly", None)
+        if wants_fused is not None and defer is not None and poly is not None:
+            try:
+                if wants_fused(poly) and defer():
+                    self._certify = source
+                    self._fused_poly = poly
+            except Exception:
+                import logging
+
+                logging.getLogger("s3shuffle_tpu.codec").debug(
+                    "fused-validation handshake failed; streaming validation "
+                    "stays active", exc_info=True,
+                )
 
     def readable(self) -> bool:
         return True
+
+    @property
+    def _batch_frames(self) -> int:
+        """Live frames-per-batch: the codec's ``decode_batch_frames`` knob
+        (ScanTuner retunes it online), falling back to BATCH_FRAMES."""
+        if not self._batch_capable:
+            return 1
+        v = getattr(self._codec, "decode_batch_frames", None)
+        if v is None:
+            return self.BATCH_FRAMES
+        return max(1, int(v))
+
+    @property
+    def _window(self) -> int:
+        """Live async decode window, read at every batch boundary (the
+        read-side mirror of CodecOutputStream._window): a retune shrinks or
+        widens the in-flight future window mid-stream."""
+        if self._codec is None:
+            return 0
+        return max(0, int(getattr(self._codec, "decode_inflight_batches", 0)))
 
     def _read_exact(self, n: int) -> bytes:
         """n bytes from the buffered source (may return fewer only at EOF).
@@ -497,60 +629,211 @@ class CodecInputStream(io.RawIOBase):
             raise IOError("Raw frame with mismatched lengths")
         return codec_id, payload, ulen
 
-    def _decode_run(self, frames) -> None:
-        """Decode an in-order run of frames sharing one codec_id into
-        ``self._decoded`` as ONE contiguous chunk (fewer, bigger pieces
-        crossing the stream stack ⇒ fewer per-chunk checksum/copy calls)."""
-        codec_id = frames[0][0]
-        if codec_id == 0:
-            self._decoded.append(
-                frames[0][1] if len(frames) == 1 else b"".join(p for _c, p, _u in frames)
-            )
-            return
-        if len(frames) > 1:
-            # batch the whole run through its codec — the configured codec
-            # when it matches, else the cached registry instance (a stream
-            # legally mixes codec ids, e.g. SLZ frames written by the
-            # codec=tpu host fallback read back under a TpuCodec hint)
-            if self._codec is not None and codec_id == self._codec.codec_id:
-                codec = self._codec
-            else:
-                codec = _codec_for_frame_id(codec_id)
-            total = sum(u for _c, _p, u in frames)
-            out = codec.decompress_blocks_concat([(p, u) for _c, p, u in frames])
-            if len(out) != total:
-                raise IOError(f"Decompressed run length {len(out)} != headers {total}")
-            self._decoded.append(out)
-            return
-        blocks = [
-            decompress_frame_payload(codec_id, p, u, self._codec)
-            for _c, p, u in frames
-        ]
-        for (_c, _p, ulen), out in zip(frames, blocks):
-            if len(out) != ulen:
-                raise IOError(f"Decompressed length {len(out)} != header {ulen}")
-        self._decoded.append(blocks[0] if len(blocks) == 1 else b"".join(blocks))
+    def _read_run(self) -> list:
+        """Pull the next in-order run of frames sharing one codec_id, up to
+        the live batch size. A codec switch parks the switching frame to seed
+        the NEXT run (frames are never reordered)."""
+        run: list = []
+        limit = self._batch_frames
+        if self._pending_frame is not None:
+            run.append(self._pending_frame)
+            self._pending_frame = None
+        while len(run) < limit:
+            frame = self._read_frame()
+            if frame is None:
+                self._src_eof = True
+                break
+            if run and frame[0] != run[0][0]:
+                self._pending_frame = frame
+                break
+            run.append(frame)
+            if limit == 1:
+                break
+        return run
 
+    def _decode_frames(self, frames):
+        """Decode an in-order run of frames sharing one codec_id into ONE
+        contiguous chunk (fewer, bigger pieces crossing the stream stack ⇒
+        fewer per-chunk copy calls). Runs on the consumer thread in sync
+        mode, the shared decode thread in async mode — it never touches the
+        source. Returns ``(chunk, certs)`` where ``certs`` (fused validation
+        armed) lists ``(frame_len, frame_crc_or_None)`` per frame in order."""
+        codec_id = frames[0][0]
+        certs = [] if self._certify is not None else None
+        t0 = time.perf_counter_ns()
+        if codec_id == 0:
+            out = (
+                frames[0][1] if len(frames) == 1
+                else b"".join(p for _c, p, _u in frames)
+            )
+            if certs is not None:
+                certs.extend(
+                    (HEADER_SIZE + len(p), None) for _c, p, _u in frames
+                )
+            if _metrics.enabled():
+                _H_DECODE_BATCH.observe((time.perf_counter_ns() - t0) / 1e9)
+                _C_DECODE_BYTES.inc(len(out))
+            return out, certs
+        # route the whole run through its codec — the configured codec when
+        # it matches, else the cached registry instance (a stream legally
+        # mixes codec ids, e.g. SLZ frames written by the codec=tpu host
+        # fallback read back under a TpuCodec hint)
+        if self._codec is not None and codec_id == self._codec.codec_id:
+            codec = self._codec
+        else:
+            codec = _codec_for_frame_id(codec_id)
+        total = sum(u for _c, _p, u in frames)
+        blocks = [(p, u) for _c, p, u in frames]
+        crcs = None
+        if certs is not None and getattr(codec, "decompress_blocks_fused", None):
+            out, crcs = codec.decompress_blocks_fused(blocks, self._fused_poly)
+        elif len(frames) == 1 and certs is None:
+            out = decompress_frame_payload(
+                codec_id, frames[0][1], frames[0][2], self._codec
+            )
+        else:
+            out = codec.decompress_blocks_concat(blocks)
+        if len(out) != total:
+            raise IOError(
+                f"Decompressed run length {len(out)} != headers {total}"
+            )
+        if certs is not None:
+            from s3shuffle_tpu.ops.checksum import crc_combine, host_crc
+
+            for i, (_c, p, u) in enumerate(frames):
+                crc = crcs[i] if crcs is not None else None
+                if crc is not None:
+                    # frame = 9-byte header (host-hashed) + payload (fused)
+                    header = HEADER.pack(codec_id, u, len(p))
+                    crc = crc_combine(
+                        host_crc(header, self._fused_poly), crc, len(p),
+                        self._fused_poly,
+                    )
+                certs.append((HEADER_SIZE + len(p), crc))
+        if _metrics.enabled():
+            _H_DECODE_BATCH.observe((time.perf_counter_ns() - t0) / 1e9)
+            _C_DECODE_BYTES.inc(len(out))
+        return out, certs
+
+    def _apply_certs(self, certs) -> None:
+        """Feed a decoded run's certificates to the deferred checksum stream
+        in order (consumer thread only — certification mutates the
+        validator's cursor). Raises the validator's ChecksumError on a
+        partition mismatch, exactly where streaming validation would."""
+        if not certs:
+            return
+        fused = 0
+        for length, crc in certs:
+            self._certify.certify(length, stored_crc=crc)
+            if crc is not None:
+                fused += 1
+        if fused and _metrics.enabled():
+            _C_FUSED_VALIDATED.inc(fused)
+
+    # ------------------------------------------------------------------
+    # async window
+    # ------------------------------------------------------------------
+    def _submit_window(self) -> None:
+        while not self._src_eof or self._pending_frame is not None:
+            if len(self._inflight) >= self._window:
+                break
+            reserved = 0
+            if self._inflight and self._budget is not None:
+                # beyond the first in-flight batch the decoded bytes must fit
+                # the task budget; a full budget SHRINKS the window instead
+                # of blocking (the consumer holding this thread is the same
+                # one whose closes release prefill budget)
+                est = self._batch_frames * max(
+                    1, int(getattr(self._codec, "block_size", 1 << 16))
+                )
+                if not self._budget.try_reserve(est):
+                    break
+                reserved = est
+            try:
+                run = self._read_run()
+                if run:
+                    fut = _get_decode_executor().submit(self._decode_frames, run)
+            except BaseException:
+                # the reservation is in neither _inflight nor _decoded yet —
+                # release here or the scan budget stays inflated for good
+                if reserved:
+                    self._budget.release_reserved(reserved)
+                raise
+            if not run:
+                if reserved:
+                    self._budget.release_reserved(reserved)
+                break
+            self._inflight.append((fut, reserved))
+            if _metrics.enabled():
+                _G_DECODE_INFLIGHT.inc(1)
+
+    def _harvest_one_decode(self) -> None:
+        fut, reserved = self._inflight.popleft()
+        if _metrics.enabled():
+            _G_DECODE_INFLIGHT.dec(1)
+        try:
+            chunk, certs = fut.result()
+            self._apply_certs(certs)
+        except BaseException:
+            if reserved and self._budget is not None:
+                self._budget.release_reserved(reserved)
+            raise
+        self._decoded.append((chunk, reserved))
+
+    def _drain_decode_inflight(self) -> None:
+        while self._inflight:
+            self._harvest_one_decode()
+
+    def _abort_decode_window(self) -> None:
+        if _metrics.enabled() and self._inflight:
+            _G_DECODE_INFLIGHT.dec(len(self._inflight))
+        for fut, reserved in self._inflight:
+            fut.cancel()
+            if reserved and self._budget is not None:
+                self._budget.release_reserved(reserved)
+        self._inflight.clear()
+
+    # ------------------------------------------------------------------
     def _fill(self) -> bool:
         if not self._decoded:
-            run: list = []
-            while len(run) < self._batch_frames:
-                frame = self._read_frame()
-                if frame is None:
-                    break
-                if run and frame[0] != run[0][0]:
-                    self._decode_run(run)
-                    run = [frame]
-                    break  # decoded enough for now; keep the new run's frame
-                run.append(frame)
-                if self._batch_frames == 1:
-                    break
-            if run:
-                self._decode_run(run)
+            try:
+                if (
+                    self._window > 1
+                    and self._wants_async is not None
+                    and self._wants_async()
+                ):
+                    while not self._decoded:
+                        self._submit_window()
+                        if not self._inflight:
+                            break
+                        self._harvest_one_decode()
+                else:
+                    # synchronous path (window off, or shrunk mid-stream:
+                    # drain leftovers first so emission order holds)
+                    self._drain_decode_inflight()
+                    if not self._decoded:
+                        run = self._read_run()
+                        if run:
+                            chunk, certs = self._decode_frames(run)
+                            self._apply_certs(certs)
+                            self._decoded.append((chunk, 0))
+            except BaseException:
+                self._abort_decode_window()
+                if self._certify is not None:
+                    # corruption must classify exactly as streaming
+                    # validation classifies it: hash the served-but-
+                    # uncertified bytes NOW — a checksum mismatch in a
+                    # completed partition raises ChecksumError here, taking
+                    # precedence over the decoder's parse error
+                    self._certify.resolve_pending()
+                raise
         if not self._decoded:
             self._eof = True
             return False
-        self._current = self._decoded.popleft()
+        chunk, reserved = self._decoded.popleft()
+        if reserved and self._budget is not None:
+            self._budget.release_reserved(reserved)
+        self._current = chunk
         self._pos = 0
         return True
 
@@ -582,6 +865,12 @@ class CodecInputStream(io.RawIOBase):
 
     def close(self) -> None:
         if not self.closed:
+            self._abort_decode_window()
+            if self._budget is not None:
+                for _chunk, reserved in self._decoded:
+                    if reserved:
+                        self._budget.release_reserved(reserved)
+            self._decoded.clear()
             self._source.close()
         super().close()
 

@@ -34,6 +34,7 @@ from s3shuffle_tpu.codec.framing import CODEC_IDS, FrameCodec
 from s3shuffle_tpu.metrics import registry as _metrics
 from s3shuffle_tpu.ops import tlz
 from s3shuffle_tpu.ops.checksum import (
+    POLY_CRC32,
     POLY_CRC32C,
     crc32_batch,
     crc_combine,
@@ -162,6 +163,11 @@ class TpuCodec(FrameCodec):
         # serializer and the sink (CodecOutputStream async batch mode);
         # <= 1 keeps every batch synchronous on the producer thread
         encode_inflight_batches: int = 0,
+        # read-side mirrors (CodecInputStream async batch mode; see
+        # FrameCodec class docs): frames decoded per batch (None = the
+        # stream's BATCH_FRAMES default) and the bounded decode window
+        decode_batch_frames: int | None = None,
+        decode_inflight_batches: int = 0,
     ):
         if block_size % 128 != 0:
             raise ValueError("TPU codec block_size must be a multiple of 128")
@@ -170,7 +176,11 @@ class TpuCodec(FrameCodec):
         super().__init__(block_size)
         self.batch_blocks = batch_blocks
         self.encode_inflight_batches = max(0, int(encode_inflight_batches))
+        if decode_batch_frames is not None:
+            self.decode_batch_frames = max(1, int(decode_batch_frames))
+        self.decode_inflight_batches = max(0, int(decode_inflight_batches))
         self._device_failures = 0  # consecutive device batch-encode failures
+        self._decode_failures = 0  # consecutive device batch-DECODE failures
         self._use_device = use_device
         #: ``codec=tpu`` chosen but no accelerator attached: reroute ENCODE to
         #: SLZ frames (a different codec_id — readers dispatch per frame, so
@@ -467,9 +477,76 @@ class TpuCodec(FrameCodec):
     def decompress_blocks(self, blocks) -> List[bytes]:
         if not self._device_path():
             return [self.decompress_block(b, n) for b, n in blocks]
+        return self._decode_full_blocks(blocks, None)[0]
+
+    # --- read side: fused stored-byte CRC certification ---
+    def wants_fused_decode_validation(self, poly: int) -> bool:
+        """True when this codec's decode launches can hand back each frame's
+        stored-byte CRC fused with the decoded planes — the read plane's
+        checksum layer then defers its host hashing pass to those
+        certificates. Only meaningful on the device path (host reads keep
+        streaming validation: the native CRC is already cheap there)."""
+        if poly not in (POLY_CRC32, POLY_CRC32C):
+            return False
+        return self._device_path()
+
+    def _decode_full_blocks(self, blocks, poly):
+        """Device batch decode with fused payload CRCs when ``poly`` is set.
+        A device failure mid-scan (tunnel collapse between batches)
+        host-decodes THIS batch — no frame is ever lost — and after three
+        consecutive failures pins the instance to the host decoder. A batch
+        the HOST decoder also rejects is corruption, not device loss: the
+        host path's precise error propagates and the failure counter is
+        untouched (corrupt frames must not pin a healthy chip off)."""
         payloads = [b for b, _n in blocks]
         ulens = [n for _b, n in blocks]
-        return tlz.decode_blocks_device(payloads, ulens, self.block_size)
+        try:
+            out, crcs = tlz.decode_batch_device(
+                payloads, ulens, self.block_size,
+                batch_rows=self.batch_blocks, poly=poly,
+            )
+            self._decode_failures = 0
+            return out, crcs
+        except Exception as device_err:
+            try:
+                host = [self.decompress_block(p, u) for p, u in blocks]
+            except Exception:
+                raise  # precise host classification (corruption) wins
+            del device_err
+            self._decode_failures += 1
+            if self._decode_failures >= 3:
+                self._use_device = False
+                logger.warning(
+                    "device batch decode failed %d times in a row — pinning "
+                    "this codec to the host TLZ decoder",
+                    self._decode_failures, exc_info=True,
+                )
+            else:
+                logger.warning(
+                    "device batch decode failed — host-decoding this batch "
+                    "(no frame lost)", exc_info=True,
+                )
+            return host, ([None] * len(blocks) if poly is not None else None)
+
+    def decompress_blocks_fused(self, blocks, poly: int):
+        """:meth:`decompress_blocks_concat` + per-frame PAYLOAD stored-byte
+        CRCs from the SAME decode launch. Returns ``(concat_bytes, crcs)``
+        where ``crcs[i]`` is the full-algorithm CRC of ``blocks[i]``'s
+        payload bytes — or None per frame the launch didn't cover (host
+        fallback, short/legacy frames); the caller certifies those from the
+        bytes it holds. Decoded output is byte-identical to the unfused
+        path's."""
+        if not self._device_path():
+            out = [self.decompress_block(b, n) for b, n in blocks]
+            for (_, ulen), o in zip(blocks, out):
+                if len(o) != ulen:
+                    raise IOError(f"Decompressed length {len(o)} != header {ulen}")
+            return b"".join(out), None
+        out, crcs = self._decode_full_blocks(blocks, poly)
+        for (_, ulen), o in zip(blocks, out):
+            if len(o) != ulen:
+                raise IOError(f"Decompressed length {len(o)} != header {ulen}")
+        return b"".join(out), crcs
 
 
 class FusedChecksumAccumulator:

@@ -256,6 +256,20 @@ class ShuffleConfig:
     # itself runs the TLZ encoder (device or host C), never on the SLZ
     # host-fallback delegate.
     encode_inflight_batches: int = 2
+    # read-side mirror of codec_batch_blocks: frames the codec input stream
+    # reads ahead and decodes per batch (one native/device call instead of
+    # one per frame). <= 1 reproduces the per-frame decode path op-for-op;
+    # joins ScanTuner's ladder when autotune is on (live instance attribute,
+    # so retunes apply mid-stream).
+    decode_batch_frames: int = 32
+    # decode batches allowed in flight between the source and the consumer
+    # (CodecInputStream async batch mode): the consumer deserializes chunk N
+    # and pulls the next coalesced-segment GET's bytes while the shared
+    # decode thread works on chunk N+1. In-flight decoded bytes reserve
+    # against max_buffer_size_task (non-blocking: a full budget shrinks the
+    # window). <= 1 keeps every decode synchronous on the consumer thread
+    # (the pre-pipeline behavior).
+    decode_inflight_batches: int = 2
     # codec=tpu with no accelerator attached: reroute shuffle-write encode to
     # SLZ frames (loud warning) instead of the ~5x-slower host C TLZ encoder;
     # TLZ decode stays active for existing data. false = always encode TLZ.
@@ -309,6 +323,10 @@ class ShuffleConfig:
             raise ValueError("codec_batch_blocks must be >= 1")
         if self.encode_inflight_batches < 0:
             raise ValueError("encode_inflight_batches must be >= 0")
+        if self.decode_batch_frames < 1:
+            raise ValueError("decode_batch_frames must be >= 1")
+        if self.decode_inflight_batches < 0:
+            raise ValueError("decode_inflight_batches must be >= 0")
         if self.autotune_interval_s < 0:
             raise ValueError("autotune_interval_s must be >= 0")
         if self.columnar not in (0, 1):

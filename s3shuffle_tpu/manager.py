@@ -98,13 +98,20 @@ class ShuffleManager:
             cfg.codec_batch_blocks,
             tpu_host_fallback=cfg.tpu_host_fallback,
             encode_inflight_batches=cfg.encode_inflight_batches,
+            decode_batch_frames=cfg.decode_batch_frames,
+            decode_inflight_batches=cfg.decode_inflight_batches,
         )
-        # Autotune: hand the codec to the write-side tuner so its
-        # encode_inflight_batches window is retuned online (CodecOutputStream
-        # reads the attribute live at every batch submission). No-op when
-        # autotune is off (no tuner on the dispatcher).
+        # Autotune: hand the codec to both tuners so its live windows are
+        # retuned online — the write-side CommitTuner owns
+        # encode_inflight_batches (CodecOutputStream reads it at every batch
+        # submission) and the read-side ScanTuner owns decode_batch_frames /
+        # decode_inflight_batches (CodecInputStream reads them at every batch
+        # boundary). No-op when autotune is off (no tuners on the
+        # dispatcher).
         if getattr(self.dispatcher, "commit_tuner", None) is not None:
             self.dispatcher.commit_tuner.bind_codec(self._codec)
+        if getattr(self.dispatcher, "scan_tuner", None) is not None:
+            self.dispatcher.scan_tuner.bind_codec(self._codec)
         # Composite commit plane (write/composite_commit.py): one per-worker
         # aggregator composing map commits into composite objects + fat
         # indexes. Registration is group-granular: the default seal callback

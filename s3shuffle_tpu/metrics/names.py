@@ -119,4 +119,9 @@ KNOWN_METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "codec_fused_crc_total": ("counter", ()),
     "codec_frames_total": ("counter", ()),
     "codec_assembly_seconds": ("histogram", ()),
+    # --- codec plane: read-side batched decode pipeline (codec/framing.py) ---
+    "codec_decode_batch_seconds": ("histogram", ()),
+    "codec_decode_bytes_total": ("counter", ()),
+    "codec_decode_inflight": ("gauge", ()),
+    "codec_fused_crc_validated_total": ("counter", ()),
 }

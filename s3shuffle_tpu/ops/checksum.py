@@ -235,6 +235,28 @@ def crc32_batch(blocks, lengths, poly: int = POLY_CRC32C, block_len: int | None 
     return (x ^ zero_crc[lengths]).astype(np.uint32)
 
 
+_host_crc32c_fn = None
+
+
+def host_crc(data, poly: int) -> int:
+    """Full-algorithm HOST CRC for the two supported reflected polynomials —
+    the small-slice companion of the fused device kernels (frame headers and
+    TLZ metadata prefixes get hashed here and stitched around the device
+    remainders with :func:`crc_combine`)."""
+    global _host_crc32c_fn
+    if poly == POLY_CRC32:
+        import zlib
+
+        return zlib.crc32(bytes(data)) & 0xFFFFFFFF
+    if poly == POLY_CRC32C:
+        if _host_crc32c_fn is None:
+            from s3shuffle_tpu.utils.checksums import _crc32c_fn
+
+            _host_crc32c_fn = _crc32c_fn()
+        return _host_crc32c_fn(bytes(data)) & 0xFFFFFFFF
+    raise ValueError(f"no host CRC for poly {poly:#x}")
+
+
 def zero_run_crcs(poly: int, length: int) -> np.ndarray:
     """Host-side fixup table: ``crc(0^n)`` for ``n in [0, length]`` (full
     init/final-xor semantics). Raw zero-init remainders from the device

@@ -7,13 +7,28 @@ partition's bytes; at every partition boundary the computed value is compared
 against the map task's stored checksum array and a mismatch raises (:68-86).
 A single ``read`` never crosses a partition boundary (:54-55); zero-length
 partitions are validated and skipped immediately (:79-82).
+
+**Deferred (certificate-driven) validation** is a TPU-first extension the
+codec layer opts into (:meth:`ChecksumValidationStream.defer_validation`):
+instead of hashing every served byte on the consumer thread, the stream
+retains references to served-but-uncertified chunks and the decode pipeline
+certifies them in order — ``certify(length, stored_crc=...)`` folds a frame's
+stored-byte CRC (computed FUSED inside the device decode launch) into the
+running value via ``crc_combine``, and ``certify(length)`` host-hashes the
+retained bytes (frames the launch didn't cover). The accumulated value is
+byte-for-byte the streaming value, partition boundaries validate with the
+identical :class:`ChecksumError`, and certificates that straddle a boundary
+degrade to retained-byte hashing — so corruption classifies exactly as it
+does under streaming validation (the PR-3 retry, coded-plane degraded-read,
+and elastic-fleet ``MapOutputLost`` paths all key off it).
 """
 
 from __future__ import annotations
 
 import io
 import time
-from typing import BinaryIO
+from collections import deque
+from typing import BinaryIO, Optional
 
 import numpy as np
 
@@ -55,24 +70,183 @@ class ChecksumValidationStream(io.RawIOBase):
         self._checksum = create_checksum(algorithm)
         self._pos_in_partition = 0
         self._hash_ns = 0  # checksum work accumulated since the last boundary
+        # deferred-validation state (armed by defer_validation)
+        self._deferred = False
+        self._retained: deque = deque()  # served-but-uncertified chunks
+        self._retained_bytes = 0
+        self._cert_reduce_id = start_reduce_id
+        self._cert_pos = 0
+        self._cert_crc = 0
+        self._cert_failed = False
         self._skip_empty_and_validate()
 
     def readable(self) -> bool:
         return True
 
+    # ------------------------------------------------------------------
+    # Deferred (certificate-driven) validation — the codec layer's surface
+    # ------------------------------------------------------------------
+    @property
+    def fused_poly(self) -> Optional[int]:
+        """The reflected CRC polynomial matching this stream's algorithm, or
+        None when the algorithm has no combinable CRC form (ADLER32)."""
+        from s3shuffle_tpu.ops.checksum import POLY_CRC32, POLY_CRC32C
+
+        return {"CRC32": POLY_CRC32, "CRC32C": POLY_CRC32C}.get(self._algorithm)
+
+    def defer_validation(self) -> bool:
+        """Switch to certificate-driven validation. Legal only at a frame
+        boundary before any byte has been served (the codec stream arms it at
+        construction). Returns False — and leaves streaming validation fully
+        active — when the algorithm has no combinable CRC form."""
+        if self.fused_poly is None:
+            return False
+        if self._pos_in_partition or self._retained:
+            return False  # mid-stream: keep the streaming contract intact
+        self._deferred = True
+        self._cert_reduce_id = self._reduce_id
+        self._cert_pos = 0
+        self._cert_crc = 0
+        return True
+
+    @property
+    def pending_uncertified(self) -> int:
+        """Bytes served to the codec layer but not yet certified."""
+        return self._retained_bytes
+
+    def certify(self, length: int, stored_crc: Optional[int] = None) -> None:
+        """Certify the next ``length`` served bytes, in order. With
+        ``stored_crc`` (a full-algorithm CRC of exactly those bytes — the
+        fused decode launch's per-frame value) the running value advances via
+        ``crc_combine`` and the retained bytes are dropped unhashed; without
+        it — or when the region straddles a partition boundary, where one
+        combined CRC cannot be split — the retained bytes are hashed exactly
+        as streaming validation would have. Partition boundaries validate the
+        moment certification completes them, raising the identical
+        :class:`ChecksumError` on mismatch."""
+        if not self._deferred:
+            raise RuntimeError("certify() on a non-deferred checksum stream")
+        if self._cert_failed:
+            # a partition already failed validation (the original
+            # ChecksumError is propagating to the consumer) — the stream is
+            # dead; re-validating with MORE bytes would manufacture a second,
+            # different computed value
+            return
+        from s3shuffle_tpu.ops.checksum import crc_combine, host_crc
+
+        poly = self.fused_poly
+        t0 = time.perf_counter_ns() if _metrics.enabled() else 0
+        while length > 0 and self._cert_reduce_id < self._end_reduce_id:
+            plen_rem = self._cert_partition_len() - self._cert_pos
+            if stored_crc is not None and length <= plen_rem:
+                self._cert_crc = crc_combine(
+                    self._cert_crc, stored_crc, length, poly
+                )
+                self._drop_retained(length)
+                self._cert_pos += length
+                length = 0
+            else:
+                # boundary-straddling certificate (or none): hash the
+                # retained bytes — the exact streaming work, same value
+                stored_crc = None
+                take = min(length, max(1, plen_rem))
+                data = self._take_retained(take)
+                if not data:
+                    break  # certificate exceeds served bytes — stream corrupt;
+                    # the boundary validation below (or the caller's own
+                    # error) reports it
+                self._cert_crc = crc_combine(
+                    self._cert_crc, host_crc(data, poly), len(data), poly
+                )
+                self._cert_pos += len(data)
+                length -= len(data)
+            if self._cert_pos >= self._cert_partition_len():
+                if _metrics.enabled():
+                    self._hash_ns += time.perf_counter_ns() - t0
+                    t0 = time.perf_counter_ns()
+                self._validate_cert()
+                self._cert_reduce_id += 1
+                self._cert_pos = 0
+                self._cert_crc = 0
+                self._skip_empty_cert()
+        if _metrics.enabled():
+            self._hash_ns += time.perf_counter_ns() - t0
+
+    def resolve_pending(self) -> None:
+        """Host-hash every served-but-uncertified byte through the validator
+        — the exact work streaming validation would have done at read time.
+        The codec layer calls this before propagating decode errors, so
+        corruption raises the SAME :class:`ChecksumError` it does under
+        streaming validation instead of a decoder parse error."""
+        if self._deferred and self._retained_bytes:
+            self.certify(self._retained_bytes)
+
+    # ------------------------------------------------------------------
+    def _cert_partition_len(self) -> int:
+        return int(
+            self._offsets[self._cert_reduce_id + 1]
+            - self._offsets[self._cert_reduce_id]
+        )
+
+    def _skip_empty_cert(self) -> None:
+        while (
+            self._cert_reduce_id < self._end_reduce_id
+            and self._cert_partition_len() == 0
+        ):
+            self._validate_cert()
+            self._cert_reduce_id += 1
+            self._cert_pos = 0
+            self._cert_crc = 0
+
+    def _validate_cert(self) -> None:
+        try:
+            self._raise_on_mismatch(
+                self._cert_reduce_id, self._cert_crc & 0xFFFFFFFF
+            )
+        except ChecksumError:
+            self._cert_failed = True
+            raise
+
+    def _take_retained(self, n: int) -> bytes:
+        parts = []
+        need = n
+        while need > 0 and self._retained:
+            chunk = self._retained.popleft()
+            if len(chunk) > need:
+                self._retained.appendleft(chunk[need:])
+                chunk = chunk[:need]
+            parts.append(chunk)
+            need -= len(chunk)
+        self._retained_bytes -= n - need
+        return parts[0] if len(parts) == 1 else b"".join(parts)
+
+    def _drop_retained(self, n: int) -> None:
+        need = n
+        while need > 0 and self._retained:
+            chunk = self._retained.popleft()
+            if len(chunk) > need:
+                self._retained.appendleft(chunk[need:])
+                need = 0
+            else:
+                need -= len(chunk)
+        self._retained_bytes -= n - need
+
+    # ------------------------------------------------------------------
     def _partition_len(self) -> int:
         return int(self._offsets[self._reduce_id + 1] - self._offsets[self._reduce_id])
 
     def _skip_empty_and_validate(self) -> None:
         # Zero-length partitions validate trivially and advance (scala :79-82).
+        # In deferred mode the CERT cursor owns validation; the read cursor
+        # only advances.
         while self._reduce_id < self._end_reduce_id and self._partition_len() == 0:
-            self._validate_current()
+            if not self._deferred:
+                self._validate_current()
             self._reduce_id += 1
             self._pos_in_partition = 0
 
-    def _validate_current(self) -> None:
-        expected = int(self._checksums[self._reduce_id]) & 0xFFFFFFFF
-        actual = self._checksum.value
+    def _raise_on_mismatch(self, reduce_id: int, actual: int) -> None:
+        expected = int(self._checksums[reduce_id]) & 0xFFFFFFFF
         if _metrics.enabled():
             _H_VALIDATE.observe(self._hash_ns / 1e9)
             self._hash_ns = 0
@@ -80,9 +254,12 @@ class ChecksumValidationStream(io.RawIOBase):
             _C_FAILURES.inc()
             raise ChecksumError(
                 f"Invalid checksum detected for {self._block.name} reduce partition "
-                f"{self._reduce_id} ({self._algorithm}): "
+                f"{reduce_id} ({self._algorithm}): "
                 f"expected {expected:#010x}, computed {actual:#010x}"
             )
+
+    def _validate_current(self) -> None:
+        self._raise_on_mismatch(self._reduce_id, self._checksum.value)
         self._checksum.reset()
 
     def read(self, size: int = -1) -> bytes:
@@ -95,7 +272,13 @@ class ChecksumValidationStream(io.RawIOBase):
         n = min(size, remaining)
         data = self._source.read(n) if n > 0 else b""
         if data:
-            if _metrics.enabled():
+            if self._deferred:
+                # hashing deferred to certification; hold the reference so a
+                # boundary-straddling certificate (or a decode failure) can
+                # still hash the exact bytes
+                self._retained.append(data)
+                self._retained_bytes += len(data)
+            elif _metrics.enabled():
                 t0 = time.perf_counter_ns()
                 self._checksum.update(data)
                 self._hash_ns += time.perf_counter_ns() - t0
@@ -103,7 +286,8 @@ class ChecksumValidationStream(io.RawIOBase):
                 self._checksum.update(data)
             self._pos_in_partition += len(data)
         if self._pos_in_partition >= self._partition_len():
-            self._validate_current()
+            if not self._deferred:
+                self._validate_current()
             self._reduce_id += 1
             self._pos_in_partition = 0
             self._skip_empty_and_validate()

@@ -365,6 +365,32 @@ class BufferedPrefetchIterator:
         return on_close
 
     # ------------------------------------------------------------------
+    # Shared-budget surface for the decode pipeline: in-flight DECODED bytes
+    # (CodecInputStream's async batch window) count against the SAME
+    # max_buffer_size_task budget as prefilled buffers, so N concurrent
+    # reduce tasks never exceed their provisioned memory. Reservation is
+    # NON-BLOCKING by design — the decode window shrinks instead of waiting,
+    # because the consumer doing the reserving is the same thread whose
+    # stream closes release prefill budget (a blocking wait could deadlock).
+    # ------------------------------------------------------------------
+    @property
+    def budget(self) -> "BufferedPrefetchIterator":
+        return self
+
+    def try_reserve(self, nbytes: int) -> bool:
+        """Reserve ``nbytes`` of the task budget if available RIGHT NOW."""
+        with self._lock:
+            if self._buffers_in_flight + nbytes > self._max_buffer_size:
+                return False
+            self._buffers_in_flight += nbytes
+            return True
+
+    def release_reserved(self, nbytes: int) -> None:
+        with self._lock:
+            self._buffers_in_flight -= nbytes
+            self._lock.notify_all()
+
+    # ------------------------------------------------------------------
     # Consumer side
     # ------------------------------------------------------------------
     def __iter__(self) -> "BufferedPrefetchIterator":

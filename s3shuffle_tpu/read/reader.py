@@ -306,9 +306,11 @@ class ShuffleReader:
             threads=stats["threads"],
         )
 
-    def _wrapped_stream(self, prefetched):
+    def _wrapped_stream(self, prefetched, budget=None):
         """checksum validation + codec decompression over one block stream —
-        the analog of ``serializerManager.wrapStream`` (:98-110)."""
+        the analog of ``serializerManager.wrapStream`` (:98-110). ``budget``
+        (the scan's prefetcher) lets the codec stream's async decode window
+        count its in-flight decoded bytes against ``max_buffer_size_task``."""
         cfg = self.dispatcher.config
         block = prefetched.block
         stream = prefetched
@@ -325,7 +327,7 @@ class ShuffleReader:
                 block, stream, offsets, checksums, start, end, cfg.checksum_algorithm
             )
         if self.codec is not None:
-            stream = CodecInputStream(self.codec, stream)
+            stream = CodecInputStream(self.codec, stream, budget=budget)
         return stream
 
     def _chunk_iterator(self, prefetcher):
@@ -339,8 +341,9 @@ class ShuffleReader:
         from s3shuffle_tpu.serializer import count_fallback_rows
 
         pending = 0
+        budget = getattr(prefetcher, "budget", None)
         for prefetched in prefetcher:
-            stream = self._wrapped_stream(prefetched)
+            stream = self._wrapped_stream(prefetched, budget=budget)
             try:
                 for chunk in self.dep.serializer.new_chunk_read_stream(stream):  # type: ignore[arg-type]
                     self.metrics.records_read += pending
@@ -364,8 +367,9 @@ class ShuffleReader:
         from s3shuffle_tpu.serializer import count_plane_rows
 
         prefetcher = self._make_prefetcher()
+        budget = getattr(prefetcher, "budget", None)
         for prefetched in prefetcher:
-            stream = self._wrapped_stream(prefetched)
+            stream = self._wrapped_stream(prefetched, budget=budget)
             try:
                 for batch in self.dep.serializer.new_batch_read_stream(stream):
                     self.metrics.records_read += batch.n

@@ -403,6 +403,12 @@ class CoalescedScanIterator:
     def stats(self) -> dict:
         return self._inner.stats
 
+    @property
+    def budget(self):
+        """The scan's shared memory budget (the inner prefetcher) — the
+        decode pipeline's in-flight decoded bytes reserve against it."""
+        return self._inner.budget
+
 
 # ---------------------------------------------------------------------------
 # Entry point
@@ -451,6 +457,10 @@ class _ObservedScanIterator:
     @property
     def stats(self) -> dict:
         return self._inner.stats
+
+    @property
+    def budget(self):
+        return getattr(self._inner, "budget", None)
 
 
 def build_scan_iterator(

@@ -218,18 +218,22 @@ class ScanTuner(_BaseTuner):
         "fetch_chunk_size": (1 * MiB, 32 * MiB),
         "coalesce_gap_bytes": (64 * 1024, 4 * MiB),
         "max_buffer_size_task": (16 * MiB, 256 * MiB),
+        "decode_batch_frames": (4, 128),
+        "decode_inflight_batches": (1, 8),
     }
 
     def __init__(self, cfg):
+        self._codecs: List[object] = []
         knobs: List[_TunedKnob] = []
 
-        def add(field: str, static: int, dense_head: bool = False) -> None:
+        def add(field: str, static: int, dense_head: bool = False, apply=None) -> None:
             lo, hi = self.CLAMPS[field]
             knobs.append(_TunedKnob(
                 field,
                 self._controller(
                     _ladder_with(lo, hi, static, dense_head), static, field, cfg
                 ),
+                apply=apply,
             ))
 
         if cfg.fetch_parallelism > 1:  # <= 1 = chunked fetch disabled
@@ -237,6 +241,19 @@ class ScanTuner(_BaseTuner):
             add("fetch_chunk_size", cfg.fetch_chunk_size)
         if cfg.coalesce_gap_bytes > 0:  # 0 = scan planner disabled
             add("coalesce_gap_bytes", cfg.coalesce_gap_bytes)
+        # read-side decode pipeline (CodecInputStream reads both attributes
+        # LIVE per batch, so apply hooks retarget bound codecs mid-stream);
+        # plane-off statics (<= 1) are never overruled
+        if getattr(cfg, "decode_batch_frames", 0) > 1:
+            add(
+                "decode_batch_frames", cfg.decode_batch_frames,
+                apply=self._apply_decode_batch_frames,
+            )
+        if getattr(cfg, "decode_inflight_batches", 0) > 1:
+            add(
+                "decode_inflight_batches", cfg.decode_inflight_batches,
+                dense_head=True, apply=self._apply_decode_window,
+            )
         # max_buffer_size_task is a MEMORY CAP, not a request-shape knob: the
         # operator's static value is the ceiling (N concurrent reduce tasks
         # each provisioned at the configured budget must never see the tuner
@@ -258,6 +275,36 @@ class ScanTuner(_BaseTuner):
                 "storage_read_bytes_total",
             ),
         )
+
+    # ------------------------------------------------------------------
+    def bind_codec(self, codec) -> None:
+        """Register a codec whose ``decode_batch_frames`` /
+        ``decode_inflight_batches`` attributes this tuner retunes.
+        CodecInputStream reads both live at every batch boundary, so a
+        retune applies mid-stream to every open read."""
+        if codec is None:
+            return
+        current: Dict[str, int] = {}
+        with self._lock:
+            if codec not in self._codecs:
+                self._codecs.append(codec)
+            for knob in self._knobs:
+                if knob.field in ("decode_batch_frames", "decode_inflight_batches"):
+                    current[knob.field] = knob.controller.current
+        for field, value in current.items():
+            setattr(codec, field, value)
+
+    def _apply_decode_batch_frames(self, value: int) -> None:
+        with self._lock:
+            codecs = list(self._codecs)
+        for codec in codecs:
+            codec.decode_batch_frames = value
+
+    def _apply_decode_window(self, value: int) -> None:
+        with self._lock:
+            codecs = list(self._codecs)
+        for codec in codecs:
+            codec.decode_inflight_batches = value
 
     # ------------------------------------------------------------------
     def tuned(self, cfg):

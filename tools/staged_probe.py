@@ -120,6 +120,30 @@ def main() -> int:
     emit(step="tlz_encode_fused_warm", wall_s=round(dt, 3),
          tlz_dev_encode_fused_mb_s=round(len(blob) / 1e6 / max(dt, 1e-9), 2),
          fused_crc_matches_host=bool(fused_ok))
+
+    # fused decode+CRC: one launch returns the decoded blocks AND each
+    # payload's stored-byte CRC32C (the read pipeline's validation
+    # certificate — ops/tlz.py decode_batch_device(poly=...)). Cross-checked
+    # against the host CRC of the payload bytes, so a window that closes
+    # right after still logged proof the fused decode certifies true
+    # checksums over real encoded data.
+    dec_payloads = [bytes(p) for p in payloads]
+    t0 = time.time()
+    dec_blocks, dec_crcs = tlz.decode_batch_device(
+        dec_payloads, [bs] * 4, bs, batch_rows=4, poly=POLY_CRC32C)
+    emit(step="tlz_decode_fused_compile_and_run", wall_s=round(time.time() - t0, 1))
+    t0 = time.time()
+    dec_blocks, dec_crcs = tlz.decode_batch_device(
+        dec_payloads, [bs] * 4, bs, batch_rows=4, poly=POLY_CRC32C)
+    dt = time.time() - t0
+    dec_fused_ok = all(
+        dec_crcs[i] is not None and int(dec_crcs[i]) == crc32c_py(dec_payloads[i])
+        for i in range(4)
+    )
+    emit(step="tlz_decode_fused_warm", wall_s=round(dt, 3),
+         tlz_dev_decode_fused_mb_s=round(len(blob) / 1e6 / max(dt, 1e-9), 2),
+         fused_crc_matches_host=bool(dec_fused_ok),
+         roundtrip_ok=bool(b"".join(dec_blocks) == blob))
     emit(step="done")
     return 0
 
