@@ -1785,6 +1785,298 @@ def coded_read_gain(
     }
 
 
+#: value columns of the skew probe's aggregation rows (all "sum"): wide
+#: rows keep the workload byte-heavy per row, so the measured reduce walls
+#: stay transfer-bound instead of argsort-bound
+SKEW_VAL_COLS = 4
+_SKEW_ROW_B = 8 + 8 * SKEW_VAL_COLS
+
+
+def _skew_rows(n_maps, parts, base_bytes, dup_bytes, bulk_bytes, hot_keys, seed):
+    """Per-map RecordBatches for the skew probe: uniform background rows
+    plus TWO hot shapes on distinct partitions — hot-by-DUPLICATES (few
+    distinct keys, collapsible by map-side combine) and hot-by-VOLUME
+    (unique keys, only read fan-out helps). Returns (batches, pid_dup,
+    pid_bulk). Keys are 8-byte big-endian ints, values SKEW_VAL_COLS LE
+    int64 columns (the ColumnarAggregator sum-row shape) — wide rows keep
+    the probe I/O-bound (row-count CPU out of the measured walls)."""
+    import numpy as np
+
+    from s3shuffle_tpu.batch import RecordBatch
+    from s3shuffle_tpu.dependency import BytesHashPartitioner
+
+    part_fn = BytesHashPartitioner(parts)
+
+    def key_bytes(ints):
+        return np.ascontiguousarray(
+            np.asarray(ints, dtype=np.int64), dtype=">i8"
+        ).view(np.uint8).reshape(-1)
+
+    def batch_of(key_ints, val_ints):
+        n = len(key_ints)
+        vals = np.ones((n, SKEW_VAL_COLS), dtype="<i8")
+        vals[:, 0] = np.asarray(val_ints, dtype="<i8")
+        return RecordBatch.from_fixed(
+            n, 8, 8 * SKEW_VAL_COLS,
+            key_bytes(key_ints),
+            np.ascontiguousarray(vals).view(np.uint8).reshape(-1),
+        )
+
+    def pid_of(i: int) -> int:
+        import struct as _struct
+
+        return part_fn(_struct.pack(">q", i))
+
+    # two distinct hot partitions, found by probing small ints
+    pid_dup = pid_of(1)
+    pid_bulk, probe = pid_dup, 2
+    while pid_bulk == pid_dup:
+        pid_bulk = pid_of(probe)
+        probe += 1
+    # hot_keys distinct keys all hashing to pid_dup
+    dup_keys, i = [], 1 << 20
+    while len(dup_keys) < hot_keys:
+        if pid_of(i) == pid_dup:
+            dup_keys.append(i)
+        i += 1
+    rng = np.random.default_rng(seed)
+    batches = []
+    for m in range(n_maps):
+        rows_k: list = []
+        rows_v: list = []
+        # uniform background: unique keys spread over every partition
+        n_uniform = max(1, parts * base_bytes // _SKEW_ROW_B)
+        uni = rng.integers(1 << 40, 1 << 50, size=n_uniform)
+        rows_k.append(uni)
+        rows_v.append(np.ones(n_uniform, dtype=np.int64))
+        # hot-by-duplicates: dup_bytes of rows cycling hot_keys keys
+        n_dup = max(1, dup_bytes // _SKEW_ROW_B)
+        rows_k.append(np.asarray(dup_keys, dtype=np.int64)[
+            np.arange(n_dup) % hot_keys
+        ])
+        rows_v.append(np.ones(n_dup, dtype=np.int64))
+        # hot-by-volume: unique keys filtered onto pid_bulk (vectorized
+        # rejection: candidates hash ~uniformly, keep ~1/parts of them)
+        n_bulk = max(1, bulk_bytes // _SKEW_ROW_B)
+        kept: list = []
+        total = 0
+        while total < n_bulk:
+            cand = rng.integers(1 << 50, 1 << 60, size=n_bulk * parts // 2)
+            pids = part_fn.partition_batch(batch_of(cand, np.zeros(len(cand))))
+            sel = cand[np.asarray(pids) == pid_bulk]
+            kept.append(sel)
+            total += len(sel)
+        bulk = np.concatenate(kept)[:n_bulk]
+        rows_k.append(bulk)
+        rows_v.append(np.ones(n_bulk, dtype=np.int64))
+        batches.append(batch_of(np.concatenate(rows_k), np.concatenate(rows_v)))
+    return batches, pid_dup, pid_bulk
+
+
+def skew_mitigation_gain(
+    n_maps: int = 3,
+    parts: int = 8,
+    base_bytes: int = 4096,
+    dup_bytes: int = 2 << 20,
+    bulk_bytes: int = 4 << 20,
+    hot_keys: int = 8,
+    mib_s: float = 32.0,
+    hot_fanout: int = 6,
+):
+    """Skew-plane probe: the extended ``skew`` scenario. One aggregating
+    shuffle with two hot shapes (fat-by-duplicates and fat-by-volume
+    partitions, the `_autotune_sizes` skew shape made aggregation-real) is
+    reduced by ``parts`` CONCURRENT reduce tasks against a per-connection
+    bandwidth-capped store (BandwidthRule — parallel ranged GETs scale,
+    like real S3 connections). Mitigated (combine sidecar + hot-partition
+    split + coded read fan-out) vs unmitigated (all three knobs 0) over the
+    IDENTICAL record multiset; byte-identical aggregated output asserted.
+    Records per-reduce-task wall p50/p99 and per-object GET concurrency —
+    the two signals the ROADMAP names for this scenario. Rounds are
+    INTERLEAVED across the two modes and each task's wall is best-of-rounds
+    (the run_comparison methodology), so process-wide drift and cold-start
+    noise cancel instead of landing on one mode."""
+    import numpy as np
+
+    from s3shuffle_tpu.colagg import ColumnarAggregator
+    from s3shuffle_tpu.config import ShuffleConfig
+    from s3shuffle_tpu.dependency import BytesHashPartitioner, ShuffleDependency
+    from s3shuffle_tpu.manager import ShuffleManager
+    from s3shuffle_tpu.metrics import registry as mreg
+    from s3shuffle_tpu.serializer import ColumnarKVSerializer
+    from s3shuffle_tpu.skew import OBJECT_GETS
+    from s3shuffle_tpu.storage.dispatcher import Dispatcher
+    from s3shuffle_tpu.storage.fault import BandwidthRule, FlakyBackend
+
+    metrics_were_on = mreg.enabled()
+    try:
+        mreg.enable()
+        batches, pid_dup, pid_bulk = _skew_rows(
+            n_maps, parts, base_bytes, dup_bytes, bulk_bytes, hot_keys, seed=47
+        )
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        class _Mode:
+            def __init__(self, tag, overrides):
+                cfg = ShuffleConfig(
+                    root_dir=f"memory://bench-skew-{tag}-{_autotune_cell_seq[0]}",
+                    app_id=f"skew-{tag}",
+                    codec="none",  # the probe measures the skew plane, not
+                    # compression (a codec would collapse the duplicate-hot
+                    # partition on its own and blur the combine prong's win)
+                    parity_segments=1, parity_stripe_k=1,
+                    parity_chunk_bytes=256 * 1024,
+                    columnar_batch_rows=4096,
+                    # straggler speculation off: the probe isolates the
+                    # three SKEW prongs (coded_read_gain measures the
+                    # straggler race)
+                    speculative_read_quantile=0.0,
+                    **overrides,
+                )
+                from s3shuffle_tpu.metadata.helper import ShuffleHelper  # noqa: F401
+
+                self.mgr = ShuffleManager(
+                    cfg, dispatcher=Dispatcher(cfg)  # private, never the singleton
+                )
+                dep = ShuffleDependency(
+                    shuffle_id=0,
+                    partitioner=BytesHashPartitioner(parts),
+                    serializer=ColumnarKVSerializer(),
+                    aggregator=ColumnarAggregator(("sum",) * SKEW_VAL_COLS),
+                )
+                self.handle = self.mgr.register_shuffle(0, dep)
+                for m, batch in enumerate(batches):
+                    w = self.mgr.get_writer(self.handle, map_id=m)
+                    w.write(batch)
+                    w.stop(success=True)
+                # bandwidth cap attached AFTER the writes: the probe
+                # measures the reduce plane
+                flaky = FlakyBackend(self.mgr.dispatcher.backend)
+                flaky.add_latency(
+                    BandwidthRule("read", match=".data", mib_s=mib_s)
+                )
+                self.mgr.dispatcher.backend = flaky
+                self.best = [float("inf")] * parts
+                self.out = None
+                self.peaks = {f"map{m}": 0 for m in range(n_maps)}
+
+            def run_round(self):
+                OBJECT_GETS.reset_peaks()  # rounds run one mode at a time
+                walls = [0.0] * parts
+                outs: list = [None] * parts
+
+                def reduce_task(rid):
+                    # the columnar terminal (what production reduce
+                    # consumers ride): the timed window covers scan +
+                    # vectorized combine
+                    t0 = time.perf_counter()
+                    result = self.mgr.get_reader(
+                        self.handle, rid, rid + 1
+                    ).read_result_batches()
+                    walls[rid] = time.perf_counter() - t0
+                    outs[rid] = result
+
+                with ThreadPoolExecutor(max_workers=parts) as pool:
+                    list(pool.map(reduce_task, range(parts)))
+                self.best = [min(a, b) for a, b in zip(self.best, walls)]
+                for m in range(n_maps):
+                    self.peaks[f"map{m}"] = max(
+                        self.peaks[f"map{m}"],
+                        OBJECT_GETS.peak(f"shuffle_0_{m}_0.data"),
+                    )
+                # identity canonicalization AFTER every timed window closed
+                # (iter_records over 100Ks of rows is GIL-heavy — inside a
+                # finished task it would tax a sibling still being timed)
+                out = [
+                    {k: bytes(v) for b in result for k, v in b.iter_records()}
+                    for result in outs
+                ]
+                if self.out is None:
+                    self.out = out
+                else:
+                    assert out == self.out, "round output drifted"
+
+        _autotune_cell_seq[0] += 1
+        mreg.REGISTRY.reset_values()  # write-side counters (combine rows,
+        # partition splits) accrue during mode construction below
+        unmit = _Mode(
+            "off",
+            dict(combine_threshold_bytes=0, split_threshold_bytes=0,
+                 hot_read_fanout=0),
+        )
+        mit = _Mode(
+            "on",
+            dict(combine_threshold_bytes=64 * 1024,
+                 split_threshold_bytes=256 * 1024,
+                 hot_read_fanout=hot_fanout),
+        )
+        for _round in range(3):  # interleaved: drift lands on both modes
+            unmit.run_round()
+            mit.run_round()
+        unmit_walls, mit_walls = unmit.best, mit.best
+        identical = mit.out == unmit.out
+        unmit_peaks, mit_peaks = unmit.peaks, mit.peaks
+        snap = mreg.REGISTRY.snapshot(compact=True)
+
+        def counter(name):
+            return sum(
+                s.get("value", 0)
+                for s in snap.get(name, {}).get("series", [])
+            )
+
+        counters = {
+            "combine_rows": counter("shuffle_map_combine_rows_total"),
+            "splits": counter("shuffle_partition_splits_total"),
+            "fanout_reads": counter("shuffle_hot_fanout_reads_total"),
+        }
+
+        def pctl(walls, q):
+            return float(np.percentile(np.asarray(walls), q))
+
+        record = {
+            "skew_mitigation_gain": round(
+                pctl(unmit_walls, 99) / max(pctl(mit_walls, 99), 1e-9), 2
+            ),
+            "skew_p99_unmitigated_s": round(pctl(unmit_walls, 99), 4),
+            "skew_p99_mitigated_s": round(pctl(mit_walls, 99), 4),
+            "skew_p50_unmitigated_s": round(pctl(unmit_walls, 50), 4),
+            "skew_p50_mitigated_s": round(pctl(mit_walls, 50), 4),
+            "skew_byte_identical": identical,
+            "skew_combine_rows": int(counters["combine_rows"]),
+            "skew_partition_splits": int(counters["splits"]),
+            "skew_hot_fanout_reads": int(counters["fanout_reads"]),
+            "skew_peak_object_gets_unmitigated": max(unmit_peaks.values()),
+            "skew_peak_object_gets_mitigated": max(mit_peaks.values()),
+            "skew_reduce_tasks": parts,
+            "skew_bandwidth_mib_s": mib_s,
+        }
+    except Exception as e:  # never fail the bench over this row
+        return {"skew_mitigation_error": str(e)[:160]}
+    finally:
+        if not metrics_were_on:
+            mreg.disable()
+            mreg.REGISTRY.reset_values()
+        Dispatcher.reset()
+    return record
+
+
+def skew_plane_knobs():
+    """The skew-plane knobs the headline runs used (ShuffleConfig
+    defaults) — recorded so BENCH rounds stay comparable when a default
+    moves."""
+    from s3shuffle_tpu.config import ShuffleConfig
+
+    cfg = ShuffleConfig()
+    return {
+        "skew_plane": {
+            "combine_threshold_bytes": cfg.combine_threshold_bytes,
+            "split_threshold_bytes": cfg.split_threshold_bytes,
+            "hot_read_fanout": cfg.hot_read_fanout,
+        }
+    }
+
+
 def _elastic_agent_main(coordinator, cfg_dict, worker_id, heartbeat_s):
     """WorkerAgent entry for the elasticity probe's fleet (module-level:
     spawn pickles the target by name). Fast heartbeats — the probe runs a
@@ -2915,6 +3207,7 @@ def main():
         **composite_write_gain(),
         **columnar_gain(),
         **coded_read_gain(),
+        **skew_mitigation_gain(),
         **device_codec_gain(),
         **device_decode_gain(),
         **autotune_gain(),
@@ -2924,6 +3217,7 @@ def main():
         **record_plane_knobs(),
         **scan_planner_knobs(),
         **coded_plane_knobs(),
+        **skew_plane_knobs(),
         **elastic_fleet_knobs(),
         **composite_plane_knobs(),
         **device_codec_knobs(),

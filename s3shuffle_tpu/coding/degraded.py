@@ -161,6 +161,14 @@ class DegradedReader:
         geom = entry[1]
         return geom.segments >= min(geom.stripe_k, max(1, geom.n_chunks))
 
+    def geometry_of(self, data_block) -> Optional[ParityGeometry]:
+        name = getattr(data_block, "name", None)
+        if name is None:
+            return None
+        with self._lock:
+            entry = self._geoms.get(name)
+        return None if entry is None else entry[1]
+
     def __bool__(self) -> bool:
         with self._lock:
             return bool(self._geoms)
@@ -444,22 +452,40 @@ class SpeculativeFetcher:
     the requested budget covers the whole range (the buffer is then complete
     — the abandoned primary GET can never corrupt a later cursor read).
 
-    The threshold is SIZE-AWARE: the configured quantile is taken from the
-    ``read_prefetch_fill_class_seconds`` series matching the prefill's
-    size class (read/prefetch.py buckets every observed fill the same
-    way), resolved once per (scan, class) and only once that class has at
-    least :data:`MIN_FILL_SAMPLES` samples — cold processes and unseen
-    size classes never speculate on noise. The raw un-classed quantile the
-    plane shipped with armed spurious races on healthy LARGE coalesced
-    segments: a 64 MiB fill judged against a p99 dominated by small-block
-    fills always looks like a straggler."""
+    The threshold is SIZE-AWARE twice over: the configured quantile is
+    taken from the ``read_prefetch_fill_per_mib_seconds`` series matching
+    the prefill's size class (read/prefetch.py buckets every observed fill
+    the same way) and scaled back by the prefill's OWN size in MiB (floored
+    at 1 — sub-MiB fills keep absolute-seconds semantics). Per-class
+    quantiles fixed the cross-class bug (a 64 MiB fill judged against a
+    p99 dominated by 100 KiB fills always looked like a straggler); the
+    per-MiB normalization fixes the WITHIN-class remainder — a class spans
+    an 8x size range, so a healthy fill at its large end still cleared a
+    raw-seconds class quantile dominated by its small end. Each class's
+    quantile is resolved once per scan and only once it has at least
+    :data:`MIN_FILL_SAMPLES` samples — cold processes and unseen size
+    classes never speculate on noise.
 
-    def __init__(self, recovery: DegradedReader, quantile: float, width: int = 4):
+    ``hot_fanout`` arms the skew plane's third prong: when the prefill's
+    data object already has >= that many REAL GETs in flight (the
+    process-wide tracker in s3shuffle_tpu/skew.py), the read skips the
+    queue entirely and reconstructs from parity-equivalent sources —
+    degraded reads as LOAD BALANCING, spreading a hot object's demand
+    across its parity sidecars instead of stacking on one object."""
+
+    def __init__(
+        self,
+        recovery: DegradedReader,
+        quantile: float,
+        width: int = 4,
+        hot_fanout: int = 0,
+    ):
         self.recovery = recovery
         self.quantile = float(quantile)
         self.width = max(1, int(width))
-        #: size-class label -> resolved threshold (None = never speculate
-        #: for that class this scan)
+        self.hot_fanout = max(0, int(hot_fanout))
+        #: size-class label -> resolved per-MiB quantile (None = never
+        #: speculate for that class this scan)
         self._thresholds: Dict[str, Optional[float]] = {}
 
     def eligible(self, stream, bsize: int) -> bool:
@@ -470,24 +496,27 @@ class SpeculativeFetcher:
 
     def threshold_s(self, bsize: int = 0) -> Optional[float]:
         """The race-arming threshold for a prefill of ``bsize`` bytes —
-        the quantile of ITS size class's observed fill latencies."""
-        from s3shuffle_tpu.read.prefetch import fill_size_class
+        its size class's per-MiB fill quantile, scaled by its own size."""
+        from s3shuffle_tpu.read.prefetch import fill_norm_mib, fill_size_class
 
         cls = fill_size_class(int(bsize))
         if cls not in self._thresholds:
-            threshold = None
+            per_mib = None
             if 0.0 < self.quantile < 1.0 and _metrics.enabled():
                 hist = _metrics.REGISTRY.histogram(
-                    "read_prefetch_fill_class_seconds",
+                    "read_prefetch_fill_per_mib_seconds",
                     labelnames=("size_class",),
                 )
                 snap = hist.labels(size_class=cls).read()
                 if snap.count >= MIN_FILL_SAMPLES:
                     value = snap.percentile(self.quantile)
                     if value > 0.0:
-                        threshold = value
-            self._thresholds[cls] = threshold
-        return self._thresholds[cls]
+                        per_mib = value
+            self._thresholds[cls] = per_mib
+        per_mib = self._thresholds[cls]
+        if per_mib is None:
+            return None
+        return per_mib * fill_norm_mib(int(bsize))
 
     def prefill(self, stream, bsize: int, primary):
         """Run ``primary`` (the normal prefill) with a reconstruction race
@@ -500,6 +529,10 @@ class SpeculativeFetcher:
         when stragglers are sustained), and primary-won fills should
         observe ``primary_exec_s`` — the GET's own execution time, pool
         queue wait excluded — for the same reason."""
+        if self.hot_fanout > 0:
+            hot = self._hot_fanout_prefill(stream)
+            if hot is not None:
+                return hot, True, None
         threshold = self.threshold_s(bsize)
         if threshold is None:
             return primary(), False, None
@@ -542,3 +575,35 @@ class SpeculativeFetcher:
                 abandon(future)
             return data, True, exec_s[0]
         return future.result(), False, exec_s[0]
+
+    def _hot_fanout_prefill(self, stream) -> Optional[bytes]:
+        """The skew plane's coded read fan-out: when the stream's data
+        object already has ``hot_fanout`` real GETs in flight, serve this
+        range from parity-equivalent sources instead of queueing on the
+        hot object. Returns the reconstructed bytes, or None to take the
+        normal path (object not hot, or reconstruction fell short — the
+        primary GET is always the safe fallback). Diverted reads never
+        enter the object's in-flight count (skew.tracked_get wraps only
+        REAL GETs), so the gate cannot feed back on its own diversions."""
+        from s3shuffle_tpu.skew import C_HOT_FANOUT_READS, OBJECT_GETS
+
+        name = getattr(getattr(stream, "data_block", None), "name", None)
+        if name is None or OBJECT_GETS.inflight(name) < self.hot_fanout:
+            return None
+        geom = self.recovery.geometry_of(stream.data_block)
+        if geom is None or (
+            stream.end_offset - stream.start_offset < geom.chunk_bytes
+        ):
+            # sub-chunk ranges never divert: parity I/O is chunk-granular,
+            # so offloading a tiny read would READ MORE from the parity
+            # object than the primary would have moved — amplification,
+            # not load balancing. The split prong's sub-range parts are
+            # sized >= one chunk exactly so they stay eligible.
+            return None
+        data = self.recovery.reconstruct(
+            stream.data_block, stream.start_offset, stream.end_offset,
+            reason="hot_fanout",
+        )
+        if data is not None and _metrics.enabled():
+            C_HOT_FANOUT_READS.inc()
+        return data

@@ -324,13 +324,12 @@ def split_index_geometry(words: np.ndarray):
     The trailer is recognized by ``GEOMETRY_MAGIC`` at position
     ``-TRAILER_WORDS`` — a cumulative byte offset can never reach that value
     (~6.0e18 bytes), so parity-less indexes (including every
-    reference-written one) pass through untouched."""
-    if len(words) >= TRAILER_WORDS + 2 and int(words[-TRAILER_WORDS]) == GEOMETRY_MAGIC:
-        offsets = words[:-TRAILER_WORDS]
-        return offsets, ParityGeometry(
-            segments=int(words[-3]),
-            stripe_k=int(words[-2]),
-            chunk_bytes=int(words[-1]),
-            payload_len=int(offsets[-1]),
-        )
-    return words, None
+    reference-written one) pass through untouched. Since the skew plane a
+    blob may also carry a skew trailer BEFORE the geometry words; this
+    helper delegates to the combined parser (s3shuffle_tpu/skew.py) and
+    drops the skew half, so geometry-only consumers (the compactor's parity
+    re-point, tests) keep their historical signature."""
+    from s3shuffle_tpu.skew import split_index_trailers
+
+    offsets, geometry, _skew = split_index_trailers(words)
+    return offsets, geometry

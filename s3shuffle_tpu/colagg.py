@@ -121,27 +121,44 @@ class ColumnarReducer:
         out._kw, out._vw = batch._kw, self.value_width
         return out
 
-    def add(self, batch: RecordBatch) -> None:
-        if batch.n == 0:
-            return
+    def _coerce(self, batch: RecordBatch) -> RecordBatch:
+        """Validate value widths and widen declared narrow rows to the wide
+        int64 combiner representation — the shared entry check of both the
+        stateful :meth:`add` path and the one-shot :meth:`reduce_chunk`."""
         if batch.vlens.size and not (batch.vlens == self.value_width).all():
             if (
                 self._val_dtypes is not None
                 and (batch.vlens == self._narrow_width).all()
             ):
-                batch = self._widen(batch)
-            else:
-                raise ValueError(
-                    f"columnar aggregation requires fixed {self.value_width}-byte "
-                    f"values ({self.ncols} int64 columns"
-                    + (
-                        f") or the declared {self._narrow_width}-byte narrow "
-                        f"schema {self._val_dtypes}"
-                        if self._val_dtypes is not None
-                        else ""
-                    )
-                    + "; got ragged/mismatched vlens"
+                return self._widen(batch)
+            raise ValueError(
+                f"columnar aggregation requires fixed {self.value_width}-byte "
+                f"values ({self.ncols} int64 columns"
+                + (
+                    f") or the declared {self._narrow_width}-byte narrow "
+                    f"schema {self._val_dtypes}"
+                    if self._val_dtypes is not None
+                    else ""
                 )
+                + "; got ragged/mismatched vlens"
+            )
+        return batch
+
+    def reduce_chunk(self, batch: RecordBatch) -> RecordBatch:
+        """One-shot in-memory reduce of a single batch: argsort + reduceat
+        over just these rows, touching NO pending/spill state. The skew
+        plane's map-side combine sidecar (write/spill_writer.py) runs hot
+        partitions' chunks through this before they hit the wire — output
+        rows are sorted unique-key WIDE partials, exactly the shape the
+        reduce-side merge already accepts mixed with raw rows."""
+        if batch.n == 0:
+            return batch
+        return self._reduce(self._coerce(batch))
+
+    def add(self, batch: RecordBatch) -> None:
+        if batch.n == 0:
+            return
+        batch = self._coerce(batch)
         self._pending.append(batch)
         self._pending_bytes += batch.nbytes
         if self._pending_bytes >= self._spill_bytes:

@@ -135,6 +135,30 @@ class ShuffleConfig:
     # parity reconstruction and take whichever finishes first. 0 disables
     # speculation (loss reconstruction stays active regardless).
     speculative_read_quantile: float = 0.99
+    # --- skew mitigation plane (TPU-first addition; the reference has no
+    # hot-key story — a fat partition serializes on one ranged GET and hot
+    # aggregations ship every raw row. Coded TeraSort/MapReduce, PAPERS.md) ---
+    # map-side combine sidecar: partitions whose routed bytes cross this
+    # threshold get their chunks pre-reduced with the columnar combine
+    # INSIDE the map task (aggregating deps with a columnar aggregator and
+    # reduce-side combine only), so hot partitions ship partial aggregates;
+    # the output is flagged in the index sidecar. 0 disables the prong
+    # entirely and keeps the shipped rows byte-identical to the pre-skew
+    # wire (the coalesce_gap_bytes=0 contract).
+    combine_threshold_bytes: int = 0
+    # hot-partition splitting: a partition whose committed size crosses this
+    # threshold has a stripe granularity (= the threshold) recorded in its
+    # index sidecar / fat-index v3 header; the scan planner then fans the
+    # partition out as independent sub-range GETs across the prefetch pool.
+    # 0 disables the prong (no trailer, unsplit reads, op-for-op).
+    split_threshold_bytes: int = 0
+    # coded read fan-out: when a data object's LIVE in-process GET
+    # concurrency reaches this count, further eligible reads of it
+    # reconstruct from parity-equivalent sources (different objects) instead
+    # of queueing on the hot one — the degraded-read plane as load
+    # balancing. Needs parity coverage (parity_segments >= stripe real-chunk
+    # count) to ever engage. 0 disables the prong.
+    hot_read_fanout: int = 0
     # --- columnar record plane (TPU-first addition; the reference moves
     # records through per-record JVM serializer streams — SURVEY.md §3.2) ---
     # 1 = columnar serializers emit the self-describing COLUMN-FRAME wire
@@ -319,6 +343,15 @@ class ShuffleConfig:
             raise ValueError("parity_chunk_bytes must be >= 1")
         if not (0.0 <= self.speculative_read_quantile < 1.0):
             raise ValueError("speculative_read_quantile must be in [0, 1)")
+        if (
+            self.combine_threshold_bytes < 0
+            or self.split_threshold_bytes < 0
+            or self.hot_read_fanout < 0
+        ):
+            raise ValueError(
+                "combine_threshold_bytes / split_threshold_bytes / "
+                "hot_read_fanout must be >= 0"
+            )
         if self.codec_batch_blocks < 1:
             raise ValueError("codec_batch_blocks must be >= 1")
         if self.encode_inflight_batches < 0:

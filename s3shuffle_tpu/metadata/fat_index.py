@@ -39,11 +39,17 @@ _WIRE_STRUCTS = ("fat_index",)
 #: v2 appends four header words ``[parity_segments, parity_stripe_k,
 #: parity_chunk_bytes, payload_len]`` — the composite data object's stripe
 #: geometry for the coded shuffle plane (all zero when uncoded); v1 blobs
-#: still parse (geometry defaults to none).
+#: still parse (geometry defaults to none). v3 (the skew plane) appends a
+#: ``split_bytes`` header word and widens member rows to 4 words
+#: ``[map_id, map_index, base_offset, flags]`` — it is emitted ONLY when a
+#: skew prong engaged (split recorded or a combined member), so zero-skew
+#: groups keep writing v2 byte-identically.
 _MAGIC = 0x5333464154494458
-_VERSION = 2
+_VERSION = 3
 _HEADER_V1 = 7
 _HEADER_V2 = 11
+_HEADER_V3 = 12
+_MEMBER_WORDS_V3 = 4
 
 
 @dataclasses.dataclass
@@ -57,6 +63,9 @@ class FatIndexMember:
     offsets: np.ndarray
     #: per-partition checksum values, or None when checksums were disabled
     checksums: Optional[np.ndarray] = None
+    #: the member's partitions carry map-side-combined partial rows (the
+    #: skew plane's combine sidecar — readers merge through the aggregator)
+    combined: bool = False
 
     @property
     def total_bytes(self) -> int:
@@ -73,11 +82,13 @@ class FatIndex:
         num_partitions: int,
         members: List[FatIndexMember],
         parity=None,  # coding.parity.ParityGeometry of the composite object
+        split_bytes: int = 0,  # skew plane: hot-partition stripe granularity
     ):
         self.shuffle_id = int(shuffle_id)
         self.group_id = int(group_id)
         self.num_partitions = int(num_partitions)
         self.parity = parity
+        self.split_bytes = int(split_bytes)
         self.members: Dict[int, FatIndexMember] = {}
         for m in members:
             if len(m.offsets) != self.num_partitions + 1:
@@ -103,28 +114,44 @@ class FatIndex:
     def to_bytes(self) -> bytes:
         """``[magic, version, shuffle_id, group_id, num_partitions,
         n_members, has_checksums, parity_segments, parity_stripe_k,
-        parity_chunk_bytes, payload_len]`` then ``n_members`` member rows
-        of ``[map_id, map_index, base_offset]``, then ``n_members`` offset
-        rows of ``num_partitions + 1`` words, then (when has_checksums)
-        ``n_members`` checksum rows of ``num_partitions`` words."""
+        parity_chunk_bytes, payload_len]`` (+ ``split_bytes`` in v3) then
+        ``n_members`` member rows of ``[map_id, map_index, base_offset]``
+        (+ ``flags`` in v3), then ``n_members`` offset rows of
+        ``num_partitions + 1`` words, then (when has_checksums)
+        ``n_members`` checksum rows of ``num_partitions`` words.
+
+        v3 is emitted ONLY when a skew prong engaged (``split_bytes > 0``
+        or a combined member): a zero-skew group writes the v2 shape
+        byte-identically — the combine/split off switches keep the wire
+        exactly the pre-skew-plane bytes, and a blob parsed from v2 round-
+        trips unchanged (the golden writer-stability pin)."""
+        from s3shuffle_tpu.skew import FLAG_COMBINED
+
         members = list(self.members.values())
         p = self.num_partitions
         has_ck = 1 if self.has_checksums else 0
         par = self.parity
-        header = np.array(
-            [_MAGIC, _VERSION, self.shuffle_id, self.group_id, p,
-             len(members), has_ck,
-             0 if par is None else int(par.segments),
-             0 if par is None else int(par.stripe_k),
-             0 if par is None else int(par.chunk_bytes),
-             0 if par is None else int(par.payload_len)],
-            dtype=np.int64,
-        )
-        rows = np.zeros((len(members), 3), dtype=np.int64)
+        skew_active = self.split_bytes > 0 or any(m.combined for m in members)
+        header_words = [
+            _MAGIC, _VERSION if skew_active else 2,
+            self.shuffle_id, self.group_id, p,
+            len(members), has_ck,
+            0 if par is None else int(par.segments),
+            0 if par is None else int(par.stripe_k),
+            0 if par is None else int(par.chunk_bytes),
+            0 if par is None else int(par.payload_len),
+        ]
+        if skew_active:
+            header_words.append(self.split_bytes)
+        header = np.array(header_words, dtype=np.int64)
+        row_words = _MEMBER_WORDS_V3 if skew_active else 3
+        rows = np.zeros((len(members), row_words), dtype=np.int64)
         offs = np.zeros((len(members), p + 1), dtype=np.int64)
         cks = np.zeros((len(members), p), dtype=np.int64) if has_ck else None
         for i, m in enumerate(members):
-            rows[i] = (m.map_id, m.map_index, m.base_offset)
+            rows[i, :3] = (m.map_id, m.map_index, m.base_offset)
+            if skew_active:
+                rows[i, 3] = FLAG_COMBINED if m.combined else 0
             offs[i] = np.asarray(m.offsets, dtype=np.int64)
             if cks is not None:
                 cks[i] = np.asarray(m.checksums, dtype=np.int64)
@@ -137,6 +164,8 @@ class FatIndex:
 
     @classmethod
     def from_bytes(cls, data: bytes) -> "FatIndex":
+        from s3shuffle_tpu.skew import FLAG_COMBINED
+
         if len(data) % 8 != 0 or len(data) < _HEADER_V1 * 8:
             raise ValueError(f"fat index blob has invalid length {len(data)}")
         words = np.frombuffer(data, dtype=">i8").astype(np.int64)
@@ -145,28 +174,35 @@ class FatIndex:
         )
         if magic != _MAGIC:
             raise ValueError("fat index blob has wrong magic")
+        split_bytes = 0
+        row_words = 3
         if version == 1:
             header, parity = _HEADER_V1, None
-        elif version == _VERSION:
-            header = _HEADER_V2
+        elif version in (2, _VERSION):
+            header = _HEADER_V2 if version == 2 else _HEADER_V3
             if len(words) < header:
-                raise ValueError(f"fat index v2 blob has invalid length {len(data)}")
+                raise ValueError(
+                    f"fat index v{version} blob has invalid length {len(data)}"
+                )
             par_m, par_k, par_chunk, par_len = (int(w) for w in words[7:11])
             parity = None
             if par_m > 0:
                 from s3shuffle_tpu.coding.parity import ParityGeometry
 
                 parity = ParityGeometry(par_m, par_k, par_chunk, par_len)
+            if version == _VERSION:
+                split_bytes = int(words[11])
+                row_words = _MEMBER_WORDS_V3
         else:
-            raise ValueError(f"fat index format version {version} != {_VERSION}")
-        expect = header + n * 3 + n * (p + 1) + (n * p if has_ck else 0)
+            raise ValueError(f"fat index format version {version} > {_VERSION}")
+        expect = header + n * row_words + n * (p + 1) + (n * p if has_ck else 0)
         if len(words) != expect:
             raise ValueError(
                 f"fat index blob has {len(words)} words, expected {expect}"
             )
         pos = header
-        rows = words[pos : pos + n * 3].reshape(n, 3)
-        pos += n * 3
+        rows = words[pos : pos + n * row_words].reshape(n, row_words)
+        pos += n * row_words
         offs = words[pos : pos + n * (p + 1)].reshape(n, p + 1)
         pos += n * (p + 1)
         cks = words[pos:].reshape(n, p) if has_ck else None
@@ -177,7 +213,13 @@ class FatIndex:
                 base_offset=int(rows[i, 2]),
                 offsets=np.array(offs[i], dtype=np.int64),
                 checksums=None if cks is None else np.array(cks[i], dtype=np.int64),
+                combined=bool(
+                    row_words > 3 and int(rows[i, 3]) & FLAG_COMBINED
+                ),
             )
             for i in range(n)
         ]
-        return cls(shuffle_id, group_id, p, members, parity=parity)
+        return cls(
+            shuffle_id, group_id, p, members, parity=parity,
+            split_bytes=split_bytes,
+        )

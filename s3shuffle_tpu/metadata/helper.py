@@ -57,12 +57,18 @@ class MapLocation:
     fetched separately, exactly as before). ``parity`` is the data
     object's stripe geometry when the coded plane wrote parity sidecars
     (from the index trailer / fat index) — what the degraded-read path
-    (coding/degraded.py) plans reconstruction with; None = uncoded."""
+    (coding/degraded.py) plans reconstruction with; None = uncoded.
+    ``split_bytes`` / ``combined`` are the skew plane's commit-time
+    coordinates (skew trailer / fat-index v3): the stripe granularity the
+    scan planner fans hot partitions out at (0 = unsplit) and whether the
+    partitions carry map-side-combined partial rows."""
 
     data_block: BlockId
     offsets: np.ndarray
     checksums: Optional[np.ndarray] = None
     parity: Optional[object] = None  # coding.parity.ParityGeometry
+    split_bytes: int = 0
+    combined: bool = False
 
 
 class ShuffleHelper:
@@ -92,16 +98,24 @@ class ShuffleHelper:
     # Write side
     # ------------------------------------------------------------------
     def write_partition_lengths(
-        self, shuffle_id: int, map_id: int, lengths: np.ndarray, parity=None
+        self, shuffle_id: int, map_id: int, lengths: np.ndarray, parity=None,
+        skew=None,
     ) -> None:
         """lengths (per-partition byte counts) → cumulative offsets
         ``[0, l0, l0+l1, ...]`` (S3ShuffleHelper.scala:44-47). ``parity``
         (a ParityGeometry) appends the 4-word stripe-geometry trailer so
         readers learn the coded layout from the index they fetch anyway;
-        None (the default, and always when ``parity_segments=0``) keeps
-        the blob byte-identical to the reference wire format."""
+        ``skew`` (a SkewInfo) appends the skew trailer BEFORE it (the
+        geometry trailer stays the blob's final words — the parse order
+        contract of ``split_index_trailers``). Both default None — and are
+        always None at their planes' off switches — keeping the blob
+        byte-identical to the reference wire format."""
         offsets = np.zeros(len(lengths) + 1, dtype=np.int64)
         np.cumsum(np.asarray(lengths, dtype=np.int64), out=offsets[1:])
+        if skew is not None and skew.active:
+            from s3shuffle_tpu.skew import skew_trailer_words
+
+            offsets = np.concatenate([offsets, skew_trailer_words(skew)])
         if parity is not None:
             from s3shuffle_tpu.coding.parity import geometry_trailer_words
 
@@ -226,6 +240,8 @@ class ShuffleHelper:
             offsets=member.base_offset + member.offsets,
             checksums=member.checksums,
             parity=fat.parity,
+            split_bytes=fat.split_bytes,
+            combined=member.combined,
         )
 
     def resolve_map_location(self, shuffle_id: int, map_id: int) -> MapLocation:
@@ -236,11 +252,13 @@ class ShuffleHelper:
         hint = self.composite_hint(shuffle_id, map_id)
         if hint is None:
             try:
-                offsets, geometry = self._singleton_index(shuffle_id, map_id)
+                offsets, geometry, skew = self._singleton_index(shuffle_id, map_id)
                 return MapLocation(
                     data_block=ShuffleDataBlockId(shuffle_id, map_id),
                     offsets=offsets,
                     parity=geometry,
+                    split_bytes=0 if skew is None else skew.split_bytes,
+                    combined=skew is not None and skew.combined,
                 )
             except FileNotFoundError:
                 if not self._discovery_allowed(shuffle_id):
@@ -262,10 +280,10 @@ class ShuffleHelper:
         return self._composite_location(shuffle_id, map_id, hint)
 
     def _singleton_index(self, shuffle_id: int, map_id: int):
-        """One per-map index blob → ``(offsets, parity_geometry|None)``.
-        The cache keeps the RAW word array (trailer included) so cached and
-        fresh reads parse identically."""
-        from s3shuffle_tpu.coding.parity import split_index_geometry
+        """One per-map index blob → ``(offsets, parity_geometry|None,
+        skew_info|None)``. The cache keeps the RAW word array (trailers
+        included) so cached and fresh reads parse identically."""
+        from s3shuffle_tpu.skew import split_index_trailers
 
         block = ShuffleIndexBlockId(shuffle_id, map_id)
         if self.dispatcher.config.cache_partition_lengths:
@@ -274,7 +292,7 @@ class ShuffleHelper:
             )
         else:
             words = self.read_block_as_array(block)
-        return split_index_geometry(words)
+        return split_index_trailers(words)
 
     def get_partition_lengths(self, shuffle_id: int, map_id: int) -> np.ndarray:
         """ABSOLUTE cumulative offsets array for one map output (composite

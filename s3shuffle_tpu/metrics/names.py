@@ -51,6 +51,7 @@ KNOWN_METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "read_prefetch_wait_seconds": ("histogram", ()),
     "read_prefetch_fill_seconds": ("histogram", ()),
     "read_prefetch_fill_class_seconds": ("histogram", ("size_class",)),
+    "read_prefetch_fill_per_mib_seconds": ("histogram", ("size_class",)),
     "read_prefetch_threads": ("gauge", ()),
     "read_prefetch_thread_moves_total": ("counter", ("direction",)),
     # --- read plane: chunked concurrent ranged GETs (read/chunked_fetch.py) ---
@@ -111,6 +112,11 @@ KNOWN_METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "shuffle_parity_bytes_written_total": ("counter", ()),
     "shuffle_parity_speculative_reads_total": ("counter", ()),
     "shuffle_parity_reconstructions_total": ("counter", ("reason",)),
+    # --- skew mitigation plane: map-side combine sidecars, hot-partition
+    # splitting, coded read fan-out (s3shuffle_tpu/skew.py) ---
+    "shuffle_map_combine_rows_total": ("counter", ()),
+    "shuffle_partition_splits_total": ("counter", ()),
+    "shuffle_hot_fanout_reads_total": ("counter", ()),
     # --- codec plane: device-resident batch pipeline
     # (codec/framing.py, codec/tpu.py) ---
     "codec_encode_batch_seconds": ("histogram", ()),

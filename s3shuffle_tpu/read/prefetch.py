@@ -57,6 +57,15 @@ _H_FILL_CLASS = _metrics.REGISTRY.histogram(
     "quantile dominated by 100 KiB fills)",
     labelnames=("size_class",),
 )
+_H_FILL_PER_MIB = _metrics.REGISTRY.histogram(
+    "read_prefetch_fill_per_mib_seconds",
+    "Background prefill latency per requested MiB (floored at 1 MiB so "
+    "fixed round-trip cost never divides into noise), per size class — "
+    "the seconds-per-byte speculation threshold's evidence: within a "
+    "class, a 2 MiB and a 7 MiB fill normalize to the same scale, so a "
+    "healthy fill at the class's large end stops reading as a straggler",
+    labelnames=("size_class",),
+)
 
 #: size-class edges for ``read_prefetch_fill_class_seconds`` — coarse on
 #: purpose: enough resolution to separate "small block" from "large
@@ -71,6 +80,14 @@ def fill_size_class(nbytes: int) -> str:
         if nbytes <= edge:
             return label
     return "gt64m"
+
+
+def fill_norm_mib(nbytes: int) -> float:
+    """The per-MiB normalization divisor for one prefill: its size in MiB,
+    floored at 1.0 — below a MiB fixed round-trip latency dominates and
+    per-byte normalization would only amplify noise, so sub-MiB fills keep
+    absolute-seconds semantics (observed value == fill seconds)."""
+    return max(float(max(nbytes, 1)) / (1 << 20), 1.0)
 _G_THREADS = _metrics.REGISTRY.gauge(
     "read_prefetch_threads", "Live ThreadPredictor thread-count decision"
 )
@@ -166,6 +183,7 @@ class BufferedPrefetchIterator:
         max_threads: int = 10,
         fetcher=None,
         speculation=None,
+        initial_threads: int = 1,
     ):
         self._source = source
         self._max_buffer_size = max(1, max_buffer_size)
@@ -179,7 +197,14 @@ class BufferedPrefetchIterator:
         # straggler half of the coded shuffle plane. None/ineligible =
         # exactly the plain path.
         self._speculation = speculation
-        self._predictor = ThreadPredictor(max_threads)
+        # ``initial_threads`` seeds the predictor's starting rung (still
+        # clamped to max_threads; the hill climb tunes freely from there).
+        # The default 1 is the reference's cold start; the skew plane's
+        # split fan-out passes the ready-part count — a scan KNOWN to hold
+        # K independent hot-partition sub-ranges must not serialize them
+        # behind the predictor's 20-sample ramp, or the recorded split
+        # would buy nothing on short scans.
+        self._predictor = ThreadPredictor(max_threads, initial=initial_threads)
         self._lock = threading.Condition()
         # Separate lock for pulling source items: next(source) can do store
         # I/O (index GETs in BlockIterator) and must not serialize completions
@@ -283,36 +308,55 @@ class BufferedPrefetchIterator:
                     return
             block, stream = item
             bsize = min(self._max_buffer_size, max(1, stream.max_bytes))
+            # Skew plane (read/scan_plan.SplitGroup): a split block's parts
+            # share ONE budget claim — the first part to get here reserves
+            # the whole block's bytes, siblings piggyback, and the last
+            # member close releases. Funding the block atomically keeps the
+            # consumer-side reassembly deadlock-free (a held part can never
+            # be waiting on budget a sibling's consumer holds).
+            group = getattr(stream, "budget_group", None)
             with self._lock:
                 self._active_fetches += 1
-                # Budget wait (:122-135): sum of in-flight buffers ≤ budget.
-                while (
-                    self._buffers_in_flight + bsize > self._max_buffer_size
-                    and self._error is None
-                ):
-                    # Every transition that can unblock this wait notifies
-                    # (budget release on stream close, error) — the timeout
-                    # is only a deadlock backstop, not a polling interval.
-                    notified = self._lock.wait(timeout=5.0)
-                    if not notified and (
-                        self._buffers_in_flight + bsize > self._max_buffer_size
-                        and self._error is None
-                    ):
-                        self._warn_backstop(
-                            "budget", f"producer needs {bsize} budget bytes"
-                        )
-                self._buffers_in_flight += bsize
+                if group is not None:
+                    need = min(self._max_buffer_size, group.total)
+                    # siblings may race here: everyone waits until the group
+                    # is funded (by WHOEVER claims first) or budget fits —
+                    # the claim below is re-checked under this same lock, so
+                    # exactly one part ever adds the reservation
+                    self._await_budget_locked(
+                        need, satisfied=lambda: group.reserved
+                    )
+                    if not group.reserved:
+                        group.reserved = True
+                        group.reserved_bytes = need
+                        self._buffers_in_flight += need
+                        # wake sibling parts parked on the same group wait
+                        self._lock.notify_all()
+                else:
+                    # Budget wait (:122-135): sum of in-flight buffers ≤ budget.
+                    self._await_budget_locked(bsize)
+                    self._buffers_in_flight += bsize
             try:
+                from s3shuffle_tpu.skew import tracked_get
                 from s3shuffle_tpu.utils import trace
 
                 t0 = time.perf_counter_ns()
                 with trace.span("read.prefetch", block=block.name, budget=bsize):
                     # ← the actual store GET (chunk-parallel for big prefills
-                    # when a fetcher is attached; serial otherwise)
+                    # when a fetcher is attached; serial otherwise), wrapped
+                    # in the per-object in-flight tracker so the hot-fanout
+                    # gate sees live GET concurrency per data object
+                    obj = getattr(
+                        getattr(stream, "data_block", None), "name", None
+                    )
                     if self._fetcher is not None:
-                        primary = lambda s=stream, n=bsize: self._fetcher.prefill(s, n)  # noqa: E731
+                        primary = lambda s=stream, n=bsize, o=obj: tracked_get(  # noqa: E731
+                            o, lambda: self._fetcher.prefill(s, n)
+                        )
                     else:
-                        primary = lambda s=stream, n=bsize: _read_up_to(s, n)  # noqa: E731
+                        primary = lambda s=stream, n=bsize, o=obj: tracked_get(  # noqa: E731
+                            o, lambda: _read_up_to(s, n)
+                        )
                     speculation_won = False
                     primary_exec_s = None
                     if (
@@ -338,10 +382,19 @@ class BufferedPrefetchIterator:
                     _H_FILL.observe(fill_s)
                     # same sample, size-classed: the speculation threshold
                     # reads the class matching its prefill's budget
-                    _H_FILL_CLASS.labels(
-                        size_class=fill_size_class(bsize)
-                    ).observe(fill_s)
-                prefetched = PrefetchedBlockStream(block, stream, buffer, self._release_budget(len(buffer), bsize))
+                    cls = fill_size_class(bsize)
+                    _H_FILL_CLASS.labels(size_class=cls).observe(fill_s)
+                    # and per-MiB-normalized — the seconds-per-byte form the
+                    # threshold actually consumes (coding/degraded.py)
+                    _H_FILL_PER_MIB.labels(size_class=cls).observe(
+                        fill_s / fill_norm_mib(bsize)
+                    )
+                on_close = (
+                    self._release_group_budget(group)
+                    if group is not None
+                    else self._release_budget(len(buffer), bsize)
+                )
+                prefetched = PrefetchedBlockStream(block, stream, buffer, on_close)
                 with self._lock:
                     self._stat_prefetch_ns += dt
                     self._stat_bytes += len(buffer)
@@ -356,11 +409,47 @@ class BufferedPrefetchIterator:
                     self._lock.notify_all()
                 return
 
+    def _await_budget_locked(self, need: int, satisfied=None) -> None:
+        """Caller holds ``self._lock``: block until ``need`` budget bytes
+        fit, an error is set, or ``satisfied()`` turns true (a sibling
+        split part claimed the shared group reservation — the caller then
+        piggybacks instead of reserving again). Every transition that can
+        unblock this wait notifies (budget release on stream close, group
+        claim, error) — the timeout is only a missed-notify backstop, not
+        a polling interval."""
+
+        def blocked() -> bool:
+            return (
+                (satisfied is None or not satisfied())
+                and self._buffers_in_flight + need > self._max_buffer_size
+                and self._error is None
+            )
+
+        while blocked():
+            notified = self._lock.wait(timeout=5.0)
+            if not notified and blocked():
+                self._warn_backstop(
+                    "budget", f"producer needs {need} budget bytes"
+                )
+
     def _release_budget(self, actual: int, reserved: int):
         def on_close(_buffer_size: int) -> None:
             with self._lock:
                 self._buffers_in_flight -= reserved
                 self._lock.notify_all()
+
+        return on_close
+
+    def _release_group_budget(self, group):
+        """Split-group budget release: the group's single whole-block
+        reservation drops when the LAST member part closes."""
+
+        def on_close(_buffer_size: int) -> None:
+            with self._lock:
+                group.closed += 1
+                if group.closed >= group.count:
+                    self._buffers_in_flight -= group.reserved_bytes
+                    self._lock.notify_all()
 
         return on_close
 

@@ -314,10 +314,25 @@ class ShuffleReader:
         cfg = self.dispatcher.config
         block = prefetched.block
         stream = prefetched
+        # Skew-plane safety rail: a map output flagged as carrying map-side
+        # combined PARTIAL rows changes the record multiset — it is only
+        # meaningful through the aggregator that merges partials. Refuse a
+        # raw read loudly instead of silently serving partial aggregates.
+        # (Resolution is memoized per scan — the planner already did it.)
+        location = self._scan_memo.resolve_map_location(
+            block.shuffle_id, block.map_id
+        )
+        if location.combined and self.dep.aggregator is None:
+            raise ValueError(
+                f"map output {block.shuffle_id}/{block.map_id} carries "
+                "map-side-combined partial rows (skew plane combine "
+                "sidecar) but this read has no aggregator to merge them; "
+                "read with the aggregating dependency that wrote the data"
+            )
         if cfg.checksum_enabled:
             # per-scan memo: one index/checksum GET per map per scan even
             # with the process-wide caches off
-            offsets = self._scan_memo.get_partition_lengths(block.shuffle_id, block.map_id)
+            offsets = location.offsets
             checksums = self._scan_memo.get_checksums(block.shuffle_id, block.map_id)
             if isinstance(block, ShuffleBlockBatchId):
                 start, end = block.start_reduce_id, block.end_reduce_id

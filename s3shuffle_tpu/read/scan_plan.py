@@ -90,15 +90,89 @@ OnBlock = Optional[Callable[[object, int], None]]
 
 @dataclasses.dataclass(frozen=True)
 class BlockRange:
-    """One readable block resolved to its byte range in the data object."""
+    """One readable block resolved to its byte range in the data object.
+
+    ``split`` is the skew plane's effective stripe granularity for this
+    range (0 = never split): ranges longer than it fan out as independent
+    sub-range segments (:class:`SplitPart`). ``part`` marks a range that IS
+    one such sub-range."""
 
     block: ReadableBlockId
     start: int
     end: int
+    split: int = 0
+    part: Optional["SplitPart"] = None
 
     @property
     def length(self) -> int:
         return self.end - self.start
+
+
+#: hard cap on one block's split fan-out — past the prefetch pool width,
+#: more parts only add request count, and a pathologically small recorded
+#: stripe (a tuner excursion, a hand-edited trailer) must not turn one fat
+#: partition into thousands of GETs
+MAX_SPLIT_PARTS = 32
+
+
+class SplitGroup:
+    """Shared state of one split block's sub-range parts.
+
+    Doubles as the prefetcher's **budget group**: the first part to reach
+    the budget wait reserves the WHOLE block's bytes in one claim
+    (``reserved``/``reserved_bytes``), later parts piggyback, and the last
+    member close releases it. Funding the block atomically is what makes
+    consumer-side reassembly deadlock-free: once any part holds budget,
+    every sibling is funded and must complete — the consumer can never be
+    left waiting on a part that is itself waiting on budget the consumer
+    holds (the planner only splits blocks that fit the budget whole, the
+    same clamp coalesced segments live under)."""
+
+    __slots__ = (
+        "block", "start", "end", "count",
+        "reserved", "reserved_bytes", "closed",
+    )
+
+    def __init__(self, block, start: int, end: int, count: int):
+        self.block = block
+        self.start = start
+        self.end = end
+        self.count = count
+        self.reserved = False
+        self.reserved_bytes = 0
+        self.closed = 0
+
+    @property
+    def total(self) -> int:
+        return self.end - self.start
+
+
+class SplitPart:
+    """One sub-range of a split block — planned as its own segment so its
+    GET runs on its own prefetch thread; the consumer side reassembles the
+    parts (in index order) into one logical block stream."""
+
+    __slots__ = ("group", "index", "start", "end")
+
+    def __init__(self, group: SplitGroup, index: int, start: int, end: int):
+        self.group = group
+        self.index = index
+        self.start = start
+        self.end = end
+
+    @property
+    def length(self) -> int:
+        return self.end - self.start
+
+    @property
+    def name(self) -> str:
+        return (
+            f"{self.group.block.name}"
+            f"[part {self.index + 1}/{self.group.count}]"
+        )
+
+    def __repr__(self) -> str:
+        return f"SplitPart({self.name}, [{self.start}:{self.end}))"
 
 
 class ScanSegment:
@@ -184,6 +258,7 @@ def plan_scan(
     max_bytes: int,
     prefetch_width: int = 1,
     recovery=None,  # coding.degraded.DegradedReader to feed geometry
+    split_budget: int = 0,  # skew plane: max_buffer_size_task (0 = no split)
 ) -> List[ScanSegment]:
     """Resolve, filter, group, and merge the scan's block list.
 
@@ -225,14 +300,64 @@ def plan_scan(
             # feed the degraded-read engine the (already-resolved, memoized
             # — zero extra store ops) stripe geometry of this data object
             recovery.note(memo, block.shuffle_id, block.map_id)
-        groups.setdefault(data_block, []).append(BlockRange(block, lo, hi))
+        split = 0
+        if split_budget > 0:
+            # skew plane: the writer recorded a stripe granularity for hot
+            # partitions (skew trailer / fat-index v3) — re-read it from
+            # the memoized location (free: range resolution just did this
+            # lookup). Only ranges that fit the prefetch budget WHOLE are
+            # split (the group budget reservation funds the block in one
+            # claim); a block past the budget keeps the unsplit prefill +
+            # synchronous-remainder path, exactly like oversized coalesced
+            # segments.
+            try:
+                loc = memo.resolve_map_location(block.shuffle_id, block.map_id)
+            except (OSError, ValueError):
+                loc = None
+            if (
+                loc is not None
+                and loc.split_bytes > 0
+                and hi - lo > loc.split_bytes
+                and hi - lo <= split_budget
+            ):
+                # cap the fan-out: a tiny recorded stripe must not explode
+                # one partition into thousands of GETs
+                split = max(
+                    int(loc.split_bytes), -(-(hi - lo) // MAX_SPLIT_PARTS)
+                )
+        groups.setdefault(data_block, []).append(
+            BlockRange(block, lo, hi, split=split)
+        )
 
     segments: List[ScanSegment] = []
     for data_block, ranges in groups.items():
         ranges.sort(key=lambda r: r.start)
         current: List[BlockRange] = []
         seg_start = seg_end = 0
+
+        def flush():
+            nonlocal current
+            if current:
+                segments.append(ScanSegment(data_block, seg_start, seg_end, current))
+                current = []
+
         for r in ranges:
+            if r.split and r.length > r.split:
+                # hot-partition fan-out: independent solo segments, one per
+                # sub-range, never merged with neighbors (merging would
+                # undo the very parallelism the split buys)
+                flush()
+                n_parts = -(-r.length // r.split)
+                grp = SplitGroup(r.block, r.start, r.end, n_parts)
+                for i in range(n_parts):
+                    plo = r.start + i * r.split
+                    phi = min(plo + r.split, r.end)
+                    part = SplitPart(grp, i, plo, phi)
+                    segments.append(ScanSegment(
+                        data_block, plo, phi,
+                        [BlockRange(r.block, plo, phi, part=part)],
+                    ))
+                continue
             if current and (
                 r.start - seg_end <= gap_bytes
                 and max(seg_end, r.end) - seg_start <= max_bytes
@@ -240,12 +365,10 @@ def plan_scan(
                 current.append(r)
                 seg_end = max(seg_end, r.end)
                 continue
-            if current:
-                segments.append(ScanSegment(data_block, seg_start, seg_end, current))
+            flush()
             current = [r]
             seg_start, seg_end = r.start, r.end
-        if current:
-            segments.append(ScanSegment(data_block, seg_start, seg_end, current))
+        flush()
     return segments
 
 
@@ -304,6 +427,74 @@ class SlicedBlockStream(io.RawIOBase):
         super().close()
 
 
+class SplitBlockStream(io.RawIOBase):
+    """One logical block reassembled from its split-part prefills, served
+    sequentially in part order — byte-identical to the unsplit stream (the
+    parts tile the block's range exactly). A part that went short (failed
+    GET) degrades to the per-block path's behavior: the surviving prefix is
+    served, then EOF — checksum validation downstream surfaces it as
+    ``ChecksumError``. ``close`` closes every part; the LAST part close
+    releases the block's group budget reservation."""
+
+    def __init__(self, group: SplitGroup, parts: List):
+        self.block = group.block
+        self.max_bytes = group.total
+        self._group = group
+        self._parts = parts  # PrefetchedBlockStreams, in part-index order
+        self._idx = 0
+        self._served_in_part = 0
+        self._failed = False
+        self._closed_once = False
+
+    def readable(self) -> bool:
+        return True
+
+    def read(self, size: int = -1) -> bytes:
+        if size is None or size < 0:
+            return self.readall()
+        while not self._failed and self._idx < len(self._parts):
+            part = self._parts[self._idx]
+            expected = part.block.length  # the SplitPart's sub-range
+            remaining = expected - self._served_in_part
+            if remaining <= 0:
+                self._idx += 1
+                self._served_in_part = 0
+                continue
+            chunk = part.read(min(size, remaining))
+            if not chunk:
+                # short part: everything after this point is missing — serve
+                # EOF from here on (never skip to the next part, whose bytes
+                # would land at the wrong logical offset)
+                self._failed = True
+                logger.warning(
+                    "Split part %s went short (%d of %d bytes); block %s "
+                    "degrades to a logged-EOF prefix",
+                    part.block.name, self._served_in_part, expected,
+                    self.block,
+                )
+                return b""
+            self._served_in_part += len(chunk)
+            return chunk
+        return b""
+
+    def readall(self) -> bytes:
+        chunks = []
+        while True:
+            chunk = self.read(1 << 20)
+            if not chunk:
+                return b"".join(chunks)
+            chunks.append(chunk)
+
+    def close(self) -> None:
+        if self._closed_once:
+            return
+        self._closed_once = True
+        for part in self._parts:
+            part.close()
+        self._parts = []
+        super().close()
+
+
 class CoalescedScanIterator:
     """Consumer-facing iterator of per-block prefetched streams, driven by a
     :class:`BufferedPrefetchIterator` over planned segments.
@@ -312,7 +503,12 @@ class CoalescedScanIterator:
     synchronous remainder past the prefetch budget — a lone block may exceed
     ``coalesce_max_bytes``). Multi-member segments are guaranteed by the
     planner to fit one prefill, arrive fully buffered, and are sliced into
-    :class:`SlicedBlockStream` members here on the consumer thread."""
+    :class:`SlicedBlockStream` members here on the consumer thread. Split
+    parts (the skew plane's hot-partition fan-out) arrive as independent
+    prefills in completion order and are reassembled into one
+    :class:`SplitBlockStream` per logical block once every sibling landed —
+    unrelated blocks keep flowing to the caller in the meantime, so held
+    parts never dam the scan."""
 
     def __init__(
         self,
@@ -329,6 +525,20 @@ class CoalescedScanIterator:
             for seg in segments:
                 if len(seg.members) == 1:
                     m = seg.members[0]
+                    if m.part is not None:
+                        part = m.part
+                        # count the LOGICAL block once, with its full length
+                        if on_block is not None and part.index == 0:
+                            on_block(part.group.block, part.group.total)
+                        stream = BlockStream(
+                            dispatcher, part, seg.data_block, m.start, m.end,
+                            recovery=recovery,
+                        )
+                        # budget-group protocol (read/prefetch.py): the
+                        # whole block's bytes reserve in ONE claim
+                        stream.budget_group = part.group
+                        yield part, stream
+                        continue
                     if on_block is not None:
                         on_block(m.block, m.length)
                     yield m.block, BlockStream(
@@ -344,14 +554,27 @@ class CoalescedScanIterator:
                         recovery=recovery,
                     )
 
+        # seed the prefetch thread count with the split fan-out: a scan the
+        # planner striped into K independent hot-partition sub-ranges gets
+        # K threads (capped at the operator's max) UP FRONT instead of the
+        # predictor's one-thread cold start — without this, short skewed
+        # scans would serialize the very parts the split recorded. Scans
+        # with no split parts keep the reference's cold start exactly.
+        n_parts = sum(
+            1
+            for seg in segments
+            if len(seg.members) == 1 and seg.members[0].part is not None
+        )
         self._inner = BufferedPrefetchIterator(
             segment_streams(),
             max_buffer_size=max_buffer_size,
             max_threads=max_threads,
             fetcher=fetcher,
             speculation=speculation,
+            initial_threads=min(max_threads, n_parts) if n_parts else 1,
         )
         self._pending: List[SlicedBlockStream] = []
+        self._split_parts: dict = {}  # SplitGroup -> {index: prefetched}
 
     def __iter__(self) -> "CoalescedScanIterator":
         return self
@@ -361,9 +584,27 @@ class CoalescedScanIterator:
             item = self._inner.__next__()  # StopIteration/errors propagate
             if isinstance(item.block, ScanSegment):
                 self._slice_segment(item)
+            elif isinstance(item.block, SplitPart):
+                assembled = self._note_part(item)
+                if assembled is not None:
+                    return assembled
             else:
                 return item
         return self._pending.pop(0)
+
+    def _note_part(self, item: PrefetchedBlockStream):
+        """Stash one split-part prefill; when the logical block's parts are
+        all present, hand back the reassembled stream (parts arrive in
+        LIFO completion order, so arrival order proves nothing — index
+        order does)."""
+        part: SplitPart = item.block
+        grp = part.group
+        parts = self._split_parts.setdefault(grp, {})
+        parts[part.index] = item
+        if len(parts) < grp.count:
+            return None
+        del self._split_parts[grp]
+        return SplitBlockStream(grp, [parts[i] for i in range(grp.count)])
 
     def _slice_segment(self, item: PrefetchedBlockStream) -> None:
         seg: ScanSegment = item.block
@@ -505,11 +746,17 @@ def build_scan_iterator(
 
     recovery = DegradedReader(dispatcher)
     speculation = None
-    if getattr(cfg, "speculative_read_quantile", 0.0) > 0:
+    hot_fanout = getattr(cfg, "hot_read_fanout", 0)
+    if getattr(cfg, "speculative_read_quantile", 0.0) > 0 or hot_fanout > 0:
+        # the fetcher carries BOTH read-side coded behaviors: the straggler
+        # race (quantile > 0) and the skew plane's hot-object fan-out
+        # (hot_read_fanout > 0); either alone constructs it, each gates
+        # itself independently inside prefill()
         speculation = SpeculativeFetcher(
             recovery,
-            cfg.speculative_read_quantile,
+            getattr(cfg, "speculative_read_quantile", 0.0),
             width=max(1, cfg.max_concurrency_task),
+            hot_fanout=hot_fanout,
         )
     if cfg.coalesce_gap_bytes > 0:
         segments = plan_scan(
@@ -526,6 +773,9 @@ def build_scan_iterator(
             # the first data byte flows
             prefetch_width=max(1, cfg.fetch_parallelism, cfg.max_concurrency_task),
             recovery=recovery,
+            # skew plane: recorded hot-partition stripes fan out as
+            # independent sub-range GETs, bounded by the prefill budget
+            split_budget=cfg.max_buffer_size_task,
         )
         it = CoalescedScanIterator(
             dispatcher,

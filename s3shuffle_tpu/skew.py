@@ -1,0 +1,213 @@
+"""Skew mitigation plane — shared wire bits and hot-object signals.
+
+Millions of users means key skew: a few fat partitions absorb most bytes,
+hot reducers serialize on single fat objects while everyone else idles, and
+the autotuner can only tune *around* the tail (the PR-9 ``skew`` scenario).
+Coded TeraSort / Coded MapReduce (PAPERS.md) show the winning trade: spend
+redundant or preparatory map-side work to cut shuffle communication on the
+critical path. Three prongs, each with its own knob, each off by default
+(``*=0`` reproduces the pre-skew-plane behavior op-for-op):
+
+- **Map-side combine sidecars** (``combine_threshold_bytes``): partitions
+  whose routed bytes cross the threshold get their chunks pre-reduced with
+  the existing columnar combine (colagg argsort + reduceat) INSIDE the map
+  task, so hot partitions ship partial aggregates instead of raw rows
+  (write/spill_writer.py). The map output is flagged in its index sidecar —
+  the :data:`FLAG_COMBINED` bit of the skew trailer / fat-index member row —
+  so readers know the partition carries partials (the reduce-side colagg
+  merges them; a reader with NO aggregator refuses loudly).
+- **Hot-partition splitting** (``split_threshold_bytes``): partition sizes
+  are measured at commit; when one crosses the threshold the writer records
+  a stripe granularity (this trailer / the fat-index v3 header) and the scan
+  planner fans the partition's byte range out as independent sub-range GETs
+  across the prefetch pool instead of serializing on one ranged read
+  (read/scan_plan.py).
+- **Coded read fan-out** (``hot_read_fanout``): when concurrent readers
+  hammer one hot object (live per-object GET concurrency, tracked here),
+  eligible reads reconstruct from parity-equivalent sources instead — the
+  PR-10 degraded-read machinery reused as a LOAD BALANCING path, not just a
+  loss path (coding/degraded.py).
+
+This module owns the pieces the prongs share: the **skew trailer** appended
+to per-map ``.index`` blobs (absent when no prong engaged, so the wire stays
+byte-identical at the off switches), the combined trailer+geometry parser,
+the per-object in-flight GET tracker, and the plane's metric instruments.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from s3shuffle_tpu.metrics import registry as _metrics
+
+#: wire-schema registry binding (s3shuffle_tpu/wire/schema.py) — this module
+#: owns the skew index trailer; shuffle-lint WIRE01 pins the constants.
+_WIRE_STRUCTS = ("index_skew_trailer",)
+
+#: magic word marking the skew trailer appended to per-map ``.index``
+#: sidecars when a skew prong engaged at commit: ``[SKEW_MAGIC, flags,
+#: split_bytes, reserved]`` after the cumulative offsets (and BEFORE the
+#: parity geometry trailer, which always stays the blob's final words).
+SKEW_MAGIC = 0x53335348534B4557  # "S3SHSKEW"
+#: trailer width in int64 words
+SKEW_TRAILER_WORDS = 4
+#: flags bit 0: the map output's partitions carry map-side-combined partial
+#: rows — readers must merge them through the dependency's aggregator
+FLAG_COMBINED = 1
+
+C_MAP_COMBINE_ROWS = _metrics.REGISTRY.counter(
+    "shuffle_map_combine_rows_total",
+    "Rows eliminated by the map-side combine sidecar (input rows minus the "
+    "pre-reduced partial rows actually shipped)",
+)
+C_PARTITION_SPLITS = _metrics.REGISTRY.counter(
+    "shuffle_partition_splits_total",
+    "Partitions whose size crossed split_threshold_bytes at commit — their "
+    "split fan-out is recorded in the index sidecar for read-side striping",
+)
+C_HOT_FANOUT_READS = _metrics.REGISTRY.counter(
+    "shuffle_hot_fanout_reads_total",
+    "Reads served from parity-equivalent sources because the primary data "
+    "object's live GET concurrency crossed hot_read_fanout",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class SkewInfo:
+    """Skew-plane coordinates of one map output, as recorded at commit:
+    whether its partitions carry map-side-combined partials, and the stripe
+    granularity (bytes) the reduce-side planner should fan hot partitions
+    out at (0 = no partition crossed the split threshold)."""
+
+    combined: bool = False
+    split_bytes: int = 0
+
+    @property
+    def active(self) -> bool:
+        return self.combined or self.split_bytes > 0
+
+
+def skew_trailer_words(skew: SkewInfo) -> np.ndarray:
+    """The 4-word trailer appended to a per-map index sidecar when any skew
+    prong engaged: ``[SKEW_MAGIC, flags, split_bytes, reserved]``."""
+    flags = FLAG_COMBINED if skew.combined else 0
+    return np.array([SKEW_MAGIC, flags, int(skew.split_bytes), 0], dtype=np.int64)
+
+
+def split_index_trailers(
+    words: np.ndarray,
+) -> Tuple[np.ndarray, Optional[object], Optional[SkewInfo]]:
+    """Split a raw index-blob int64 array into ``(offsets, parity_geometry,
+    skew_info)``. Trailer order on the wire is ``offsets + [skew trailer] +
+    [geometry trailer]`` — the geometry trailer (when present) is always the
+    final four words, so it is peeled first, then the skew trailer, and the
+    geometry's ``payload_len`` comes from the TRUE final cumulative offset
+    (never a trailer word — the PR-10 bug class). Both magics sit at values
+    no cumulative byte offset can reach (~6.0e18), so trailer-less blobs —
+    including every reference-written one — pass through untouched."""
+    from s3shuffle_tpu.coding.parity import (
+        GEOMETRY_MAGIC,
+        TRAILER_WORDS,
+        ParityGeometry,
+    )
+
+    geom_words = None
+    if len(words) >= TRAILER_WORDS + 2 and int(words[-TRAILER_WORDS]) == GEOMETRY_MAGIC:
+        geom_words = words[-TRAILER_WORDS:]
+        words = words[:-TRAILER_WORDS]
+    skew = None
+    if (
+        len(words) >= SKEW_TRAILER_WORDS + 2
+        and int(words[-SKEW_TRAILER_WORDS]) == SKEW_MAGIC
+    ):
+        flags = int(words[-3])
+        skew = SkewInfo(
+            combined=bool(flags & FLAG_COMBINED),
+            split_bytes=int(words[-2]),
+        )
+        words = words[:-SKEW_TRAILER_WORDS]
+    geometry = None
+    if geom_words is not None:
+        geometry = ParityGeometry(
+            segments=int(geom_words[1]),
+            stripe_k=int(geom_words[2]),
+            chunk_bytes=int(geom_words[3]),
+            payload_len=int(words[-1]),
+        )
+    return words, geometry, skew
+
+
+# ---------------------------------------------------------------------------
+# Per-object GET concurrency — the hot-fanout trigger signal
+# ---------------------------------------------------------------------------
+
+#: peak-table bound: hot detection only needs LIVE counts; peaks are a
+#: bench/debug surface and must not grow with every object ever scanned
+_PEAKS_MAX = 4096
+
+
+class ObjectGetTracker:
+    """Live in-flight GET count per data object, fed by the prefetch plane
+    around every primary store GET (read/prefetch.py). The coded read
+    fan-out gate (coding/degraded.py) reads :meth:`inflight` to decide when
+    a hot object's next read should divert to parity-equivalent sources;
+    the skew bench reads :meth:`peak` to report per-object GET concurrency.
+    Process-local by design — cross-worker coordination would need the
+    control plane, and the hot spot this plane targets (N reduce tasks of
+    one process hammering one fat object) is visible right here."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._inflight: Dict[str, int] = {}
+        self._peak: Dict[str, int] = {}
+
+    def start(self, name: str) -> None:
+        with self._lock:
+            live = self._inflight.get(name, 0) + 1
+            self._inflight[name] = live
+            if live > self._peak.get(name, 0):
+                if len(self._peak) >= _PEAKS_MAX and name not in self._peak:
+                    self._peak.pop(next(iter(self._peak)))
+                self._peak[name] = live
+
+    def finish(self, name: str) -> None:
+        with self._lock:
+            live = self._inflight.get(name, 0) - 1
+            if live <= 0:
+                self._inflight.pop(name, None)
+            else:
+                self._inflight[name] = live
+
+    def inflight(self, name: str) -> int:
+        with self._lock:
+            return self._inflight.get(name, 0)
+
+    def peak(self, name: str) -> int:
+        with self._lock:
+            return self._peak.get(name, 0)
+
+    def reset_peaks(self) -> None:
+        with self._lock:
+            self._peak = {}
+
+
+#: process-wide tracker instance (one read plane per process)
+OBJECT_GETS = ObjectGetTracker()
+
+
+def tracked_get(name: Optional[str], fn):
+    """Run ``fn`` (a primary store GET) with the object's in-flight count
+    held — the hot-fanout gate must see only REAL GETs in flight, never
+    reads it already diverted to parity (counting those would feed back
+    into the trigger and ratchet every read onto the parity path)."""
+    if name is None:
+        return fn()
+    OBJECT_GETS.start(name)
+    try:
+        return fn()
+    finally:
+        OBJECT_GETS.finish(name)

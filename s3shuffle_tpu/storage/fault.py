@@ -153,6 +153,41 @@ class LatencyRule:
         time.sleep(self.delay_s)
 
 
+class BandwidthRule:
+    """Throttle operations of ``op`` whose path contains ``match`` to
+    ``mib_s`` per CALL — the delay scales with the call's byte count, so it
+    models a bandwidth-limited store CONNECTION: each concurrent ranged GET
+    gets its own sleep and they overlap, exactly like parallel S3
+    connections each capped at per-stream throughput (the reason multipart
+    download and the skew plane's hot-partition split fan-out pay off).
+    Calls that carry no byte count (create/open/status/...) pass free."""
+
+    def __init__(self, op: str, match: str = "", mib_s: float = 64.0):
+        if op not in OPS:
+            raise ValueError(f"unknown op {op!r}; one of {OPS}")
+        if mib_s <= 0:
+            raise ValueError("mib_s must be > 0")
+        self.op = op
+        self.match = match
+        self.mib_s = float(mib_s)
+        self.hits = 0
+        self.bytes = 0
+        self._lock = threading.Lock()
+
+    #: FlakyBackend dispatch marker: this rule wants the call's byte count
+    per_byte = True
+
+    def maybe_delay(self, op: str, path: str, nbytes: int = 0) -> None:
+        if op != self.op or self.match not in path or nbytes <= 0:
+            return
+        with self._lock:
+            self.hits += 1
+            self.bytes += nbytes
+        import time
+
+        time.sleep(nbytes / (self.mib_s * 1024 * 1024))
+
+
 class _FlakyReader(RangedReader):
     def __init__(self, inner: RangedReader, path: str, check: Callable[[str, str], None]):
         self._inner = inner
@@ -164,7 +199,7 @@ class _FlakyReader(RangedReader):
         return self._inner.size
 
     def read_fully(self, position: int, length: int) -> bytes:
-        self._check("read", self._path)
+        self._check("read", self._path, nbytes=length)
         return self._inner.read_fully(position, length)
 
     def close(self) -> None:
@@ -182,7 +217,10 @@ class _FlakyWriteStream(io.RawIOBase):
         return True
 
     def write(self, b) -> int:
-        self._check("write", self._path)
+        self._check(
+            "write", self._path,
+            nbytes=b.nbytes if isinstance(b, memoryview) else len(b),
+        )
         return self._inner.write(b)
 
     def flush(self) -> None:
@@ -219,12 +257,15 @@ class FlakyBackend(StorageBackend):
         self.latency.append(rule)
         return rule
 
-    def _check(self, op: str, path: str) -> None:
+    def _check(self, op: str, path: str, nbytes: int = 0) -> None:
         self.calls[op] = self.calls.get(op, 0) + 1
         for rule in self.rules:
             rule.maybe_raise(op, path)
         for lat in self.latency:
-            lat.maybe_delay(op, path)
+            if getattr(lat, "per_byte", False):
+                lat.maybe_delay(op, path, nbytes)
+            else:
+                lat.maybe_delay(op, path)
 
     # ------------------------------------------------------------------
     def create(self, path: str) -> BinaryIO:

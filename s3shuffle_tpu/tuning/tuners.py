@@ -220,6 +220,7 @@ class ScanTuner(_BaseTuner):
         "max_buffer_size_task": (16 * MiB, 256 * MiB),
         "decode_batch_frames": (4, 128),
         "decode_inflight_batches": (1, 8),
+        "hot_read_fanout": (2, 64),
     }
 
     def __init__(self, cfg):
@@ -254,6 +255,11 @@ class ScanTuner(_BaseTuner):
                 "decode_inflight_batches", cfg.decode_inflight_batches,
                 dense_head=True, apply=self._apply_decode_window,
             )
+        # skew plane: the hot-object diversion trigger (concurrency count at
+        # which reads fan out to parity sources) rides the tuned scan cfg
+        # like every other read knob; 0 = prong off, never overruled
+        if getattr(cfg, "hot_read_fanout", 0) > 0:
+            add("hot_read_fanout", cfg.hot_read_fanout, dense_head=True)
         # max_buffer_size_task is a MEMORY CAP, not a request-shape knob: the
         # operator's static value is the ceiling (N concurrent reduce tasks
         # each provisioned at the configured budget must never see the tuner
@@ -356,6 +362,8 @@ class CommitTuner(_BaseTuner):
         "composite_flush_bytes": (4 * MiB, 256 * MiB),
         "encode_inflight_batches": (1, 8),
         "columnar_batch_rows": (8192, 1 << 18),
+        "combine_threshold_bytes": (64 * 1024, 16 * MiB),
+        "split_threshold_bytes": (1 * MiB, 64 * MiB),
     }
 
     def __init__(self, cfg):
@@ -384,6 +392,12 @@ class CommitTuner(_BaseTuner):
             )
         if cfg.columnar and cfg.columnar_batch_rows > 1:  # 0 = legacy plane
             add("columnar_batch_rows", cfg.columnar_batch_rows)
+        # skew plane write-side knobs (0 = prong off, never overruled): the
+        # combine sidecar's engage point and the hot-partition split stripe
+        if getattr(cfg, "combine_threshold_bytes", 0) > 0:
+            add("combine_threshold_bytes", cfg.combine_threshold_bytes)
+        if getattr(cfg, "split_threshold_bytes", 0) > 0:
+            add("split_threshold_bytes", cfg.split_threshold_bytes)
         super().__init__(cfg, knobs)
         self._signals = _SignalDelta(
             histograms=("write_upload_queue_wait_seconds",),
@@ -426,6 +440,18 @@ class CommitTuner(_BaseTuner):
         if static <= 1:  # degenerate static: never overrule
             return static
         return self.value("columnar_batch_rows", static)
+
+    def combine_threshold_bytes(self, static: int) -> int:
+        """Combine-sidecar engage-point consult (skew plane, map write)."""
+        if static <= 0:  # prong disabled by the operator: never re-enable
+            return static
+        return self.value("combine_threshold_bytes", static)
+
+    def split_threshold_bytes(self, static: int) -> int:
+        """Hot-partition split-stripe consult (skew plane, commit/seal)."""
+        if static <= 0:  # prong disabled by the operator: never re-enable
+            return static
+        return self.value("split_threshold_bytes", static)
 
     def seal_thresholds(self, static_members: int, static_bytes: int) -> Tuple[int, int]:
         """Composite seal-point consult: (member-count cap, byte cap)."""

@@ -17,10 +17,13 @@ __version__ = "0.1.0"
 #: fat-index v2 header, snapshot wire v3, registration parity field → 4;
 #: r13's columnar record plane — the column-frame data wire is the default
 #: framing of columnar serializers (columnar=0 restores the format-4
-#: frames byte-identically) → 5).
+#: frames byte-identically) → 5; r15's skew mitigation plane — the skew
+#: index trailer and fat-index v3 (combined-partials flags + hot-partition
+#: split stripes; combine/split=0 restores the format-5 blobs
+#: byte-identically) → 6).
 #: Driver and all workers of one job must run the same value; re-reading
 #: kept shuffle data (cleanup=False) across versions is unsupported.
-SHUFFLE_FORMAT_VERSION = 5
+SHUFFLE_FORMAT_VERSION = 6
 
 BUILD_INFO = {
     "name": "s3shuffle_tpu",

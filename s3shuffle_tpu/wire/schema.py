@@ -56,15 +56,48 @@ WIRE_STRUCTS = {
         "read_versions": [],
         "current_version": None,
         "since_format": 1,
-        "current_format": 4,
+        "current_format": 6,
         "doc": "Cumulative partition offsets of one map output — its "
                "existence is the COMMIT POINT of the map (index written "
                "last). Byte-compatible with reference-written index files "
-               "when uncoded.",
+               "when uncoded and skew-free.",
         "layout": [
             "`num_partitions + 1` words: cumulative offsets `[0, l0, l0+l1, ...]`",
-            "optional 4-word stripe-geometry trailer (format >= 4, parity on; "
-            "see `index_geometry_trailer`)",
+            "optional 4-word skew trailer (format >= 6, a skew prong "
+            "engaged; see `index_skew_trailer`)",
+            "optional 4-word stripe-geometry trailer (format >= 4, parity "
+            "on — always the blob's FINAL words; see "
+            "`index_geometry_trailer`)",
+        ],
+    },
+    "index_skew_trailer": {
+        "title": "Skew index trailer (`S3SHSKEW`)",
+        "kind": "store object (embedded)",
+        "module": "s3shuffle_tpu/skew.py",
+        "constants": {
+            "SKEW_MAGIC": 0x53335348534B4557,
+            "SKEW_TRAILER_WORDS": 4,
+            "FLAG_COMBINED": 1,
+        },
+        "read_versions": [],
+        "current_version": None,
+        "since_format": 6,
+        "current_format": 6,
+        "doc": "Appended to a per-map `.index` blob when a skew-mitigation "
+               "prong engaged at commit: flags bit 0 marks partitions that "
+               "carry map-side-combined partial rows, and split_bytes "
+               "records the stripe granularity the scan planner fans hot "
+               "partitions out at. Sits BEFORE the geometry trailer (which "
+               "stays the blob's final words); recognized by magic and "
+               "split back off before any offset consumer sees the words. "
+               "Absent at combine/split=0 so the skew-free index stays "
+               "byte-identical to the pre-skew wire.",
+        "layout": [
+            "word 0: magic `S3SHSKEW` (0x53335348534B4557)",
+            "word 1: flags (bit 0 = combined partial rows)",
+            "word 2: split_bytes (hot-partition stripe granularity; 0 = "
+            "no partition crossed the split threshold)",
+            "word 3: reserved (0)",
         ],
     },
     "index_geometry_trailer": {
@@ -112,24 +145,33 @@ WIRE_STRUCTS = {
         "module": "s3shuffle_tpu/metadata/fat_index.py",
         "constants": {
             "_MAGIC": 0x5333464154494458,
-            "_VERSION": 2,
+            "_VERSION": 3,
             "_HEADER_V1": 7,
             "_HEADER_V2": 11,
+            "_HEADER_V3": 12,
+            "_MEMBER_WORDS_V3": 4,
         },
-        "read_versions": [1, 2],
-        "current_version": 2,
+        "read_versions": [1, 2, 3],
+        "current_version": 3,
         "since_format": 3,
-        "current_format": 4,
+        "current_format": 6,
         "doc": "One index object for every member of a composite group — "
                "the group's COMMIT POINT (data object first, fat index "
                "last). v2 (format 4) appended four stripe-geometry header "
-               "words; v1 blobs still parse (geometry defaults to none).",
+               "words; v3 (format 6, the skew plane) appends a split_bytes "
+               "header word and widens member rows to 4 words with a flags "
+               "column — emitted ONLY when a skew prong engaged, so "
+               "zero-skew groups keep writing v2 byte-identically. v1/v2 "
+               "blobs still parse (geometry/skew default to none).",
         "layout": [
             "header v1 (7 words): magic `S3FATIDX`, version, shuffle_id, "
             "group_id, num_partitions, n_members, has_checksums",
             "header v2 (+4 words): parity_segments, parity_stripe_k, "
             "parity_chunk_bytes, payload_len (all zero when uncoded)",
-            "`n_members` rows of `[map_id, map_index, base_offset]`",
+            "header v3 (+1 word): split_bytes (hot-partition stripe "
+            "granularity)",
+            "`n_members` rows of `[map_id, map_index, base_offset]` "
+            "(v3: `+[flags]`, bit 0 = combined partial rows)",
             "`n_members` rows of `num_partitions + 1` member-relative "
             "cumulative offsets",
             "when has_checksums: `n_members` rows of `num_partitions` "

@@ -43,8 +43,8 @@ from s3shuffle_tpu.block_ids import (
     ShuffleIndexBlockId,
     ShuffleParityBlockId,
 )
-from s3shuffle_tpu.coding.parity import split_index_geometry
 from s3shuffle_tpu.metadata.fat_index import FatIndex, FatIndexMember
+from s3shuffle_tpu.skew import split_index_trailers
 from s3shuffle_tpu.metadata.helper import ShuffleHelper
 from s3shuffle_tpu.metadata.map_output import STORE_LOCATION, MapStatus
 from s3shuffle_tpu.metrics import registry as _metrics
@@ -79,6 +79,9 @@ class _Candidate:
     offsets: np.ndarray
     checksums: Optional[np.ndarray]
     parity_segments: int = 0
+    #: skew plane: the singleton's index carried FLAG_COMBINED — its
+    #: partitions hold map-side partials, preserved in the fat-index row
+    combined: bool = False
 
 
 def compact_shuffle(
@@ -161,7 +164,7 @@ def compact_shuffle(
         if size >= threshold:
             continue
         try:
-            offsets, geometry = split_index_geometry(
+            offsets, geometry, skew = split_index_trailers(
                 helper.read_block_as_array(
                     ShuffleIndexBlockId(shuffle_id, idx.map_id)
                 )
@@ -183,6 +186,7 @@ def compact_shuffle(
             _Candidate(
                 idx.map_id, int(size), offsets, checksums,
                 parity_segments=geometry.segments if geometry else 0,
+                combined=skew is not None and skew.combined,
             )
         )
     if len(candidates) < 2:
@@ -236,6 +240,7 @@ def compact_shuffle(
                         base_offset=base,
                         offsets=m.offsets,
                         checksums=m.checksums,
+                        combined=m.combined,
                     )
                 )
                 statuses.append(

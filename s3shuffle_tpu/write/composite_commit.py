@@ -86,6 +86,9 @@ class CompositeMember:
     #: parity sidecars of the composite object this member landed in —
     #: assigned at the group seal (0 until then / when uncoded)
     parity_segments: int = 0
+    #: skew plane: the member's partitions carry map-side-combined partial
+    #: rows — recorded in the fat-index v3 member flags at the seal
+    combined: bool = False
 
     def offsets(self) -> np.ndarray:
         """Member-relative cumulative offsets (the fat-index row)."""
@@ -268,6 +271,7 @@ class CompositeCommitAggregator:
         checksums: Optional[np.ndarray],
         payload,
         total_bytes: int,
+        combined: bool = False,
     ):
         """Append one map task's fully-drained payload to the open group
         (opening a new one as needed) and return its assigned
@@ -319,6 +323,7 @@ class CompositeCommitAggregator:
                     lengths=np.asarray(lengths, dtype=np.int64),
                     checksums=None if checksums is None else np.asarray(checksums, dtype=np.int64),
                     total_bytes=int(total_bytes),
+                    combined=bool(combined),
                 )
                 group.members.append(member)
                 members_cap, bytes_cap = self._seal_thresholds()
@@ -401,6 +406,30 @@ class CompositeCommitAggregator:
             delete_parity_objects(self.dispatcher, group.parity_blocks)
 
     # ------------------------------------------------------------------
+    def _split_bytes_for(self, group: _OpenGroup) -> int:
+        """Skew plane, seal-time half of the hot-partition split decision:
+        member partition sizes are measured (the commit lengths), so a
+        group whose members hold partitions past ``split_threshold_bytes``
+        records the stripe granularity in the fat-index v3 header — the
+        scan planner then fans those partitions out as independent
+        sub-range GETs. 0 (recorded nowhere, v2 emission) when the knob is
+        off or nothing crossed."""
+        threshold = self.dispatcher.config.split_threshold_bytes
+        if threshold <= 0:
+            return 0
+        if self._tuner is not None:
+            threshold = self._tuner.split_threshold_bytes(threshold)
+        crossed = sum(
+            int((m.lengths > threshold).sum()) for m in group.members
+        )
+        if not crossed:
+            return 0
+        if _metrics.enabled():
+            from s3shuffle_tpu.skew import C_PARTITION_SPLITS
+
+            C_PARTITION_SPLITS.inc(crossed)
+        return int(threshold)
+
     def _finish(self, group: _OpenGroup) -> None:
         """Seal one detached group: final data flush, then the fat index —
         the commit point — then the registration callback."""
@@ -444,10 +473,12 @@ class CompositeCommitAggregator:
                             base_offset=m.base_offset,
                             offsets=m.offsets(),
                             checksums=m.checksums,
+                            combined=m.combined,
                         )
                         for m in group.members
                     ],
                     parity=geometry,
+                    split_bytes=self._split_bytes_for(group),
                 )
                 # small idempotent-by-overwrite PUT, re-driven at object
                 # granularity like the per-map sidecars; it stays the LAST

@@ -101,6 +101,11 @@ class MapOutputWriter:
         self._total_bytes = 0
         self._last_partition_id = -1
         self._committed = False
+        # Skew plane: set via note_combined() by a map writer whose
+        # partitions shipped map-side-combined partial rows — recorded in
+        # the index sidecar's skew trailer (or the fat-index member flags)
+        # so readers know to merge through the aggregator.
+        self._combined_partials = False
         self._block = ShuffleDataBlockId(shuffle_id, map_id)
 
     # ------------------------------------------------------------------
@@ -184,6 +189,43 @@ class MapOutputWriter:
         self._total_bytes += nbytes
 
     # ------------------------------------------------------------------
+    def note_combined(self) -> None:
+        """The map writer shipped map-side-combined partial rows for at
+        least one partition (skew plane, write/spill_writer.py) — the
+        commit records it so readers merge through the aggregator."""
+        self._combined_partials = True
+
+    def _skew_info(self):
+        """The commit-time skew decision: partition sizes are in hand (the
+        measured lengths), so this is where hot partitions get their split
+        fan-out recorded. Returns a SkewInfo for the index trailer / fat
+        index, or None when no prong engaged (the trailer then stays
+        absent and the blob byte-identical to the pre-skew wire)."""
+        cfg = self.dispatcher.config
+        threshold = cfg.split_threshold_bytes
+        if threshold > 0:
+            tuner = getattr(self.dispatcher, "commit_tuner", None)
+            if tuner is not None:
+                threshold = tuner.split_threshold_bytes(threshold)
+        split_bytes = 0
+        if threshold > 0:
+            crossed = int((self._lengths > threshold).sum())
+            if crossed:
+                split_bytes = int(threshold)
+                from s3shuffle_tpu.skew import C_PARTITION_SPLITS
+                from s3shuffle_tpu.metrics import registry as _metrics
+
+                if _metrics.enabled():
+                    C_PARTITION_SPLITS.inc(crossed)
+        if not self._combined_partials and split_bytes == 0:
+            return None
+        from s3shuffle_tpu.skew import SkewInfo
+
+        return SkewInfo(
+            combined=self._combined_partials, split_bytes=split_bytes
+        )
+
+    # ------------------------------------------------------------------
     def commit_all_partitions(self) -> MapOutputCommitMessage:
         if self._committed:
             raise RuntimeError("commit_all_partitions called twice")
@@ -201,6 +243,7 @@ class MapOutputWriter:
                 )
             self._stream.close()  # final flush to the store, logs bandwidth
         geometry = self._emit_parity()
+        skew = self._skew_info()
         if self._total_bytes > 0 or self.dispatcher.config.always_create_index:
             from s3shuffle_tpu.storage.retrying import retry_call
 
@@ -227,7 +270,8 @@ class MapOutputWriter:
             # exactly when the data object does.
             retry_call(
                 lambda: self.helper.write_partition_lengths(
-                    self.shuffle_id, self.map_id, self._lengths, parity=geometry
+                    self.shuffle_id, self.map_id, self._lengths,
+                    parity=geometry, skew=skew,
                 ),
                 policy, op="commit_index", scheme=scheme,
             )
@@ -289,6 +333,7 @@ class MapOutputWriter:
                 checksums,
                 source,
                 self._total_bytes,
+                combined=self._combined_partials,
             )
         finally:
             if payload is not None:

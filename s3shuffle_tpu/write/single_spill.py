@@ -88,6 +88,28 @@ class SingleSpillMapOutputWriter:
             put_parity_objects(self.dispatcher, block, geometry, payloads)
         if checksums is not None and self.dispatcher.config.checksum_enabled:
             self.helper.write_checksums(self.shuffle_id, self.map_id, checksums)
+        # Skew plane: partition sizes are in hand here exactly like the main
+        # writer's commit — a hot partition in a single-spill output records
+        # its split stripe too, or this path would be silently exempt from
+        # the mitigation (the same gap class the parity tee above closes).
+        # Combine never applies (the payload is pre-merged raw rows).
+        skew = None
+        threshold = self.dispatcher.config.split_threshold_bytes
+        if threshold > 0:
+            tuner = getattr(self.dispatcher, "commit_tuner", None)
+            if tuner is not None:
+                threshold = tuner.split_threshold_bytes(threshold)
+            crossed = int(
+                (np.asarray(partition_lengths, dtype=np.int64) > threshold).sum()
+            )
+            if crossed:
+                from s3shuffle_tpu.metrics import registry as _metrics
+                from s3shuffle_tpu.skew import C_PARTITION_SPLITS, SkewInfo
+
+                if _metrics.enabled():
+                    C_PARTITION_SPLITS.inc(crossed)
+                skew = SkewInfo(split_bytes=int(threshold))
         self.helper.write_partition_lengths(
-            self.shuffle_id, self.map_id, partition_lengths, parity=geometry
+            self.shuffle_id, self.map_id, partition_lengths, parity=geometry,
+            skew=skew,
         )

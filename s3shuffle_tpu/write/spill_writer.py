@@ -316,6 +316,23 @@ class ShuffleMapWriter(MapWriterBase):
         ]
         self._combine_reducer = None  # columnar map-side combine state
         self._since_budget_check = 0
+        # Skew plane, combine-sidecar prong: for aggregating deps whose
+        # combine runs REDUCE-side (map_side_combine off), partitions whose
+        # routed bytes cross combine_threshold_bytes get their chunks
+        # pre-reduced map-side (colagg reduce_chunk) so hot partitions ship
+        # partial aggregates. Static 0 = prong off, never overruled.
+        dep = self.dep
+        cfg = self.output_writer.dispatcher.config
+        self._combine_gate = (
+            not dep.map_side_combine
+            and dep.aggregator is not None
+            and getattr(dep.aggregator, "supports_columnar", False)
+            and self.serializer.supports_batches
+            and getattr(cfg, "combine_threshold_bytes", 0) > 0
+        )
+        self._sidecar_reducer = None
+        self._sidecar_routed = None  # per-partition routed-bytes tally
+        self._sidecar_threshold = 0
 
     # ------------------------------------------------------------------
     def write(self, records: Iterable[Tuple[Any, Any]]) -> None:
@@ -418,12 +435,58 @@ class ShuffleMapWriter(MapWriterBase):
             for pid in range(dep.num_partitions):
                 lo, hi = int(bounds[pid]), int(bounds[pid + 1])
                 if hi > lo:
-                    self._pipelines[pid].record_writer.write_batch(
-                        grouped.slice_rows(lo, hi)
-                    )
+                    sl = grouped.slice_rows(lo, hi)
+                    if self._combine_gate:
+                        sl = self._maybe_combine_chunk(pid, sl)
+                    self._pipelines[pid].record_writer.write_batch(sl)
             self._records_written += batch.n
             if self._buffered_total() > self.spill_memory_budget:
                 self._spill()
+
+    def _maybe_combine_chunk(self, pid: int, sl):
+        """Combine-sidecar decision for one (chunk × partition) slice: once
+        the partition's routed bytes cross the threshold, its chunks are
+        pre-reduced (argsort + reduceat, chunk-local — streaming, bounded
+        by the chunk itself) and the smaller form ships. A chunk the
+        reduction does not shrink (mostly-unique keys — the widening of a
+        narrow schema can even grow it) ships raw, so the sidecar can only
+        ever REMOVE wire bytes. Shipping any partial flags the map output
+        (note_combined → the index sidecar's FLAG_COMBINED) so readers
+        merge through the aggregator."""
+        if self._sidecar_routed is None:
+            cfg = self.output_writer.dispatcher.config
+            threshold = cfg.combine_threshold_bytes
+            tuner = getattr(self.output_writer.dispatcher, "commit_tuner", None)
+            if tuner is not None:
+                threshold = tuner.combine_threshold_bytes(threshold)
+            self._sidecar_threshold = int(threshold)
+            self._sidecar_routed = np.zeros(self.dep.num_partitions, dtype=np.int64)
+            self._sidecar_reducer = self.dep.aggregator.new_reducer(
+                spill_bytes=cfg.aggregator_spill_bytes
+            )
+        routed = int(self._sidecar_routed[pid])
+        self._sidecar_routed[pid] += sl.nbytes
+        if routed < self._sidecar_threshold:
+            return sl
+        try:
+            reduced = self._sidecar_reducer.reduce_chunk(sl)
+        except ValueError as e:
+            # a value shape the columnar plane cannot combine (outside the
+            # declared schema): ship raw and stop trying for this task
+            logger.debug(
+                "map-side combine sidecar disabled for map %d: %s",
+                self.map_id, e,
+            )
+            self._combine_gate = False
+            return sl
+        if reduced.n < sl.n and reduced.nbytes < sl.nbytes:
+            if _metrics.enabled():
+                from s3shuffle_tpu.skew import C_MAP_COMBINE_ROWS
+
+                C_MAP_COMBINE_ROWS.inc(sl.n - reduced.n)
+            self.output_writer.note_combined()
+            return reduced
+        return sl
 
     def _buffered_total(self) -> int:
         return sum(p.buffered_bytes() for p in self._pipelines)

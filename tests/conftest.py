@@ -134,9 +134,9 @@ class RecordingBackend(FlakyBackend):
         super().__init__(inner)
         self.ops = []
 
-    def _check(self, op: str, path: str) -> None:
+    def _check(self, op: str, path: str, nbytes: int = 0) -> None:
         self.ops.append((op, path))
-        super()._check(op, path)
+        super()._check(op, path, nbytes=nbytes)
 
     def count(self, op: str, needle: str = "") -> int:
         return sum(1 for o, p in self.ops if o == op and needle in p)

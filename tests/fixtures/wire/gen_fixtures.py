@@ -26,6 +26,7 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 SNAP_MAGIC = 0x5333485348534E41  # "S3SHSNAP"
 FAT_MAGIC = 0x5333464154494458  # "S3FATIDX"
 GEOM_MAGIC = 0x5333504152474D54  # "S3PARGMT"
+SKEW_MAGIC = 0x53335348534B4557  # "S3SHSKEW"
 
 #: shared scenario: shuffle 3, 4 partitions, two map outputs
 SID, EPOCH, P = 3, 2, 4
@@ -103,6 +104,31 @@ def fat_index_v2() -> bytes:
     return FatIndex(SID, 11, P, members, parity=parity).to_bytes()
 
 
+def fat_index_v3() -> bytes:
+    # the skew plane's shape: split_bytes header word + 4-word member rows
+    # (flags bit 0 = combined partials); emitted only when a prong engaged
+    from s3shuffle_tpu.coding.parity import ParityGeometry
+    from s3shuffle_tpu.metadata.fat_index import FatIndex, FatIndexMember
+
+    members = [
+        FatIndexMember(
+            map_id=20, map_index=0, base_offset=0,
+            offsets=np.array([0, 25, 50, 75, 100], dtype=np.int64),
+            checksums=np.array([101, 102, 103, 104], dtype=np.int64),
+            combined=True,
+        ),
+        FatIndexMember(
+            map_id=21, map_index=1, base_offset=100,
+            offsets=np.array([0, 16, 32, 48, 64], dtype=np.int64),
+            checksums=np.array([201, 202, 203, 204], dtype=np.int64),
+        ),
+    ]
+    parity = ParityGeometry(segments=2, stripe_k=4, chunk_bytes=32,
+                            payload_len=164)
+    return FatIndex(SID, 11, P, members, parity=parity,
+                    split_bytes=48).to_bytes()
+
+
 def index_plain_v1() -> bytes:
     # cumulative offsets only — byte-identical to the reference writer
     return be([0, 10, 30, 60, 100])
@@ -111,6 +137,14 @@ def index_plain_v1() -> bytes:
 def index_geom_v4() -> bytes:
     # format-4 coded layout: same offsets + the 4-word geometry trailer
     return be([0, 10, 30, 60, 100, GEOM_MAGIC, 2, 4, 32])
+
+
+def index_skew_v6() -> bytes:
+    # format-6 skew layout: offsets + skew trailer (combined flag, 40-byte
+    # split stripe) + geometry trailer (the geometry words stay FINAL)
+    return be(
+        [0, 10, 30, 60, 100, SKEW_MAGIC, 1, 40, 0, GEOM_MAGIC, 2, 4, 32]
+    )
 
 
 def checksum_v1() -> bytes:
@@ -165,8 +199,10 @@ BLOBS = {
     "snapshot_v3.bin": snapshot_v3,
     "fat_index_v1.bin": fat_index_v1,
     "fat_index_v2.bin": fat_index_v2,
+    "fat_index_v3.bin": fat_index_v3,
     "index_plain_v1.bin": index_plain_v1,
     "index_geom_v4.bin": index_geom_v4,
+    "index_skew_v6.bin": index_skew_v6,
     "checksum_v1.bin": checksum_v1,
     "parity_header_v1.bin": parity_header_v1,
     "colframe_fixed_v1.bin": colframe_fixed_v1,

@@ -672,12 +672,23 @@ def test_reduce_failure_with_no_loss_and_no_recovery_is_fatal(tmp_path):
 # ---------------------------------------------------------------------------
 
 
-def _prime_fill_class(cls: str, seconds: float, n: int):
-    hist = mreg.REGISTRY.histogram(
+def _prime_fills(nbytes: int, seconds: float, n: int):
+    """Prime the fill evidence exactly as the prefetch plane observes it:
+    the absolute class histogram AND the per-MiB-normalized series the
+    speculation threshold consumes (read/prefetch.py observes both per
+    prefill)."""
+    from s3shuffle_tpu.read.prefetch import fill_norm_mib, fill_size_class
+
+    cls = fill_size_class(nbytes)
+    h_abs = mreg.REGISTRY.histogram(
         "read_prefetch_fill_class_seconds", labelnames=("size_class",)
     )
+    h_mib = mreg.REGISTRY.histogram(
+        "read_prefetch_fill_per_mib_seconds", labelnames=("size_class",)
+    )
     for _ in range(n):
-        hist.labels(size_class=cls).observe(seconds)
+        h_abs.labels(size_class=cls).observe(seconds)
+        h_mib.labels(size_class=cls).observe(seconds / fill_norm_mib(nbytes))
 
 
 def test_speculation_threshold_is_size_class_aware(metrics_on):
@@ -686,8 +697,8 @@ def test_speculation_threshold_is_size_class_aware(metrics_on):
     quantile armed a parity race on every large fill."""
     from s3shuffle_tpu.coding.degraded import DegradedReader, SpeculativeFetcher
 
-    _prime_fill_class("le1m", 0.01, 20)     # small blocks: ~10 ms
-    _prime_fill_class("le64m", 0.5, 12)     # healthy large segments: ~500 ms
+    _prime_fills(256 * 1024, 0.01, 20)   # small blocks: ~10 ms
+    _prime_fills(32 << 20, 0.5, 12)      # healthy large segments: ~500 ms
     fetcher = SpeculativeFetcher(DegradedReader(None), quantile=0.9)
     small = fetcher.threshold_s(256 * 1024)
     large = fetcher.threshold_s(32 << 20)
@@ -699,14 +710,34 @@ def test_speculation_threshold_is_size_class_aware(metrics_on):
     assert fetcher.threshold_s(128 << 20) is None
 
 
+def test_speculation_threshold_scales_per_byte_within_class(metrics_on):
+    """The seconds-per-byte half (ROADMAP coded-plane follow-on): a class
+    spans an 8x size range, so the threshold must scale with the prefill's
+    OWN size — a 32 MiB fill earns 4x the bar of an 8.1 MiB one, instead
+    of both being judged by one raw-seconds class quantile."""
+    from s3shuffle_tpu.coding.degraded import DegradedReader, SpeculativeFetcher
+
+    # homogeneous evidence: le64m fills at ~15.6 ms/MiB (0.5 s per 32 MiB)
+    _prime_fills(32 << 20, 0.5, 12)
+    fetcher = SpeculativeFetcher(DegradedReader(None), quantile=0.9)
+    small_end = fetcher.threshold_s(9 << 20)    # 9 MiB, same class
+    large_end = fetcher.threshold_s(32 << 20)
+    assert small_end is not None and large_end is not None
+    ratio = large_end / small_end
+    assert 3.0 <= ratio <= 4.2, (
+        f"threshold should scale ~linearly with size within a class "
+        f"(expected ~32/9, got {ratio})"
+    )
+
+
 def test_healthy_large_fill_no_longer_races(metrics_on):
     """Regression for the spurious race: a 0.2 s large-segment fill — slow
     by small-block standards, normal for its size class — must complete on
     the primary path with ZERO speculative reads."""
     from s3shuffle_tpu.coding.degraded import DegradedReader, SpeculativeFetcher
 
-    _prime_fill_class("le1m", 0.01, 20)
-    _prime_fill_class("le64m", 0.5, 12)
+    _prime_fills(256 * 1024, 0.01, 20)
+    _prime_fills(32 << 20, 0.5, 12)
 
     class _Stream:
         data_block = None
@@ -729,7 +760,7 @@ def test_small_class_still_arms_races(metrics_on):
     fill that blows past its own class's quantile still races."""
     from s3shuffle_tpu.coding import degraded as dg
 
-    _prime_fill_class("le1m", 0.01, 20)
+    _prime_fills(256 * 1024, 0.01, 20)
 
     class _Block:
         name = "shuffle_0_0.data"

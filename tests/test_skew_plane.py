@@ -1,0 +1,832 @@
+"""Skew mitigation plane (ISSUE 15): map-side combine sidecars,
+hot-partition splitting, and coded read fan-out.
+
+Layered like the plane:
+
+- **wire** — the skew index trailer round-trips through
+  ``write_partition_lengths`` → ``resolve_map_location`` alongside the
+  parity geometry trailer, and stays ABSENT at the off switches;
+- **combine sidecar** — aggregated reduce output is byte-identical
+  combine-on vs combine-off (sum/min/max and the narrow-schema shapes),
+  non-aggregating deps pass through untouched, and a reader with no
+  aggregator refuses combined partials loudly;
+- **splitting** — scan byte-identity across split counts × coalescing ×
+  parity on/off, the fat-index v3 composite path, the fan-out cap, and
+  the short-part prefix degradation;
+- **hot fan-out** — reads divert to parity reconstruction exactly when
+  the object is hot AND the range is chunk-sized, byte-identically;
+- **off switches** — combine/split/fanout = 0 is op-for-op the pre-plane
+  request pattern on the shared RecordingBackend, with reference-wire
+  index blobs.
+"""
+
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+from conftest import RecordingBackend
+
+from s3shuffle_tpu.batch import RecordBatch
+from s3shuffle_tpu.block_ids import ShuffleBlockId, ShuffleIndexBlockId
+from s3shuffle_tpu.colagg import ColumnarAggregator
+from s3shuffle_tpu.config import ShuffleConfig
+from s3shuffle_tpu.dependency import BytesHashPartitioner, ShuffleDependency
+from s3shuffle_tpu.manager import ShuffleManager
+from s3shuffle_tpu.metadata.helper import ScanIndexMemo, ShuffleHelper
+from s3shuffle_tpu.metrics import registry as mreg
+from s3shuffle_tpu.serializer import ColumnarKVSerializer
+from s3shuffle_tpu.skew import OBJECT_GETS, SkewInfo
+from s3shuffle_tpu.storage.dispatcher import Dispatcher
+from s3shuffle_tpu.write.map_output_writer import MapOutputWriter
+
+
+@pytest.fixture
+def metrics_on():
+    mreg.REGISTRY.reset_values()
+    mreg.enable()
+    yield mreg.REGISTRY
+    mreg.disable()
+    mreg.REGISTRY.reset_values()
+
+
+def _counter(registry, name, **labels):
+    snap = registry.snapshot(compact=True)
+    return sum(
+        float(s.get("value", 0))
+        for s in snap.get(name, {}).get("series", [])
+        if all(s.get("labels", {}).get(k) == v for k, v in labels.items())
+    )
+
+
+def _env(tmp_path, tag, **over):
+    Dispatcher.reset()
+    cfg = ShuffleConfig(root_dir=f"file://{tmp_path}/{tag}", app_id=tag, **over)
+    d = Dispatcher(cfg)
+    return cfg, d, ShuffleHelper(d)
+
+
+def _write_maps(d, helper, sid, sizes, seed=0):
+    rng = random.Random(seed)
+    truth = {}
+    for m, row in enumerate(sizes):
+        w = MapOutputWriter(d, helper, sid, m, len(row))
+        for p, n in enumerate(row):
+            data = rng.randbytes(n)
+            truth[(m, p)] = data
+            pw = w.get_partition_writer(p)
+            if data:
+                pw.write(data)
+            pw.close()
+        w.commit_all_partitions()
+    return truth
+
+
+def _scan(d, helper, cfg, sid, sizes):
+    from s3shuffle_tpu.read.chunked_fetch import ChunkedRangeFetcher
+    from s3shuffle_tpu.read.scan_plan import build_scan_iterator
+
+    blocks = [
+        ShuffleBlockId(sid, m, p)
+        for m in range(len(sizes))
+        for p in range(len(sizes[m]))
+    ]
+    it = build_scan_iterator(
+        d, ScanIndexMemo(helper), blocks, cfg,
+        fetcher=ChunkedRangeFetcher.from_config(cfg),
+    )
+    got = {}
+    for s in it:
+        got[(s.block.map_id, s.block.reduce_id)] = s.readall()
+        s.close()
+    return got
+
+
+# ---------------------------------------------------------------------------
+# Wire: the skew trailer
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("parity_on", [False, True])
+def test_skew_trailer_roundtrips_with_and_without_parity(tmp_path, parity_on):
+    over = (
+        dict(parity_segments=1, parity_stripe_k=1, parity_chunk_bytes=1024)
+        if parity_on
+        else {}
+    )
+    cfg, d, helper = _env(tmp_path, f"wire-{parity_on}", **over)
+    w = MapOutputWriter(d, helper, 0, 0, 2)
+    for p, n in enumerate((3000, 500)):
+        pw = w.get_partition_writer(p)
+        pw.write(b"x" * n)
+        pw.close()
+    w.note_combined()
+    # the split half engages through the config knob: partition 0 crosses
+    d.config.split_threshold_bytes = 2048
+    w.commit_all_partitions()
+    loc = helper.resolve_map_location(0, 0)
+    assert loc.combined is True
+    assert loc.split_bytes == 2048
+    assert list(loc.offsets) == [0, 3000, 3500]
+    if parity_on:
+        assert loc.parity is not None and loc.parity.payload_len == 3500
+    else:
+        assert loc.parity is None
+
+
+def test_skew_trailer_absent_when_no_prong_engaged(tmp_path):
+    cfg, d, helper = _env(tmp_path, "wire-off")
+    _write_maps(d, helper, 0, [[1000, 200]], seed=1)
+    blob = d.backend.read_all(d.get_path(ShuffleIndexBlockId(0, 0)))
+    expected = np.ascontiguousarray(
+        np.array([0, 1000, 1200], dtype=np.int64), dtype=">i8"
+    ).tobytes()
+    assert blob == expected  # reference wire, byte-identical
+    loc = helper.resolve_map_location(0, 0)
+    assert loc.split_bytes == 0 and loc.combined is False
+
+
+def test_skew_info_active_gate():
+    assert not SkewInfo().active
+    assert SkewInfo(combined=True).active
+    assert SkewInfo(split_bytes=1).active
+
+
+# ---------------------------------------------------------------------------
+# Combine sidecar
+# ---------------------------------------------------------------------------
+
+OPS_CASES = [("sum",), ("min",), ("max",), ("sum", "min", "max")]
+
+
+def _agg_rows(ops, n_rows=6000, hot_keys=6, parts=4, seed=7):
+    """Rows with a HOT partition (few duplicate keys) plus unique-key
+    background — (key_bytes, value_bytes) with len(ops) int64 columns."""
+    rng = np.random.default_rng(seed)
+    part_fn = BytesHashPartitioner(parts)
+    import struct
+
+    hot = []
+    i = 100
+    hot_pid = part_fn(struct.pack(">q", 77))
+    while len(hot) < hot_keys:
+        if part_fn(struct.pack(">q", i)) == hot_pid:
+            hot.append(i)
+        i += 1
+    keys = np.concatenate([
+        np.asarray(hot, dtype=np.int64)[np.arange(n_rows) % hot_keys],
+        rng.integers(1 << 30, 1 << 40, size=n_rows // 4),
+    ])
+    vals = rng.integers(-1000, 1000, size=(len(keys), len(ops)))
+    rows = [
+        (
+            struct.pack(">q", int(k)),
+            np.asarray(v, dtype="<i8").tobytes(),
+        )
+        for k, v in zip(keys, vals)
+    ]
+    return rows, parts
+
+
+def _run_agg_shuffle(tmp_path, tag, ops, rows, parts, n_maps=2, **over):
+    Dispatcher.reset()
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/{tag}", app_id=tag,
+        columnar_batch_rows=512, **over,
+    )
+    mgr = ShuffleManager(cfg)
+    dep = ShuffleDependency(
+        shuffle_id=0,
+        partitioner=BytesHashPartitioner(parts),
+        serializer=ColumnarKVSerializer(),
+        aggregator=ColumnarAggregator(ops),
+    )
+    handle = mgr.register_shuffle(0, dep)
+    for m in range(n_maps):
+        w = mgr.get_writer(handle, map_id=m)
+        w.write(RecordBatch.from_records(rows[m::n_maps]))
+        assert w.stop(success=True) is not None
+    out = {}
+    for rid in range(parts):
+        for k, v in mgr.get_reader(handle, rid, rid + 1).read():
+            assert k not in out
+            out[k] = bytes(v)
+    return mgr, handle, out
+
+
+@pytest.mark.parametrize("ops", OPS_CASES)
+def test_combine_sidecar_reduce_identity(tmp_path, metrics_on, ops):
+    """The tentpole identity: threshold-gated map-side combine must leave
+    the AGGREGATED reduce output byte-for-byte what the uncombined path
+    produces — partials merge through the same commutative ops."""
+    rows, parts = _agg_rows(ops)
+    _m0, _h0, base = _run_agg_shuffle(
+        tmp_path, f"agg-off-{len(ops)}", ops, rows, parts,
+        combine_threshold_bytes=0,
+    )
+    assert _counter(metrics_on, "shuffle_map_combine_rows_total") == 0
+    _m1, h1, combined = _run_agg_shuffle(
+        tmp_path, f"agg-on-{len(ops)}", ops, rows, parts,
+        combine_threshold_bytes=4096,
+    )
+    assert combined == base
+    # the sidecar engaged and rows were pre-reduced away
+    assert _counter(metrics_on, "shuffle_map_combine_rows_total") > 0
+    # and the outputs are flagged in the index sidecar
+    assert any(
+        _m1.helper.resolve_map_location(0, m).combined for m in range(2)
+    )
+
+
+def test_combine_sidecar_narrow_schema_identity(tmp_path, metrics_on):
+    """Narrow wire values (structured val_dtypes): raw narrow rows and
+    wide combined partials interleave in one partition stream; the reduce
+    side widens/merges — output identical to the uncombined run."""
+    import struct
+
+    parts = 3
+    part_fn = BytesHashPartitioner(parts)
+    rng = np.random.default_rng(3)
+    keys = [int(k) for k in rng.integers(0, 40, size=4000)]
+    rows = [
+        (struct.pack(">q", k), np.array([k % 7, k % 5], dtype="<i2").astype("<i2").tobytes())
+        for k in keys
+    ]
+
+    def run(tag, threshold):
+        Dispatcher.reset()
+        cfg = ShuffleConfig(
+            root_dir=f"file://{tmp_path}/{tag}", app_id=tag,
+            columnar_batch_rows=256, combine_threshold_bytes=threshold,
+        )
+        mgr = ShuffleManager(cfg)
+        dep = ShuffleDependency(
+            shuffle_id=0,
+            partitioner=part_fn,
+            serializer=ColumnarKVSerializer(),
+            aggregator=ColumnarAggregator(
+                ("sum", "max"), val_dtypes=("i2", "i2")
+            ),
+        )
+        handle = mgr.register_shuffle(0, dep)
+        w = mgr.get_writer(handle, map_id=0)
+        w.write(RecordBatch.from_records(rows))
+        w.stop(success=True)
+        out = {}
+        for rid in range(parts):
+            for k, v in mgr.get_reader(handle, rid, rid + 1).read():
+                out[k] = bytes(v)
+        return out
+
+    base = run("narrow-off", 0)
+    combined = run("narrow-on", 1024)
+    assert combined == base
+    assert _counter(metrics_on, "shuffle_map_combine_rows_total") > 0
+
+
+def test_combine_passthrough_without_aggregator(tmp_path, metrics_on):
+    """Non-aggregating dependency: the knob must be inert — data objects
+    byte-identical to the threshold=0 run, no flag, no metric."""
+
+    def run(tag, threshold):
+        Dispatcher.reset()
+        cfg = ShuffleConfig(
+            root_dir=f"file://{tmp_path}/{tag}", app_id=tag,
+            columnar_batch_rows=256, combine_threshold_bytes=threshold,
+        )
+        mgr = ShuffleManager(cfg)
+        dep = ShuffleDependency(
+            shuffle_id=0,
+            partitioner=BytesHashPartitioner(2),
+            serializer=ColumnarKVSerializer(),
+        )
+        handle = mgr.register_shuffle(0, dep)
+        w = mgr.get_writer(handle, map_id=0)
+        w.write(RecordBatch.from_records(
+            [(b"k%03d" % (i % 50), b"v" * 8) for i in range(2000)]
+        ))
+        w.stop(success=True)
+        from s3shuffle_tpu.block_ids import ShuffleDataBlockId
+
+        blob = mgr.dispatcher.backend.read_all(
+            mgr.dispatcher.get_path(ShuffleDataBlockId(0, 0))
+        )
+        loc = mgr.helper.resolve_map_location(0, 0)
+        return blob, loc
+
+    blob_off, _loc0 = run("pt-off", 0)
+    blob_on, loc = run("pt-on", 1024)
+    assert blob_on == blob_off
+    assert loc.combined is False
+    assert _counter(metrics_on, "shuffle_map_combine_rows_total") == 0
+
+
+def test_reader_without_aggregator_refuses_combined_partials(tmp_path, metrics_on):
+    ops = ("sum",)
+    rows, parts = _agg_rows(ops, n_rows=3000)
+    mgr, handle, _out = _run_agg_shuffle(
+        tmp_path, "refuse", ops, rows, parts, combine_threshold_bytes=2048,
+    )
+    assert any(
+        mgr.helper.resolve_map_location(0, m).combined for m in range(2)
+    )
+    raw_dep = ShuffleDependency(
+        shuffle_id=0,
+        partitioner=BytesHashPartitioner(parts),
+        serializer=ColumnarKVSerializer(),
+    )
+    raw_handle = mgr.register_shuffle(0, raw_dep)
+    with pytest.raises(ValueError, match="partial rows"):
+        list(mgr.get_reader(raw_handle, 0, parts).read())
+
+
+def test_reduce_chunk_is_stateless_one_shot():
+    agg = ColumnarAggregator(("sum", "min"))
+    reducer = agg.new_reducer()
+    batch = RecordBatch.from_records([
+        (b"b", np.array([1, 5], dtype="<i8").tobytes()),
+        (b"a", np.array([2, 7], dtype="<i8").tobytes()),
+        (b"b", np.array([3, 2], dtype="<i8").tobytes()),
+    ])
+    out = reducer.reduce_chunk(batch)
+    got = {k: tuple(np.frombuffer(v, dtype="<i8")) for k, v in out.iter_records()}
+    assert got == {b"a": (2, 7), b"b": (4, 2)}
+    # no pending state accumulated: results() drains empty
+    assert sum(b.n for b in reducer.results()) == 0
+
+
+# ---------------------------------------------------------------------------
+# Hot-partition splitting
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("fat_parts", [2, 4, 8])
+@pytest.mark.parametrize("gap", [0, 1 << 20])
+@pytest.mark.parametrize("parity", [0, 1])
+def test_split_scan_byte_identity(tmp_path, metrics_on, fat_parts, gap, parity):
+    """The tentpole identity for prong (b): a recorded split fans the hot
+    partition out as independent sub-range GETs, and the reassembled bytes
+    are identical across split counts × coalescing × parity."""
+    split = 8 * 1024
+    sizes = [[512, split * fat_parts, 300], [256, 700, split * fat_parts]]
+    over = dict(split_threshold_bytes=split)
+    if parity:
+        over.update(
+            parity_segments=1, parity_stripe_k=1, parity_chunk_bytes=4096
+        )
+    cfg, d, helper = _env(
+        tmp_path, f"split-{fat_parts}-{gap}-{parity}",
+        coalesce_gap_bytes=gap, **over,
+    )
+    truth = _write_maps(d, helper, 0, sizes, seed=fat_parts)
+    assert _counter(metrics_on, "shuffle_partition_splits_total") == 2
+    got = _scan(d, helper, cfg, 0, sizes)
+    assert got == truth
+    if gap > 0:
+        # the planner actually split: count the part segments
+        from s3shuffle_tpu.read.scan_plan import plan_scan
+
+        blocks = [
+            ShuffleBlockId(0, m, p)
+            for m in range(len(sizes))
+            for p in range(len(sizes[m]))
+        ]
+        segs = plan_scan(
+            d, ScanIndexMemo(helper), blocks, gap_bytes=gap,
+            max_bytes=cfg.coalesce_max_bytes,
+            split_budget=cfg.max_buffer_size_task,
+        )
+        parts_seen = [
+            s.members[0].part
+            for s in segs
+            if len(s.members) == 1 and s.members[0].part is not None
+        ]
+        assert len(parts_seen) == 2 * fat_parts
+        assert {p.group.count for p in parts_seen} == {fat_parts}
+
+
+def test_split_fanout_capped(tmp_path):
+    """A pathologically small recorded stripe must not explode one
+    partition into unbounded GETs — MAX_SPLIT_PARTS bounds the fan-out."""
+    from s3shuffle_tpu.read.scan_plan import MAX_SPLIT_PARTS, plan_scan
+
+    cfg, d, helper = _env(tmp_path, "cap", split_threshold_bytes=64)
+    sizes = [[64 * 200]]
+    truth = _write_maps(d, helper, 0, sizes, seed=2)
+    segs = plan_scan(
+        d, ScanIndexMemo(helper), [ShuffleBlockId(0, 0, 0)],
+        gap_bytes=cfg.coalesce_gap_bytes, max_bytes=cfg.coalesce_max_bytes,
+        split_budget=cfg.max_buffer_size_task,
+    )
+    assert 2 <= len(segs) <= MAX_SPLIT_PARTS
+    got = _scan(d, helper, cfg, 0, sizes)
+    assert got == truth
+
+
+def test_split_composite_rides_fat_index_v3(tmp_path):
+    """Composite layout: the seal records split_bytes in the fat-index v3
+    header; members resolve with it and the scan stays byte-identical.
+    A zero-skew composite keeps writing the v2 shape."""
+    from s3shuffle_tpu.metadata.fat_index import FatIndex
+    from s3shuffle_tpu.write.composite_commit import CompositeCommitAggregator
+
+    split = 8 * 1024
+    sizes = [[400, split * 3], [split * 2, 128]]
+    cfg, d, helper = _env(
+        tmp_path, "csplit",
+        composite_commit_maps=2, split_threshold_bytes=split,
+    )
+    agg = CompositeCommitAggregator(d, helper)
+    rng = random.Random(5)
+    truth = {}
+    for m, row in enumerate(sizes):
+        w = MapOutputWriter(d, helper, 0, m, len(row), aggregator=agg)
+        for p, n in enumerate(row):
+            data = rng.randbytes(n)
+            truth[(m, p)] = data
+            pw = w.get_partition_writer(p)
+            pw.write(data)
+            pw.close()
+        w.commit_all_partitions()
+    agg.flush_shuffle(0)
+    fat = helper.read_fat_index(0, 0)
+    assert fat.split_bytes == split
+    raw = d.backend.read_all(
+        d.get_path(
+            __import__(
+                "s3shuffle_tpu.block_ids", fromlist=["ShuffleFatIndexBlockId"]
+            ).ShuffleFatIndexBlockId(0, 0)
+        )
+    )
+    assert int(np.frombuffer(raw, dtype=">i8")[1]) == 3  # v3 on the wire
+    loc = helper.resolve_map_location(0, 1)
+    assert loc.split_bytes == split
+    assert _scan(d, helper, cfg, 0, sizes) == truth
+    # zero-skew group writes v2
+    fat2 = FatIndex(9, 1, 2, [])
+    assert int(np.frombuffer(fat2.to_bytes(), dtype=">i8")[1]) == 2
+
+
+def test_split_block_stream_short_part_serves_prefix():
+    """A part whose GET went short degrades the LOGICAL block to the
+    per-block path's failed-read shape: surviving prefix, then EOF —
+    never bytes from a later part at the wrong offset."""
+    from s3shuffle_tpu.read.scan_plan import (
+        SplitBlockStream,
+        SplitGroup,
+        SplitPart,
+    )
+
+    class _FakePart:
+        def __init__(self, part, payload):
+            self.block = part
+            self._data = payload
+            self._pos = 0
+            self.closed = False
+
+        def read(self, n):
+            out = self._data[self._pos : self._pos + n]
+            self._pos += len(out)
+            return out
+
+        def close(self):
+            self.closed = True
+
+    block = ShuffleBlockId(0, 0, 1)
+    grp = SplitGroup(block, 0, 30, 3)
+    parts = [SplitPart(grp, i, i * 10, (i + 1) * 10) for i in range(3)]
+    fakes = [
+        _FakePart(parts[0], b"a" * 10),
+        _FakePart(parts[1], b"b" * 4),  # SHORT: failed GET
+        _FakePart(parts[2], b"c" * 10),
+    ]
+    stream = SplitBlockStream(grp, fakes)
+    assert stream.block is block and stream.max_bytes == 30
+    got = stream.readall()
+    assert got == b"a" * 10 + b"b" * 4  # prefix only — no part-2 bytes
+    assert stream.read(5) == b""
+    stream.close()
+    assert all(f.closed for f in fakes)
+    stream.close()  # idempotent
+
+
+def test_split_group_budget_funds_block_once(tmp_path):
+    """The deadlock-freedom invariant: one split block reserves its budget
+    in ONE claim (first part), siblings piggyback, last close releases —
+    even when the block is as large as the whole budget."""
+    split = 16 * 1024
+    sizes = [[split * 4]]
+    cfg, d, helper = _env(
+        tmp_path, "budget",
+        split_threshold_bytes=split,
+        max_buffer_size_task=split * 4,  # block == whole budget
+    )
+    truth = _write_maps(d, helper, 0, sizes, seed=11)
+    got = _scan(d, helper, cfg, 0, sizes)
+    assert got == truth
+
+
+def test_group_budget_single_claim_under_racing_parts():
+    """Two sibling parts racing the group's FIRST reservation while the
+    budget is contended: exactly one claims, the other piggybacks once the
+    claim lands — never a double reservation (a permanent budget leak) and
+    never a stuck second waiter (a scan hang)."""
+    from s3shuffle_tpu.read.prefetch import BufferedPrefetchIterator
+    from s3shuffle_tpu.read.scan_plan import SplitGroup
+
+    it = BufferedPrefetchIterator(iter([]), max_buffer_size=100)
+    grp = SplitGroup(ShuffleBlockId(0, 0, 0), 0, 80, 2)
+    assert it.try_reserve(60)  # budget contended: 80 more cannot fit
+    results = []
+
+    def claimant():
+        with it._lock:
+            it._await_budget_locked(80, satisfied=lambda: grp.reserved)
+            if not grp.reserved:
+                grp.reserved = True
+                grp.reserved_bytes = 80
+                it._buffers_in_flight += 80
+                it._lock.notify_all()
+                results.append("claimed")
+            else:
+                results.append("piggyback")
+
+    threads = [threading.Thread(target=claimant) for _ in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)  # both are parked on the budget wait
+    it.release_reserved(60)
+    for t in threads:
+        t.join(timeout=5.0)
+    assert not any(t.is_alive() for t in threads), "a waiter never woke"
+    assert sorted(results) == ["claimed", "piggyback"]
+    assert it._buffers_in_flight == 80  # reserved exactly ONCE
+    it.release_reserved(80)
+
+
+def test_single_spill_commit_records_split(tmp_path, metrics_on):
+    """The single-spill fast path measures partition sizes at commit like
+    the main writer — a hot partition there must record its split stripe
+    too (this path was the parity plane's silently-exempt gap class)."""
+    from s3shuffle_tpu.write.single_spill import SingleSpillMapOutputWriter
+
+    split = 8 * 1024
+    cfg, d, helper = _env(tmp_path, "sspill", split_threshold_bytes=split)
+    rng = random.Random(8)
+    parts_bytes = [rng.randbytes(512), rng.randbytes(split * 3)]
+    spill = tmp_path / "spill.bin"
+    spill.write_bytes(b"".join(parts_bytes))
+    SingleSpillMapOutputWriter(d, helper, 0, 0).transfer_map_spill_file(
+        str(spill), np.array([len(b) for b in parts_bytes], dtype=np.int64)
+    )
+    assert _counter(metrics_on, "shuffle_partition_splits_total") == 1
+    loc = helper.resolve_map_location(0, 0)
+    assert loc.split_bytes == split and loc.combined is False
+    sizes = [[len(b) for b in parts_bytes]]
+    got = _scan(d, helper, cfg, 0, sizes)
+    assert got == {(0, 0): parts_bytes[0], (0, 1): parts_bytes[1]}
+
+
+def test_split_inert_at_zero_and_for_small_blocks(tmp_path):
+    from s3shuffle_tpu.read.scan_plan import plan_scan
+
+    cfg, d, helper = _env(tmp_path, "inert")
+    sizes = [[40_000, 200]]
+    _write_maps(d, helper, 0, sizes, seed=4)
+    blocks = [ShuffleBlockId(0, 0, p) for p in range(2)]
+    segs = plan_scan(
+        d, ScanIndexMemo(helper), blocks, gap_bytes=cfg.coalesce_gap_bytes,
+        max_bytes=cfg.coalesce_max_bytes,
+        split_budget=cfg.max_buffer_size_task,
+    )
+    assert all(m.part is None for s in segs for m in s.members)
+
+
+# ---------------------------------------------------------------------------
+# Coded read fan-out
+# ---------------------------------------------------------------------------
+
+
+def _coded_env(tmp_path, tag, **over):
+    return _env(
+        tmp_path, tag,
+        parity_segments=1, parity_stripe_k=1, parity_chunk_bytes=4096,
+        speculative_read_quantile=0.0, **over,
+    )
+
+
+def test_hot_fanout_diverts_when_object_hot(tmp_path, metrics_on):
+    sizes = [[8192, 8192], [8192, 8192]]
+    cfg, d, helper = _coded_env(tmp_path, "hot", hot_read_fanout=1)
+    truth = _write_maps(d, helper, 0, sizes, seed=21)
+    hot = "shuffle_0_0_0.data"
+    OBJECT_GETS.start(hot)  # simulate another reader mid-GET on the object
+    try:
+        got = _scan(d, helper, cfg, 0, sizes)
+    finally:
+        OBJECT_GETS.finish(hot)
+    assert got == truth  # reconstruction is byte-identical
+    assert _counter(metrics_on, "shuffle_hot_fanout_reads_total") > 0
+    assert (
+        _counter(
+            metrics_on, "shuffle_parity_reconstructions_total",
+            reason="hot_fanout",
+        )
+        > 0
+    )
+
+
+def test_hot_fanout_respects_off_switch_and_cold_objects(tmp_path, metrics_on):
+    sizes = [[8192, 8192]]
+    # off switch: simulated heat diverts nothing
+    cfg, d, helper = _coded_env(tmp_path, "hot-off", hot_read_fanout=0)
+    truth = _write_maps(d, helper, 0, sizes, seed=22)
+    hot = "shuffle_0_0_0.data"
+    OBJECT_GETS.start(hot)
+    try:
+        assert _scan(d, helper, cfg, 0, sizes) == truth
+    finally:
+        OBJECT_GETS.finish(hot)
+    assert _counter(metrics_on, "shuffle_hot_fanout_reads_total") == 0
+    # knob on but object COLD: nothing diverts either
+    cfg2, d2, helper2 = _coded_env(tmp_path, "hot-cold", hot_read_fanout=1)
+    truth2 = _write_maps(d2, helper2, 0, sizes, seed=23)
+    assert _scan(d2, helper2, cfg2, 0, sizes) == truth2
+    assert _counter(metrics_on, "shuffle_hot_fanout_reads_total") == 0
+
+
+def test_hot_fanout_skips_sub_chunk_ranges(tmp_path, metrics_on):
+    """Parity I/O is chunk-granular: diverting a tiny read would move MORE
+    parity bytes than the primary — sub-chunk ranges always keep the
+    primary GET."""
+    sizes = [[512, 256]]  # all ranges far below the 4096-byte chunk
+    cfg, d, helper = _coded_env(tmp_path, "hot-small", hot_read_fanout=1)
+    truth = _write_maps(d, helper, 0, sizes, seed=24)
+    hot = "shuffle_0_0_0.data"
+    OBJECT_GETS.start(hot)
+    try:
+        assert _scan(d, helper, cfg, 0, sizes) == truth
+    finally:
+        OBJECT_GETS.finish(hot)
+    assert _counter(metrics_on, "shuffle_hot_fanout_reads_total") == 0
+
+
+def test_hot_fanout_under_injected_latency_concurrent_readers(
+    tmp_path, metrics_on
+):
+    """The integration shape: reader A grinds through a slow hot object;
+    reader B arrives while A's GETs are in flight and serves its ranges
+    from parity instead of queueing — both byte-identical."""
+    from s3shuffle_tpu.storage.fault import FlakyBackend, LatencyRule
+
+    sizes = [[8192, 8192, 8192]]
+    cfg, d, helper = _coded_env(tmp_path, "hot-conc", hot_read_fanout=1)
+    truth = _write_maps(d, helper, 0, sizes, seed=25)
+    hot = "shuffle_0_0_0.data"
+    flaky = FlakyBackend(d.backend)
+    flaky.add_latency(LatencyRule("read", match=hot, delay_s=0.25))
+    saved, d.backend = d.backend, flaky
+    try:
+        cold_cfg = ShuffleConfig(
+            root_dir=cfg.root_dir, app_id=cfg.app_id,
+            parity_segments=1, parity_stripe_k=1, parity_chunk_bytes=4096,
+            speculative_read_quantile=0.0, hot_read_fanout=0,
+        )
+        results = {}
+
+        def slow_reader():
+            results["a"] = _scan(d, helper, cold_cfg, 0, sizes)
+
+        t = threading.Thread(target=slow_reader)
+        t.start()
+        deadline = time.time() + 5.0
+        while OBJECT_GETS.inflight(hot) < 1 and time.time() < deadline:
+            time.sleep(0.005)
+        assert OBJECT_GETS.inflight(hot) >= 1
+        results["b"] = _scan(d, helper, cfg, 0, sizes)
+        t.join()
+    finally:
+        d.backend = saved
+    assert results["a"] == truth and results["b"] == truth
+    assert _counter(metrics_on, "shuffle_hot_fanout_reads_total") >= 1
+
+
+# ---------------------------------------------------------------------------
+# Off switches: op-for-op on the shared RecordingBackend
+# ---------------------------------------------------------------------------
+
+
+def test_knobs_zero_op_for_op_and_knobs_on_add_no_store_ops(tmp_path):
+    """combine/split = 0 leaves the request pattern AND the index wire
+    byte-identical to the pre-plane path; knobs ON must add ZERO store
+    ops on the write side (the prongs rewire bytes, never requests)."""
+    from s3shuffle_tpu.storage.local import LocalBackend
+
+    ops = ("sum",)
+    rows, parts = _agg_rows(ops, n_rows=3000)
+
+    def run(tag, **over):
+        Dispatcher.reset()
+        cfg = ShuffleConfig(
+            root_dir=f"file://{tmp_path}/{tag}", app_id=tag,
+            columnar_batch_rows=512, **over,
+        )
+        mgr = ShuffleManager(cfg)
+        rec = RecordingBackend(LocalBackend())
+        mgr.dispatcher.backend = rec
+        dep = ShuffleDependency(
+            shuffle_id=0,
+            partitioner=BytesHashPartitioner(parts),
+            serializer=ColumnarKVSerializer(),
+            aggregator=ColumnarAggregator(ops),
+        )
+        handle = mgr.register_shuffle(0, dep)
+        for m in range(2):
+            w = mgr.get_writer(handle, map_id=m)
+            w.write(RecordBatch.from_records(rows[m::2]))
+            w.stop(success=True)
+        return mgr, rec
+
+    mgr_off, rec_off = run("op-off")
+    mgr_on, rec_on = run(
+        "op-on", combine_threshold_bytes=2048, split_threshold_bytes=4096,
+    )
+
+    def shape(rec):
+        # (op, object name) with write-call counts collapsed: combined
+        # payloads are SMALLER by design, so raw write-call counts differ —
+        # the invariant is the REQUEST/object pattern, not byte chunking
+        names = [(op, p.rsplit("/", 1)[-1]) for op, p in rec.ops]
+        return (
+            sorted(set(n for op, n in names if op in ("create", "write"))),
+            sorted((op, n) for op, n in names if op not in ("write",)),
+        )
+
+    off_objects, off_ops = shape(rec_off)
+    on_objects, on_ops = shape(rec_on)
+    assert on_objects == off_objects  # same store objects, nothing extra
+    assert [op for op, _n in on_ops] == [op for op, _n in off_ops]
+    # knobs=0 index blob is the raw reference wire (no trailer)
+    loc = mgr_off.helper.resolve_map_location(0, 0)
+    blob = mgr_off.dispatcher.backend.read_all(
+        mgr_off.dispatcher.get_path(ShuffleIndexBlockId(0, 0))
+    )
+    assert blob == np.ascontiguousarray(
+        loc.offsets, dtype=">i8"
+    ).tobytes()
+
+
+def test_fanout_zero_scan_ops_unchanged_under_heat(tmp_path):
+    """hot_read_fanout=0 with a hot object: the scan's store ops are
+    identical to a cold scan — the gate must be fully inert when off."""
+    from s3shuffle_tpu.storage.local import LocalBackend
+
+    sizes = [[8192, 8192]]
+    cfg, d, helper = _coded_env(tmp_path, "fan0", hot_read_fanout=0)
+    truth = _write_maps(d, helper, 0, sizes, seed=31)
+
+    def scan_ops(heat):
+        rec = RecordingBackend(d.backend)
+        saved, d.backend = d.backend, rec
+        d.clear_status_cache()
+        helper.clear_caches()  # both scans pay the index GETs identically
+        if heat:
+            OBJECT_GETS.start("shuffle_0_0_0.data")
+        try:
+            assert _scan(d, helper, cfg, 0, sizes) == truth
+        finally:
+            if heat:
+                OBJECT_GETS.finish("shuffle_0_0_0.data")
+            d.backend = saved
+        return sorted((op, p.rsplit("/", 1)[-1]) for op, p in rec.ops)
+
+    assert scan_ops(heat=False) == scan_ops(heat=True)
+
+
+# ---------------------------------------------------------------------------
+# Tuner wiring
+# ---------------------------------------------------------------------------
+
+
+def test_skew_knobs_join_tuner_ladders():
+    from s3shuffle_tpu.tuning.tuners import CommitTuner, ScanTuner
+
+    cfg_on = ShuffleConfig(
+        combine_threshold_bytes=128 * 1024,
+        split_threshold_bytes=2 << 20,
+        hot_read_fanout=4,
+    )
+    commit = CommitTuner(cfg_on)
+    assert commit.combine_threshold_bytes(128 * 1024) == 128 * 1024
+    assert commit.split_threshold_bytes(2 << 20) == 2 << 20
+    assert "combine_threshold_bytes" in commit.overrides()
+    assert "split_threshold_bytes" in commit.overrides()
+    scan = ScanTuner(cfg_on)
+    assert scan.overrides()["hot_read_fanout"] == 4
+    # plane-off statics are never overruled
+    cfg_off = ShuffleConfig()
+    commit_off = CommitTuner(cfg_off)
+    assert commit_off.combine_threshold_bytes(0) == 0
+    assert commit_off.split_threshold_bytes(0) == 0
+    assert "hot_read_fanout" not in ScanTuner(cfg_off).overrides()

@@ -99,6 +99,38 @@ def test_fat_index_writer_matches_current_golden():
     assert fat.to_bytes() == blob("fat_index_v2.bin")
 
 
+def test_fat_index_v3_golden_decodes():
+    """The skew-plane shape: split_bytes header word + 4-word member rows
+    with a flags column (bit 0 = combined partials)."""
+    fat = FatIndex.from_bytes(blob("fat_index_v3.bin"))
+    assert (fat.shuffle_id, fat.group_id, fat.num_partitions) == (3, 11, 4)
+    assert fat.split_bytes == 48
+    assert fat.parity == ParityGeometry(2, 4, 32, 164)
+    assert fat.member(20).combined is True
+    assert fat.member(21).combined is False
+    m = fat.member(21)
+    assert (m.map_index, m.base_offset, m.total_bytes) == (1, 100, 64)
+    assert list(m.checksums) == [201, 202, 203, 204]
+
+
+def test_fat_index_v3_writer_matches_current_golden():
+    fat = FatIndex.from_bytes(blob("fat_index_v3.bin"))
+    assert fat.to_bytes() == blob("fat_index_v3.bin")
+
+
+def test_fat_index_zero_skew_still_writes_v2():
+    """The conditional-emission contract: a group with NO skew info keeps
+    writing v2 byte-identically (the combine/split=0 wire stability the
+    op-for-op gates rely on) — only an engaged prong bumps the blob."""
+    v2 = FatIndex.from_bytes(blob("fat_index_v2.bin"))
+    assert v2.split_bytes == 0 and not any(
+        m.combined for m in v2.members.values()
+    )
+    assert words_of(v2.to_bytes())[1] == 2
+    v3 = FatIndex.from_bytes(blob("fat_index_v3.bin"))
+    assert words_of(v3.to_bytes())[1] == 3
+
+
 # ---------------------------------------------------------------------------
 # Per-map index (+ geometry trailer), checksum sidecar, parity header
 # ---------------------------------------------------------------------------
@@ -116,6 +148,25 @@ def test_index_geometry_trailer_golden_decodes():
     offsets, geometry = split_index_geometry(words_of(blob("index_geom_v4.bin")))
     assert list(offsets) == [0, 10, 30, 60, 100]
     assert geometry == ParityGeometry(2, 4, 32, 100)
+
+
+def test_index_skew_trailer_golden_decodes():
+    """Format-6 skew trailer: sits BEFORE the geometry trailer, both are
+    peeled off before any offset consumer sees the words, and the parity
+    geometry's payload_len comes from the TRUE final cumulative offset
+    (never a trailer word — the PR-10 bug class extended to two trailers)."""
+    from s3shuffle_tpu.skew import split_index_trailers
+
+    words = words_of(blob("index_skew_v6.bin"))
+    offsets, geometry, skew = split_index_trailers(words)
+    assert list(offsets) == [0, 10, 30, 60, 100]
+    assert geometry == ParityGeometry(2, 4, 32, 100)
+    assert skew is not None and skew.combined and skew.split_bytes == 40
+    # the geometry-only historical helper keeps its signature and ALSO
+    # never leaks trailer words to offset consumers
+    offsets2, geometry2 = split_index_geometry(words)
+    assert list(offsets2) == [0, 10, 30, 60, 100]
+    assert geometry2 == geometry
 
 
 def test_checksum_golden_decodes():
@@ -236,13 +287,13 @@ def test_registry_edit_without_version_bump_trips_wire01():
 
     edited = copy.deepcopy(model)
     entry = edited.wire_structs["fat_index"]
-    entry["constants"]["_VERSION"] = 3  # pretend the registry moved to v3
-    entry["read_versions"] = [1, 2, 3]
-    entry["current_version"] = 3
+    entry["constants"]["_VERSION"] = 4  # pretend the registry moved to v4
+    entry["read_versions"] = [1, 2, 3, 4]
+    entry["current_version"] = 4
     entry["current_format"] = model.shuffle_format_version + 1  # no bump
     found = _lint_real_module("s3shuffle_tpu/metadata/fat_index.py", edited)
     messages = "\n".join(v.message for v in found)
-    assert "_VERSION is 2" in messages  # code/registry constant skew
+    assert "_VERSION is 3" in messages  # code/registry constant skew
     assert "SHUFFLE_FORMAT_VERSION" in messages  # missing version.py bump
 
 

@@ -373,6 +373,26 @@ def _coding_plane_line(snapshot: dict) -> Optional[str]:
     return "Coding plane: " + "; ".join(parts)
 
 
+def _skew_line(snapshot: dict) -> Optional[str]:
+    """One-line skew-plane digest: what each mitigation prong did — rows
+    pre-reduced away by map-side combine sidecars, partitions whose split
+    fan-out was recorded at commit, and reads diverted to parity-equivalent
+    sources because the primary object was hot."""
+    combined = _counter_total(snapshot, "shuffle_map_combine_rows_total")
+    splits = _counter_total(snapshot, "shuffle_partition_splits_total")
+    fanout = _counter_total(snapshot, "shuffle_hot_fanout_reads_total")
+    if combined <= 0 and splits <= 0 and fanout <= 0:
+        return None
+    parts = []
+    if combined > 0:
+        parts.append(f"{combined:g} rows pre-reduced map-side")
+    if splits > 0:
+        parts.append(f"{splits:g} hot partitions split for read fan-out")
+    if fanout > 0:
+        parts.append(f"{fanout:g} hot-fanout reads served from parity")
+    return "Skew: " + "; ".join(parts)
+
+
 def _fleet_line(snapshot: dict) -> Optional[str]:
     """One-line elastic-fleet digest: membership churn (joins / drains /
     leaves / expiries), task requeues by trigger, graceful-drain wall, and
@@ -498,6 +518,7 @@ def render_metrics_snapshot(
         _scan_planner_line(snapshot),
         _write_plane_line(snapshot),
         _coding_plane_line(snapshot),
+        _skew_line(snapshot),
         _codec_line(snapshot),
         _codec_read_line(snapshot),
         _tuning_line(snapshot),
@@ -731,6 +752,14 @@ def _selftest() -> int:
         "14 reconstructions",
     ):
         assert needle in text, f"coding line missing {needle!r}:\n{text}"
+    # the skew digest renders from the synthetic skew counters (three
+    # unlabeled 7-value counters — one clause per mitigation prong)
+    for needle in (
+        "Skew: 7 rows pre-reduced map-side",
+        "7 hot partitions split for read fan-out",
+        "7 hot-fanout reads served from parity",
+    ):
+        assert needle in text, f"skew line missing {needle!r}:\n{text}"
     # the codec digest renders from the synthetic codec-plane series
     # (1 MiB over a 3.08s histogram; 7 fused of 7 frames; gauge 7 in flight)
     for needle in (
