@@ -24,11 +24,12 @@ import dataclasses
 import logging
 import multiprocessing as mp
 import pickle
-from typing import Any, Iterable, List, Sequence, Tuple
+from typing import Any, Iterable, List, Optional, Sequence, Tuple
 
 from s3shuffle_tpu.config import ShuffleConfig
 from s3shuffle_tpu.dependency import ShuffleDependency
 from s3shuffle_tpu.metadata.service import MetadataServer, stage_id_for
+from s3shuffle_tpu.utils import trace
 
 logger = logging.getLogger("s3shuffle_tpu.cluster")
 
@@ -259,6 +260,13 @@ class DistributedDriver:
         from s3shuffle_tpu.metadata.helper import ShuffleHelper
 
         self.helper = ShuffleHelper(self.dispatcher)
+        # the driver's flight ring records job phases too; worker_id tags
+        # its postmortem dumps apart from the agents'
+        trace.configure_flight(
+            dir=config.flight_dir,
+            ring=config.flight_ring_events,
+            worker_id="driver",
+        )
         self._next_shuffle_id = 0
         # the worker-silence lease is an operator knob now (worker_lease_s);
         # the attribute stays assignable for tests/tools that tighten it
@@ -484,51 +492,59 @@ class DistributedDriver:
         shuffle_id = self._next_shuffle_id
         self._next_shuffle_id += 1
 
-        # range bounds from a columnar sample
-        sample: List[bytes] = []
-        for b in input_batches:
-            ko = b.koffsets
-            step = max(1, b.n // 64)
-            sample.extend(
-                b.keys[ko[i] : ko[i + 1]].tobytes() for i in range(0, b.n, step)
-            )
-        dep = ShuffleDependency(
-            shuffle_id=shuffle_id,
-            partitioner=RangePartitioner(range_bounds(sample, num_partitions)),
-            serializer=serializer if serializer is not None else ColumnarKVSerializer(),
-            key_ordering=natural_key,
-        )
-        desc = dep_to_descriptor(dep)
-        self.server.tracker.register_shuffle(shuffle_id, dep.num_partitions)
+        # the job root span: every driver phase below is its DIRECT child,
+        # so the critical-path analyzer's coverage check (phase durations
+        # vs job wall) holds by construction
+        with trace.span(
+            "driver.job", shuffle_id=shuffle_id, partitions=num_partitions
+        ):
+            with trace.span("driver.stage_inputs", shuffle_id=shuffle_id):
+                # range bounds from a columnar sample
+                sample: List[bytes] = []
+                for b in input_batches:
+                    ko = b.koffsets
+                    step = max(1, b.n // 64)
+                    sample.extend(
+                        b.keys[ko[i] : ko[i + 1]].tobytes()
+                        for i in range(0, b.n, step)
+                    )
+                dep = ShuffleDependency(
+                    shuffle_id=shuffle_id,
+                    partitioner=RangePartitioner(range_bounds(sample, num_partitions)),
+                    serializer=serializer if serializer is not None else ColumnarKVSerializer(),
+                    key_ordering=natural_key,
+                )
+                desc = dep_to_descriptor(dep)
+                self.server.tracker.register_shuffle(shuffle_id, dep.num_partitions)
 
-        # stage inputs to the store
-        input_paths = []
-        for map_id, batch in enumerate(input_batches):
-            path = self._scratch(shuffle_id, f"input_{map_id}")
-            write_input_object(self.dispatcher.backend, path, batch)
-            input_paths.append(path)
+                # stage inputs to the store
+                input_paths = []
+                for map_id, batch in enumerate(input_batches):
+                    path = self._scratch(shuffle_id, f"input_{map_id}")
+                    write_input_object(self.dispatcher.backend, path, batch)
+                    input_paths.append(path)
 
-        # recovery state: everything a recompute of any one map needs,
-        # kept for the job's lifetime (inputs stay staged in the store)
-        self._job_state[shuffle_id] = {
-            "desc": desc, "input_paths": list(input_paths),
-            "recovery_round": 0, "recovery_attempts": {},
-        }
-        map_stage = stage_id_for(shuffle_id, "map")
-        reduce_stage = stage_id_for(shuffle_id, "reduce")
-        try:
-            return self._run_sort_stages(
-                shuffle_id, dep, desc, input_paths, map_stage, reduce_stage
-            )
-        finally:
-            # teardown on EVERY exit: a failed job's stages must not stay
-            # in the queue — the fleet-level reap iterates ALL stages, so a
-            # leaked stage's tasks would be requeued and re-executed during
-            # later jobs, and its _job_state could spawn recovery stages
-            # for a shuffle nobody is waiting on
-            self.server.task_queue.drop_stage(map_stage)
-            self.server.task_queue.drop_stage(reduce_stage)
-            self._job_state.pop(shuffle_id, None)
+            # recovery state: everything a recompute of any one map needs,
+            # kept for the job's lifetime (inputs stay staged in the store)
+            self._job_state[shuffle_id] = {
+                "desc": desc, "input_paths": list(input_paths),
+                "recovery_round": 0, "recovery_attempts": {},
+            }
+            map_stage = stage_id_for(shuffle_id, "map")
+            reduce_stage = stage_id_for(shuffle_id, "reduce")
+            try:
+                return self._run_sort_stages(
+                    shuffle_id, dep, desc, input_paths, map_stage, reduce_stage
+                )
+            finally:
+                # teardown on EVERY exit: a failed job's stages must not stay
+                # in the queue — the fleet-level reap iterates ALL stages, so a
+                # leaked stage's tasks would be requeued and re-executed during
+                # later jobs, and its _job_state could spawn recovery stages
+                # for a shuffle nobody is waiting on
+                self.server.task_queue.drop_stage(map_stage)
+                self.server.task_queue.drop_stage(reduce_stage)
+                self._job_state.pop(shuffle_id, None)
 
     def _run_sort_stages(
         self, shuffle_id, dep, desc, input_paths, map_stage, reduce_stage
@@ -536,15 +552,21 @@ class DistributedDriver:
         from s3shuffle_tpu.batch import RecordBatch
         from s3shuffle_tpu.worker import read_input_batches
 
-        self.server.task_queue.submit_stage(
-            map_stage,
-            [
-                {"task_id": m, "kind": "map", "shuffle_id": shuffle_id,
-                 "map_id": m, "dep": desc, "input_path": p}
-                for m, p in enumerate(input_paths)
-            ],
-        )
-        self._wait_stage(map_stage)
+        with trace.span("driver.map_stage", shuffle_id=shuffle_id):
+            # the map tasks' causal parent is THIS stage span: workers adopt
+            # the descriptor's context, so their spans land in the driver's
+            # tree across the process boundary
+            ctx = trace.current_context()
+            self.server.task_queue.submit_stage(
+                map_stage,
+                [
+                    {"task_id": m, "kind": "map", "shuffle_id": shuffle_id,
+                     "map_id": m, "dep": desc, "input_path": p,
+                     **({"trace": ctx} if ctx else {})}
+                    for m, p in enumerate(input_paths)
+                ],
+            )
+            self._wait_stage(map_stage)
         # between-stage fleet beat: a worker dying right after its last map
         # poll is detected HERE (membership expiry + cross-stage requeue +
         # lost-output recovery), not first deep into the reduce wait
@@ -569,46 +591,115 @@ class DistributedDriver:
         if self.config.compact_below_bytes > 0:
             from s3shuffle_tpu.write.compactor import compact_shuffle
 
-            try:
-                compact_shuffle(
-                    self.dispatcher, self.helper, shuffle_id,
-                    tracker=self.server.tracker,
-                )
-            except Exception:
-                logger.warning("compaction failed for shuffle %d", shuffle_id,
-                               exc_info=True)
+            with trace.span("driver.compact", shuffle_id=shuffle_id):
+                try:
+                    compact_shuffle(
+                        self.dispatcher, self.helper, shuffle_id,
+                        tracker=self.server.tracker,
+                    )
+                except Exception:
+                    logger.warning("compaction failed for shuffle %d", shuffle_id,
+                                   exc_info=True)
 
         # the map stage is this shuffle's epoch barrier: seal it with a
         # store-published snapshot and advertise (epoch) to reduce tasks so
         # their scans run with zero tracker round-trips
-        snap_epoch = publish_snapshot(self.server.tracker, self.config, shuffle_id)
+        with trace.span("driver.publish_snapshot", shuffle_id=shuffle_id):
+            snap_epoch = publish_snapshot(
+                self.server.tracker, self.config, shuffle_id
+            )
 
         out_paths = [self._scratch(shuffle_id, f"output_{r}") for r in range(dep.num_partitions)]
-        self.server.task_queue.submit_stage(
-            reduce_stage,
-            [
-                {"task_id": r, "kind": "reduce", "shuffle_id": shuffle_id,
-                 "reduce_id": r, "dep": desc, "output_path": p,
-                 **({"snapshot": {"epoch": snap_epoch}} if snap_epoch is not None else {})}
-                for r, p in enumerate(out_paths)
-            ],
-        )
-        done = self._wait_stage(
-            reduce_stage,
-            on_failed=lambda failed: self._handle_reduce_failures(
-                shuffle_id, reduce_stage, failed
-            ),
-        )
+        with trace.span("driver.reduce_stage", shuffle_id=shuffle_id):
+            ctx = trace.current_context()
+            self.server.task_queue.submit_stage(
+                reduce_stage,
+                [
+                    {"task_id": r, "kind": "reduce", "shuffle_id": shuffle_id,
+                     "reduce_id": r, "dep": desc, "output_path": p,
+                     **({"snapshot": {"epoch": snap_epoch}} if snap_epoch is not None else {}),
+                     **({"trace": ctx} if ctx else {})}
+                    for r, p in enumerate(out_paths)
+                ],
+            )
+            done = self._wait_stage(
+                reduce_stage,
+                on_failed=lambda failed: self._handle_reduce_failures(
+                    shuffle_id, reduce_stage, failed
+                ),
+            )
 
-        out = []
-        for r, base in enumerate(out_paths):
-            # the COMMITTED attempt's result names the actual (attempt-
-            # suffixed) object — a zombie attempt's object is never read
-            result = done.get(r) or done.get(str(r)) or {}
-            path = result.get("path", base)
-            batches = read_input_batches(self.dispatcher.backend, path)
-            out.append(batches[0] if batches else RecordBatch.empty())
+        with trace.span("driver.collect", shuffle_id=shuffle_id):
+            out = []
+            for r, base in enumerate(out_paths):
+                # the COMMITTED attempt's result names the actual (attempt-
+                # suffixed) object — a zombie attempt's object is never read
+                result = done.get(r) or done.get(str(r)) or {}
+                path = result.get("path", base)
+                batches = read_input_batches(self.dispatcher.backend, path)
+                out.append(batches[0] if batches else RecordBatch.empty())
         return out
+
+    # -- distributed trace & fleet telemetry ---------------------------
+    def dump_trace(self, path: Optional[str] = None) -> Optional[str]:
+        """Assemble ONE merged Chrome-trace file: the driver's own spans
+        plus every span shard the workers shipped to the coordinator's
+        trace store, with cross-process flow events on the causal edges.
+        ``path`` defaults to the path ``trace.enable`` was given. Returns
+        the path written, or None when tracing is off or there is nowhere
+        to write."""
+        if not trace.enabled():
+            return None
+        target = path or trace.trace_path()
+        if target is None:
+            return None
+        try:
+            worker_spans = self.server.trace_store.drain()
+        except Exception:
+            logger.warning("worker trace-shard drain failed", exc_info=True)
+            worker_spans = []
+        doc = trace.assemble(
+            [trace.drain_spans(), worker_spans], counters=trace.counters()
+        )
+        return trace.write_trace_doc(target, doc)
+
+    def fleet_view(self) -> dict:
+        """Coordinator-merged fleet telemetry: per-worker snapshot ages and
+        hot-object GET peaks, the merged metrics registry view (this
+        process's own snapshot folded in, so driver-side staging I/O is
+        priced too), and the ``$/shuffle`` cost digest from the configured
+        rate card."""
+        from s3shuffle_tpu.costs import cost_digest, parse_rate_card
+        from s3shuffle_tpu.metadata.service import merge_registry_snapshots
+        from s3shuffle_tpu.metrics import registry as metrics_registry
+
+        view = self.server.fleet.view()
+        if metrics_registry.enabled():
+            view["metrics"] = merge_registry_snapshots(
+                [view["metrics"], metrics_registry.REGISTRY.snapshot(compact=True)]
+            )
+        view["cost"] = cost_digest(
+            view["metrics"],
+            parse_rate_card(self.config.cost_rate_card),
+            shuffles=max(1, self._next_shuffle_id),
+        )
+        return view
+
+    def dump_fleet(self, path: str) -> str:
+        """Write the fleet view as the JSON doc ``trace_report --fleet``
+        renders (atomic write), mirroring the cost digest into
+        ``cost_dollars_total`` on the way out."""
+        from s3shuffle_tpu.costs import record_cost_metrics
+
+        view = self.fleet_view()
+        record_cost_metrics(view["cost"])
+        doc = {
+            "fleet_workers": view["workers"],
+            "object_gets_peaks": view["object_gets_peaks"],
+            "metrics": view["metrics"],
+            "cost": view["cost"],
+        }
+        return trace.write_trace_doc(path, doc)
 
     # ------------------------------------------------------------------
     def shutdown(self, remove_root: bool = True) -> None:

@@ -298,6 +298,23 @@ class ShuffleConfig:
     # SLZ frames (loud warning) instead of the ~5x-slower host C TLZ encoder;
     # TLZ decode stays active for existing data. false = always encode TLZ.
     tpu_host_fallback: bool = True
+    # --- observability / trace plane (TPU-first addition; the reference's
+    # quantitative story is the external jvm-profiler → InfluxDB → Grafana
+    # stack, examples/README.md:54-101) ---
+    # flight recorder: records retained in the always-on bounded in-memory
+    # ring (task milestones always; completed spans too when tracing is on).
+    # 0 disables recording entirely (the overhead-probe baseline).
+    flight_ring_events: int = 512
+    # directory for postmortem flight-recorder dumps (written atomically on
+    # graceful drain, task failure, protocol-witness violation, SIGTERM, and
+    # atexit-after-error). "" keeps the ring recording but never writes a
+    # dump — clean runs leave zero residual files either way.
+    flight_dir: str = ""
+    # storage rate card feeding trace_report's $/shuffle cost digest:
+    # "class=rate,..." in dollars per op (get / put / list / delete) and per
+    # GiB moved (gb_read / gb_written); "" uses the built-in
+    # S3-standard-like card (s3shuffle_tpu/costs.py).
+    cost_rate_card: str = ""
     # --- misc ---
     app_id: str = "app"
     supports_rename: bool | None = None  # None → probe backend
@@ -372,6 +389,13 @@ class ShuffleConfig:
             raise ValueError("worker_lease_s must be > 0")
         if self.metadata_shard_endpoints < 0:
             raise ValueError("metadata_shard_endpoints must be >= 0")
+        if self.flight_ring_events < 0:
+            raise ValueError("flight_ring_events must be >= 0")
+        # parse-validate the rate card now — a typo'd card must fail at
+        # config construction, not at the first cost digest after the run
+        from s3shuffle_tpu.costs import parse_rate_card
+
+        parse_rate_card(self.cost_rate_card)
         algo = self.checksum_algorithm.upper()
         if algo not in ("ADLER32", "CRC32", "CRC32C"):
             # Parity: reference supports ADLER32 & CRC32 only and raises

@@ -33,6 +33,7 @@ import numpy as np
 
 from s3shuffle_tpu.metadata.map_output import MapOutputTracker, MapStatus
 from s3shuffle_tpu.metrics import registry as _metrics
+from s3shuffle_tpu.utils import trace as _trace
 
 logger = logging.getLogger("s3shuffle_tpu.metadata.service")
 
@@ -58,6 +59,21 @@ _H_DRAIN = _metrics.REGISTRY.histogram(
     "worker_drain_seconds",
     "Wall clock a departing worker spent in its graceful drain (seal + "
     "flush + deregister), as reported at deregistration",
+)
+_C_SHARD_BYTES = _metrics.REGISTRY.counter(
+    "trace_shard_bytes_total",
+    "Serialized span-shard bytes accepted into the coordinator's trace store",
+)
+_C_SHARD_DROPS = _metrics.REGISTRY.counter(
+    "trace_shard_drops_total",
+    "Span shards the coordinator's trace store refused, by reason",
+    labelnames=("reason",),
+)
+_G_FLEET_AGE = _metrics.REGISTRY.gauge(
+    "fleet_snapshot_age_seconds",
+    "Seconds since each worker's last fleet-telemetry sample, refreshed "
+    "whenever the fleet view is merged",
+    labelnames=("worker",),
 )
 
 _LEN = struct.Struct("<I")
@@ -590,6 +606,163 @@ class TaskQueue:
             self._stopping = True
 
 
+def merge_registry_snapshots(snapshots: List[dict]) -> dict:
+    """Merge per-process metric-registry snapshots into one fleet view.
+
+    Series identity is (metric name, label values). Counters and histogram
+    buckets/sum/count ADD across processes (each process counted disjoint
+    events); gauges keep the MAX (a level, not a flow — summing N workers'
+    queue depths is meaningful but summing their snapshot ages is not, and
+    max is the conservative read for both alerting uses). The result has the
+    same shape as ``MetricRegistry.snapshot()``, so every digest renderer
+    (``trace_report``, :func:`s3shuffle_tpu.costs.cost_digest`) prices a
+    fleet exactly like a single process."""
+    merged: dict = {}
+    for snap in snapshots:
+        if not isinstance(snap, dict):
+            continue
+        for name, metric in snap.items():
+            if not isinstance(metric, dict) or "series" not in metric:
+                continue
+            entry = merged.get(name)
+            if entry is None:
+                entry = {"kind": metric.get("kind", "counter"), "_series": {}}
+                if "labelnames" in metric:
+                    entry["labelnames"] = list(metric["labelnames"])
+                merged[name] = entry
+            kind = entry["kind"]
+            for series in metric["series"]:
+                key = json.dumps(series.get("labels", {}), sort_keys=True)
+                cur = entry["_series"].get(key)
+                if cur is None:
+                    # deep-copy through JSON: series came off the wire or a
+                    # live registry; the merge must never alias either
+                    entry["_series"][key] = json.loads(json.dumps(series))
+                elif kind == "histogram":
+                    cur["buckets"] = [
+                        a + b
+                        for a, b in zip(
+                            cur.get("buckets", []), series.get("buckets", [])
+                        )
+                    ]
+                    cur["sum"] = cur.get("sum", 0.0) + series.get("sum", 0.0)
+                    cur["count"] = cur.get("count", 0) + series.get("count", 0)
+                elif kind == "gauge":
+                    cur["value"] = max(
+                        cur.get("value", 0.0), series.get("value", 0.0)
+                    )
+                else:
+                    cur["value"] = cur.get("value", 0.0) + series.get("value", 0.0)
+    out = {}
+    for name, entry in merged.items():
+        final = {k: v for k, v in entry.items() if k != "_series"}
+        final["series"] = list(entry["_series"].values())
+        out[name] = final
+    return out
+
+
+class TraceShardStore:
+    """Coordinator-side buffer of span shards shipped by workers.
+
+    Workers drain their local span buffer after every task and push it here
+    (``report_trace_spans``); the driver pulls everything at trace-assembly
+    time (``get_trace_spans``) and merges it with its own spans into ONE
+    Chrome-trace file. Byte-capped so a misbehaving fleet cannot balloon the
+    coordinator: a shard that would cross the cap is refused whole (the
+    worker discards it — tracing is best-effort observability, never
+    backpressure on the data plane) and counted in
+    ``trace_shard_drops_total{reason="capacity"}``.
+    """
+
+    #: default in-memory cap on buffered serialized span bytes
+    BYTES_MAX = 64 << 20
+
+    def __init__(self, bytes_max: int = BYTES_MAX) -> None:
+        self._lock = threading.Lock()
+        self._spans: List[dict] = []
+        self._bytes = 0
+        self.bytes_max = int(bytes_max)
+
+    def report(self, spans: List[dict]) -> int:
+        """Accept one shard (a list of span event dicts). Returns the count
+        accepted — 0 means the shard was refused at the byte cap."""
+        if not spans:
+            return 0
+        size = len(json.dumps(spans).encode("utf-8"))
+        with self._lock:
+            if self._bytes + size > self.bytes_max:
+                if _metrics.enabled():
+                    _C_SHARD_DROPS.labels(reason="capacity").inc()
+                return 0
+            self._spans.extend(spans)
+            self._bytes += size
+        if _metrics.enabled():
+            _C_SHARD_BYTES.inc(size)
+        return len(spans)
+
+    def drain(self) -> List[dict]:
+        """Return-and-clear every buffered span (driver trace assembly)."""
+        with self._lock:
+            out, self._spans = self._spans, []
+            self._bytes = 0
+        return out
+
+
+class FleetTelemetry:
+    """Per-worker registry snapshots merged into one fleet view.
+
+    Each worker periodically pushes its compact metrics snapshot plus its
+    local ``ObjectGetTracker`` per-key peaks (``report_fleet_sample``);
+    :meth:`view` merges them — counters/histograms summed, gauges maxed,
+    peaks maxed per key — and stamps ``fleet_snapshot_age_seconds{worker}``
+    so staleness is itself observable. Latest-sample-wins per worker: the
+    table is bounded by fleet size, not run length.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._samples: dict = {}  # worker_id -> {snapshot, peaks, received_at, wall_time}
+
+    def report(self, worker_id: str, snapshot: dict, peaks: Optional[dict] = None) -> None:
+        with self._lock:
+            self._samples[str(worker_id)] = {
+                "snapshot": snapshot if isinstance(snapshot, dict) else {},
+                "peaks": {
+                    str(k): int(v) for k, v in (peaks or {}).items()
+                },
+                "received_at": time.monotonic(),
+                "wall_time": time.time(),
+            }
+
+    def view(self) -> dict:
+        """JSON-safe fleet view: per-worker ages and peaks, the cross-worker
+        OBJECT_GETS peak merge, and the merged metrics snapshot."""
+        now = time.monotonic()
+        with self._lock:
+            samples = {w: dict(s) for w, s in self._samples.items()}
+        workers = {}
+        merged_peaks: dict = {}
+        for worker_id in sorted(samples):
+            sample = samples[worker_id]
+            age = max(0.0, now - sample["received_at"])
+            if _metrics.enabled():
+                _G_FLEET_AGE.labels(worker=worker_id).set(age)
+            workers[worker_id] = {
+                "age_seconds": age,
+                "wall_time": sample["wall_time"],
+                "peaks": sample["peaks"],
+            }
+            for key, peak in sample["peaks"].items():
+                merged_peaks[key] = max(merged_peaks.get(key, 0), peak)
+        return {
+            "workers": workers,
+            "object_gets_peaks": merged_peaks,
+            "metrics": merge_registry_snapshots(
+                [s["snapshot"] for s in samples.values()]
+            ),
+        }
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self) -> None:
         tracker: MapOutputTracker = self.server.tracker  # type: ignore[attr-defined]
@@ -825,6 +998,15 @@ class _Handler(socketserver.BaseRequestHandler):
             return tracker.report_task_stats(list(a[0]))
         if method == "get_shuffle_stats":
             return tracker.get_shuffle_stats(int(a[0]))
+        if method == "report_trace_spans":
+            return self.server.trace_store.report(list(a[0]))  # type: ignore[attr-defined]
+        if method == "get_trace_spans":
+            return self.server.trace_store.drain()  # type: ignore[attr-defined]
+        if method == "report_fleet_sample":
+            peaks = a[2] if len(a) > 2 else {}
+            return self.server.fleet.report(str(a[0]), a[1], peaks)  # type: ignore[attr-defined]
+        if method == "get_fleet_view":
+            return self.server.fleet.view()  # type: ignore[attr-defined]
         raise RuntimeError(f"Unknown method: {method}")
 
 
@@ -887,6 +1069,8 @@ class MetadataServer:
         self.task_queue = TaskQueue()
         self.membership = WorkerMembership()
         self.snapshots = SnapshotCache()
+        self.trace_store = TraceShardStore()
+        self.fleet = FleetTelemetry()
         self._server = _Server((host, port), _Handler)
         self._shard_servers = [
             _Server((host, 0), _Handler) for _ in range(max(0, int(shard_endpoints)))
@@ -896,6 +1080,8 @@ class MetadataServer:
             srv.task_queue = self.task_queue  # type: ignore[attr-defined]
             srv.membership = self.membership  # type: ignore[attr-defined]
             srv.snapshots = self.snapshots  # type: ignore[attr-defined]
+            srv.trace_store = self.trace_store  # type: ignore[attr-defined]
+            srv.fleet = self.fleet  # type: ignore[attr-defined]
             srv.shard_addresses = []  # type: ignore[attr-defined]
         addrs = [srv.server_address[:2] for srv in self._shard_servers]
         for srv in self._all_servers():
@@ -994,6 +1180,12 @@ class RemoteMapOutputTracker:
         return sock
 
     def _call(self, method: str, *args):
+        # the span is the tracker-RPC leaf of the distributed trace — a
+        # shared no-op unless tracing is on (same contract as the metric)
+        with _trace.span("meta.rpc", method=method):
+            return self._call_inner(method, *args)
+
+    def _call_inner(self, method: str, *args):
         if _metrics.enabled():
             _C_RPC.labels(method=method, shard=self.shard_label).inc()
         policy = self._retry_policy
@@ -1236,3 +1428,25 @@ class RemoteMapOutputTracker:
     def membership(self) -> dict:
         """The coordinator's membership table + bounded event log."""
         return self._call("q_membership")
+
+    # -- distributed trace + fleet telemetry ---------------------------
+    def report_trace_spans(self, spans: List[dict]) -> int:
+        """Ship one span shard to the coordinator's trace store. Returns
+        the count accepted (0 = refused at the byte cap; the caller
+        discards — tracing never backpressures the data plane)."""
+        return int(self._call("report_trace_spans", spans))
+
+    def get_trace_spans(self) -> List[dict]:
+        """Drain every buffered worker span (driver trace assembly)."""
+        return list(self._call("get_trace_spans"))
+
+    def report_fleet_sample(
+        self, worker_id: str, snapshot: dict, peaks: Optional[dict] = None
+    ) -> None:
+        """Push this worker's compact metrics snapshot + OBJECT_GETS peaks
+        into the coordinator's fleet-telemetry table."""
+        self._call("report_fleet_sample", worker_id, snapshot, peaks or {})
+
+    def get_fleet_view(self) -> dict:
+        """Merged fleet view (per-worker ages/peaks + merged metrics)."""
+        return self._call("get_fleet_view")

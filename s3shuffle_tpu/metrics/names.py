@@ -130,4 +130,11 @@ KNOWN_METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "codec_decode_bytes_total": ("counter", ()),
     "codec_decode_inflight": ("gauge", ()),
     "codec_fused_crc_validated_total": ("counter", ()),
+    # --- trace plane: span shards, flight recorder, fleet telemetry, cost
+    # (utils/trace.py, metadata/service.py, s3shuffle_tpu/costs.py) ---
+    "trace_shard_bytes_total": ("counter", ()),
+    "trace_shard_drops_total": ("counter", ("reason",)),
+    "flight_dumps_total": ("counter", ("reason",)),
+    "fleet_snapshot_age_seconds": ("gauge", ("worker",)),
+    "cost_dollars_total": ("counter", ("op_class",)),
 }

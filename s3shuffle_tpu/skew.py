@@ -190,6 +190,14 @@ class ObjectGetTracker:
         with self._lock:
             return self._peak.get(name, 0)
 
+    def peaks(self) -> Dict[str, int]:
+        """Bulk copy of every recorded per-object GET-concurrency peak —
+        the fleet-telemetry sample: workers ship this table so the
+        coordinator can merge (max per key) hot-object pressure across the
+        whole fleet, which no process-local view can see."""
+        with self._lock:
+            return dict(self._peak)
+
     def reset_peaks(self) -> None:
         with self._lock:
             self._peak = {}

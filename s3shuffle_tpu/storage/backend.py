@@ -258,8 +258,11 @@ def _wrap(backend: StorageBackend, retry_policy) -> StorageBackend:
 
 def _maybe_instrument(backend: StorageBackend) -> StorageBackend:
     from s3shuffle_tpu.metrics import registry as _metrics_registry
+    from s3shuffle_tpu.utils import trace as _trace
 
-    if not _metrics_registry.enabled():
+    # tracing wants the wrapper too: the storage-op spans that link a
+    # worker's GETs/PUTs into the distributed trace live on it
+    if not _metrics_registry.enabled() and not _trace.enabled():
         return backend
     from s3shuffle_tpu.storage.instrumented import InstrumentedBackend
 

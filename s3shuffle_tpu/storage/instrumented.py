@@ -25,6 +25,7 @@ from typing import BinaryIO, List
 
 from s3shuffle_tpu.metrics import registry as _reg
 from s3shuffle_tpu.storage.backend import FileStatus, RangedReader, StorageBackend
+from s3shuffle_tpu.utils import trace as _trace
 
 _OP_SECONDS = _reg.REGISTRY.histogram(
     "storage_op_seconds",
@@ -54,19 +55,22 @@ class _InstrumentedReader(RangedReader):
         return self._inner.size
 
     def read_fully(self, position: int, length: int) -> bytes:
-        if not _reg.enabled():
-            return self._inner.read_fully(position, length)
-        t0 = time.perf_counter_ns()
-        try:
-            data = self._inner.read_fully(position, length)
-        except Exception:
-            _OP_ERRORS.labels(scheme=self._scheme, op="read").inc()
-            raise
-        _OP_SECONDS.labels(scheme=self._scheme, op="read").observe(
-            (time.perf_counter_ns() - t0) / 1e9
-        )
-        _READ_BYTES.labels(scheme=self._scheme).inc(len(data))
-        return data
+        # trace.span is the shared no-op unless tracing is on — the ranged
+        # GET is the "GET wait" leaf of the distributed trace
+        with _trace.span("storage.op", op="read", bytes=length):
+            if not _reg.enabled():
+                return self._inner.read_fully(position, length)
+            t0 = time.perf_counter_ns()
+            try:
+                data = self._inner.read_fully(position, length)
+            except Exception:
+                _OP_ERRORS.labels(scheme=self._scheme, op="read").inc()
+                raise
+            _OP_SECONDS.labels(scheme=self._scheme, op="read").observe(
+                (time.perf_counter_ns() - t0) / 1e9
+            )
+            _READ_BYTES.labels(scheme=self._scheme).inc(len(data))
+            return data
 
     def close(self) -> None:
         self._inner.close()
@@ -151,20 +155,21 @@ class InstrumentedBackend(StorageBackend):
             setattr(self.inner, name, value)
 
     def _timed(self, op: str, fn, *args):
-        if not _reg.enabled():
-            return fn(*args)
-        t0 = time.perf_counter_ns()
-        try:
-            out = fn(*args)
-        except FileNotFoundError:
-            raise  # a semantic miss (exists() probes), not a store failure
-        except Exception:
-            _OP_ERRORS.labels(scheme=self.scheme, op=op).inc()
-            raise
-        _OP_SECONDS.labels(scheme=self.scheme, op=op).observe(
-            (time.perf_counter_ns() - t0) / 1e9
-        )
-        return out
+        with _trace.span("storage.op", op=op):
+            if not _reg.enabled():
+                return fn(*args)
+            t0 = time.perf_counter_ns()
+            try:
+                out = fn(*args)
+            except FileNotFoundError:
+                raise  # a semantic miss (exists() probes), not a store failure
+            except Exception:
+                _OP_ERRORS.labels(scheme=self.scheme, op=op).inc()
+                raise
+            _OP_SECONDS.labels(scheme=self.scheme, op=op).observe(
+                (time.perf_counter_ns() - t0) / 1e9
+            )
+            return out
 
     # ------------------------------------------------------------------
     def create(self, path: str) -> BinaryIO:

@@ -1,0 +1,12 @@
+"""Trace-plane declarations.
+
+This package holds the *declarative* half of the tracing subsystem — the
+span-name registry (:mod:`s3shuffle_tpu.trace.names`) that shuffle-lint's
+TRC01 rule and the drift tests check call sites against. The runtime tracer
+itself lives in :mod:`s3shuffle_tpu.utils.trace` (kept there for import-graph
+reasons: the data plane imports it lazily inside hot functions).
+"""
+
+from s3shuffle_tpu.trace.names import KNOWN_SPANS
+
+__all__ = ["KNOWN_SPANS"]

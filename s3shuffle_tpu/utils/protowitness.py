@@ -134,6 +134,19 @@ class ProtocolWitness:
     def _violate(self, msg: str) -> None:
         logger.error("protocol witness: %s", msg)
         self.violations.append(msg)
+        # a witness violation is exactly the moment the flight recorder
+        # exists for: the ring holds the ops that led here, and the state
+        # that produced the breach is about to be torn down by the test or
+        # the failing job. Best-effort — the witness must stay usable even
+        # if the trace plane is broken.
+        try:
+            from s3shuffle_tpu.utils import trace as _trace
+
+            _trace.flight_record("witness.violation", "i", message=msg)
+            _trace.flight_note_error()
+            _trace.flight_dump("witness_violation")
+        except Exception:  # pragma: no cover - trace plane must never veto
+            logger.debug("flight dump on witness violation failed", exc_info=True)
 
     def _state(self, unit: Unit) -> _UnitState:
         state = self._units.get(unit)

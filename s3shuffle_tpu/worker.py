@@ -39,6 +39,8 @@ import numpy as np
 
 from s3shuffle_tpu.metadata.map_output import STORE_LOCATION
 from s3shuffle_tpu.metadata.service import RemoteMapOutputTracker
+from s3shuffle_tpu.utils import trace
+
 logger = logging.getLogger("s3shuffle_tpu.worker")
 
 
@@ -172,6 +174,15 @@ class WorkerAgent:
                 self.config, map_id_attempt_stride=self.ATTEMPT_STRIDE
             )
         self.worker_id = worker_id or f"{socket.gethostname()}-{os.getpid()}"
+        # always-on flight recorder: the bounded ring records task/drain
+        # boundary events regardless of S3SHUFFLE_TRACE; config decides the
+        # ring size and WHERE postmortem dumps land (flight_dir unset =
+        # record but never write)
+        trace.configure_flight(
+            dir=self.config.flight_dir,
+            ring=self.config.flight_ring_events,
+            worker_id=self.worker_id,
+        )
         # the manager's tracker is the snapshot-backed facade: once a reduce
         # task advertises a sealed shuffle's snapshot (pulled ONCE through
         # the storage plane), every enumeration lookup is served locally —
@@ -441,23 +452,30 @@ class WorkerAgent:
         zero records and triggers zero requeues — the worker holds no
         lease when it departs. Returns the drain seconds."""
         t0 = time.monotonic()
-        agg = self.manager.composite
-        if agg is not None:
-            try:
-                sealed = agg.drain()
-                if sealed:
-                    logger.info(
-                        "worker %s drain sealed %d open composite group(s)",
-                        self.worker_id, sealed,
+        trace.flight_record("worker.drain", "B", worker=self.worker_id)
+        with trace.span("worker.drain", worker=self.worker_id):
+            agg = self.manager.composite
+            if agg is not None:
+                try:
+                    sealed = agg.drain()
+                    if sealed:
+                        logger.info(
+                            "worker %s drain sealed %d open composite group(s)",
+                            self.worker_id, sealed,
+                        )
+                except Exception:
+                    # seal failures already failed their member tasks loudly
+                    # via on_group_abort — the drain itself must still finish
+                    logger.exception(
+                        "worker %s: drain-path composite seal failed", self.worker_id
                     )
-            except Exception:
-                # seal failures already failed their member tasks loudly
-                # via on_group_abort — the drain itself must still finish
-                logger.exception(
-                    "worker %s: drain-path composite seal failed", self.worker_id
-                )
-        self._push_task_stats()
+            self._push_task_stats()
+        self._push_trace_spans()
         drain_s = time.monotonic() - t0
+        trace.flight_record("worker.drain", "E", seconds=drain_s)
+        # the postmortem artifact of a PLANNED departure: the ring holds the
+        # drain's lead-up (last tasks, the seal, the stats push)
+        trace.flight_dump("drain")
         # stop the heartbeat loop BEFORE deregistering so no fresh beat is
         # issued for a worker the membership table just recorded as left
         # (the coordinator side is also refresh-only for heartbeats)
@@ -621,48 +639,69 @@ class WorkerAgent:
         map_output = None
         result = None
         stale = False
+        status = "ok"
+        # always-on flight record: if this worker dies mid-task, the
+        # postmortem ring shows exactly which task was in flight
+        trace.flight_record(
+            "worker.task", "B",
+            task_id=task.get("task_id"), kind=kind, stage=stage_id,
+        )
         try:
-            result = fn(self, task, stage_id)
-            map_output = result.pop("_map_output", None) if isinstance(result, dict) else None
-            deferred = (
-                result.pop("_composite_deferred", False)
-                if isinstance(result, dict) else False
-            )
-            if deferred:
-                key = (int(map_output[0]), int(map_output[1]))
-                if key in self._sealed_members:
-                    # the group sealed during this very commit (size/count
-                    # threshold): report on the normal path below, with the
-                    # seal-decided parity count appended to the payload
-                    sealed_parity = self._sealed_members.pop(key)
-                    map_output = _with_sealed_parity(map_output, sealed_parity)
-                else:
-                    # capture THIS task's stats entries now (the outbox holds
-                    # only them — reports since the last drain were this
-                    # task's) so the seal-time report pushes or discards
-                    # exactly its own, never a sibling member's
-                    from s3shuffle_tpu.metrics import registry as metrics_registry
-                    from s3shuffle_tpu.metrics.stats import COLLECTOR
+            # adopt the driver's trace context (no-op when the descriptor
+            # carries none) so this task's spans — and every storage op and
+            # tracker RPC under them — link into the driver's tree by
+            # trace_id/parent_id across the process boundary
+            with trace.context(task.get("trace")):
+                with trace.span(
+                    "worker.task",
+                    task_id=str(task.get("task_id")),
+                    kind=str(kind),
+                    worker=self.worker_id,
+                ):
+                    result = fn(self, task, stage_id)
+                map_output = result.pop("_map_output", None) if isinstance(result, dict) else None
+                deferred = (
+                    result.pop("_composite_deferred", False)
+                    if isinstance(result, dict) else False
+                )
+                if deferred:
+                    key = (int(map_output[0]), int(map_output[1]))
+                    if key in self._sealed_members:
+                        # the group sealed during this very commit (size/count
+                        # threshold): report on the normal path below, with the
+                        # seal-decided parity count appended to the payload
+                        sealed_parity = self._sealed_members.pop(key)
+                        map_output = _with_sealed_parity(map_output, sealed_parity)
+                    else:
+                        # capture THIS task's stats entries now (the outbox holds
+                        # only them — reports since the last drain were this
+                        # task's) so the seal-time report pushes or discards
+                        # exactly its own, never a sibling member's
+                        from s3shuffle_tpu.metrics import registry as metrics_registry
+                        from s3shuffle_tpu.metrics.stats import COLLECTOR
 
-                    stats = (
-                        COLLECTOR.drain_outbox()
-                        if metrics_registry.enabled() else []
-                    )
-                    self._pending_composite[key] = (
-                        stage_id, task, result, map_output, stats,
-                    )
-                    self.tasks_run += 1
-                    self._drain_composite()  # age-based seal check
-                    return "run"
-            accepted = self.client.complete_task(
-                stage_id, task["task_id"], result, self.worker_id, map_output
-            )
+                        stats = (
+                            COLLECTOR.drain_outbox()
+                            if metrics_registry.enabled() else []
+                        )
+                        self._pending_composite[key] = (
+                            stage_id, task, result, map_output, stats,
+                        )
+                        self.tasks_run += 1
+                        self._drain_composite()  # age-based seal check
+                        self._finish_task_trace(task, "deferred")
+                        return "run"
+                accepted = self.client.complete_task(
+                    stage_id, task["task_id"], result, self.worker_id, map_output
+                )
         except StaleAttemptError as e:
             logger.warning("worker %s: %s — attempt abandoned", self.worker_id, e)
             accepted = True  # nothing to report; the lease moved on
             stale = True  # ... and any stats it recorded are the retry's to report
+            status = "stale"
         except Exception as e:
             logger.exception("task %s failed", task.get("task_id"))
+            status = "failed"
             accepted = self.client.fail_task(
                 stage_id, task["task_id"], f"{type(e).__name__}: {e}",
                 self.worker_id,
@@ -682,7 +721,62 @@ class WorkerAgent:
         self._push_task_stats(discard=stale or accepted is False)
         self.tasks_run += 1
         self._drain_composite()  # age-based seal check every poll
+        self._finish_task_trace(task, status)
+        self._push_fleet_sample()
         return "run"
+
+    def _finish_task_trace(self, task: dict, status: str) -> None:
+        """Task-boundary observability: the always-on flight 'E' record, a
+        postmortem dump when the task FAILED (the ring holds the failure's
+        lead-up — the task's spans and boundary events), then the span-shard
+        ship to the coordinator."""
+        trace.flight_record(
+            "worker.task", "E", task_id=task.get("task_id"), status=status
+        )
+        if status == "failed":
+            trace.flight_note_error()
+            trace.flight_dump("task_failure")
+        self._push_trace_spans()
+
+    def _push_trace_spans(self) -> None:
+        """Ship this worker's buffered spans to the coordinator's trace
+        store — the stats-outbox pattern. Best-effort and fire-and-forget: a
+        refused or failed shard is DISCARDED; tracing must never
+        backpressure or fail the data plane."""
+        if not trace.enabled():
+            return
+        spans = trace.drain_spans()
+        if not spans:
+            return
+        try:
+            self.client.report_trace_spans(spans)
+        except Exception:
+            logger.warning(
+                "worker %s: could not push trace spans", self.worker_id,
+                exc_info=True,
+            )
+
+    def _push_fleet_sample(self) -> None:
+        """Push this worker's compact registry snapshot + per-object GET
+        peaks into the coordinator's fleet-telemetry table (metrics runs
+        only). Best-effort, same contract as the stats outbox."""
+        from s3shuffle_tpu.metrics import registry as metrics_registry
+
+        if not metrics_registry.enabled():
+            return
+        from s3shuffle_tpu.skew import OBJECT_GETS
+
+        try:
+            self.client.report_fleet_sample(
+                self.worker_id,
+                metrics_registry.REGISTRY.snapshot(compact=True),
+                OBJECT_GETS.peaks(),
+            )
+        except Exception:
+            logger.warning(
+                "worker %s: could not push fleet sample", self.worker_id,
+                exc_info=True,
+            )
 
     def _push_task_stats(self, discard: bool = False) -> None:
         """Drain this process's ShuffleStats outbox (entries recorded at

@@ -1,0 +1,70 @@
+"""Tier-1 wiring for the observability-overhead bench probe: the probe
+must run the same workload through observability OFF / flight-ring-only /
+full-tracing modes, prove byte identity across all three, and record the
+overhead fields that gate the BENCH artifact. The < 3% budget is asserted
+inside the probe at full bench size (bench main); this smoke keeps tier-1
+fast with a small workload and a noise-tolerant budget — millisecond walls
+cannot measure single-digit percentages honestly."""
+
+import random
+
+import pytest
+
+import bench
+
+
+def _small_parts(n_maps=2, n_records=6000):
+    from s3shuffle_tpu.batch import RecordBatch
+
+    rng = random.Random(7)
+    records = [(rng.randbytes(8), rng.randbytes(48)) for _ in range(n_records)]
+    return [RecordBatch.from_records(records[i::n_maps]) for i in range(n_maps)]
+
+
+def test_observability_probe_byte_identity_and_fields():
+    out = bench.observability_overhead(
+        parts=_small_parts(), repeats=2, budget_pct=25.0
+    )
+    assert "observability_error" not in out, out
+    # byte identity across off/flight/trace is asserted INSIDE the probe
+    # (a divergence surfaces as observability_error); the field records it
+    assert out["observability_byte_identity"] is True
+    assert out["observability_overhead_budget_pct"] == 25.0
+    for mode in ("off", "flight", "trace"):
+        assert out[f"observability_{mode}_wall_s"] > 0
+    for knob in ("flight", "trace"):
+        pct = out[f"observability_{knob}_overhead_pct"]
+        assert pct < 25.0, out
+
+
+def test_observability_probe_restores_global_trace_state():
+    from s3shuffle_tpu.utils import trace
+
+    bench.observability_overhead(parts=_small_parts(n_records=2000), repeats=1,
+                                 budget_pct=50.0)
+    assert not trace.enabled()
+    assert trace.events_snapshot() == []
+    assert trace._flight_enabled  # flight recorder back at its default ring
+    assert trace._flight.maxlen == trace.FLIGHT_RING_DEFAULT
+
+
+def test_bench_json_records_observability_knobs():
+    out = bench.observability_knobs()
+    from s3shuffle_tpu.config import ShuffleConfig
+
+    cfg = ShuffleConfig()
+    assert out["observability_plane"] == {
+        "flight_ring_events": cfg.flight_ring_events,
+        "flight_dir": "(dumps disabled)",
+        "cost_rate_card": "(builtin s3-standard card)",
+    }
+
+
+@pytest.mark.slow
+def test_observability_overhead_under_budget_full_size():
+    """The real acceptance gate at bench workload size: tracing on AND the
+    always-on flight ring each cost < 3% vs observability fully off."""
+    out = bench.observability_overhead()  # default workload, 3% budget
+    assert "observability_error" not in out, out
+    assert out["observability_flight_overhead_pct"] < 3.0, out
+    assert out["observability_trace_overhead_pct"] < 3.0, out

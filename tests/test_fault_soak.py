@@ -258,6 +258,14 @@ def _fleet_agent_main(coordinator, cfg_dict, worker_id):
     agent = _Agent(
         tuple(coordinator), config=_Cfg(**cfg_dict), worker_id=worker_id
     )
+    if agent.config.drain_on_sigterm:
+        # mirror worker.main(): SIGTERM is the preemption notice — drain
+        # (and flight-dump) at the next task boundary instead of dying
+        import signal
+
+        signal.signal(
+            signal.SIGTERM, lambda _signum, _frame: agent.request_drain()
+        )
     agent.run_forever(poll_interval=0.01, heartbeat_s=0.3)
     for witness in _pw.drain_installed():
         witness.assert_clean()
@@ -316,6 +324,34 @@ def _assert_zero_shuffle_residual(driver, shuffle_ids):
     assert residual == [], f"residual shuffle objects: {residual}"
 
 
+def _assert_flight_dump(flight_dir, wid, reason):
+    """The dead worker left a parseable postmortem: a header line naming
+    the reason, then the ring's JSONL records — including the task records
+    of the work it had in flight. And ONLY the dead worker's: a healthy
+    worker must never dump. Returns the ring records for extra checks."""
+    import glob
+    import json as _json
+    import os as _os
+
+    paths = sorted(glob.glob(_os.path.join(flight_dir, "flight-*.jsonl")))
+    assert paths, f"no flight-recorder dump under {flight_dir}"
+    owners = {_os.path.basename(p).split("-")[1] for p in paths}
+    assert owners == {wid}, f"unexpected flight dumps: {paths}"
+    matching = [p for p in paths if p.endswith(f"-{reason}.jsonl")]
+    assert matching, f"no -{reason} dump among {paths}"
+    with open(matching[-1]) as f:
+        lines = [_json.loads(line) for line in f]
+    header, ring = lines[0], lines[1:]
+    assert header["flight_recorder"] == 1
+    assert header["reason"] == reason
+    assert header["worker"] == wid
+    assert header["events"] == len(ring)
+    assert any(r["name"] == "worker.task" for r in ring), (
+        "postmortem ring holds no in-flight task records"
+    )
+    return ring
+
+
 def test_worker_drain_soak_zero_records_zero_requeues(tmp_path, metrics_on):
     """Graceful drain mid-job: the drained worker seals, reports, and
     leaves — the job completes byte-identical to the no-churn run with
@@ -330,6 +366,7 @@ def test_worker_drain_soak_zero_records_zero_requeues(tmp_path, metrics_on):
     cfg = ShuffleConfig(
         root_dir=f"file://{tmp_path}/store", app_id="drain-soak", codec="zlib",
         worker_lease_s=5.0, composite_commit_maps=2,
+        flight_dir=f"{tmp_path}/flight",
     )
     records = _fleet_records()
     batches = _fleet_batches(records, n_maps=6)
@@ -378,6 +415,9 @@ def test_worker_drain_soak_zero_records_zero_requeues(tmp_path, metrics_on):
         # the drained worker exited by itself, witness-clean
         workers[drained["wid"]].join(timeout=10)
         assert workers[drained["wid"]].exitcode == 0
+        # its flight recorder dumped a postmortem on the drain path — and
+        # ONLY its: the still-healthy workers have dumped nothing
+        _assert_flight_dump(f"{tmp_path}/flight", drained["wid"], "drain")
         _assert_zero_shuffle_residual(driver, [0, 1])
     finally:
         driver.shutdown()
@@ -458,6 +498,89 @@ def test_worker_kill_fast_deterministic(tmp_path, metrics_on):
                 f"survivor {wid} exited {workers[wid].exitcode} "
                 "(protocol witness violation?)"
             )
+    finally:
+        driver.shutdown()
+        for p in workers.values():
+            p.join(timeout=10)
+            if p.is_alive():
+                p.terminate()
+
+
+def test_worker_sigterm_postmortem_flight_dump(tmp_path, metrics_on):
+    """Kill mode with a postmortem: SIGTERM a worker mid-job (the cloud
+    preemption notice — ``drain_on_sigterm`` turns it into a graceful
+    drain at the next task boundary). The job completes byte-identical,
+    the dead worker leaves a parseable flight-recorder dump whose ring
+    shows the tasks it had in flight, and nobody else dumps — a clean
+    baseline run and the survivors' clean stop path leave ZERO dumps."""
+    import os as _os
+    import threading
+    import time as _time
+
+    from s3shuffle_tpu.cluster import DistributedDriver
+
+    Dispatcher.reset()
+    flight_dir = f"{tmp_path}/flight"
+    cfg = ShuffleConfig(
+        root_dir=f"file://{tmp_path}/store", app_id="sigterm-soak",
+        codec="zlib", worker_lease_s=5.0, composite_commit_maps=2,
+        flight_dir=flight_dir,
+    )
+    records = _fleet_records(seed=55)
+    batches = _fleet_batches(records, n_maps=6)
+    driver = DistributedDriver(cfg)
+    workers = _spawn_fleet(driver, cfg, ["w0", "w1", "w2"])
+    killed = {}
+    try:
+        baseline = _job_output(driver, batches)
+        # zero residual dumps on a clean run: nothing died, nothing dumped
+        assert not _os.path.exists(flight_dir) or not _os.listdir(flight_dir)
+        q = driver.server.task_queue
+
+        def terminate_one_mid_job():
+            # catch a worker red-handed (running a task) so the dump
+            # provably covers in-flight work; a quiet fleet past the
+            # deadline gets an arbitrary SIGTERM
+            deadline = _time.monotonic() + 20.0
+            while _time.monotonic() < deadline:
+                with q._lock:
+                    holders = {
+                        r["worker"]
+                        for stage, st in q._stages.items()
+                        if stage.startswith("shuffle1-")
+                        for r in st["running"].values()
+                    }
+                victim = next((w for w in workers if w in holders), None)
+                if victim is not None:
+                    workers[victim].terminate()
+                    killed["wid"] = victim
+                    return
+                _time.sleep(0.001)
+            victim = next(iter(workers))
+            workers[victim].terminate()
+            killed["wid"] = victim
+
+        killer = threading.Thread(target=terminate_one_mid_job, daemon=True)
+        killer.start()
+        churn = _job_output(driver, batches)
+        killer.join(timeout=25)
+        assert killed, "nothing was terminated"
+        assert churn == baseline  # byte-identical despite the preemption
+        # SIGTERM is not SIGKILL: the worker finishes its task, dumps its
+        # ring on the drain path, and exits clean
+        workers[killed["wid"]].join(timeout=15)
+        assert workers[killed["wid"]].exitcode == 0
+        ring = _assert_flight_dump(flight_dir, killed["wid"], "drain")
+        assert any(
+            r["name"] == "worker.task" and r.get("ph") == "B" for r in ring
+        )
+        assert any(r["name"] == "worker.drain" for r in ring)
+        _assert_zero_shuffle_residual(driver, [0, 1])
+        # fleet shutdown: the survivors' clean stop path adds no dumps
+        driver.shutdown()
+        for p in workers.values():
+            p.join(timeout=10)
+        _assert_flight_dump(flight_dir, killed["wid"], "drain")
     finally:
         driver.shutdown()
         for p in workers.values():

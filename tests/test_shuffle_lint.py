@@ -24,6 +24,7 @@ FIXTURE_MODEL = ProjectModel(
     config_methods={"log_values", "from_dict", "from_env", "scheme"},
     metric_names={"read_prefetch_wait_seconds": "histogram"},
     metric_labels={"read_prefetch_wait_seconds": ()},
+    span_names={"read.prefetch": "span", "read.tasks": "counter"},
     wire_structs={
         "demo": {
             "module": "<fixture>",
@@ -191,6 +192,68 @@ def f(collection):
     return collection.counter("anything_goes_here")
 """
     assert "MET01" not in _rules_fired(_lint(src))
+
+
+def test_trc01_kind_mismatch_flagged():
+    src = """
+from s3shuffle_tpu.utils import trace
+def f():
+    trace.count("read.prefetch")    # declared as a span, not a counter
+"""
+    fired = [v for v in _lint(src) if v.rule == "TRC01"]
+    assert fired and "declared as span" in fired[0].message
+
+
+def test_trc01_non_literal_name_flagged():
+    src = """
+from s3shuffle_tpu.utils import trace
+def f(name):
+    with trace.span(name):
+        pass
+"""
+    fired = [v for v in _lint(src) if v.rule == "TRC01"]
+    assert fired and "string literal" in fired[0].message
+
+
+def test_trc01_flight_record_checked_as_span_kind():
+    src = """
+from s3shuffle_tpu.utils import trace
+def f():
+    trace.flight_record("read.tasks", "B")   # counter name as a record
+"""
+    assert "TRC01" in _rules_fired(_lint(src))
+
+
+def test_trc01_non_trace_receiver_ignored():
+    src = """
+def f(tracker):
+    return tracker.count("anything_goes")
+"""
+    assert "TRC01" not in _rules_fired(_lint(src))
+
+
+def test_trc01_inert_without_span_table():
+    model = ProjectModel()  # no trace/names.py in the modeled project
+    src = """
+from s3shuffle_tpu.utils import trace
+def f():
+    with trace.span("never.declared"):
+        pass
+"""
+    assert "TRC01" not in _rules_fired(_lint(src, model=model))
+
+
+def test_trc01_trace_runtime_and_registry_exempt():
+    src = """
+def flush(trace):
+    with trace.span("internal.name"):
+        pass
+"""
+    for suffix in (
+        os.path.join("s3shuffle_tpu", "utils", "trace.py"),
+        os.path.join("s3shuffle_tpu", "trace", "names.py"),
+    ):
+        assert "TRC01" not in _rules_fired(_lint(src, path=suffix)), suffix
 
 
 def test_exc01_bare_except_flagged():
@@ -894,6 +957,70 @@ def test_trace_report_selftest_covers_all_declared_names():
     from tools.trace_report import _synthetic_snapshot
 
     assert set(_synthetic_snapshot()) == set(KNOWN_METRICS)
+
+
+# ---------------------------------------------------------------------------
+# TRC01 groundwork: trace/names.py is the single source of truth, both
+# directions (mirrors the MET01 pair above)
+# ---------------------------------------------------------------------------
+
+
+def test_every_trace_call_site_uses_a_declared_name():
+    """Forward direction, independent of the lint engine: every literal
+    ``trace.span/count/flight_record`` call in the package uses a name
+    declared in trace/names.py with the matching kind."""
+    import ast
+
+    from s3shuffle_tpu.trace.names import KNOWN_SPANS
+    from tools.shuffle_lint.rules.common import terminal_name
+    from tools.shuffle_lint.rules.trc01 import _METHOD_KINDS, _RECEIVERS
+
+    offenders = []
+    for path, src in _iter_package_sources():
+        norm = path.replace(os.sep, "/")
+        if norm.endswith(("utils/trace.py", "trace/names.py")):
+            continue
+        for node in ast.walk(ast.parse(src)):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            kind = _METHOD_KINDS.get(node.func.attr)
+            if kind is None or terminal_name(node.func.value) not in _RECEIVERS:
+                continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
+                offenders.append(f"{path}:{node.lineno}: non-literal name")
+            elif KNOWN_SPANS.get(arg.value) != kind:
+                offenders.append(
+                    f"{path}:{node.lineno}: {arg.value!r} declared as "
+                    f"{KNOWN_SPANS.get(arg.value)}, used as {kind}"
+                )
+    assert offenders == [], "\n".join(offenders)
+
+
+def test_every_declared_span_name_is_emitted_somewhere():
+    """Reverse direction: trace/names.py must not rot into declaring span
+    names nothing emits."""
+    from s3shuffle_tpu.trace.names import KNOWN_SPANS
+
+    blob = "\n".join(
+        src for path, src in _iter_package_sources()
+        if not path.replace(os.sep, "/").endswith("trace/names.py")
+    )
+    unemitted = [name for name in KNOWN_SPANS if f'"{name}"' not in blob]
+    assert unemitted == [], (
+        f"declared in trace/names.py but never emitted: {unemitted}"
+    )
+
+
+def test_model_loads_span_table_from_names_py():
+    from s3shuffle_tpu.trace.names import KNOWN_SPANS
+
+    model = ProjectModel.load(REPO_ROOT)
+    assert model.span_names == dict(KNOWN_SPANS)
+    assert set(KNOWN_SPANS.values()) == {"span", "counter"}
 
 
 # ---------------------------------------------------------------------------

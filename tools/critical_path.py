@@ -1,0 +1,250 @@
+"""Critical-path analyzer for assembled shuffle traces.
+
+``DistributedDriver.dump_trace`` writes ONE merged Chrome-trace file whose
+complete events carry causal coordinates (``trace_id`` / ``span_id`` /
+``parent_id`` in ``args``). This pass turns that file into an answer to the
+only question anyone asks of a slow job: *where did the wall time go?*
+
+Three products, printed by :func:`main` and returned structured by
+:func:`analyze`:
+
+- **phase tiling** — the root job span's direct children (the driver's
+  stage spans) tile the job wall by construction; their coverage of the
+  root duration is reported and is the digest's honesty check (a tiling
+  below ~90% means the driver grew an untraced phase and the blame below
+  is partial);
+- **blame tree** — every span's *exclusive* time (duration minus its
+  children's, clamped at zero) is attributed to a blame bucket by span
+  name: GET wait (``storage.op`` read-class ops and the ``read.*`` plane)
+  vs decode/encode (``codec.*``) vs commit barrier (``write.*`` and
+  write-class storage ops) vs tracker RPC (``meta.rpc``) vs the driver /
+  worker planes themselves. Worker spans overlap in wall time across
+  processes, so bucket totals are aggregate *work*, not wall — both are
+  reported, never conflated;
+- **top-k critical path** — from the root, repeatedly descend into the
+  longest child; the resulting chain is the single heaviest causal path
+  through driver and workers.
+
+Offline and dependency-free: operates on the JSON file alone, no cluster
+required. ``python -m tools.critical_path trace.json [--top K]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+#: ops of a ``storage.op`` span that are GET-side (everything else a
+#: storage span can time — create/write_close/rename/delete — is part of
+#: the commit barrier). Mirrors ``OP_TO_CLASS`` in s3shuffle_tpu/costs.py.
+_READ_OPS = frozenset({"read", "open", "status", "list"})
+
+#: blame buckets, in the order the digest prints them
+BUCKETS = (
+    "get_wait",
+    "decode_encode",
+    "commit",
+    "tracker_rpc",
+    "requeue",
+    "driver",
+    "worker",
+    "other",
+)
+
+
+def bucket_of(name: str, args: Optional[dict] = None) -> str:
+    """Blame bucket of one span, from its name (and for ``storage.op``
+    spans, the timed op). Name prefixes are the bucket key by design —
+    trace/names.py documents that a new span's plane prefix IS its blame
+    category."""
+    if name == "meta.rpc":
+        return "tracker_rpc"
+    if name == "storage.op":
+        op = str((args or {}).get("op", ""))
+        return "get_wait" if op in _READ_OPS else "commit"
+    if name.startswith("codec."):
+        return "decode_encode"
+    if name.startswith("read."):
+        return "get_wait"
+    if name.startswith("write."):
+        return "commit"
+    if name.startswith("requeue.") or "requeue" in name:
+        return "requeue"
+    if name.startswith("driver."):
+        return "driver"
+    if name.startswith("worker.") or name.startswith("witness."):
+        return "worker"
+    return "other"
+
+
+def _spans(doc: dict) -> List[dict]:
+    """The complete events of an assembled trace doc that carry causal
+    coordinates. Non-span events (flows, metadata) are not blamable."""
+    out = []
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        args = ev.get("args") or {}
+        if "span_id" not in args:
+            continue
+        out.append(ev)
+    return out
+
+
+def analyze(doc: dict, top: int = 5) -> Optional[dict]:
+    """Structured critical-path digest of one assembled trace doc, or None
+    when the doc holds no root job span to anchor on.
+
+    Root selection: the longest span with no in-doc parent, preferring a
+    ``driver.job`` span when one exists (a worker shard that outlived its
+    driver parent must not become the root). Everything is then scoped to
+    the root's ``trace_id`` — spans of other traces in the same file are
+    ignored, not misattributed.
+    """
+    spans = _spans(doc)
+    if not spans:
+        return None
+    by_id: Dict[str, dict] = {ev["args"]["span_id"]: ev for ev in spans}
+    roots = [
+        ev for ev in spans if ev["args"].get("parent_id") not in by_id
+    ]
+    if not roots:
+        return None
+    jobs = [ev for ev in roots if ev["name"] == "driver.job"]
+    root = max(jobs or roots, key=lambda ev: ev.get("dur", 0))
+    trace_id = root["args"].get("trace_id")
+
+    children: Dict[str, List[dict]] = {}
+    scoped = [
+        ev for ev in spans if ev["args"].get("trace_id") == trace_id
+    ]
+    for ev in scoped:
+        pid = ev["args"].get("parent_id")
+        if pid in by_id and ev is not root:
+            children.setdefault(pid, []).append(ev)
+
+    root_dur = float(root.get("dur", 0)) or 1.0
+
+    # phase tiling: the root's direct children, longest first
+    phases = sorted(
+        children.get(root["args"]["span_id"], ()),
+        key=lambda ev: ev.get("dur", 0),
+        reverse=True,
+    )
+    phase_rows = [
+        {
+            "name": ev["name"],
+            "dur_us": float(ev.get("dur", 0)),
+            "pct_of_wall": float(ev.get("dur", 0)) / root_dur,
+        }
+        for ev in phases
+    ]
+    coverage = min(1.0, sum(r["dur_us"] for r in phase_rows) / root_dur)
+
+    # blame: exclusive time per bucket across EVERY scoped span. Sibling
+    # spans from different workers overlap in wall time, so this is
+    # aggregate work — the wall-clock answer is the phase tiling above.
+    blame = {b: 0.0 for b in BUCKETS}
+    for ev in scoped:
+        kids = children.get(ev["args"]["span_id"], ())
+        exclusive = max(
+            0.0,
+            float(ev.get("dur", 0)) - sum(float(k.get("dur", 0)) for k in kids),
+        )
+        blame[bucket_of(ev["name"], ev.get("args"))] += exclusive
+    work_total = sum(blame.values()) or 1.0
+    blame_rows = [
+        {"bucket": b, "work_us": blame[b], "pct_of_work": blame[b] / work_total}
+        for b in BUCKETS
+        if blame[b] > 0
+    ]
+    blame_rows.sort(key=lambda r: r["work_us"], reverse=True)
+
+    # critical path: heaviest child chain from the root
+    path = []
+    cur = root
+    while cur is not None:
+        path.append(
+            {
+                "name": cur["name"],
+                "dur_us": float(cur.get("dur", 0)),
+                "pct_of_wall": float(cur.get("dur", 0)) / root_dur,
+                "pid": cur.get("pid"),
+                "args": {
+                    k: v
+                    for k, v in (cur.get("args") or {}).items()
+                    if k not in ("trace_id", "span_id", "parent_id")
+                },
+            }
+        )
+        kids = children.get(cur["args"]["span_id"])
+        cur = max(kids, key=lambda ev: ev.get("dur", 0)) if kids else None
+
+    return {
+        "trace_id": trace_id,
+        "job_wall_us": root_dur,
+        "coverage": coverage,
+        "phases": phase_rows,
+        "blame": blame_rows,
+        "critical_path": path[: max(1, int(top)) + 1],
+        "spans_analyzed": len(scoped),
+    }
+
+
+def _pct(x: float) -> str:
+    return f"{100.0 * x:5.1f}%"
+
+
+def format_digest(digest: dict) -> str:
+    """Human rendering of one :func:`analyze` result."""
+    lines = [
+        f"job wall: {digest['job_wall_us'] / 1e6:.3f}s  "
+        f"(trace {digest['trace_id']}, {digest['spans_analyzed']} spans, "
+        f"phase coverage {_pct(digest['coverage'])})",
+        "",
+        "phases (wall tiling):",
+    ]
+    for row in digest["phases"]:
+        lines.append(
+            f"  {_pct(row['pct_of_wall'])}  {row['dur_us'] / 1e6:8.3f}s  {row['name']}"
+        )
+    lines.append("")
+    lines.append("blame (exclusive work, all processes):")
+    for row in digest["blame"]:
+        lines.append(
+            f"  {_pct(row['pct_of_work'])}  {row['work_us'] / 1e6:8.3f}s  {row['bucket']}"
+        )
+    lines.append("")
+    lines.append("critical path (heaviest child chain):")
+    for depth, row in enumerate(digest["critical_path"]):
+        extra = ", ".join(f"{k}={v}" for k, v in row["args"].items())
+        lines.append(
+            f"  {'  ' * depth}{_pct(row['pct_of_wall'])}  {row['name']}"
+            + (f"  [{extra}]" if extra else "")
+        )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.critical_path",
+        description="Attribute a merged shuffle trace's job wall to a blame tree.",
+    )
+    parser.add_argument("trace", help="assembled trace JSON (DistributedDriver.dump_trace output)")
+    parser.add_argument("--top", type=int, default=5, help="critical-path depth to print")
+    parser.add_argument("--json", action="store_true", help="emit the digest as JSON")
+    ns = parser.parse_args(argv)
+    with open(ns.trace, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    digest = analyze(doc, top=ns.top)
+    if digest is None:
+        print("no root job span found in trace", file=sys.stderr)
+        return 1
+    print(json.dumps(digest, indent=2) if ns.json else format_digest(digest))
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via main() in tests
+    sys.exit(main())

@@ -44,6 +44,7 @@ def _selftest() -> int:
         config_methods={"log_values", "from_dict", "from_env", "scheme"},
         metric_names={"read_prefetch_wait_seconds": "histogram"},
         metric_labels={"read_prefetch_wait_seconds": ()},
+        span_names={"read.prefetch": "span", "read.tasks": "counter"},
         wire_structs={
             "demo": {
                 "module": "<fixture>",

@@ -159,6 +159,9 @@ class ProjectModel:
     metric_labels: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
     #: config field -> declaration line in config.py (dead-knob reporting)
     config_field_lines: Dict[str, int] = field(default_factory=dict)
+    #: trace span/counter names (s3shuffle_tpu/trace/names.py KNOWN_SPANS,
+    #: name -> kind) — TRC01's single source of truth; empty dict = inert
+    span_names: Dict[str, str] = field(default_factory=dict)
     #: wire-struct registry (s3shuffle_tpu/wire/schema.py WIRE_STRUCTS) —
     #: WIRE01's single source of truth; empty dict = rule inert
     wire_structs: dict = field(default_factory=dict)
@@ -174,12 +177,15 @@ class ProjectModel:
         model = cls()
         config_py = os.path.join(project_root, "s3shuffle_tpu", "config.py")
         names_py = os.path.join(project_root, "s3shuffle_tpu", "metrics", "names.py")
+        spans_py = os.path.join(project_root, "s3shuffle_tpu", "trace", "names.py")
         schema_py = os.path.join(project_root, "s3shuffle_tpu", "wire", "schema.py")
         version_py = os.path.join(project_root, "s3shuffle_tpu", "version.py")
         if os.path.exists(config_py):
             model._load_config_fields(config_py)
         if os.path.exists(names_py):
             model._load_metric_names(names_py)
+        if os.path.exists(spans_py):
+            model._load_span_names(spans_py)
         if os.path.exists(schema_py):
             model._load_wire_structs(schema_py)
         if os.path.exists(version_py):
@@ -209,6 +215,11 @@ class ProjectModel:
         self.metric_labels = {
             name: tuple(spec[1]) for name, spec in table.items()
         }
+
+    def _load_span_names(self, path: str) -> None:
+        table = _literal_table(path, "KNOWN_SPANS")
+        if table is not None:
+            self.span_names = dict(table)
 
     def _load_wire_structs(self, path: str) -> None:
         table = _literal_table(path, "WIRE_STRUCTS")

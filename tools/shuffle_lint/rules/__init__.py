@@ -17,10 +17,11 @@ from tools.shuffle_lint.rules import (  # noqa: F401  (registry import)
     met01,
     ord01,
     thr01,
+    trc01,
     wire01,
 )
 
 #: every active rule, in rule-id order
-ALL_RULES = (cfg01, cw01, exc01, imp01, lk01, met01, ord01, thr01, wire01)
+ALL_RULES = (cfg01, cw01, exc01, imp01, lk01, met01, ord01, thr01, trc01, wire01)
 
 __all__ = ["ALL_RULES"]

@@ -1,0 +1,113 @@
+"""TRC01 — trace call sites must use names declared in ``trace/names.py``.
+
+The invariant mirrors MET01's for the metric plane:
+:mod:`s3shuffle_tpu.trace.names` is the single source of truth for every
+span, trace counter, and flight-recorder record name the package emits —
+the critical-path analyzer (``tools/critical_path.py``) buckets blame by
+name prefix, ``trace_report`` tables key on names, and the reverse-drift
+test in ``tests/test_shuffle_lint.py`` asserts every declared name is
+actually emitted somewhere. A span started under an undeclared name lands
+in the analyzer's ``other`` bucket where nobody looks for it; a typo'd
+name silently forks a span family in every trace consumer at once.
+
+Detection: ``trace.span("name", ...)`` / ``trace.count("name", ...)`` /
+``trace.flight_record("name", ...)`` call sites where the receiver's
+terminal name is ``trace`` or ``_trace`` (both import idioms in the tree).
+The first argument must be a string literal, present in ``KNOWN_SPANS``,
+with a matching kind (``span()`` and ``flight_record()`` emit kind
+``span``; ``count()`` emits kind ``counter``). The rule is inert when the
+project model carries no span table (fixture runs inject one); the trace
+runtime and the registry itself are skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.shuffle_lint.core import FileContext, Violation
+from tools.shuffle_lint.rules.common import terminal_name
+
+RULE_ID = "TRC01"
+DESCRIPTION = "trace span name not declared in s3shuffle_tpu/trace/names.py"
+
+#: fixture model declares read.prefetch (span) and read.tasks (counter)
+POSITIVE = '''
+from s3shuffle_tpu.utils import trace
+
+
+def fill(block):
+    with trace.span("read.prefech"):   # BUG: typo'd span name
+        trace.count("read.prefetch")   # BUG: span name used as a counter
+        return block.fetch()
+'''
+
+NEGATIVE = '''
+from s3shuffle_tpu.utils import trace
+
+
+def fill(block):
+    with trace.span("read.prefetch", block=block.name):
+        trace.count("read.tasks")
+        trace.flight_record("read.prefetch", "B")
+        return block.fetch()
+'''
+
+#: trace-module method -> the kind its name must be declared as
+_METHOD_KINDS = {"span": "span", "flight_record": "span", "count": "counter"}
+#: receiver spellings of the trace module across the tree
+_RECEIVERS = frozenset({"trace", "_trace"})
+#: the runtime and the registry define/document names, they don't emit them
+_SKIP_SUFFIXES = ("utils/trace.py", "trace/names.py")
+
+
+def check(ctx: FileContext) -> List[Violation]:
+    known = ctx.model.span_names
+    if not known:  # no span table in the model: rule is inert
+        return []
+    norm = ctx.path.replace("\\", "/")
+    if norm.endswith(_SKIP_SUFFIXES):
+        return []
+    out: List[Violation] = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+            continue
+        method = node.func.attr
+        kind = _METHOD_KINDS.get(method)
+        if kind is None:
+            continue
+        if terminal_name(node.func.value) not in _RECEIVERS:
+            continue
+        if not node.args:
+            continue
+        name_arg = node.args[0]
+        if not (isinstance(name_arg, ast.Constant) and isinstance(name_arg.value, str)):
+            out.append(
+                Violation(
+                    RULE_ID, ctx.path, node.lineno, node.col_offset,
+                    f"trace.{method}() name must be a string literal so the "
+                    "static span registry (trace/names.py) can account for it",
+                )
+            )
+            continue
+        name = name_arg.value
+        if name not in known:
+            out.append(
+                Violation(
+                    RULE_ID, ctx.path, node.lineno, node.col_offset,
+                    f"trace name {name!r} is not declared in "
+                    "s3shuffle_tpu/trace/names.py (declare it there — the "
+                    "critical-path analyzer and trace tooling key on that "
+                    "table)",
+                )
+            )
+        elif known[name] != kind:
+            out.append(
+                Violation(
+                    RULE_ID, ctx.path, node.lineno, node.col_offset,
+                    f"trace name {name!r} used via trace.{method}() (kind "
+                    f"{kind}) but declared as {known[name]} in "
+                    "s3shuffle_tpu/trace/names.py",
+                )
+            )
+    return out

@@ -537,6 +537,72 @@ def render_metrics_snapshot(
     return "\n".join(out)
 
 
+def _fmt_dollars(d: float) -> str:
+    return f"${d:.4f}" if d >= 0.01 else f"${d:.6f}"
+
+
+def render_fleet(doc: dict, top: int = 10) -> str:
+    """Render a fleet-telemetry dump (``DistributedDriver.dump_fleet``):
+    per-worker snapshot ages, the fleet-wide hot-object GET-concurrency
+    peaks, the rate-card cost digest ($/shuffle), and the merged registry
+    view over every worker plus the driver."""
+    out: List[str] = []
+    workers = doc.get("fleet_workers", {})
+    out.append(f"Fleet: {len(workers)} worker(s)")
+    if workers:
+        rows = []
+        for wid, info in sorted(workers.items()):
+            peaks = info.get("peaks") or {}
+            hottest = max(peaks.values()) if peaks else 0
+            rows.append(
+                (
+                    wid,
+                    f"{float(info.get('age_seconds', 0.0)):.1f}s",
+                    len(peaks),
+                    f"{hottest:g}",
+                )
+            )
+        out.append(
+            _table(("worker", "snapshot age", "objects tracked", "peak GETs"), rows)
+        )
+    peaks = doc.get("object_gets_peaks") or {}
+    if peaks:
+        hot = sorted(peaks.items(), key=lambda kv: -kv[1])[:top]
+        out.append("")
+        out.append("Hot objects (fleet-wide GET-concurrency peaks):")
+        out.append(
+            _table(
+                ("object", "peak concurrent GETs"),
+                [(name.rsplit("/", 1)[-1], f"{v:g}") for name, v in hot],
+            )
+        )
+    cost = doc.get("cost") or {}
+    if cost:
+        ops = cost.get("ops", {})
+        dollars = cost.get("dollars", {})
+        rows = [
+            (cls, f"{ops.get(cls, 0):g}", _fmt_dollars(float(dollars.get(cls, 0.0))))
+            for cls in sorted(set(ops) | set(dollars))
+            if ops.get(cls) or dollars.get(cls)
+        ]
+        out.append("")
+        out.append("Cost (storage rate card):")
+        if rows:
+            out.append(_table(("op class", "ops", "dollars"), rows))
+        shuffles = cost.get("shuffles", 1)
+        out.append(
+            f"  total {_fmt_dollars(float(cost.get('dollars_total', 0.0)))} over "
+            f"{shuffles:g} shuffle(s) = "
+            f"{_fmt_dollars(float(cost.get('dollars_per_shuffle', 0.0)))}/shuffle"
+        )
+    metrics = doc.get("metrics") or {}
+    if metrics:
+        out.append("")
+        out.append("Merged fleet metrics (all workers + driver):")
+        out.append(render_metrics_snapshot(metrics, top=top))
+    return "\n".join(out)
+
+
 def render_shuffle_stats(report: dict, top: int = 10) -> str:
     out = [f"ShuffleStats: shuffle {report.get('shuffle_id', '?')}"]
     rows = []
@@ -589,10 +655,14 @@ def render_shuffle_stats(report: dict, top: int = 10) -> str:
 
 
 def render(doc: dict, top: int = 10) -> str:
-    """Dispatch on document shape: Chrome trace, ShuffleStats dump, a single
-    report, or a bare registry snapshot (the BENCH ``metrics`` field)."""
+    """Dispatch on document shape: Chrome trace, fleet-telemetry dump,
+    ShuffleStats dump, a single report, or a bare registry snapshot (the
+    BENCH ``metrics`` field). The fleet check precedes the generic
+    ``metrics`` check — a dump_fleet doc carries both keys."""
     if "traceEvents" in doc:
         return render_trace(doc, top=top)
+    if "fleet_workers" in doc:
+        return render_fleet(doc, top=top)
     if "shuffles" in doc:
         return "\n\n".join(
             render_shuffle_stats(r, top=top) for r in doc["shuffles"]
@@ -644,13 +714,15 @@ def _synthetic_snapshot() -> dict:
                       "shard": "0", "source": "snapshot", "reason": "orphan",
                       "knob": "fetch_parallelism", "event": "join",
                       "choice": "reconstruct", "size_class": "le1m",
-                      "format": "column", "plane": "write", "site": "write"}
+                      "format": "column", "plane": "write", "site": "write",
+                      "worker": "w0", "op_class": "get"}
     _ALT_LABELS = {"scheme": "s3", "op": "open", "direction": "down",
                    "codec": "zlib", "method": "get_map_sizes_by_ranges",
                    "shard": "1", "source": "rpc", "reason": "generation",
                    "knob": "upload_queue_bytes", "event": "expire",
                    "choice": "recompute", "size_class": "gt64m",
-                   "format": "legacy", "plane": "read", "site": "read"}
+                   "format": "legacy", "plane": "read", "site": "read",
+                   "worker": "w1", "op_class": "put"}
     snapshot: Dict[str, dict] = {}
     for name, (kind, labelnames) in sorted(KNOWN_METRICS.items()):
         series_list = []
@@ -807,6 +879,38 @@ def _selftest() -> int:
         "50.00% snapshot hit ratio",
     ):
         assert needle in text, f"control-plane line missing {needle!r}:\n{text}"
+    # fleet-telemetry dump rendering: worker table, hot-object peaks, the
+    # rate-card cost digest ($/shuffle), and the merged registry view —
+    # dispatched through render() by the 'fleet_workers' discriminator
+    fleet_doc = {
+        "fleet_workers": {
+            "w0": {"age_seconds": 1.25, "wall_time": 0.0,
+                   "peaks": {"app/shuffle_0/part_3.data": 9}},
+            "w1": {"age_seconds": 0.5, "wall_time": 0.0, "peaks": {}},
+        },
+        "object_gets_peaks": {"app/shuffle_0/part_3.data": 9},
+        "metrics": metrics,
+        "cost": {
+            "rate_card": {"get": 4e-7, "put": 5e-6},
+            "ops": {"get": 1000.0, "put": 100.0},
+            "read_bytes": 1 << 20, "written_bytes": 1 << 20,
+            "dollars": {"get": 4e-4, "put": 5e-4},
+            "dollars_total": 9e-4, "shuffles": 2, "dollars_per_shuffle": 4.5e-4,
+        },
+    }
+    text = render(fleet_doc)
+    for needle in (
+        "Fleet: 2 worker(s)",
+        "part_3.data",
+        "Cost (storage rate card):",
+        "$0.000900 over 2 shuffle(s) = $0.000450/shuffle",
+        "Merged fleet metrics",
+    ):
+        assert needle in text, f"fleet render missing {needle!r}:\n{text}"
+    # worker/op_class-labeled metric families render with both label rows
+    for needle in ("worker=w0", "worker=w1", "op_class=get", "op_class=put"):
+        assert needle in text, f"fleet label row missing {needle!r}:\n{text}"
+
     p50 = histogram_quantile(bounds, buckets, 0.5)
     assert 0.008 <= p50 <= 0.016, p50
     p99 = histogram_quantile(bounds, buckets, 0.99)
@@ -824,6 +928,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[1])
     ap.add_argument("path", nargs="?", help="trace JSON or ShuffleStats report")
     ap.add_argument("--top", type=int, default=10, help="rows in the span table")
+    ap.add_argument("--fleet", action="store_true",
+                    help="render a fleet-telemetry dump "
+                         "(DistributedDriver.dump_fleet output) with the "
+                         "$/shuffle cost digest")
     ap.add_argument("--selftest", action="store_true",
                     help="render synthetic inputs and verify the output")
     args = ap.parse_args(argv)
@@ -833,6 +941,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         ap.error("need a trace/report path (or --selftest)")
     with open(args.path) as f:
         doc = json.load(f)
+    if args.fleet and "fleet_workers" not in doc:
+        ap.error(
+            "--fleet needs a dump_fleet document (no 'fleet_workers' key "
+            "in the file)"
+        )
     print(render(doc, top=args.top))
     return 0
 
