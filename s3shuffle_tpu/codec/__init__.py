@@ -33,6 +33,7 @@ def get_codec(
     encode_inflight_batches: int | None = None,
     decode_batch_frames: int | None = None,
     decode_inflight_batches: int | None = None,
+    repin_probe_s: float | None = None,
 ) -> "FrameCodec | None":
     """Resolve a codec by config name. ``none`` → None (raw bytes, no framing,
     still concatenatable). ``auto`` → native if built, else zlib.
@@ -94,6 +95,8 @@ def get_codec(
             bs["batch_blocks"] = codec_batch_blocks
         if encode_inflight_batches is not None:
             bs["encode_inflight_batches"] = encode_inflight_batches
+        if repin_probe_s is not None:
+            bs["repin_probe_s"] = repin_probe_s
         return _stamp(TpuCodec(host_encode_fallback=tpu_host_fallback, **bs))
     raise ValueError(f"Unknown codec: {name}")
 

@@ -25,14 +25,16 @@ FusedChecksumAccumulator.
 from __future__ import annotations
 
 import logging
+import os
 import threading
+import time
 from typing import List
 
 import numpy as np
 
 from s3shuffle_tpu.codec.framing import CODEC_IDS, FrameCodec
 from s3shuffle_tpu.metrics import registry as _metrics
-from s3shuffle_tpu.ops import tlz
+from s3shuffle_tpu.ops import rates, tlz
 from s3shuffle_tpu.ops.checksum import (
     POLY_CRC32,
     POLY_CRC32C,
@@ -145,10 +147,6 @@ def _probe_device_backend() -> bool:
 class TpuCodec(FrameCodec):
     name = "tpu-lz"
     codec_id = CODEC_IDS["tpu-lz"]
-    #: the encode kernel can return each block's CRC32C with its payload
-    #: planes in the same launch (ops/tlz.py encode_batch_device(poly=...));
-    #: the write plane keys its fused-checksum wiring on this flag
-    supports_fused_checksum = True
 
     def __init__(
         self,
@@ -168,6 +166,11 @@ class TpuCodec(FrameCodec):
         # stream's BATCH_FRAMES default) and the bounded decode window
         decode_batch_frames: int | None = None,
         decode_inflight_batches: int = 0,
+        # seconds a device-failure host pin lasts before ONE trial batch
+        # re-probes the device (a tunnel that collapsed mid-shuffle usually
+        # comes back); 0 = the legacy permanent pin. Config knob
+        # ``codec_repin_probe_s``.
+        repin_probe_s: float = 300.0,
     ):
         if block_size % 128 != 0:
             raise ValueError("TPU codec block_size must be a multiple of 128")
@@ -182,6 +185,14 @@ class TpuCodec(FrameCodec):
         self._device_failures = 0  # consecutive device batch-encode failures
         self._decode_failures = 0  # consecutive device batch-DECODE failures
         self._use_device = use_device
+        #: the ctor's explicit choice, kept apart from the probe-cached
+        #: verdict in ``_use_device``: an EXPLICIT device force bypasses the
+        #: measured-rate gate, and a failure-pin re-probe restores it
+        self._explicit_device = use_device
+        self._repin_probe_s = max(0.0, float(repin_probe_s))
+        self._host_pinned_at: float | None = None  # _clock() of the last pin
+        self._reprobing = False  # current batch is a re-probe trial
+        self._clock = time.monotonic  # patchable in the repin tests
         #: ``codec=tpu`` chosen but no accelerator attached: reroute ENCODE to
         #: SLZ frames (a different codec_id — readers dispatch per frame, so
         #: mixing is legal within a shuffle) instead of eating the ~5x-slower
@@ -216,13 +227,81 @@ class TpuCodec(FrameCodec):
         thread parked inside backend init — callers that import jax
         themselves afterwards (the device-only helpers like
         :func:`fused_compress_and_checksum`) can still block on jax's init
-        lock; the shuffle data plane never does."""
+        lock; the shuffle data plane never does.
+
+        A failure pin (:meth:`_pin_host`) expires after ``repin_probe_s``
+        seconds: ONE trial batch then goes back to the device — its first
+        failure re-pins immediately, its first success clears the pin."""
         if self._use_device is not None:
-            return self._use_device
+            if (
+                not self._use_device
+                and self._host_pinned_at is not None
+                and self._clock() - self._host_pinned_at >= self._repin_probe_s
+            ):
+                self._reprobing = True
+                self._host_pinned_at = None
+                self._use_device = self._explicit_device
+                if self._use_device is not None:
+                    return self._use_device
+            else:
+                return self._use_device
         verdict, resolved = _probe_state()
         if resolved:
             self._use_device = verdict
         return verdict
+
+    def _pin_host(self) -> None:
+        """Pin this instance to the host path after device failures. With
+        ``repin_probe_s`` > 0 the pin expires (see :meth:`_device_path`);
+        0 keeps the legacy permanent pin."""
+        self._use_device = False
+        self._reprobing = False
+        self._device_failures = 0
+        self._decode_failures = 0
+        self._host_pinned_at = (
+            self._clock() if self._repin_probe_s > 0 else None
+        )
+
+    def _device_ok(self) -> None:
+        """A device batch succeeded: clear any re-probe trial state."""
+        if self._reprobing or self._host_pinned_at is not None:
+            self._reprobing = False
+            self._host_pinned_at = None
+            logger.info(
+                "device re-probe succeeded — codec back on the device path"
+            )
+
+    def _forced_verdict(self) -> bool:
+        """True when the device side was EXPLICITLY forced (ctor
+        ``use_device=True`` or S3SHUFFLE_TPU_CODEC_DEVICE truthy): the
+        operator bypassed measurement, so the rate gate steps aside."""
+        if self._explicit_device is True:
+            return True
+        env = os.environ.get("S3SHUFFLE_TPU_CODEC_DEVICE")
+        return env is not None and env.strip().lower() in (
+            "1", "true", "yes", "on",
+        )
+
+    def _select_device(self, op: str) -> bool:
+        """Availability (:meth:`_device_path`) AND the measured-rate gate
+        (ops/rates.py): a chip runs ``op`` only when its cached probe rate
+        beats the competing host rate — availability alone shipped a 120x
+        encode regression (3.6 vs 435 MB/s) before this gate existed."""
+        return self._device_path() and rates.select(
+            op, forced=self._forced_verdict()
+        )
+
+    @property
+    def supports_fused_checksum(self) -> bool:
+        """The encode kernel can return each block's CRC32C with its payload
+        planes in the same launch (ops/tlz.py encode_batch_device(poly=...));
+        the write plane keys its fused-checksum wiring on this. True only
+        when batches will actually route to the device encode — availability
+        AND the measured-rate gate — since streaming host checksums win
+        whenever the encode itself stays on the host."""
+        return self._device_path() and rates.decide(
+            "encode", forced=self._forced_verdict()
+        )[0]
 
     def _encode_delegate(self):
         """The SLZ codec encode should reroute to, or None to encode TLZ.
@@ -248,8 +327,11 @@ class TpuCodec(FrameCodec):
             if self._use_device is not None
             else _probe_state()
         )
-        if verdict:
-            self.host_encode_fallback = False  # chip attached: TLZ on device
+        if verdict and rates.decide("encode", forced=self._forced_verdict())[0]:
+            # chip attached AND measured worth using: TLZ on device. A chip
+            # that is merely PRESENT but rate-gated to host behaves like no
+            # chip — the SLZ reroute beats the host C TLZ encoder at write.
+            self.host_encode_fallback = False
             return None
         delegate = self._pending_delegate
         if delegate is None:
@@ -328,7 +410,7 @@ class TpuCodec(FrameCodec):
         batch — no queued block is ever lost — and after three consecutive
         failures pins the instance to the host path (each retry would eat an
         exception + fallback per batch forever)."""
-        if self._device_path():
+        if self._select_device("encode"):
             timings: dict = {}
             try:
                 payloads, crc_info = tlz.encode_batch_device(
@@ -337,17 +419,24 @@ class TpuCodec(FrameCodec):
                     timings=timings,
                 )
                 self._device_failures = 0
+                self._device_ok()
                 if _metrics.enabled() and timings.get("assembly_s"):
                     _H_ASSEMBLY.observe(timings["assembly_s"])
                 return payloads, crc_info
             except Exception:
                 self._device_failures += 1
-                if self._device_failures >= 3:
-                    self._use_device = False
+                if self._device_failures >= 3 or self._reprobing:
+                    n = self._device_failures
+                    trial = self._reprobing
+                    self._pin_host()
                     logger.warning(
-                        "device batch encode failed %d times in a row — "
-                        "pinning this codec to the host TLZ encoder",
-                        self._device_failures, exc_info=True,
+                        "device batch encode failed %s — pinning this codec "
+                        "to the host TLZ encoder%s",
+                        "on its re-probe trial" if trial
+                        else f"{n} times in a row",
+                        "" if self._repin_probe_s <= 0
+                        else f" (re-probe in {self._repin_probe_s:g}s)",
+                        exc_info=True,
                     )
                 else:
                     logger.warning(
@@ -448,7 +537,7 @@ class TpuCodec(FrameCodec):
         if delegate is not None:
             return delegate.compress_blocks(blocks)
         full = [b for b in blocks if len(b) == self.block_size]
-        if not full or not self._device_path():
+        if not full or not self._select_device("encode"):
             return [self._compress_block_local(b) for b in blocks]
         return tlz.encode_blocks_device(blocks, self.block_size)
 
@@ -463,7 +552,7 @@ class TpuCodec(FrameCodec):
         if delegate is not None:
             return delegate.frame_blocks(blocks)
         full = [b for b in blocks if len(b) == self.block_size]
-        if full and self._device_path():
+        if full and self._select_device("encode"):
             payloads = tlz.encode_blocks_device(blocks, self.block_size)
         else:
             payloads = [self._compress_block_local(b) for b in blocks]
@@ -475,7 +564,7 @@ class TpuCodec(FrameCodec):
         )
 
     def decompress_blocks(self, blocks) -> List[bytes]:
-        if not self._device_path():
+        if not self._select_device("decode"):
             return [self.decompress_block(b, n) for b, n in blocks]
         return self._decode_full_blocks(blocks, None)[0]
 
@@ -485,10 +574,16 @@ class TpuCodec(FrameCodec):
         stored-byte CRC fused with the decoded planes — the read plane's
         checksum layer then defers its host hashing pass to those
         certificates. Only meaningful on the device path (host reads keep
-        streaming validation: the native CRC is already cheap there)."""
+        streaming validation: the native CRC is already cheap there), and
+        only when the measured-rate table says the FUSED launch beats the
+        effective rate of streaming (unfused device decode + host CRC) —
+        the last probe clocked fused at 51 MB/s vs ~600 MB/s effective
+        streaming, a collapse the old availability gate shipped."""
         if poly not in (POLY_CRC32, POLY_CRC32C):
             return False
-        return self._device_path()
+        return self._select_device("decode") and rates.select_fused_decode(
+            forced=self._forced_verdict()
+        )
 
     def _decode_full_blocks(self, blocks, poly):
         """Device batch decode with fused payload CRCs when ``poly`` is set.
@@ -506,6 +601,7 @@ class TpuCodec(FrameCodec):
                 batch_rows=self.batch_blocks, poly=poly,
             )
             self._decode_failures = 0
+            self._device_ok()
             return out, crcs
         except Exception as device_err:
             try:
@@ -514,12 +610,18 @@ class TpuCodec(FrameCodec):
                 raise  # precise host classification (corruption) wins
             del device_err
             self._decode_failures += 1
-            if self._decode_failures >= 3:
-                self._use_device = False
+            if self._decode_failures >= 3 or self._reprobing:
+                n = self._decode_failures
+                trial = self._reprobing
+                self._pin_host()
                 logger.warning(
-                    "device batch decode failed %d times in a row — pinning "
-                    "this codec to the host TLZ decoder",
-                    self._decode_failures, exc_info=True,
+                    "device batch decode failed %s — pinning this codec to "
+                    "the host TLZ decoder%s",
+                    "on its re-probe trial" if trial
+                    else f"{n} times in a row",
+                    "" if self._repin_probe_s <= 0
+                    else f" (re-probe in {self._repin_probe_s:g}s)",
+                    exc_info=True,
                 )
             else:
                 logger.warning(
@@ -536,7 +638,7 @@ class TpuCodec(FrameCodec):
         fallback, short/legacy frames); the caller certifies those from the
         bytes it holds. Decoded output is byte-identical to the unfused
         path's."""
-        if not self._device_path():
+        if not self._select_device("decode"):
             out = [self.decompress_block(b, n) for b, n in blocks]
             for (_, ulen), o in zip(blocks, out):
                 if len(o) != ulen:
@@ -610,7 +712,7 @@ def fused_compress_and_checksum(
         and blocks
         and all(len(b) == codec.block_size for b in blocks)
         and codec._encode_delegate() is None
-        and codec._device_path()
+        and codec._select_device("encode")
     ):
         blob = b"".join(blocks)
         framed, crcs = codec.compress_framed_fused(

@@ -153,6 +153,15 @@ def _encode_device(chunks: np.ndarray, coefs: np.ndarray) -> Optional[np.ndarray
         return None
     try:
         m, k = coefs.shape
+        from s3shuffle_tpu.coding import gf_pallas
+
+        if gf_pallas.supported(m, k):
+            # the constant-select Pallas kernel (no table gathers);
+            # interpret mode keeps it byte-exact off-chip
+            import jax
+
+            interpret = jax.default_backend() != "tpu"
+            return gf_pallas.encode_groups_pallas(chunks, coefs, interpret)
         out = _device_kernel(m, k)(chunks, coefs)
         return np.asarray(out)
     except Exception as e:  # noqa: BLE001 — any device/toolchain failure
@@ -167,11 +176,15 @@ def _encode_device(chunks: np.ndarray, coefs: np.ndarray) -> Optional[np.ndarray
 
 def encode_groups(chunks: np.ndarray, coefs: np.ndarray) -> np.ndarray:
     """Encode a batch of stripe groups: ``chunks[G, k, L]`` uint8 ->
-    ``parity[G, m, L]`` uint8. Device kernel when available and the batch is
-    big enough; host numpy otherwise (byte-identical by the unit property
-    test)."""
+    ``parity[G, m, L]`` uint8. The device kernel runs only when the batch is
+    big enough to amortize a dispatch AND the measured-rate gate says the
+    chip has proven faster than the host table encode (ops/rates.py — no
+    probe data means host); host numpy otherwise (byte-identical by the unit
+    property test)."""
+    from s3shuffle_tpu.ops import rates
+
     chunks = np.ascontiguousarray(chunks, dtype=np.uint8)
-    if chunks.nbytes >= _DEVICE_MIN_BYTES:
+    if chunks.nbytes >= _DEVICE_MIN_BYTES and rates.select("gf_encode"):
         out = _encode_device(chunks, coefs)
         if out is not None:
             return out

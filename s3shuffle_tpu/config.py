@@ -298,6 +298,11 @@ class ShuffleConfig:
     # SLZ frames (loud warning) instead of the ~5x-slower host C TLZ encoder;
     # TLZ decode stays active for existing data. false = always encode TLZ.
     tpu_host_fallback: bool = True
+    # seconds a device-failure host pin lasts before the codec re-probes the
+    # device with ONE trial batch (a tunnel that collapsed mid-shuffle
+    # usually comes back; the old permanent pin parked long-running workers
+    # on the host forever). 0 = the legacy permanent pin.
+    codec_repin_probe_s: float = 300.0
     # --- observability / trace plane (TPU-first addition; the reference's
     # quantitative story is the external jvm-profiler → InfluxDB → Grafana
     # stack, examples/README.md:54-101) ---
@@ -377,6 +382,8 @@ class ShuffleConfig:
             raise ValueError("decode_batch_frames must be >= 1")
         if self.decode_inflight_batches < 0:
             raise ValueError("decode_inflight_batches must be >= 0")
+        if self.codec_repin_probe_s < 0:
+            raise ValueError("codec_repin_probe_s must be >= 0")
         if self.autotune_interval_s < 0:
             raise ValueError("autotune_interval_s must be >= 0")
         if self.columnar not in (0, 1):

@@ -100,6 +100,7 @@ class ShuffleManager:
             encode_inflight_batches=cfg.encode_inflight_batches,
             decode_batch_frames=cfg.decode_batch_frames,
             decode_inflight_batches=cfg.decode_inflight_batches,
+            repin_probe_s=cfg.codec_repin_probe_s,
         )
         # Autotune: hand the codec to both tuners so its live windows are
         # retuned online — the write-side CommitTuner owns

@@ -130,6 +130,9 @@ KNOWN_METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "codec_decode_bytes_total": ("counter", ()),
     "codec_decode_inflight": ("gauge", ()),
     "codec_fused_crc_validated_total": ("counter", ()),
+    # --- codec plane: measured-rate gate + Pallas kernels (ops/rates.py) ---
+    "codec_path_selected_total": ("counter", ("path", "reason")),
+    "codec_kernel_compile_seconds": ("histogram", ("kernel",)),
     # --- trace plane: span shards, flight recorder, fleet telemetry, cost
     # (utils/trace.py, metadata/service.py, s3shuffle_tpu/costs.py) ---
     "trace_shard_bytes_total": ("counter", ()),

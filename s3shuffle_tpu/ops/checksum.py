@@ -274,14 +274,14 @@ def raw_crc_graph_fn(poly: int, length: int, batch: int):
     jit trace — the hook the fused TLZ encode kernel uses to fold the CRC
     pass into its own launch. Picks the fused Pallas kernel when enabled and
     the shape tiles (:func:`_use_pallas`), else the MXU bit-matmul; either
-    way the weight table is device-resident, shipped once per (poly, L)."""
+    way the constant tables are device-resident, shipped once per poly."""
     if _use_pallas(batch, length):
         from s3shuffle_tpu.ops import crc_pallas
 
-        w_planes = crc_pallas._device_plane_weights(poly, length)
+        tables = crc_pallas._device_tables(poly)
 
         def fn(data_u8):
-            return crc_pallas.crc_raw_in_graph(data_u8, w_planes)
+            return crc_pallas.crc_raw_in_graph(data_u8, tables)
 
         return fn
     w_bits = _device_weights(poly, length)
@@ -289,13 +289,35 @@ def raw_crc_graph_fn(poly: int, length: int, batch: int):
 
 
 def _use_pallas(b: int, length: int) -> bool:
-    """Opt-in (S3SHUFFLE_PALLAS_CRC=1): the fused Pallas kernel keeps the 8x
-    bit expansion in VMEM. XLA's fusion is competitive (and on some rigs
-    faster at large batches), so the XLA lowering stays the default."""
+    """Pallas tiled-fold kernel vs the XLA bit-matmul, inside device traces.
+
+    ``S3SHUFFLE_PALLAS_CRC=1`` forces the Pallas kernel, any other value
+    forces the XLA lowering; unset, the measured-rate table decides
+    (ops/rates.py): Pallas arms only when the last chip probe clocked
+    ``tpu_crc32c_pallas_mb_s`` above the XLA ``tpu_crc32c_mb_s`` — no probe
+    data keeps the (working) XLA path. Either way the kernel requires an
+    actual TPU backend and tileable shapes (CI proves it byte-identical in
+    interpret mode through :func:`crc_pallas.crc_raw_batch` directly)."""
     import os
 
-    if os.environ.get("S3SHUFFLE_PALLAS_CRC") != "1":
-        return False
+    from s3shuffle_tpu.ops import rates
+
+    env = os.environ.get("S3SHUFFLE_PALLAS_CRC")
+    if env is not None:
+        if env.strip() != "1":
+            rates.record_selection("xla", "env-crc")
+            return False
+        reason = "env-crc"
+    else:
+        pallas_rate = rates.rate("tpu_crc32c_pallas_mb_s")
+        xla_rate = rates.rate("tpu_crc32c_mb_s")
+        if pallas_rate is None:
+            rates.record_selection("xla", "no-data")
+            return False
+        if xla_rate is not None and pallas_rate <= xla_rate:
+            rates.record_selection("xla", "measured-host")
+            return False
+        reason = "measured-device"
     from s3shuffle_tpu.ops import crc_pallas
 
     try:
@@ -306,7 +328,10 @@ def _use_pallas(b: int, length: int) -> bool:
     except Exception:
         logger.debug("jax backend probe failed; pallas CRC off", exc_info=True)
         return False
-    return crc_pallas.supported(b, length)
+    if not crc_pallas.supported(b, length):
+        return False
+    rates.record_selection("pallas", reason)
+    return True
 
 
 @functools.lru_cache(maxsize=8)

@@ -179,14 +179,12 @@ _POP = np.array([bin(i).count("1") for i in range(256)], dtype=np.int64)
 # ---------------------------------------------------------------------------
 
 
-def _encode_math(blocks_u8, n_groups: int):
-    """The raw (unjitted) encode computation — shared by the standalone
-    jitted kernel and larger fused traces (see __graft_entry__). Returns
-    (match_bitmap, cont_bitmap, split_bitmap, dists_compact, ks_compact,
-    lits_compact, n_new, n_split, n_match) where ``dists_compact[:, :n_new]``
-    are the stored (non-continuation) match distances,
-    ``ks_compact[:, :n_split]`` the split points, and
-    ``lits_compact[:, :n_groups - n_match - n_split]`` the literal groups."""
+def _candidate_math(blocks_u8, n_groups: int):
+    """Hash + nearest-previous-identical-window candidate search — the
+    front half of the encoder, always XLA: the stable argsort at its core
+    has no Mosaic lowering, so even when the plane-decision stage runs as a
+    Pallas kernel (ops/tlz_pallas.py) this stage stays in the enclosing
+    trace. Returns (B, G) int32 candidate source POSITIONS (-1 = none)."""
     jax, jnp = _jax()
 
     mults = jnp.asarray(_MULTS_I32)
@@ -195,13 +193,6 @@ def _encode_math(blocks_u8, n_groups: int):
     n_pos = n_bytes - GROUP + 1  # every valid window start
     buf = blocks_u8.astype(jnp.int32)  # (B, n_bytes)
     rows = jnp.arange(b)[:, None]
-    lanes = jnp.arange(GROUP, dtype=jnp.int32)
-    groups = buf.reshape(b, n_groups, GROUP)
-
-    def window_at(pos):
-        # gather the GROUP-byte window starting at each position in ``pos``
-        idx = (pos[:, :, None] + lanes).reshape(b, -1)
-        return jnp.take_along_axis(buf, idx, axis=1).reshape(b, -1, GROUP)
 
     # hash of the window at every byte position: GROUP shifted MACs
     h = jnp.zeros((b, n_pos), dtype=jnp.int32)
@@ -221,7 +212,26 @@ def _encode_math(blocks_u8, n_groups: int):
     cand_sorted = jnp.where(prev_same, prev_pos, -1)
     cand = jnp.zeros_like(cand_sorted).at[rows, order].set(cand_sorted)
     dest = jnp.arange(n_groups, dtype=jnp.int32) * GROUP
-    cand_d = jnp.take(cand, dest, axis=1).astype(jnp.int32)  # (B, G)
+    return jnp.take(cand, dest, axis=1).astype(jnp.int32)  # (B, G)
+
+
+def _plane_decisions_math(blocks_u8, cand_d, n_groups: int):
+    """Match/continuation/split decisions from the candidate positions — the
+    gather-heavy middle of the encoder, mirrored byte-for-byte by the Pallas
+    plane kernel (ops/tlz_pallas.py, regression-tested identical). Returns
+    FULL (uncompacted) planes: (is_match, is_cont, is_split, dists, ks)."""
+    jax, jnp = _jax()
+    b = blocks_u8.shape[0]
+    n_bytes = n_groups * GROUP
+    buf = blocks_u8.astype(jnp.int32)  # (B, n_bytes)
+    lanes = jnp.arange(GROUP, dtype=jnp.int32)
+    groups = buf.reshape(b, n_groups, GROUP)
+    dest = jnp.arange(n_groups, dtype=jnp.int32) * GROUP
+
+    def window_at(pos):
+        # gather the GROUP-byte window starting at each position in ``pos``
+        idx = (pos[:, :, None] + lanes).reshape(b, -1)
+        return jnp.take_along_axis(buf, idx, axis=1).reshape(b, -1, GROUP)
 
     # verify exact equality (hash collisions ⇒ missed match, never wrong);
     # matches are stored as DISTANCES (dest - src, 1..MAX_DIST) — constant
@@ -301,6 +311,18 @@ def _encode_math(blocks_u8, n_groups: int):
         & (ks <= GROUP - 1)
         & (ks <= prefix_run)
     )
+    return is_match, is_cont, is_split, dists, ks
+
+
+def _compact_pack_math(blocks_u8, is_match, is_cont, is_split, dists, ks,
+                       n_groups: int):
+    """Rank/scatter compaction + bitmap packing of the full decision planes
+    into the 9-tuple wire layout — the back half of the encoder, always XLA
+    (masked scatters have no Mosaic lowering)."""
+    jax, jnp = _jax()
+    b = blocks_u8.shape[0]
+    rows = jnp.arange(b)[:, None]
+    groups = blocks_u8.astype(jnp.int32).reshape(b, n_groups, GROUP)
     is_lit = ~is_match & ~is_split
 
     is_new = is_match & ~is_cont
@@ -353,13 +375,29 @@ def _encode_math(blocks_u8, n_groups: int):
     )
 
 
+def _encode_math(blocks_u8, n_groups: int):
+    """The raw (unjitted) encode computation — shared by the standalone
+    jitted kernel and larger fused traces (see __graft_entry__). Composition
+    of the three encoder stages (candidate search → plane decisions →
+    compaction); the Pallas encode path swaps ONLY the middle stage
+    (ops/tlz_pallas.py _encode_math_pallas). Returns
+    (match_bitmap, cont_bitmap, split_bitmap, dists_compact, ks_compact,
+    lits_compact, n_new, n_split, n_match) where ``dists_compact[:, :n_new]``
+    are the stored (non-continuation) match distances,
+    ``ks_compact[:, :n_split]`` the split points, and
+    ``lits_compact[:, :n_groups - n_match - n_split]`` the literal groups."""
+    cand_d = _candidate_math(blocks_u8, n_groups)
+    planes = _plane_decisions_math(blocks_u8, cand_d, n_groups)
+    return _compact_pack_math(blocks_u8, *planes, n_groups)
+
+
 @functools.lru_cache(maxsize=8)
 def _encode_kernel(n_groups: int):
     jax, _jnp = _jax()
     return jax.jit(functools.partial(_encode_math, n_groups=n_groups))
 
 
-def _encode_fused_math(blocks_u8, n_groups: int, crc_fn):
+def _encode_fused_math(blocks_u8, n_groups: int, crc_fn, encode_fn=None):
     """Encode + fused CRC in ONE trace: the planes of :func:`_encode_math`
     plus, from the same launch, raw zero-init CRC remainders of (a) each raw
     input block (the framing raw-escape branch checksums stored RAW bytes)
@@ -367,9 +405,11 @@ def _encode_fused_math(blocks_u8, n_groups: int, crc_fn):
     a TLZ payload — the host stitches the small header/metadata CRCs around
     it with :func:`ops.checksum.crc_combine`). Both remainder batches ride
     one (2B, L) CRC pass, so the separate checksum launch — and its second
-    H2D staging of every compressed byte — disappears."""
+    H2D staging of every compressed byte — disappears. ``encode_fn`` swaps
+    the plane computation (default :func:`_encode_math`; the Pallas path
+    passes its own — same 9-tuple contract)."""
     _jax_mod, jnp = _jax()
-    outs = _encode_math(blocks_u8, n_groups)
+    outs = (encode_fn or _encode_math)(blocks_u8, n_groups)
     lits, n_split, n_match = outs[5], outs[7], outs[8]
     b = blocks_u8.shape[0]
     n_bytes = n_groups * GROUP
@@ -386,23 +426,70 @@ def _encode_fused_math(blocks_u8, n_groups: int, crc_fn):
     return outs + (raw[:b], raw[b:])
 
 
+def _encode_impl() -> str:
+    """Which device encode formulation represents the chip: ``pallas`` (the
+    VMEM plane kernel, ops/tlz_pallas.py) when ``S3SHUFFLE_TLZ_PALLAS=1`` or
+    the measured-rate table clocks it above the XLA graph, else ``xla``.
+    This is a WITHIN-device choice — whether the device runs at all is the
+    codec's rate gate (codec/tpu.py + ops/rates.py)."""
+    import os
+
+    env = os.environ.get("S3SHUFFLE_TLZ_PALLAS")
+    if env is not None:
+        return "pallas" if env.strip() == "1" else "xla"
+    from s3shuffle_tpu.ops import rates
+
+    p = rates.rate("tpu_tlz_encode_pallas_mb_s")
+    x = rates.rate("tpu_tlz_encode_mb_s")
+    if p is not None and (x is None or p > x):
+        return "pallas"
+    return "xla"
+
+
+def _decode_fused_impl() -> str:
+    """Pallas vs XLA formulation of the FUSED decode launch (same contract
+    as :func:`_encode_impl`; the unfused decode has no Pallas variant)."""
+    import os
+
+    env = os.environ.get("S3SHUFFLE_TLZ_PALLAS")
+    if env is not None:
+        return "pallas" if env.strip() == "1" else "xla"
+    from s3shuffle_tpu.ops import rates
+
+    p = rates.rate("tpu_tlz_decode_fused_pallas_mb_s")
+    x = rates.rate("tpu_tlz_decode_fused_mb_s")
+    if p is not None and (x is None or p > x):
+        return "pallas"
+    return "xla"
+
+
 @functools.lru_cache(maxsize=16)
-def _batch_kernel(batch_rows: int, n_groups: int, poly: Optional[int]):
+def _batch_kernel(batch_rows: int, n_groups: int, poly: Optional[int],
+                  impl: str = "xla"):
     """Precompiled fixed-shape batched encode kernel — one trace per
-    (batch rows, block shape, fused poly), never per call: a varying batch
-    dim retraces per distinct size under jit (XLA compiles per shape), which
-    taxed every tail batch on the old path. The staged batch is DONATED so
-    XLA may reuse its device buffer for outputs. ``poly`` selects the fused
-    CRC variant (None = encode planes only)."""
+    (batch rows, block shape, fused poly, impl), never per call: a varying
+    batch dim retraces per distinct size under jit (XLA compiles per shape),
+    which taxed every tail batch on the old path. The staged batch is
+    DONATED so XLA may reuse its device buffer for outputs. ``poly`` selects
+    the fused CRC variant (None = encode planes only); ``impl`` selects the
+    plane-decision stage (``xla`` graph or the ``pallas`` VMEM kernel —
+    byte-identical outputs, regression-tested)."""
     jax, _jnp = _jax()
+    if impl == "pallas":
+        from s3shuffle_tpu.ops import tlz_pallas
+
+        stage_fn = tlz_pallas.encode_math_fn(n_groups)
+    else:
+        stage_fn = functools.partial(_encode_math, n_groups=n_groups)
     if poly is None:
-        fn = functools.partial(_encode_math, n_groups=n_groups)
+        fn = stage_fn
     else:
         from s3shuffle_tpu.ops.checksum import raw_crc_graph_fn
 
         crc_fn = raw_crc_graph_fn(poly, n_groups * GROUP, 2 * batch_rows)
         fn = functools.partial(
-            _encode_fused_math, n_groups=n_groups, crc_fn=crc_fn
+            _encode_fused_math, n_groups=n_groups, crc_fn=crc_fn,
+            encode_fn=lambda blocks, _n: stage_fn(blocks),
         )
     return jax.jit(fn, donate_argnums=(0,))
 
@@ -565,7 +652,9 @@ def encode_batch_device(
             warnings.filterwarnings(
                 "ignore", message="Some donated buffers were not usable"
             )
-            outs = _batch_kernel(rows, n_groups, poly)(jax.device_put(staged))
+            outs = _batch_kernel(rows, n_groups, poly, _encode_impl())(
+                jax.device_put(staged)
+            )
         arrs = tuple(np.asarray(x) for x in outs)
         t0 = _time.perf_counter()
         payloads.extend(_assemble_batch(arrs[:9], e - s, n_groups))
@@ -1284,16 +1373,24 @@ def _decode_kernel(n_groups: int):
 
 
 @functools.lru_cache(maxsize=16)
-def _decode_batch_kernel(batch_rows: int, n_groups: int, poly: Optional[int]):
+def _decode_batch_kernel(batch_rows: int, n_groups: int, poly: Optional[int],
+                         impl: str = "xla"):
     """Precompiled fixed-shape batched decode kernel — one trace per
-    (batch rows, block shape, fused poly), never per call: the old path
-    jitted over whatever batch size arrived, so XLA recompiled per distinct
-    frame-run length (every tail run of every partition). Staged plane
-    arrays are DONATED so XLA may reuse their device buffers. ``poly``
-    selects the fused CRC variant (None = decode only)."""
+    (batch rows, block shape, fused poly, impl), never per call: the old
+    path jitted over whatever batch size arrived, so XLA recompiled per
+    distinct frame-run length (every tail run of every partition). Staged
+    plane arrays are DONATED so XLA may reuse their device buffers. ``poly``
+    selects the fused CRC variant (None = decode only); ``impl="pallas"``
+    (fused only) runs plane reconstruction AND the CRC fold in ONE Pallas
+    grid (ops/tlz_pallas.py) instead of serializing a second launch."""
     jax, _jnp = _jax()
     if poly is None:
         fn = functools.partial(_decode_math, n_groups=n_groups)
+        return jax.jit(fn, donate_argnums=(0, 1, 2, 3, 4, 5))
+    if impl == "pallas":
+        from s3shuffle_tpu.ops import tlz_pallas
+
+        fn = tlz_pallas.decode_fused_math_fn(n_groups, poly)
         return jax.jit(fn, donate_argnums=(0, 1, 2, 3, 4, 5))
     from s3shuffle_tpu.ops.checksum import raw_crc_graph_fn
 
@@ -1553,7 +1650,9 @@ def decode_batch_device(
                 )
                 raw_crcs = None
             else:
-                dec, raw = _decode_batch_kernel(launch_rows, n_groups, poly)(
+                dec, raw = _decode_batch_kernel(
+                    launch_rows, n_groups, poly, _decode_fused_impl()
+                )(
                     jax.device_put(is_match), jax.device_put(is_cont),
                     jax.device_put(is_split), jax.device_put(offs),
                     jax.device_put(ks), jax.device_put(lits),

@@ -1,0 +1,409 @@
+"""Pallas TPU kernels for the TLZ codec: encode plane decisions and the
+fused decode+CRC launch.
+
+Why these exist: the 2026-08-04 chip probe clocked the XLA-composed TLZ
+graph at 3.6 MB/s encode (vs 435 MB/s for one host C core) and measured the
+fused decode collapsing 1004 → 51 MB/s. The XLA encode graph materializes
+every verification/promotion/split gather — roughly a dozen ``(B, G, 8)``
+int32 intermediates — through HBM; the fused decode serializes plane
+reconstruction and the CRC matmul as separate fusions over the same bytes.
+These kernels keep that traffic in VMEM:
+
+- **Encode plane kernel** (:func:`encode_math_fn`): the encoder's three
+  stages (ops/tlz.py) are candidate search (stable argsort — no Mosaic
+  lowering, stays XLA), plane decisions (gather-heavy — THIS kernel), and
+  rank/scatter compaction (masked scatters — stays XLA). The kernel grids
+  over batch rows — the ``(rows, block)`` staging layout PR 8 builds, one
+  precompiled launch per power-of-two row bucket — holding one block and
+  all its decision intermediates in VMEM per grid step, and emits the full
+  (uncompacted) match/cont/split/distance/split-point planes. The math
+  mirrors ``tlz._plane_decisions_math`` exactly; byte-identity of the final
+  frames against the host C encoder is regression-tested in interpret mode.
+
+- **Fused decode kernel** (:func:`decode_fused_math_fn`): per grid step one
+  row's plane reconstruction (rank gathers, per-byte source map, log2
+  pointer-jumping — all VMEM-resident) AND the literal-plane CRC fold run
+  in the SAME grid: the CRC state advances tile-by-tile with the fixed
+  per-tile weights + shift matrix of ops/crc_pallas.py, so certifying reads
+  no longer pay a second pass over the literal bytes.
+
+Correctness is CI-provable on ``JAX_PLATFORMS=cpu``: every wrapper threads
+``interpret=True`` off-TPU, and the property suites assert bit-for-bit
+equality with the host encoder/decoder and native crc32c. Whether these
+kernels (rather than the XLA formulations, or the host) actually run in
+production is decided by the measured-rate gate — see ``tlz._encode_impl``
+/ ``tlz._decode_fused_impl`` and ops/rates.py: no probe data = host.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+
+from s3shuffle_tpu.ops.tlz import GROUP, MAX_DIST, _jump_rounds
+
+logger = logging.getLogger("s3shuffle_tpu.ops.tlz_pallas")
+
+#: CRC tile width inside the fused decode kernel (matches crc_pallas._TL)
+_TL = 128
+
+
+def _jax():
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    return jax, jnp, pl
+
+
+def _interpret() -> bool:
+    """Interpret mode off-TPU: the kernels stay byte-exact (and CI-testable)
+    on JAX_PLATFORMS=cpu, while a real chip gets the Mosaic lowering."""
+    try:
+        import jax
+
+        return jax.default_backend() != "tpu"
+    except Exception:  # pragma: no cover - jax import failure
+        logger.debug("jax backend query failed — interpret mode",
+                     exc_info=True)
+        return True
+
+
+# ---------------------------------------------------------------------------
+# Encode: plane-decision kernel (one batch row per grid step)
+# ---------------------------------------------------------------------------
+
+
+def _make_planes_kernel(n_groups: int):
+    n_bytes = n_groups * GROUP
+
+    def kernel(buf_ref, cand_ref, m_ref, c_ref, s_ref, d_ref, k_ref):
+        import jax
+        import jax.numpy as jnp
+
+        buf = buf_ref[:].astype(jnp.int32)  # (1, n_bytes)
+        cand_d = cand_ref[:]  # (1, G) int32 candidate positions (-1 = none)
+        lanes3 = jax.lax.broadcasted_iota(jnp.int32, (1, 1, GROUP), 2)
+        groups = buf.reshape(1, n_groups, GROUP)
+        dest = jax.lax.broadcasted_iota(jnp.int32, (1, n_groups), 1) * GROUP
+
+        def window_at(pos):
+            # gather the GROUP-byte window starting at each position
+            idx = (pos[:, :, None] + lanes3).reshape(1, n_groups * GROUP)
+            return jnp.take_along_axis(buf, idx, axis=1).reshape(
+                1, n_groups, GROUP
+            )
+
+        # verify exact equality (mirrors tlz._plane_decisions_math — keep
+        # the two in lockstep, the property suite asserts byte-identity)
+        safe = jnp.maximum(cand_d, 0)
+        cand_dist = dest - cand_d
+        is_match = (
+            jnp.all(window_at(safe) == groups, axis=2)
+            & (cand_d >= 0)
+            & (cand_dist <= MAX_DIST)
+        )
+        dists = jnp.where(is_match, cand_dist, 0)
+
+        # continuation promotion, two passes (see tlz.py for the rationale)
+        for _ in range(2):
+            prev_dist = jnp.concatenate(
+                [jnp.zeros((1, 1), jnp.int32), dists[:, :-1]], axis=1
+            )
+            prev_match = jnp.concatenate(
+                [jnp.zeros((1, 1), bool), is_match[:, :-1]], axis=1
+            )
+            c_src = dest - prev_dist
+            c_ok = (
+                prev_match
+                & (prev_dist > 0)
+                & jnp.all(window_at(jnp.maximum(c_src, 0)) == groups, axis=2)
+            )
+            dists = jnp.where(c_ok, prev_dist, dists)
+            is_match = is_match | c_ok
+
+        prev_dist = jnp.concatenate(
+            [jnp.zeros((1, 1), jnp.int32), dists[:, :-1]], axis=1
+        )
+        prev_match = jnp.concatenate(
+            [jnp.zeros((1, 1), bool), is_match[:, :-1]], axis=1
+        )
+        is_cont = is_match & prev_match & (dists == prev_dist)
+
+        # split-literal tier (boundary groups; see tlz.py)
+        next_dist = jnp.concatenate(
+            [dists[:, 1:], jnp.zeros((1, 1), jnp.int32)], axis=1
+        )
+        next_match = jnp.concatenate(
+            [is_match[:, 1:], jnp.zeros((1, 1), bool)], axis=1
+        )
+        byte_pos = dest[:, :, None] + lanes3  # (1, G, GROUP)
+        pre_src = byte_pos - prev_dist[:, :, None]
+        suf_src = byte_pos - next_dist[:, :, None]
+
+        def gather(idx):
+            flat = jnp.clip(idx, 0, n_bytes - 1).reshape(1, n_groups * GROUP)
+            return jnp.take_along_axis(buf, flat, axis=1).reshape(
+                1, n_groups, GROUP
+            )
+
+        pre_eq = gather(pre_src) == groups
+        suf_eq = (gather(suf_src) == groups) & (suf_src >= 0)
+        prefix_run = jnp.sum(jnp.cumprod(pre_eq, axis=2), axis=2)
+        suffix_start = GROUP - jnp.sum(
+            jnp.cumprod(suf_eq[:, :, ::-1], axis=2), axis=2
+        )
+        ks = suffix_start.astype(jnp.int32)
+        is_split = (
+            ~is_match
+            & prev_match
+            & next_match
+            & (prev_dist > 0)
+            & (next_dist > 0)
+            & (ks >= 1)
+            & (ks <= GROUP - 1)
+            & (ks <= prefix_run)
+        )
+
+        m_ref[:] = is_match.astype(jnp.int32)
+        c_ref[:] = is_cont.astype(jnp.int32)
+        s_ref[:] = is_split.astype(jnp.int32)
+        d_ref[:] = dists
+        k_ref[:] = ks
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=16)
+def _planes_pallas(b: int, n_groups: int, interpret: bool):
+    jax, jnp, pl = _jax()
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_bytes = n_groups * GROUP
+    row = lambda i: (i, 0)  # noqa: E731 — one batch row per grid step
+    plane = pl.BlockSpec((1, n_groups), row, memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        _make_planes_kernel(n_groups),
+        out_shape=tuple(
+            jax.ShapeDtypeStruct((b, n_groups), jnp.int32) for _ in range(5)
+        ),
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, n_bytes), row, memory_space=pltpu.VMEM),
+            plane,
+        ],
+        out_specs=tuple(plane for _ in range(5)),
+        interpret=interpret,
+    )
+
+
+def plane_decisions(blocks_u8, cand_d, n_groups: int, interpret: bool):
+    """Traceable Pallas replacement for ``tlz._plane_decisions_math``:
+    (is_match, is_cont, is_split, dists, ks) full planes, byte-identical."""
+    _jax_mod, jnp, _pl = _jax()
+    b = int(blocks_u8.shape[0])
+    m, c, s, d, k = _planes_pallas(b, n_groups, interpret)(blocks_u8, cand_d)
+    return m.astype(bool), c.astype(bool), s.astype(bool), d, k
+
+
+def encode_math_fn(n_groups: int):
+    """A drop-in for ``tlz._encode_math`` (same 9-tuple, byte-identical
+    payloads) with the plane-decision stage as a Pallas kernel. Interpret
+    mode is resolved once at trace-build time (off-TPU = interpret)."""
+    interpret = _interpret()
+
+    def fn(blocks_u8):
+        from s3shuffle_tpu.ops import tlz
+
+        cand_d = tlz._candidate_math(blocks_u8, n_groups)
+        planes = plane_decisions(blocks_u8, cand_d, n_groups, interpret)
+        return tlz._compact_pack_math(blocks_u8, *planes, n_groups)
+
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# Fused decode: plane reconstruction + CRC fold in one grid
+# ---------------------------------------------------------------------------
+
+
+def _make_decode_fused_kernel(n_groups: int):
+    n_bytes = n_groups * GROUP
+    n_tiles = n_bytes // _TL
+    rounds = _jump_rounds(n_bytes)
+
+    def kernel(m_ref, c_ref, s_ref, offs_ref, ks_ref, lits_ref,
+               w_ref, fold_ref, dec_ref, par_ref):
+        import jax
+        import jax.numpy as jnp
+
+        is_match = m_ref[:] != 0  # (1, G)
+        is_cont = c_ref[:] != 0
+        is_split = s_ref[:] != 0
+        offs_padded = offs_ref[:]  # (1, G) int32 stored distances in order
+        ks_padded = ks_ref[:]  # (1, G) int32 stored split points in order
+        lits_flat = lits_ref[:]  # (1, n_bytes) uint8, front-aligned
+        lanes3 = jax.lax.broadcasted_iota(jnp.int32, (1, 1, GROUP), 2)
+        idx = jax.lax.broadcasted_iota(jnp.int32, (1, n_groups), 1)
+        pos = jax.lax.broadcasted_iota(jnp.int32, (1, n_bytes), 1)
+
+        # --- plane reconstruction (mirrors tlz._decode_math, b == 1) ---
+        is_new = is_match & ~is_cont
+        new_rank = jnp.cumsum(is_new, axis=1) - 1
+        dist_of = jnp.take_along_axis(
+            offs_padded, jnp.maximum(new_rank, 0), axis=1
+        )
+        off_of = GROUP * idx - dist_of
+        split_rank = jnp.cumsum(is_split, axis=1) - 1
+        k_of = jnp.take_along_axis(
+            ks_padded, jnp.maximum(split_rank, 0), axis=1
+        )
+        d_prev = jnp.concatenate(
+            [jnp.zeros((1, 1), jnp.int32), dist_of[:, :-1]], axis=1
+        )
+        d_next = jnp.concatenate(
+            [dist_of[:, 1:], jnp.zeros((1, 1), jnp.int32)], axis=1
+        )
+        is_lit = ~is_match & ~is_split
+        lit_rank = jnp.cumsum(is_lit, axis=1) - 1
+        lits_padded = lits_flat.reshape(1, n_groups, GROUP)
+        lit_vals = jnp.take_along_axis(
+            lits_padded, jnp.maximum(lit_rank, 0)[:, :, None], axis=1
+        )
+        sparse = jnp.where(is_lit[:, :, None], lit_vals, 0).reshape(
+            1, n_bytes
+        )
+        off_b = (off_of[:, :, None] + lanes3).reshape(1, n_bytes)
+        split_d = jnp.where(
+            lanes3 < k_of[:, :, None], d_prev[:, :, None], d_next[:, :, None]
+        )
+        split_src = (GROUP * idx[:, :, None] + lanes3 - split_d).reshape(
+            1, n_bytes
+        )
+        match_b = jnp.repeat(is_match, GROUP, axis=1)
+        split_b = jnp.repeat(is_split, GROUP, axis=1)
+        src = jnp.where(match_b, jnp.clip(off_b, 0, n_bytes - 1), pos)
+        src = jnp.where(split_b, jnp.clip(split_src, 0, n_bytes - 1), src)
+        for _ in range(rounds):
+            src = jnp.take_along_axis(src, src, axis=1)
+        dec_ref[:] = jnp.take_along_axis(sparse, src, axis=1)
+
+        # --- literal-plane CRC in the SAME grid step ---
+        # n_lits from the bitmaps (== the staged count for well-formed rows:
+        # the parser rejects inconsistent planes before staging)
+        n_lits = (
+            n_groups
+            - jnp.sum(is_match.astype(jnp.int32))
+            - jnp.sum(is_split.astype(jnp.int32))
+        )
+        shift = (n_groups - n_lits) * GROUP
+        src2 = pos - shift
+        gathered = jnp.take_along_axis(
+            lits_flat, jnp.maximum(src2, 0), axis=1
+        )
+        lits_right = jnp.where(src2 >= 0, gathered, 0).astype(jnp.uint8)
+
+        # tiled systolic fold (the crc_pallas formulation, inlined so the
+        # CRC shares this grid): state' = A_TL(state) ⊕ r(tile)
+        def fold_tile(t, state):
+            tile = jax.lax.dynamic_slice(
+                lits_right, (0, t * _TL), (1, _TL)
+            ).astype(jnp.int32)
+            r = jnp.zeros((1, 32), jnp.int32)
+            for k in range(8):
+                bits_k = ((tile >> k) & 1).astype(jnp.int8)
+                r = r + jax.lax.dot_general(
+                    bits_k,
+                    w_ref[k],
+                    dimension_numbers=(((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.int32,
+                )
+            adv = jax.lax.dot_general(
+                state.astype(jnp.int8),
+                fold_ref[:],
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
+            return jnp.where(t == 0, r & 1, (adv + r) & 1)
+
+        par_ref[:] = jax.lax.fori_loop(
+            0, n_tiles, fold_tile, jnp.zeros((1, 32), jnp.int32)
+        )
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=16)
+def _decode_fused_pallas(b: int, n_groups: int, interpret: bool):
+    jax, jnp, pl = _jax()
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_bytes = n_groups * GROUP
+    row = lambda i: (i, 0)  # noqa: E731 — one batch row per grid step
+    plane = pl.BlockSpec((1, n_groups), row, memory_space=pltpu.VMEM)
+    full = lambda i: (0, 0)  # noqa: E731 — constant tables, every step
+    return pl.pallas_call(
+        _make_decode_fused_kernel(n_groups),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, n_bytes), jnp.uint8),
+            jax.ShapeDtypeStruct((b, 32), jnp.int32),
+        ),
+        grid=(b,),
+        in_specs=[
+            plane,  # is_match
+            plane,  # is_cont
+            plane,  # is_split
+            plane,  # offs
+            plane,  # ks
+            pl.BlockSpec((1, n_bytes), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (8, 32, _TL), lambda i: (0, 0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec((32, 32), full, memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, n_bytes), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 32), row, memory_space=pltpu.VMEM),
+        ),
+        interpret=interpret,
+    )
+
+
+def decode_fused_math_fn(n_groups: int, poly: int):
+    """A drop-in for ``tlz._decode_fused_math`` (same signature/outputs)
+    whose CRC pass runs in the same Pallas grid as plane reconstruction.
+    Requires ``n_groups * GROUP`` divisible by the CRC tile width (the
+    caller guards; TpuCodec blocks are always 128-aligned)."""
+    n_bytes = n_groups * GROUP
+    if n_bytes % _TL != 0:
+        raise ValueError(f"block of {n_bytes} bytes not {_TL}-tileable")
+    interpret = _interpret()
+    from s3shuffle_tpu.ops import crc_pallas
+
+    w_np = crc_pallas.plane_weights(poly)
+    fold_np = crc_pallas.fold_matrix(poly)
+
+    def fn(is_match, is_cont, is_split, offs_padded, ks_padded, lits_padded,
+           n_lits):
+        _jax_mod, jnp, _pl = _jax()
+        b = int(is_match.shape[0])
+        del n_lits  # recomputed in-kernel from the (validated) bitmaps
+        dec, par = _decode_fused_pallas(b, n_groups, interpret)(
+            is_match.astype(jnp.int32),
+            is_cont.astype(jnp.int32),
+            is_split.astype(jnp.int32),
+            offs_padded,
+            ks_padded,
+            lits_padded.reshape(b, n_bytes),
+            jnp.asarray(w_np),
+            jnp.asarray(fold_np),
+        )
+        parity = par.astype(jnp.uint32)
+        raw = jnp.sum(
+            parity << jnp.arange(32, dtype=jnp.uint32)[None, :],
+            axis=1,
+            dtype=jnp.uint32,
+        )
+        return dec, raw
+
+    return fn

@@ -1,0 +1,294 @@
+"""Seeded interpret-mode property suites for the hand-written Pallas kernels.
+
+On the CPU test mesh every kernel runs in Pallas interpret mode
+(``jax.default_backend() != "tpu"``), which executes the exact same kernel
+program through the JAX interpreter — so CI proves byte-identity without a
+chip: TLZ encode against the host C encoder, the fused decode+CRC against
+the host decode and native crc32c, the tiled CRC fold against the host raw
+remainder, and the GF(2^8) parity kernel against the numpy table encoder,
+plus mid-kernel failure falling back host-side without losing a frame.
+
+``S3SHUFFLE_TLZ_PALLAS=1`` forces the within-device impl choice to the
+Pallas formulation (ops/tlz.py _encode_impl/_decode_fused_impl), so these
+suites drive the REAL production entry points, not kernel internals.
+"""
+
+import numpy as np
+import pytest
+
+import s3shuffle_tpu.codec.tpu as tpu_mod
+from s3shuffle_tpu.codec.tpu import TpuCodec
+from s3shuffle_tpu.ops import crc_pallas, tlz, tlz_pallas
+from s3shuffle_tpu.ops.checksum import POLY_CRC32C, _crc_raw_bytes
+from s3shuffle_tpu.utils.checksums import crc32c_py
+
+
+@pytest.fixture
+def force_pallas(monkeypatch):
+    monkeypatch.setenv("S3SHUFFLE_TLZ_PALLAS", "1")
+
+
+def _host_payload(data: bytes) -> bytes:
+    native = tlz._encode_block_native(data)
+    if native is not None:
+        return native
+    return tlz._assemble_payload_numpy(data)
+
+
+def _make_block(kind: str, size: int, rng) -> bytes:
+    if kind == "text":
+        return (b"the quick brown fox jumps over the lazy dog " * size)[:size]
+    if kind == "zeros":
+        return bytes(size)
+    if kind == "random":
+        return bytes(rng.integers(0, 256, size, dtype=np.uint8))
+    # mixed: compressible run, then noise, then a repeat of the run
+    run = (b"columnar shuffle row payload " * size)[: size // 3]
+    noise = bytes(rng.integers(0, 256, size - 2 * len(run), dtype=np.uint8))
+    return (run + noise + run)[:size]
+
+
+# ---------------------------------------------------------------------------
+# TLZ encode: Pallas plane kernel byte-identical to the host C encoder
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("block_size", [512, 2048])
+@pytest.mark.parametrize("n_blocks", [1, 3, 4])  # 3 = padded tail bucket
+def test_pallas_encode_byte_identical_to_host(
+    force_pallas, block_size, n_blocks
+):
+    rng = np.random.default_rng(block_size * 31 + n_blocks)
+    kinds = ["text", "random", "zeros", "mixed"]
+    blocks = [
+        _make_block(kinds[i % len(kinds)], block_size, rng)
+        for i in range(n_blocks)
+    ]
+    blob = b"".join(blocks)
+    assert tlz._encode_impl() == "pallas"
+    payloads, _ = tlz.encode_batch_device(
+        blob, n_blocks, block_size, batch_blocks=4
+    )
+    for data, payload in zip(blocks, payloads):
+        assert bytes(payload) == _host_payload(data)
+        assert bytes(tlz.decode_payload_numpy(bytes(payload),
+                                              block_size)) == data
+
+
+def test_pallas_fused_encode_crcs_match_host(force_pallas):
+    """poly= routes through _encode_fused_math with the Pallas plane stage:
+    payloads stay byte-identical AND the fused raw-block CRCs are true."""
+    bs = 1024
+    rng = np.random.default_rng(99)
+    blocks = [_make_block(k, bs, rng) for k in ("text", "mixed")]
+    blob = b"".join(blocks)
+    payloads, crc_info = tlz.encode_batch_device(
+        blob, 2, bs, batch_blocks=2, poly=POLY_CRC32C
+    )
+    assert crc_info is not None
+    block_crcs, _lit_crcs, _lit_lens = crc_info
+    for i, data in enumerate(blocks):
+        assert bytes(payloads[i]) == _host_payload(data)
+        assert int(block_crcs[i]) == crc32c_py(data)
+
+
+# ---------------------------------------------------------------------------
+# Fused decode: Pallas grid reconstruction + in-kernel CRC
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n_blocks", [2, 4])
+def test_pallas_fused_decode_roundtrip_and_crc(force_pallas, n_blocks):
+    bs = 1024
+    rng = np.random.default_rng(n_blocks * 7)
+    kinds = ["text", "mixed", "zeros", "random"]
+    blocks = [_make_block(kinds[i], bs, rng) for i in range(n_blocks)]
+    payloads = [_host_payload(b) for b in blocks]
+    assert tlz._decode_fused_impl() == "pallas"
+    dec, crcs = tlz.decode_batch_device(
+        payloads, [bs] * n_blocks, bs, batch_rows=4, poly=POLY_CRC32C
+    )
+    for i in range(n_blocks):
+        assert bytes(dec[i]) == blocks[i]
+        assert crcs[i] is not None
+        assert int(crcs[i]) == crc32c_py(payloads[i])
+
+
+def test_pallas_fused_decode_matches_xla_formulation(monkeypatch):
+    """The two fused-decode formulations must agree bit-for-bit on decoded
+    bytes AND certificates — the gate may pick either per the rate table."""
+    bs = 1024
+    rng = np.random.default_rng(5)
+    blocks = [_make_block(k, bs, rng) for k in ("mixed", "text")]
+    payloads = [_host_payload(b) for b in blocks]
+    results = {}
+    for impl in ("1", "0"):
+        monkeypatch.setenv("S3SHUFFLE_TLZ_PALLAS", impl)
+        results[impl] = tlz.decode_batch_device(
+            payloads, [bs] * 2, bs, batch_rows=2, poly=POLY_CRC32C
+        )
+    dec_p, crc_p = results["1"]
+    dec_x, crc_x = results["0"]
+    assert [bytes(d) for d in dec_p] == [bytes(d) for d in dec_x]
+    assert [int(c) for c in crc_p] == [int(c) for c in crc_x]
+
+
+# ---------------------------------------------------------------------------
+# CRC32C tiled fold: every length/alignment, incl. right-aligned staging
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("b,length", [(8, 128), (8, 512), (16, 1280),
+                                      (24, 256)])
+def test_pallas_crc_matches_host_remainder(b, length):
+    rng = np.random.default_rng(b * length)
+    data = rng.integers(0, 256, (b, length), dtype=np.uint8)
+    got = crc_pallas.crc_raw_batch(data, POLY_CRC32C, interpret=True)
+    want = [_crc_raw_bytes(bytes(row), POLY_CRC32C, 0) & 0xFFFFFFFF
+            for row in data]
+    assert [int(c) for c in got] == want
+
+
+@pytest.mark.parametrize("tail", [0, 1, 37, 127, 128, 300])
+def test_pallas_crc_right_aligned_rows(tail):
+    """The literal-plane form: rows are right-aligned with zero front
+    padding, which must be a fixed point of the fold (zero-init raw
+    remainder of zeros is zero) — the remainder equals the suffix's."""
+    length = 512
+    rng = np.random.default_rng(tail)
+    rows = np.zeros((8, length), dtype=np.uint8)
+    for i in range(8):
+        n = min(length, tail + i)
+        if n:
+            rows[i, length - n:] = rng.integers(0, 256, n, dtype=np.uint8)
+    got = crc_pallas.crc_raw_batch(rows, POLY_CRC32C, interpret=True)
+    want = [
+        _crc_raw_bytes(bytes(row[length - min(length, tail + i):]),
+                       POLY_CRC32C, 0) & 0xFFFFFFFF
+        for i, row in enumerate(rows)
+    ]
+    assert [int(c) for c in got] == want
+
+
+def test_pallas_crc_rejects_untileable_shapes():
+    assert not crc_pallas.supported(7, 128)   # rows not 8-tileable
+    assert not crc_pallas.supported(8, 100)   # length not 128-tileable
+    assert not crc_pallas.supported(0, 128)
+    with pytest.raises(ValueError):
+        crc_pallas.crc_raw_batch(
+            np.zeros((7, 128), np.uint8), POLY_CRC32C, interpret=True
+        )
+
+
+# ---------------------------------------------------------------------------
+# GF(2^8) parity kernel vs the numpy table encoder, with recovery
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("m,k", [(1, 2), (1, 16), (2, 4), (2, 8), (3, 5),
+                                 (4, 16), (8, 64)])
+def test_pallas_gf_matches_numpy(m, k):
+    from s3shuffle_tpu.coding import gf, gf_pallas
+
+    assert gf_pallas.supported(m, k)
+    rng = np.random.default_rng(m * 100 + k)
+    chunks = rng.integers(0, 256, (3, k, 100), dtype=np.uint8)  # odd G and L
+    coefs = gf.parity_coefficients(m, k)
+    got = gf_pallas.encode_groups_pallas(chunks, coefs, interpret=True)
+    assert got.shape == (3, m, 100)
+    assert np.array_equal(got, gf._encode_host(chunks, coefs))
+
+
+def test_pallas_gf_parity_recovers_erased_chunks():
+    from s3shuffle_tpu.coding import gf, gf_pallas
+
+    k, m, L = 4, 2, 256
+    rng = np.random.default_rng(42)
+    chunks = rng.integers(0, 256, (1, k, L), dtype=np.uint8)
+    coefs = gf.parity_coefficients(m, k)
+    parity = gf_pallas.encode_groups_pallas(chunks, coefs, interpret=True)
+    recovered = gf.recover_group(
+        k, coefs,
+        {0: chunks[0, 0], 2: chunks[0, 2]},
+        {0: parity[0, 0], 1: parity[0, 1]},
+        [1, 3],
+    )
+    assert recovered is not None
+    assert np.array_equal(recovered[1], chunks[0, 1])
+    assert np.array_equal(recovered[3], chunks[0, 3])
+
+
+# ---------------------------------------------------------------------------
+# Mid-kernel failure: host-side fallback without frame loss
+# ---------------------------------------------------------------------------
+
+
+def test_encode_kernel_failure_falls_back_without_frame_loss(
+    force_pallas, monkeypatch
+):
+    bs = 1024
+    rng = np.random.default_rng(1)
+    blocks = [_make_block(k, bs, rng) for k in ("text", "random")]
+    codec = TpuCodec(block_size=bs, batch_blocks=4, use_device=True)
+
+    def broken_kernel(*a, **kw):
+        def boom(*aa, **kk):
+            raise RuntimeError("mosaic lowering failed mid-kernel")
+
+        return boom
+
+    monkeypatch.setattr(tpu_mod.tlz, "_batch_kernel", broken_kernel)
+    payloads, crcs = codec._encode_full_blocks(
+        memoryview(b"".join(blocks)), 2, bs, None
+    )
+    assert crcs is None
+    assert [bytes(p) for p in payloads] == [_host_payload(b) for b in blocks]
+    for data, payload in zip(blocks, payloads):
+        assert bytes(tlz.decode_payload_numpy(bytes(payload), bs)) == data
+
+
+def test_decode_kernel_failure_falls_back_without_frame_loss(
+    force_pallas, monkeypatch
+):
+    bs = 1024
+    rng = np.random.default_rng(2)
+    blocks = [_make_block(k, bs, rng) for k in ("mixed", "zeros")]
+    payloads = [_host_payload(b) for b in blocks]
+    codec = TpuCodec(block_size=bs, batch_blocks=4, use_device=True)
+
+    def broken_kernel(*a, **kw):
+        def boom(*aa, **kk):
+            raise RuntimeError("mosaic lowering failed mid-kernel")
+
+        return boom
+
+    monkeypatch.setattr(tpu_mod.tlz, "_decode_batch_kernel", broken_kernel)
+    out, crcs = codec._decode_full_blocks(
+        [(p, bs) for p in payloads], POLY_CRC32C
+    )
+    assert [bytes(o) for o in out] == blocks  # every frame recovered
+    assert crcs == [None, None]  # caller certifies those from its own bytes
+
+
+# ---------------------------------------------------------------------------
+# tlz_pallas plane stage: direct identity against the XLA math
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kind", ["text", "random", "zeros", "mixed"])
+def test_plane_kernel_identical_to_xla_math(kind):
+    import jax
+
+    bs = 512
+    n_groups = bs // tlz.GROUP
+    rng = np.random.default_rng(hash(kind) % 2**32)
+    batch = np.stack([
+        np.frombuffer(_make_block(kind, bs, rng), dtype=np.uint8)
+        for _ in range(2)
+    ])
+    dev = jax.device_put(batch)
+    got = tlz_pallas.encode_math_fn(n_groups)(dev)
+    want = tlz._encode_math(dev, n_groups)
+    assert len(got) == len(want) == 9
+    for g, w in zip(got, want):
+        assert np.array_equal(np.asarray(g), np.asarray(w))

@@ -144,6 +144,116 @@ def main() -> int:
          tlz_dev_decode_fused_mb_s=round(len(blob) / 1e6 / max(dt, 1e-9), 2),
          fused_crc_matches_host=bool(dec_fused_ok),
          roundtrip_ok=bool(b"".join(dec_blocks) == blob))
+
+    # hand-written Pallas kernels (ops/tlz_pallas.py, ops/crc_pallas.py,
+    # coding/gf_pallas.py): each step individually guarded, so a Mosaic
+    # lowering this jaxlib lacks logs its error as evidence instead of
+    # killing the remaining steps — the measured-rate gate (ops/rates.py)
+    # only ever selects a kernel whose rate actually landed in the cache.
+    interp = backend != "tpu"
+    pbatch = np.tile(raw, 8).reshape(8, bs)
+    dev_p = jax.device_put(pbatch)
+    n_groups = bs // tlz.GROUP
+    try:
+        from s3shuffle_tpu.ops import tlz_pallas
+
+        enc_fn = tlz_pallas.encode_math_fn(n_groups)
+        enc_pallas = jax.jit(lambda d: enc_fn(d)[6:9])
+        t0 = time.time()
+        jax.tree_util.tree_map(
+            lambda x: x.block_until_ready(), enc_pallas(dev_p))
+        emit(step="tlz_encode_pallas_compile_and_run",
+             wall_s=round(time.time() - t0, 1))
+        t0 = time.time()
+        jax.tree_util.tree_map(
+            lambda x: x.block_until_ready(), enc_pallas(dev_p))
+        dt = time.time() - t0
+        emit(step="tlz_encode_pallas_warm", wall_s=round(dt, 3),
+             tpu_tlz_encode_pallas_mb_s=round(
+                 pbatch.nbytes / 1e6 / max(dt, 1e-9), 2))
+    except Exception as e:
+        emit(step="tlz_encode_pallas_error", error=str(e)[:200])
+
+    try:
+        from s3shuffle_tpu.ops import crc_pallas
+
+        tables = crc_pallas._device_tables(POLY_CRC32C)
+        crc_fn = jax.jit(
+            lambda d: crc_pallas.crc_raw_in_graph(d, tables, interp))
+        t0 = time.time()
+        crc_fn(dev_p).block_until_ready()
+        emit(step="crc32c_pallas_compile_and_run",
+             wall_s=round(time.time() - t0, 1))
+        t0 = time.time()
+        raws = crc_fn(dev_p)
+        raws.block_until_ready()
+        dt = time.time() - t0
+        host_raws = [_crc_raw_bytes(bytes(r), POLY_CRC32C, 0) & 0xFFFFFFFF
+                     for r in pbatch]
+        emit(step="crc32c_pallas_warm", wall_s=round(dt, 3),
+             tpu_crc32c_pallas_mb_s=round(
+                 pbatch.nbytes / 1e6 / max(dt, 1e-9), 2),
+             device_matches_host_crc=bool(
+                 [int(c) for c in raws] == host_raws))
+    except Exception as e:
+        emit(step="crc32c_pallas_error", error=str(e)[:200])
+
+    try:
+        from s3shuffle_tpu.ops import tlz_pallas
+
+        enc = tlz._encode_kernel(n_groups)(dev_p)
+        bitmap, cont, split, offs, ks, lits, n_new, n_split, n_match = (
+            np.asarray(x) for x in enc)
+        unpack = lambda a: np.unpackbits(  # noqa: E731
+            a, axis=1, count=n_groups, bitorder="little").astype(bool)
+        dm, dc, ds = (jax.device_put(unpack(a))
+                      for a in (bitmap, cont, split))
+        do = jax.device_put(offs.astype(np.int32))
+        dk = jax.device_put(ks.astype(np.int32))
+        dl = jax.device_put(lits)
+        dnl = jax.device_put(
+            (n_groups - n_match.astype(np.int64)
+             - n_split.astype(np.int64)).astype(np.int32))
+        dec_fn = jax.jit(tlz_pallas.decode_fused_math_fn(
+            n_groups, POLY_CRC32C))
+        t0 = time.time()
+        jax.tree_util.tree_map(lambda x: x.block_until_ready(),
+                               dec_fn(dm, dc, ds, do, dk, dl, dnl))
+        emit(step="tlz_decode_fused_pallas_compile_and_run",
+             wall_s=round(time.time() - t0, 1))
+        t0 = time.time()
+        dec, _raws = dec_fn(dm, dc, ds, do, dk, dl, dnl)
+        dec.block_until_ready()
+        dt = time.time() - t0
+        emit(step="tlz_decode_fused_pallas_warm", wall_s=round(dt, 3),
+             tpu_tlz_decode_fused_pallas_mb_s=round(
+                 pbatch.nbytes / 1e6 / max(dt, 1e-9), 2),
+             roundtrip_ok=bool(np.array_equal(np.asarray(dec), pbatch)))
+    except Exception as e:
+        emit(step="tlz_decode_fused_pallas_error", error=str(e)[:200])
+
+    try:
+        from s3shuffle_tpu.coding import gf, gf_pallas
+
+        gk, gm = 8, 2
+        gl = bs // 8  # 16 KiB stripes, %128 == 0
+        chunks = pbatch.reshape(-1, gk, gl)
+        coefs = gf.parity_coefficients(gm, gk)
+        t0 = time.time()
+        par = gf_pallas.encode_groups_pallas(chunks, coefs, interpret=interp)
+        emit(step="gf_encode_pallas_compile_and_run",
+             wall_s=round(time.time() - t0, 1))
+        t0 = time.time()
+        par = gf_pallas.encode_groups_pallas(chunks, coefs, interpret=interp)
+        dt = time.time() - t0
+        emit(step="gf_encode_pallas_warm", wall_s=round(dt, 3),
+             tpu_gf_encode_mb_s=round(
+                 chunks.nbytes / 1e6 / max(dt, 1e-9), 2),
+             device_matches_host_gf=bool(
+                 np.array_equal(par, gf._encode_host(chunks, coefs))))
+    except Exception as e:
+        emit(step="gf_encode_pallas_error", error=str(e)[:200])
+
     emit(step="done")
     return 0
 
