@@ -238,8 +238,11 @@ class TpuCodec(FrameCodec):
                 and self._host_pinned_at is not None
                 and self._clock() - self._host_pinned_at >= self._repin_probe_s
             ):
+                # shuffle-lint: disable=THR02 reason=pin/reprobe scalars are deliberately lock-free GIL-atomic writes; racing encoders converge (worst case one extra trial batch) and a lock here sits on the per-batch hot path
                 self._reprobing = True
+                # shuffle-lint: disable=THR02 reason=same lock-free pin state machine as _reprobing above
                 self._host_pinned_at = None
+                # shuffle-lint: disable=THR02 reason=same lock-free pin state machine as _reprobing above
                 self._use_device = self._explicit_device
                 if self._use_device is not None:
                     return self._use_device
@@ -257,6 +260,7 @@ class TpuCodec(FrameCodec):
         self._use_device = False
         self._reprobing = False
         self._device_failures = 0
+        # shuffle-lint: disable=THR02 reason=failure counters are best-effort lock-free tallies; a lost increment only delays the host pin by one failed batch
         self._decode_failures = 0
         self._host_pinned_at = (
             self._clock() if self._repin_probe_s > 0 else None

@@ -33,6 +33,7 @@ import numpy as np
 
 from s3shuffle_tpu.metadata.map_output import MapOutputTracker, MapStatus
 from s3shuffle_tpu.metrics import registry as _metrics
+from s3shuffle_tpu.utils import racewitness
 from s3shuffle_tpu.utils import trace as _trace
 
 logger = logging.getLogger("s3shuffle_tpu.metadata.service")
@@ -172,6 +173,9 @@ class WorkerMembership:
         self._lock = threading.Lock()
         self._workers: dict = {}  # worker_id -> {state, joined_at, last_seen}
         self._events: List[dict] = []
+        # Race witness (no-op off): every RPC handler thread reads/mutates
+        # the membership table and event ring — all of it under self._lock.
+        racewitness.watch_shared(self, ("_workers", "_events"))
 
     def _prune_departed(self) -> None:
         """Under the lock: drop oldest departed entries beyond the cap."""
@@ -682,6 +686,9 @@ class TraceShardStore:
         self._spans: List[dict] = []
         self._bytes = 0
         self.bytes_max = int(bytes_max)
+        # Race witness (no-op off): worker report threads and the driver's
+        # drain share the span ring and its byte accounting.
+        racewitness.watch_shared(self, ("_spans", "_bytes"))
 
     def report(self, spans: List[dict]) -> int:
         """Accept one shard (a list of span event dicts). Returns the count

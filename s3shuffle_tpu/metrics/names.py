@@ -140,4 +140,9 @@ KNOWN_METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "flight_dumps_total": ("counter", ("reason",)),
     "fleet_snapshot_age_seconds": ("gauge", ("worker",)),
     "cost_dollars_total": ("counter", ("op_class",)),
+    # --- concurrency verification plane: race witness + schedule explorer
+    # (utils/racewitness.py, utils/sched.py) ---
+    "race_witness_checks_total": ("counter", ()),
+    "race_witness_reports_total": ("counter", ()),
+    "sched_schedules_explored_total": ("counter", ()),
 }

@@ -34,6 +34,7 @@ from typing import Iterator, List, Optional, Tuple
 from s3shuffle_tpu.metrics import registry as _metrics
 from s3shuffle_tpu.read.block_stream import BlockStream
 from s3shuffle_tpu.tuning.controller import Controller
+from s3shuffle_tpu.utils import racewitness
 from s3shuffle_tpu.utils.io import read_up_to as _read_up_to
 
 logger = logging.getLogger("s3shuffle_tpu.read")
@@ -232,6 +233,14 @@ class BufferedPrefetchIterator:
         # visible in soak runs instead of silently adding latency.
         self._backstop_warn_interval_s = 30.0
         self._last_backstop_warn = -float("inf")
+        # Race witness (no-op unless S3SHUFFLE_RACE_WITNESS=1): the budget
+        # counters and the completion stack are the prefetcher's shared
+        # state — every access must be ordered by self._lock (the PR-15
+        # double-reserve lived exactly here). Watch BEFORE the fill threads
+        # spawn so their accesses are ordered after construction.
+        racewitness.watch_shared(
+            self, ("_buffers_in_flight", "_active_fetches", "_completed")
+        )
         self._configure_threads()
 
     def _warn_backstop(self, which: str, detail: str) -> None:

@@ -62,6 +62,7 @@ from s3shuffle_tpu.read.block_iterator import (
 from s3shuffle_tpu.read.block_stream import BlockStream
 from s3shuffle_tpu.read.prefetch import BufferedPrefetchIterator, PrefetchedBlockStream
 from s3shuffle_tpu.storage.dispatcher import Dispatcher
+from s3shuffle_tpu.utils import racewitness
 
 logger = logging.getLogger("s3shuffle_tpu.read")
 
@@ -141,6 +142,11 @@ class SplitGroup:
         self.reserved = False
         self.reserved_bytes = 0
         self.closed = 0
+        # Race witness (no-op off): the claim/piggyback/release protocol on
+        # these three fields must run entirely under the prefetcher's
+        # condition lock (the PR-15 double-reserve was a claim decided on a
+        # stale read of ``reserved``).
+        racewitness.watch_shared(self, ("reserved", "reserved_bytes", "closed"))
 
     @property
     def total(self) -> int:

@@ -37,6 +37,26 @@ session on cycles. Programmatic use::
         ... run a workload ...
     assert w.find_cycles() == []
 
+``threading.Event`` and ``threading.Barrier`` are interposed too (package
+allocation sites only, like locks). They are not mutual-exclusion devices,
+so they add no *hold* edges — but they ARE ordering devices, and ignoring
+them made two classes of bug invisible:
+
+- lock-order: a thread that calls ``event.wait()`` while holding witnessed
+  locks records ``held-site -> event-site`` edges, and the ``set()`` side
+  records ``event-site -> held-site`` edges for the locks the setter holds
+  at ``set()`` time. The classic lost-wakeup deadlock — A holds L and waits
+  on E, B needs L before it can ever ``set(E)`` — then shows up as the
+  cycle ``L -> E -> L``. Barrier waits record the wait-side edges only
+  (``held-site -> barrier-site``): holding a witnessed lock across a
+  barrier is the hazard worth seeing; the set-side direction has no
+  single "releasing" thread to blame.
+- happens-before: ``set -> wait`` and barrier entry -> barrier exit are
+  synchronization edges. The sync-listener interface below forwards them
+  (plus every witnessed lock acquire/release) to an optional listener —
+  :mod:`s3shuffle_tpu.utils.racewitness` plugs in here to build its vector
+  clocks, so an Event-guarded handoff is ordering, not a data race.
+
 Overhead when not installed: zero (nothing is patched).
 """
 
@@ -50,6 +70,22 @@ from typing import Dict, List, Optional, Set, Tuple
 
 #: the raw primitive, captured before any patching can occur
 _allocate_lock = _thread.allocate_lock
+
+#: optional sync-event listener (duck-typed): ``on_acquire(obj)`` after a
+#: witnessed primitive establishes an ordering INTO the calling thread
+#: (lock acquired, Event.wait satisfied, Barrier.wait passed) and
+#: ``on_release(obj)`` just BEFORE it publishes an ordering OUT of the
+#: calling thread (lock about to be released, Event.set, Barrier.wait
+#: entered). racewitness installs itself here; None costs one global read.
+_sync_listener = None
+
+
+def set_sync_listener(listener) -> None:
+    """Register/clear (``None``) the happens-before listener. At most one —
+    the race witness owns the slot; the cooperative scheduler patches the
+    factories wholesale instead of listening."""
+    global _sync_listener
+    _sync_listener = listener
 
 _THIS_FILE = os.path.abspath(__file__)
 _PKG_ROOT = os.path.dirname(os.path.dirname(_THIS_FILE))
@@ -97,6 +133,31 @@ class LockWitness:
             if stack[i][0] is lock:
                 del stack[i]
                 return
+
+    def on_ordered(self, from_site: str, to_site: str) -> None:
+        """Record a directed ordering edge between two sites that is NOT a
+        hold-while-acquiring pair (Event/Barrier rendezvous edges)."""
+        if from_site == to_site:
+            return
+        tname = threading.current_thread().name
+        with self._mu:
+            self._edges.setdefault(from_site, set()).add(to_site)
+            self._examples.setdefault((from_site, to_site), tname)
+
+    def on_wait_point(self, site: str) -> None:
+        """The calling thread blocks at rendezvous ``site`` while holding
+        witnessed locks: record ``held -> site`` for each."""
+        for _obj, held_site in self._holder.stack:
+            self.on_ordered(held_site, site)
+
+    def on_signal_point(self, site: str) -> None:
+        """The rendezvous at ``site`` completes only after the signalling
+        thread — which currently holds these locks — makes progress:
+        record ``site -> held``. With the wait-side edges this closes the
+        lost-wakeup cycle ``L -> E -> L`` (A holds L waiting on E; B needs
+        L before it can set E)."""
+        for _obj, held_site in self._holder.stack:
+            self.on_ordered(site, held_site)
 
     def on_released_all(self, lock: object) -> int:
         """Condition.wait released the lock completely (every reentry).
@@ -172,9 +233,17 @@ class _WitnessedLock:
         ok = self._inner.acquire(blocking, timeout)
         if ok:
             self._witness.on_acquired(self, self._site)
+            listener = _sync_listener
+            if listener is not None:
+                listener.on_acquire(self)
         return ok
 
     def release(self) -> None:
+        # publish BEFORE dropping the inner lock: a racing acquirer must
+        # observe the releasing thread's full clock, not a stale one
+        listener = _sync_listener
+        if listener is not None:
+            listener.on_release(self)
         self._inner.release()
         self._witness.on_released(self)
 
@@ -202,6 +271,9 @@ class _WitnessedRLock(_WitnessedLock):
         return locked() if callable(locked) else self._inner._is_owned()
 
     def _release_save(self):
+        listener = _sync_listener
+        if listener is not None:
+            listener.on_release(self)
         state = self._inner._release_save()
         removed = self._witness.on_released_all(self)
         return (state, removed)
@@ -214,9 +286,96 @@ class _WitnessedRLock(_WitnessedLock):
         # rest are reentries
         for _ in range(max(1, removed)):
             self._witness.on_acquired(self, self._site)
+        listener = _sync_listener
+        if listener is not None:
+            listener.on_acquire(self)
 
     def _is_owned(self) -> bool:
         return self._inner._is_owned()
+
+
+class _WitnessedEvent:
+    """``threading.Event`` wrapper: ``set -> wait`` is an ordering edge.
+
+    Order-graph model (see module docstring): ``wait`` records
+    ``held -> event-site``; ``set`` records ``event-site -> held``.
+    Happens-before: ``set`` publishes to the listener, a satisfied ``wait``
+    joins — an Event-guarded handoff is synchronization, not a race."""
+
+    def __init__(self, witness: LockWitness, inner, site: str):
+        self._witness = witness
+        self._inner = inner
+        self._site = site
+
+    def is_set(self) -> bool:
+        return self._inner.is_set()
+
+    def set(self) -> None:
+        listener = _sync_listener
+        if listener is not None:
+            listener.on_release(self)
+        self._witness.on_signal_point(self._site)
+        self._inner.set()
+
+    def clear(self) -> None:
+        self._inner.clear()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        self._witness.on_wait_point(self._site)
+        ok = self._inner.wait(timeout)
+        if ok:
+            listener = _sync_listener
+            if listener is not None:
+                listener.on_acquire(self)
+        return ok
+
+    def __repr__(self) -> str:
+        return f"<witnessed {self._inner!r} from {self._site}>"
+
+
+class _WitnessedBarrier:
+    """``threading.Barrier`` wrapper: the trip is an all-to-all ordering
+    edge. Each party publishes its clock on entry and joins the barrier's
+    merged clock on exit; the order graph gets the wait-side
+    ``held -> barrier-site`` hazard edges (holding a witnessed lock across
+    a barrier wait is the deadlock shape worth surfacing)."""
+
+    def __init__(self, witness: LockWitness, inner, site: str):
+        self._witness = witness
+        self._inner = inner
+        self._site = site
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        listener = _sync_listener
+        if listener is not None:
+            listener.on_release(self)
+        self._witness.on_wait_point(self._site)
+        idx = self._inner.wait(timeout)
+        listener = _sync_listener
+        if listener is not None:
+            listener.on_acquire(self)
+        return idx
+
+    def reset(self) -> None:
+        self._inner.reset()
+
+    def abort(self) -> None:
+        self._inner.abort()
+
+    @property
+    def parties(self) -> int:
+        return self._inner.parties
+
+    @property
+    def n_waiting(self) -> int:
+        return self._inner.n_waiting
+
+    @property
+    def broken(self) -> bool:
+        return self._inner.broken
+
+    def __repr__(self) -> str:
+        return f"<witnessed {self._inner!r} from {self._site}>"
 
 
 # ---------------------------------------------------------------------------
@@ -233,6 +392,8 @@ class _Install:
         self.orig_lock = threading.Lock
         self.orig_rlock = threading.RLock
         self.orig_condition = threading.Condition
+        self.orig_event = threading.Event
+        self.orig_barrier = threading.Barrier
 
 
 def _caller_site(depth: int = 2) -> Optional[str]:
@@ -284,6 +445,23 @@ def _make_condition(lock=None):
     return orig_condition(lock)
 
 
+def _make_event():
+    site = _caller_site()
+    inner = _installed.orig_event() if _installed else threading.Event()
+    if site is None or _installed is None:
+        return inner
+    return _WitnessedEvent(_installed.witness, inner, site)
+
+
+def _make_barrier(parties, action=None, timeout=None):
+    orig_barrier = _installed.orig_barrier if _installed else threading.Barrier
+    site = _caller_site()
+    inner = orig_barrier(parties, action, timeout)
+    if site is None or _installed is None:
+        return inner
+    return _WitnessedBarrier(_installed.witness, inner, site)
+
+
 def install(extra_paths: Tuple[str, ...] = ()) -> LockWitness:
     """Patch ``threading.{Lock,RLock,Condition}`` with witnessed factories.
     Locks constructed by code under ``s3shuffle_tpu`` (plus ``extra_paths``)
@@ -304,6 +482,8 @@ def install(extra_paths: Tuple[str, ...] = ()) -> LockWitness:
     threading.Lock = _make_lock  # type: ignore[assignment]
     threading.RLock = _make_rlock  # type: ignore[assignment]
     threading.Condition = _make_condition  # type: ignore[assignment]
+    threading.Event = _make_event  # type: ignore[assignment]
+    threading.Barrier = _make_barrier  # type: ignore[assignment]
     return _installed.witness
 
 
@@ -314,6 +494,8 @@ def uninstall() -> None:
     threading.Lock = _installed.orig_lock  # type: ignore[assignment]
     threading.RLock = _installed.orig_rlock  # type: ignore[assignment]
     threading.Condition = _installed.orig_condition  # type: ignore[assignment]
+    threading.Event = _installed.orig_event  # type: ignore[assignment]
+    threading.Barrier = _installed.orig_barrier  # type: ignore[assignment]
     _installed = None
 
 

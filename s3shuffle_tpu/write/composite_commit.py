@@ -48,6 +48,7 @@ from s3shuffle_tpu.metadata.fat_index import FatIndex, FatIndexMember
 from s3shuffle_tpu.metadata.helper import ShuffleHelper
 from s3shuffle_tpu.metrics import registry as _metrics
 from s3shuffle_tpu.storage.dispatcher import Dispatcher
+from s3shuffle_tpu.utils import racewitness
 from s3shuffle_tpu.write.measure import MeasuredOutputStream
 
 logger = logging.getLogger("s3shuffle_tpu.write")
@@ -118,6 +119,11 @@ class _OpenGroup:
         #: sealing/teardown: appenders that lose the race re-check this and
         #: open a fresh group instead of writing into a sealed stream
         self.detached = False
+        # Race witness (no-op off): the member list and detach flag are the
+        # state the seal-visibility barrier (PR 10) protects — an appender
+        # and a sealer touching them without a happens-before edge is
+        # exactly the record-loss race.
+        racewitness.watch_shared(self, ("members", "detached"))
 
 
 class CompositeCommitAggregator:
@@ -169,6 +175,9 @@ class CompositeCommitAggregator:
         # ROADMAP). Barrier flushes now wait for the counter to drain.
         self._seal_cv = threading.Condition()
         self._sealing: Dict[int, int] = {}
+        # Race witness (no-op off): the open-group registry and in-flight
+        # seal table are the aggregator's cross-thread state.
+        racewitness.watch_shared(self, ("_groups", "_sealing"))
 
     @property
     def enabled(self) -> bool:

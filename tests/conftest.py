@@ -49,6 +49,24 @@ _spec.loader.exec_module(lockwitness)
 
 _WITNESS = lockwitness.install_from_env()
 
+# Happens-before race witness (S3SHUFFLE_RACE_WITNESS=1): same early-load
+# constraint and same spec-loading idiom — it layers on lockwitness's
+# interposition (racewitness.install() installs the lock witness itself if
+# the env didn't), so it too must be in place before product imports.
+_RW_NAME = "s3shuffle_tpu.utils.racewitness"
+_rw_spec = _ilu.spec_from_file_location(
+    _RW_NAME,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "s3shuffle_tpu", "utils", "racewitness.py",
+    ),
+)
+racewitness = _ilu.module_from_spec(_rw_spec)
+_sys.modules[_RW_NAME] = racewitness
+_rw_spec.loader.exec_module(racewitness)
+
+_RACE_WITNESS = racewitness.install_from_env()
+
 from s3shuffle_tpu.storage.dispatcher import Dispatcher  # noqa: E402
 
 # Mode matrix (the analog of the reference CI's second run with
@@ -116,6 +134,19 @@ def _lock_witness_verdict():
         report = _WITNESS.format_report()
         print("\n" + report)
         assert not _WITNESS.find_cycles(), report
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _race_witness_verdict():
+    """With S3SHUFFLE_RACE_WITNESS=1: fail the session if the happens-before
+    witness saw an unsynchronized access pair on any watched structure, and
+    fold its tallies into race_witness_{checks,reports}_total."""
+    yield
+    if _RACE_WITNESS is not None:
+        report = _RACE_WITNESS.format_report()
+        print("\n" + report)
+        racewitness.publish_metrics(_RACE_WITNESS)
+        assert not _RACE_WITNESS.reports, report
 
 
 # Product import is safe here: the lock witness installed above, at module

@@ -1,0 +1,701 @@
+"""Concurrency verification plane (ISSUE 19): happens-before race witness
+(utils/racewitness.py) + deterministic schedule explorer (utils/sched.py).
+
+Layered like the plane:
+
+- **witness unit suite** — vector-clock basics: fork/join edges from
+  Thread start/join, lock acquire/release edges, ``Event.set -> wait`` and
+  ``Barrier`` trip edges (the lockwitness sync-listener protocol), queue
+  ``put -> get`` edges, and the ``quarantine`` helper that keeps
+  deliberately-racy tests from poisoning a session-level witness verdict;
+- **explorer unit suite** — deterministic catch + token replay of the
+  demo scenarios, deadlock detection, witness⊗scheduler composition
+  (cooperative primitives emit the same clock edges real ones do), and
+  the ``--selftest`` CLI wired into tier-1;
+- **teeth (fail-pre-fix)** — reverting the PR-10 seal barrier
+  (``CompositeCommitAggregator._await_seals``) and the PR-15 group-budget
+  re-check deterministically trips BOTH detectors, while the unmodified
+  protocols stay clean across >=200 seeded schedules each. The two
+  detectors are complementary on purpose: the witness flags the PR-10
+  revert as a missing happens-before edge (no physical race needed —
+  vector clocks don't care about timing), while the PR-15 double-reserve
+  is an ATOMICITY violation whose accesses are all lock-ordered — clean
+  to the witness's HB view, caught by the explorer driving the lost-wakeup
+  interleaving and asserting the budget invariant.
+"""
+
+import subprocess
+import sys
+import threading
+import _thread
+
+import pytest
+
+from s3shuffle_tpu.block_ids import ShuffleBlockId
+from s3shuffle_tpu.utils import racewitness, sched
+from s3shuffle_tpu.utils.sched import SchedDeadlock
+
+REPO_ROOT = __file__.rsplit("/tests/", 1)[0]
+
+
+def _gate():
+    """A raw, witness-INVISIBLE rendezvous: sequences physical execution
+    without creating a happens-before edge (``_thread`` locks are below
+    the interposition layer), so tests can stage accesses deterministically
+    and still exercise the clocks' verdict."""
+    g = _thread.allocate_lock()
+    g.acquire()
+    return g
+
+
+# ---------------------------------------------------------------------------
+# Race witness: vector-clock unit suite
+# ---------------------------------------------------------------------------
+
+
+def test_witness_flags_unordered_sibling_writes():
+    """Two spawned threads write the same watched field with no sync edge
+    between them: flagged deterministically — the accesses are sequenced
+    in real time (raw gate), but the clocks have no path between the
+    siblings, which is exactly the definition of the race."""
+
+    class Box:
+        pass
+
+    with racewitness.quarantine() as q:
+        box = Box()
+        box.x = 0
+        box = racewitness.watch_shared(box, ("x",))
+        done = _gate()
+
+        def first():
+            box.x = 1
+            done.release()
+
+        def second():
+            done.acquire()
+            box.x = 2
+
+        t1 = threading.Thread(target=first)
+        t2 = threading.Thread(target=second)
+        t1.start(), t2.start()
+        t1.join(), t2.join()
+        reports = q.new_reports()
+        assert reports, "sibling writes with no HB edge must be flagged"
+        assert any("x" in r for r in reports)
+
+
+def test_witness_lock_protected_accesses_clean():
+    class Box:
+        pass
+
+    with racewitness.quarantine() as q:
+        lock = threading.Lock()
+        box = Box()
+        box.x = 0
+        box = racewitness.watch_shared(box, ("x",))
+
+        def bump():
+            with lock:
+                box.x += 1
+
+        threads = [threading.Thread(target=bump) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert box.x == 4
+        assert not q.new_reports(), "\n".join(q.new_reports())
+
+
+def test_witness_event_set_wait_edge():
+    """``Event.set -> wait`` is a synchronization edge (the lockwitness
+    sync-listener protocol): a flag-guarded handoff is ordered, the same
+    handoff over a witness-invisible gate is a race."""
+
+    class Box:
+        pass
+
+    with racewitness.quarantine() as q:
+        evt = threading.Event()
+        box = Box()
+        box.x = 0
+        box = racewitness.watch_shared(box, ("x",))
+
+        def producer():
+            box.x = 41
+            evt.set()
+
+        def consumer():
+            assert evt.wait(10)
+            box.x += 1
+
+        t1 = threading.Thread(target=producer)
+        t2 = threading.Thread(target=consumer)
+        t1.start(), t2.start()
+        t1.join(), t2.join()
+        assert box.x == 42
+        assert not q.new_reports(), "\n".join(q.new_reports())
+
+        # same handoff, gate instead of Event: no edge, flagged
+        box2 = Box()
+        box2.x = 0
+        box2 = racewitness.watch_shared(box2, ("x",))
+        handoff = _gate()
+
+        def producer_raw():
+            box2.x = 41
+            handoff.release()
+
+        def consumer_raw():
+            handoff.acquire()
+            box2.x += 1
+
+        t3 = threading.Thread(target=producer_raw)
+        t4 = threading.Thread(target=consumer_raw)
+        t3.start(), t4.start()
+        t3.join(), t4.join()
+        assert q.new_reports(), "gate handoff must NOT count as an HB edge"
+
+
+def test_witness_barrier_trip_orders_all_parties():
+    """A Barrier trip is an all-to-all ordering edge: each party's
+    pre-barrier writes are visible (ordered) to every party's post-barrier
+    reads."""
+
+    class Box:
+        pass
+
+    with racewitness.quarantine() as q:
+        barrier = threading.Barrier(2)
+        box = Box()
+        box.a = 0
+        box.b = 0
+        box = racewitness.watch_shared(box, ("a", "b"))
+        seen = []
+
+        def left():
+            box.a = 1
+            barrier.wait(10)
+            seen.append(box.b)
+
+        def right():
+            box.b = 2
+            barrier.wait(10)
+            seen.append(box.a)
+
+        t1 = threading.Thread(target=left)
+        t2 = threading.Thread(target=right)
+        t1.start(), t2.start()
+        t1.join(), t2.join()
+        assert sorted(seen) == [1, 2]
+        assert not q.new_reports(), "\n".join(q.new_reports())
+
+
+def test_witness_queue_put_get_edge():
+    import queue
+
+    class Box:
+        pass
+
+    with racewitness.quarantine() as q:
+        ch = queue.Queue()
+        box = Box()
+        box.x = 0
+        box = racewitness.watch_shared(box, ("x",))
+
+        def producer():
+            box.x = 7
+            ch.put("ready")
+
+        def consumer():
+            assert ch.get(timeout=10) == "ready"
+            box.x += 1
+
+        t1 = threading.Thread(target=producer)
+        t2 = threading.Thread(target=consumer)
+        t1.start(), t2.start()
+        t1.join(), t2.join()
+        assert box.x == 8
+        assert not q.new_reports(), "\n".join(q.new_reports())
+
+
+def test_quarantine_restores_session_witness_verdict():
+    """Reports provoked inside a quarantine block never leak into the
+    surrounding witness's verdict (the soak fixture's assert_clean)."""
+    preinstalled = racewitness.active_witness() is not None
+    with racewitness.watching() as outer:
+        base_reports = list(outer.reports)
+        base_checks = outer.checks
+        with racewitness.quarantine() as q:
+            assert q.witness is outer  # same session witness, snapshotted
+
+            class Box:
+                pass
+
+            box = Box()
+            box.x = 0
+            box = racewitness.watch_shared(box, ("x",))
+            done = _gate()
+
+            def first():
+                box.x = 1
+                done.release()
+
+            def second():
+                done.acquire()
+                box.x = 2
+
+            t1 = threading.Thread(target=first)
+            t2 = threading.Thread(target=second)
+            t1.start(), t2.start()
+            t1.join(), t2.join()
+            assert q.new_reports(), "quarantined race must still be visible"
+        assert outer.reports == base_reports
+        assert outer.checks == base_checks
+        outer_obj = outer
+    if not preinstalled:
+        assert racewitness.active_witness() is None
+    del outer_obj
+
+
+# ---------------------------------------------------------------------------
+# Schedule explorer: deterministic catch, replay, deadlock detection
+# ---------------------------------------------------------------------------
+
+
+def test_explorer_catches_lost_update_and_replays():
+    from tools.schedule_explore import scenario_lost_update
+
+    result = sched.explore(scenario_lost_update, schedules=200, seed=11)
+    assert result.failed, "lost update must be caught within 200 schedules"
+    assert result.token and result.token.startswith("s3sched:1:")
+    again = sched.replay(scenario_lost_update, result.token)
+    assert again.failed, "replay token must reproduce the failing schedule"
+    assert type(again.error) is type(result.error)
+
+
+def test_explorer_locked_scenario_clean():
+    from tools.schedule_explore import scenario_locked_update
+
+    result = sched.explore(scenario_locked_update, schedules=100, seed=11)
+    assert not result.failed, repr(result.error)
+
+
+def test_explorer_detects_lock_inversion_deadlock():
+    from tools.schedule_explore import scenario_lock_inversion
+
+    result = sched.explore(scenario_lock_inversion, schedules=200, seed=5)
+    assert result.failed
+    assert isinstance(result.error, SchedDeadlock), repr(result.error)
+    again = sched.replay(scenario_lock_inversion, result.token)
+    assert isinstance(again.error, SchedDeadlock)
+
+
+def test_explorer_composes_with_race_witness():
+    """Cooperative primitives emit the same clock edges real ones do: a
+    lock-protected scenario explored under the witness stays clean, an
+    unlocked one is flagged — the two planes verify each other."""
+    with racewitness.quarantine() as q:
+
+        def locked_scenario(s):
+            class Box:
+                pass
+
+            lock = threading.Lock()
+            box = Box()
+            box.val = 0
+            box = racewitness.watch_shared(box, ("val",))
+
+            def bump():
+                with lock:
+                    box.val += 1
+
+            s.spawn(bump, "bump-a")
+            s.spawn(bump, "bump-b")
+
+        res = sched.explore(locked_scenario, schedules=50, seed=3)
+        assert not res.failed, repr(res.error)
+        assert not q.new_reports(), "\n".join(q.new_reports())
+
+        def unlocked_scenario(s):
+            class Box:
+                pass
+
+            box = Box()
+            box.val = 0
+            box = racewitness.watch_shared(box, ("val",))
+
+            def bump():
+                v = box.val
+                box.val = v + 1
+
+            s.spawn(bump, "bump-a")
+            s.spawn(bump, "bump-b")
+
+        sched.explore(unlocked_scenario, schedules=50, seed=3)
+        assert q.new_reports(), "unlocked accesses must be flagged in-schedule"
+
+
+def test_schedule_explore_cli_selftest():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.schedule_explore", "--selftest"],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "schedule_explore selftest OK" in proc.stdout
+
+
+def test_schedule_explore_cli_catches_and_replays(tmp_path):
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "tools.schedule_explore",
+            "--scenario", "lost-update", "--schedules", "200", "--seed", "11",
+        ],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=300,
+    )
+    assert proc.returncode != 0, "a caught scenario must exit nonzero"
+    token = next(
+        (
+            tok
+            for line in (proc.stdout + proc.stderr).splitlines()
+            for tok in line.split()
+            if tok.startswith("s3sched:1:")
+        ),
+        None,
+    )
+    assert token, proc.stdout + proc.stderr
+    replay_proc = subprocess.run(
+        [
+            sys.executable, "-m", "tools.schedule_explore",
+            "--scenario", "lost-update", "--replay", token,
+        ],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=300,
+    )
+    assert replay_proc.returncode != 0, "replay must reproduce the failure"
+
+
+# ---------------------------------------------------------------------------
+# TEETH — PR-15 group-budget double-reserve (skew plane)
+# ---------------------------------------------------------------------------
+#
+# The product protocol (read/prefetch.py _fill_loop): the first split part
+# to pass the budget wait claims the WHOLE block's bytes, siblings
+# piggyback. The fix's load-bearing line is the `if not group.reserved`
+# RE-CHECK after `_await_budget_locked(..., satisfied=...)` returns — the
+# wait can return because a sibling's claim satisfied it, and claiming
+# again double-charges the budget forever. The scenarios below drive the
+# REAL product methods (_await_budget_locked / release_reserved /
+# try_reserve on a real iterator and SplitGroup); the claim body is inlined
+# (it lives inline in _fill_loop) with and without the re-check.
+
+
+def _pr15_scenario(with_recheck: bool):
+    from s3shuffle_tpu.read.prefetch import BufferedPrefetchIterator
+    from s3shuffle_tpu.read.scan_plan import SplitGroup
+
+    def scenario(s):
+        it = BufferedPrefetchIterator(iter([]), max_buffer_size=100)
+        grp = SplitGroup(ShuffleBlockId(0, 0, 0), 0, 80, 2)
+        assert it.try_reserve(60)  # budget contended: 80 more cannot fit
+
+        def claimant():
+            with it._lock:
+                it._await_budget_locked(80, satisfied=lambda: grp.reserved)
+                if not with_recheck or not grp.reserved:
+                    grp.reserved = True
+                    grp.reserved_bytes = 80
+                    it._buffers_in_flight += 80
+                    it._lock.notify_all()
+
+        def releaser():
+            it.release_reserved(60)
+
+        s.spawn(claimant, "claimant-a")
+        s.spawn(claimant, "claimant-b")
+        s.spawn(releaser, "releaser")
+
+        def check():
+            with it._lock:
+                in_flight = it._buffers_in_flight
+            assert in_flight == 80, (
+                f"group budget reserved more than once: {in_flight} != 80"
+            )
+
+        return check
+
+    return scenario
+
+
+def test_pr15_double_reserve_revert_caught_by_explorer():
+    """Drop the re-check (the PR-15 fix) and the explorer finds the
+    double-claim interleaving within its bounded budget — and the replay
+    token reproduces it decision-for-decision."""
+    result = sched.explore(_pr15_scenario(with_recheck=False), schedules=200, seed=7)
+    assert result.failed, "double-reserve must be caught within 200 schedules"
+    assert "reserved more than once" in str(result.error)
+    again = sched.replay(_pr15_scenario(with_recheck=False), result.token)
+    assert again.failed and "reserved more than once" in str(again.error)
+
+
+def test_pr15_group_claim_protocol_clean_across_schedules():
+    """The FIXED protocol holds the single-claim invariant across >=200
+    seeded schedules (iterative context bounding, preemption budgets
+    0..3)."""
+    result = sched.explore(_pr15_scenario(with_recheck=True), schedules=200, seed=7)
+    assert not result.failed, (
+        f"fixed protocol failed under schedule {result.token}: {result.error!r}"
+    )
+    assert result.schedules_run == 200
+
+
+def test_pr15_unlocked_claim_check_caught_by_racewitness():
+    """The pre-fix shape the witness CAN see: checking ``grp.reserved``
+    outside the prefetch lock. The claim writes it under the lock; an
+    unlocked check has no happens-before edge to that write — flagged
+    deterministically, no physical racing required (the accesses are gate-
+    sequenced)."""
+    from s3shuffle_tpu.read.prefetch import BufferedPrefetchIterator
+    from s3shuffle_tpu.read.scan_plan import SplitGroup
+
+    with racewitness.quarantine() as q:
+        it = BufferedPrefetchIterator(iter([]), max_buffer_size=100)
+        grp = SplitGroup(ShuffleBlockId(0, 0, 0), 0, 80, 2)
+        claimed = _gate()
+
+        def claimant():
+            with it._lock:
+                grp.reserved = True
+                grp.reserved_bytes = 80
+                it._buffers_in_flight += 80
+            claimed.release()
+
+        t = threading.Thread(target=claimant)
+        t.start()
+        claimed.acquire()
+        # THE REVERT: the sibling's check-then-act reads grp.reserved
+        # without taking it._lock
+        saw = grp.reserved
+        t.join()
+        assert saw is True
+        reports = q.new_reports()
+        assert any("reserved" in r for r in reports), (
+            "witness missed the unlocked claim check:\n" + "\n".join(reports)
+        )
+
+
+def test_pr15_locked_claim_check_is_witness_clean():
+    """Same sequence with the check under the lock (the fixed protocol):
+    the lock edge orders the pair — clean."""
+    from s3shuffle_tpu.read.prefetch import BufferedPrefetchIterator
+    from s3shuffle_tpu.read.scan_plan import SplitGroup
+
+    with racewitness.quarantine() as q:
+        it = BufferedPrefetchIterator(iter([]), max_buffer_size=100)
+        grp = SplitGroup(ShuffleBlockId(0, 0, 0), 0, 80, 2)
+        claimed = _gate()
+
+        def claimant():
+            with it._lock:
+                grp.reserved = True
+                grp.reserved_bytes = 80
+                it._buffers_in_flight += 80
+            claimed.release()
+
+        t = threading.Thread(target=claimant)
+        t.start()
+        claimed.acquire()
+        with it._lock:
+            saw = grp.reserved
+        t.join()
+        assert saw is True
+        assert not q.new_reports(), "\n".join(q.new_reports())
+
+
+# ---------------------------------------------------------------------------
+# TEETH — PR-10 seal-visibility barrier (composite commit plane)
+# ---------------------------------------------------------------------------
+#
+# flush_shuffle's contract: when it returns, every previously committed
+# member is REGISTERED — enforced by _await_seals draining the in-flight
+# seal counter under _seal_cv. The scenarios drive the REAL seal-window
+# methods (_note_seal_begin / _note_seal_end / _await_seals) on an
+# aggregator whose seal plumbing is built exactly as __init__ builds it.
+
+
+def _seal_window_agg(watch: bool = False):
+    from s3shuffle_tpu.write.composite_commit import CompositeCommitAggregator
+
+    agg = object.__new__(CompositeCommitAggregator)
+    agg._lock = threading.Lock()
+    agg._groups = {}
+    agg._seal_cv = threading.Condition()
+    agg._sealing = {}
+    if watch:
+        agg = racewitness.watch_shared(agg, ("_groups", "_sealing"))
+    return agg
+
+
+def _pr10_scenario():
+    def scenario(s):
+        agg = _seal_window_agg()
+        sid = 7
+        registered = []
+        # a sealer already claimed the shuffle's group: detach + begin are
+        # atomic in the product (commit_map / _finish_each), so the barrier
+        # below can only ever observe (no group, seal in flight)
+        agg._note_seal_begin(sid)
+
+        def sealer():
+            s.checkpoint()  # the registration window
+            registered.append("m0")  # on_group_commit lands the members
+            agg._note_seal_end(sid)
+
+        def barrier_flush():
+            with agg._lock:
+                group = agg._groups.pop(sid, None)
+            assert group is None  # the sealer holds it
+            agg._await_seals(sid)  # PR-10 fix (monkeypatched away in revert)
+            # the reduce-side scan happens NOW — members must be visible
+            assert registered == ["m0"], (
+                "record loss: barrier returned before the in-flight seal "
+                "registered its members"
+            )
+
+        s.spawn(sealer, "sealer")
+        s.spawn(barrier_flush, "barrier")
+
+    return scenario
+
+
+def test_pr10_seal_barrier_revert_caught_by_explorer(monkeypatch):
+    from s3shuffle_tpu.write.composite_commit import CompositeCommitAggregator
+
+    # THE REVERT: the barrier no longer waits out in-flight seals
+    monkeypatch.setattr(
+        CompositeCommitAggregator, "_await_seals",
+        lambda self, shuffle_id: None,
+    )
+    result = sched.explore(_pr10_scenario(), schedules=200, seed=13)
+    assert result.failed, "record-loss window must be caught within 200 schedules"
+    assert "record loss" in str(result.error)
+    again = sched.replay(_pr10_scenario(), result.token)
+    assert again.failed and "record loss" in str(again.error)
+
+
+def test_pr10_seal_barrier_protocol_clean_across_schedules():
+    result = sched.explore(_pr10_scenario(), schedules=200, seed=13)
+    assert not result.failed, (
+        f"fixed barrier failed under schedule {result.token}: {result.error!r}"
+    )
+    assert result.schedules_run == 200
+
+
+def test_pr10_await_seals_revert_caught_by_racewitness(monkeypatch):
+    """The happens-before view of the same bug: the sealer mutates the
+    group registry under the aggregator lock and announces completion via
+    _seal_cv; a barrier that skips _await_seals reads the registry with NO
+    edge to those writes. Flagged deterministically — the read is gate-
+    sequenced strictly after the seal, and the clocks still have no path."""
+    from s3shuffle_tpu.write.composite_commit import CompositeCommitAggregator
+
+    monkeypatch.setattr(
+        CompositeCommitAggregator, "_await_seals",
+        lambda self, shuffle_id: None,
+    )
+    with racewitness.quarantine() as q:
+        agg = _seal_window_agg(watch=True)
+        sid = 7
+        with agg._lock:
+            agg._groups[sid] = "open-group"
+        agg._note_seal_begin(sid)
+        sealed = _gate()
+
+        def sealer():
+            with agg._lock:
+                agg._groups.pop(sid, None)
+            agg._note_seal_end(sid)
+            sealed.release()
+
+        t = threading.Thread(target=sealer)
+        t.start()
+        sealed.acquire()
+        agg._await_seals(sid)  # reverted: returns without the _seal_cv edge
+        saw = sid in agg._groups  # pre-fix: reader scans unordered state
+        t.join()
+        assert saw is False
+        reports = q.new_reports()
+        assert any("_groups" in r for r in reports), (
+            "witness missed the barrier-less registry read:\n"
+            + "\n".join(reports)
+        )
+
+
+def test_pr10_await_seals_orders_the_reader():
+    """With the real _await_seals, the SAME unordered-looking read is
+    clean: draining the seal counter under _seal_cv joins the sealer's
+    clock (note_seal_end notifies and releases after the registry write),
+    which is precisely the edge the PR-10 fix exists to provide."""
+    with racewitness.quarantine() as q:
+        agg = _seal_window_agg(watch=True)
+        sid = 7
+        with agg._lock:
+            agg._groups[sid] = "open-group"
+        agg._note_seal_begin(sid)
+        sealed = _gate()
+
+        def sealer():
+            with agg._lock:
+                agg._groups.pop(sid, None)
+            agg._note_seal_end(sid)
+            sealed.release()
+
+        t = threading.Thread(target=sealer)
+        t.start()
+        sealed.acquire()
+        agg._await_seals(sid)  # the fix: acquire _seal_cv, drain, join clock
+        saw = sid in agg._groups
+        t.join()
+        assert saw is False
+        assert not q.new_reports(), "\n".join(q.new_reports())
+
+
+# ---------------------------------------------------------------------------
+# Metrics wiring
+# ---------------------------------------------------------------------------
+
+
+def test_witness_and_explorer_publish_metrics():
+    from s3shuffle_tpu.metrics import registry as mreg
+
+    mreg.REGISTRY.reset_values()
+    mreg.enable()
+    try:
+        with racewitness.quarantine() as q:
+
+            class Box:
+                pass
+
+            box = Box()
+            box.x = 0
+            box = racewitness.watch_shared(box, ("x",))
+            box.x = 1
+            racewitness.publish_metrics(q.witness)
+
+        def scenario(s):
+            s.spawn(lambda: None, "noop")
+
+        res = sched.explore(scenario, schedules=3, seed=1)
+        assert not res.failed
+        snap = mreg.REGISTRY.snapshot(compact=True)
+
+        def total(name):
+            return sum(
+                s["value"] for s in snap.get(name, {}).get("series", [])
+            )
+
+        assert total("race_witness_checks_total") >= 1
+        assert total("sched_schedules_explored_total") >= 3
+    finally:
+        mreg.disable()
+        mreg.REGISTRY.reset_values()

@@ -14,6 +14,15 @@ Every soak also runs under the runtime protocol witness
 (utils/protowitness.py) wrapped OVER the fault + retry layers, so each run
 doubles as a commit-protocol check: commit-op ordering (index PUT last)
 and the seal barrier must hold even while the weather forces re-drives.
+
+With ``S3SHUFFLE_RACE_WITNESS=1`` each soak ALSO asserts the happens-before
+race witness (utils/racewitness.py) found no unsynchronized access pairs —
+per-test, mirroring the protowitness wiring, so a racy interleaving the
+weather provokes is blamed on the soak that drove it instead of surfacing
+only in the session-teardown verdict. Worker subprocesses inherit the env
+and arm their own witness (see ``_fleet_agent_main``): a surviving worker
+that exits cleanly vouches for BOTH its commit protocol and its
+synchronization discipline.
 """
 
 import pytest
@@ -30,7 +39,7 @@ from s3shuffle_tpu.storage.fault import (
     transient_timeout,
 )
 from s3shuffle_tpu.storage.retrying import RetryingBackend
-from s3shuffle_tpu.utils import protowitness
+from s3shuffle_tpu.utils import protowitness, racewitness
 
 N_MAPS = 3
 N_PARTS = 4
@@ -44,6 +53,16 @@ def metrics_on():
     yield mreg.REGISTRY
     mreg.disable()
     mreg.REGISTRY.reset_values()
+
+
+def _assert_race_witness_clean():
+    """With S3SHUFFLE_RACE_WITNESS=1 (env-armed witness): fail THIS soak if
+    the happens-before witness has flagged any unsynchronized access pair —
+    localized blame, matching the per-test protowitness assert_clean calls.
+    No-op when the witness is off."""
+    w = racewitness.active_witness()
+    if w is not None:
+        w.assert_clean()
 
 
 def _records():
@@ -129,6 +148,7 @@ def test_fault_soak_shuffle_byte_identical(tmp_path, metrics_on, composite_maps)
         with protowitness.watching(ctx.manager) as witness:
             handle, _expected2, soak_out = _run_shuffle(ctx)
         witness.assert_clean()
+        _assert_race_witness_clean()
 
         # byte-identical to the fault-free run
         assert soak_out == clean_out
@@ -219,6 +239,7 @@ def test_fault_soak_object_loss_mode(tmp_path, metrics_on, k, m):
             assert sorted(out) == clean_out  # byte-identical despite losses
         # degraded reads + reconstruction must still respect the protocol
         witness.assert_clean()
+        _assert_race_witness_clean()
 
         snap = metrics_on.snapshot(compact=True)
         recon = sum(
@@ -252,8 +273,13 @@ def _fleet_agent_main(coordinator, cfg_dict, worker_id):
     from s3shuffle_tpu.config import ShuffleConfig as _Cfg
     from s3shuffle_tpu.storage.dispatcher import Dispatcher as _Disp
     from s3shuffle_tpu.utils import protowitness as _pw
+    from s3shuffle_tpu.utils import racewitness as _rw
     from s3shuffle_tpu.worker import WorkerAgent as _Agent
 
+    # inherited S3SHUFFLE_RACE_WITNESS=1 arms the happens-before witness in
+    # THIS process too (spawn workers don't run conftest) — installed before
+    # the agent builds any sync object so the interposition covers them all
+    _race = _rw.install_from_env()
     _Disp.reset()
     agent = _Agent(
         tuple(coordinator), config=_Cfg(**cfg_dict), worker_id=worker_id
@@ -269,6 +295,8 @@ def _fleet_agent_main(coordinator, cfg_dict, worker_id):
     agent.run_forever(poll_interval=0.01, heartbeat_s=0.3)
     for witness in _pw.drain_installed():
         witness.assert_clean()
+    if _race is not None:
+        _race.assert_clean()  # a racy pair turns into a nonzero exit code
 
 
 def _fleet_records(n=6000, seed=52):
@@ -419,6 +447,7 @@ def test_worker_drain_soak_zero_records_zero_requeues(tmp_path, metrics_on):
         # ONLY its: the still-healthy workers have dumped nothing
         _assert_flight_dump(f"{tmp_path}/flight", drained["wid"], "drain")
         _assert_zero_shuffle_residual(driver, [0, 1])
+        _assert_race_witness_clean()
     finally:
         driver.shutdown()
         for p in workers.values():
@@ -491,6 +520,7 @@ def test_worker_kill_fast_deterministic(tmp_path, metrics_on):
         # survivors drain out witness-clean at shutdown
         survivors = [w for w in workers if w != killed["wid"]]
         _assert_zero_shuffle_residual(driver, [0, 1])
+        _assert_race_witness_clean()
         driver.shutdown()
         for wid in survivors:
             workers[wid].join(timeout=10)
@@ -576,6 +606,7 @@ def test_worker_sigterm_postmortem_flight_dump(tmp_path, metrics_on):
         )
         assert any(r["name"] == "worker.drain" for r in ring)
         _assert_zero_shuffle_residual(driver, [0, 1])
+        _assert_race_witness_clean()
         # fleet shutdown: the survivors' clean stop path adds no dumps
         driver.shutdown()
         for p in workers.values():
@@ -657,6 +688,7 @@ def test_worker_churn_soak_kill_minus_n(tmp_path, metrics_on):
         events = [e["event"] for e in driver.server.membership.snapshot()["events"]]
         assert "join" in events
         _assert_zero_shuffle_residual(driver, list(range(driver._next_shuffle_id)))
+        _assert_race_witness_clean()
         # shut the fleet down; every surviving worker must exit clean
         # (witness-armed) — only SIGKILLed processes may die nonzero
         driver.shutdown()

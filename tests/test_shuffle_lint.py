@@ -796,6 +796,62 @@ def test_cli_sarif_carries_suppression_justification(tmp_path):
     )
 
 
+def test_cli_sarif_thr02_finding_and_suppression(tmp_path):
+    """THR02 rides the generic SARIF renderer: an unsynchronized shared
+    mutation appears as an open result, and a reasoned suppression of the
+    same finding is carried with its justification."""
+    rule = next(r for r in ALL_RULES if r.RULE_ID == "THR02")
+    bad = tmp_path / "seeded_thr02.py"
+    bad.write_text(rule.POSITIVE)
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "tools.shuffle_lint",
+            "--format", "sarif", str(bad),
+        ],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    open_results = [
+        r for r in doc["runs"][0]["results"] if "suppressions" not in r
+    ]
+    assert any(r["ruleId"] == "THR02" for r in open_results)
+    assert "THR02" in {
+        r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]
+    }
+
+    # suppress every open finding on its own line with a reason (the fixture
+    # legitimately trips other rules too, e.g. THR01): exit 0, justification
+    # kept in the SARIF suppressions block
+    lines = rule.POSITIVE.splitlines()
+    for r in open_results:
+        i = r["locations"][0]["physicalLocation"]["region"]["startLine"] - 1
+        lines[i] += (
+            "  # shuffle-lint: disable={} reason=fixture lock-free design"
+            .format(r["ruleId"])
+        )
+    sup = tmp_path / "suppressed_thr02.py"
+    sup.write_text("\n".join(lines) + "\n")
+    proc2 = subprocess.run(
+        [
+            sys.executable, "-m", "tools.shuffle_lint",
+            "--format", "sarif", str(sup),
+        ],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120,
+    )
+    assert proc2.returncode == 0, proc2.stdout + proc2.stderr
+    doc2 = json.loads(proc2.stdout)
+    suppressed = [
+        r
+        for r in doc2["runs"][0]["results"]
+        if r["ruleId"] == "THR02" and "suppressions" in r
+    ]
+    assert suppressed, "suppressed THR02 finding missing from SARIF output"
+    assert suppressed[0]["suppressions"][0]["justification"] == (
+        "fixture lock-free design"
+    )
+
+
 def test_cli_changed_only_filters_to_git_diff(tmp_path):
     """--changed-only scopes REPORTING to git-changed files while the scan
     stays whole-tree; in a scratch repo with one clean and one dirty file,

@@ -1,0 +1,271 @@
+#!/usr/bin/env python
+"""Deterministic schedule explorer CLI.
+
+Front-end for :mod:`s3shuffle_tpu.utils.sched`: runs a concurrency scenario
+under many seeded cooperative schedules (random walk + bounded preemption /
+iterative context bounding) and, when a schedule fails — assertion,
+deadlock, livelock — prints a **replay token** that re-executes that exact
+interleaving decision-for-decision.
+
+A scenario is a callable ``scenario(sched) -> Optional[check]``: it spawns
+tasks via ``sched.spawn(fn, name)`` and may return a zero-arg check run
+after the schedule completes. Built-in demo scenarios (``--list``) cover
+the classic bug shapes; project scenarios are addressed as
+``module.path:callable`` (e.g. a revert-mutation scenario from the test
+suite).
+
+Usage:
+    python -m tools.schedule_explore --scenario lost-update --schedules 200
+    python -m tools.schedule_explore --scenario lost-update \
+        --replay 's3sched:1:513960061:1:1.1'
+    python -m tools.schedule_explore --selftest   # fast smoke (CI tier-1)
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import threading
+from typing import Callable, Dict, List, Optional
+
+from s3shuffle_tpu.utils import sched
+
+
+# ---------------------------------------------------------------------------
+# Built-in scenarios: the classic shapes, smallest possible form
+# ---------------------------------------------------------------------------
+
+
+def scenario_lost_update(s: sched.Scheduler):
+    """Unsynchronized read-modify-write: two bumpers, one counter."""
+    state = {"n": 0}
+
+    def bump():
+        v = state["n"]
+        s.checkpoint()  # the window
+        state["n"] = v + 1
+
+    s.spawn(bump, "bump-a")
+    s.spawn(bump, "bump-b")
+
+    def check():
+        assert state["n"] == 2, f"lost update: n={state['n']} (expected 2)"
+
+    return check
+
+
+def scenario_locked_update(s: sched.Scheduler):
+    """Same shape as lost-update but lock-protected: must stay clean."""
+    state = {"n": 0}
+    mu = threading.Lock()
+
+    def bump():
+        with mu:
+            v = state["n"]
+            s.checkpoint()
+            state["n"] = v + 1
+
+    s.spawn(bump, "bump-a")
+    s.spawn(bump, "bump-b")
+
+    def check():
+        assert state["n"] == 2, f"lost update under lock?! n={state['n']}"
+
+    return check
+
+
+def scenario_lock_inversion(s: sched.Scheduler):
+    """AB-BA lock ordering: deadlocks whenever both inner acquires
+    interleave — the explorer must report SchedDeadlock."""
+    l1, l2 = threading.Lock(), threading.Lock()
+
+    def fwd():
+        with l1:
+            s.checkpoint()
+            with l2:
+                pass
+
+    def rev():
+        with l2:
+            s.checkpoint()
+            with l1:
+                pass
+
+    s.spawn(fwd, "fwd")
+    s.spawn(rev, "rev")
+    return None
+
+
+def scenario_lost_notify(s: sched.Scheduler):
+    """Flag checked OUTSIDE the condition's lock before waiting: the
+    notify can land in the check→wait window and the waiter then waits on
+    a notification that already happened (rescued only by its backstop
+    timeout, which the cooperative clock fires only at idle — and the
+    post-timeout re-check sees the flag, so the *observable* failure is a
+    timeout-wake, asserted by the check)."""
+    cv = threading.Condition()
+    box = {"ready": False, "timeouts": 0}
+
+    def waiter():
+        if not box["ready"]:  # BUG: unlocked check
+            s.checkpoint()
+            with cv:
+                # shuffle-lint: disable=CW01 reason=deliberately buggy demo scenario: the missing while-predicate IS the bug the explorer exists to catch
+                if not cv.wait(timeout=5.0):
+                    box["timeouts"] += 1
+
+    def setter():
+        with cv:
+            box["ready"] = True
+            cv.notify_all()
+
+    s.spawn(waiter, "waiter")
+    s.spawn(setter, "setter")
+
+    def check():
+        assert box["timeouts"] == 0, (
+            "lost notification: waiter fell through to its backstop timeout"
+        )
+
+    return check
+
+
+SCENARIOS: Dict[str, Callable] = {
+    "lost-update": scenario_lost_update,
+    "locked-update": scenario_locked_update,
+    "lock-inversion": scenario_lock_inversion,
+    "lost-notify": scenario_lost_notify,
+}
+
+
+def _resolve(name: str) -> Callable:
+    if name in SCENARIOS:
+        return SCENARIOS[name]
+    if ":" in name:
+        mod_name, attr = name.rsplit(":", 1)
+        mod = importlib.import_module(mod_name)
+        fn = getattr(mod, attr, None)
+        if fn is None:
+            raise SystemExit(f"no callable {attr!r} in module {mod_name!r}")
+        return fn
+    raise SystemExit(
+        f"unknown scenario {name!r} (try --list, or module.path:callable)"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Reporting
+# ---------------------------------------------------------------------------
+
+
+def _report(name: str, result: sched.ExploreResult) -> int:
+    if result.failed:
+        err = result.error
+        kind = type(err).__name__
+        print(f"scenario {name}: FAILED after {result.schedules_run} schedule(s)")
+        print(f"  error:  {kind}: {err}")
+        print(f"  replay: {result.token}")
+        return 1
+    print(
+        f"scenario {name}: clean across {result.schedules_run} schedule(s) "
+        f"({sched.schedules_explored()} explored this process)"
+    )
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Selftest (wired into tier-1: python -m tools.schedule_explore --selftest)
+# ---------------------------------------------------------------------------
+
+
+def _selftest() -> int:
+    # 1) the racy shape must fail, and its token must replay to the SAME
+    #    failure (determinism is the whole point)
+    r = sched.explore(scenario_lost_update, schedules=100, seed=7)
+    assert r.failed, "lost-update scenario not caught"
+    assert "lost update" in str(r.error), r.error
+    assert r.token and r.token.startswith("s3sched:1:"), r.token
+    rr = sched.replay(scenario_lost_update, r.token)
+    assert rr.failed and "lost update" in str(rr.error), "replay diverged"
+    assert rr.token == r.token, f"replay token drift: {rr.token} != {r.token}"
+    print(f"selftest: lost-update caught (token {r.token})")
+
+    # 2) the locked variant must be clean across the full budget ladder
+    r2 = sched.explore(scenario_locked_update, schedules=100, seed=7)
+    assert not r2.failed, f"false positive on locked-update: {r2.error}"
+    print("selftest: locked-update clean across 100 schedules")
+
+    # 3) AB-BA inversion must be reported as a deadlock with block sites
+    r3 = sched.explore(scenario_lock_inversion, schedules=60, seed=1)
+    assert r3.failed and isinstance(r3.error, sched.SchedDeadlock), r3
+    assert "blocked on" in str(r3.error)
+    rr3 = sched.replay(scenario_lock_inversion, r3.token)
+    assert rr3.failed and isinstance(rr3.error, sched.SchedDeadlock)
+    print("selftest: lock-inversion deadlock detected and replayed")
+
+    # 4) lost-notify: cooperative timeouts only fire at idle, so the
+    #    backstop-rescue is observable as a failure
+    r4 = sched.explore(scenario_lost_notify, schedules=100, seed=3)
+    assert r4.failed and "lost notification" in str(r4.error), r4
+    print("selftest: lost-notify caught via idle-only timeout semantics")
+
+    # 5) token round-trip
+    s = sched.Scheduler.from_token("s3sched:1:42:2:0.1.0")
+    assert (s.seed, s.max_preemptions) == (42, 2)
+    assert s._replay == [0, 1, 0]
+    try:
+        sched.Scheduler.from_token("nope:1:2:3")
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("bad token accepted")
+    print("selftest: replay token round-trip OK")
+
+    # locked-update alone contributes 100; failing scenarios stop early
+    assert sched.schedules_explored() >= 100
+    sched.publish_metrics()
+    print(f"schedules explored: {sched.schedules_explored()}")
+    print("schedule_explore selftest OK")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[1])
+    ap.add_argument("--scenario", help="built-in name or module.path:callable")
+    ap.add_argument("--schedules", type=int, default=200,
+                    help="schedules to explore (default 200)")
+    ap.add_argument("--seed", type=int, default=0, help="base seed")
+    ap.add_argument("--max-preemptions", type=int, default=3,
+                    help="context-bounding ceiling (budgets cycle 0..N)")
+    ap.add_argument("--replay", metavar="TOKEN",
+                    help="re-execute one schedule from a replay token")
+    ap.add_argument("--list", action="store_true",
+                    help="list built-in scenarios")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the built-in smoke checks and exit")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        return _selftest()
+    if args.list:
+        for name, fn in sorted(SCENARIOS.items()):
+            doc = (fn.__doc__ or "").strip().splitlines()[0]
+            print(f"{name:16s} {doc}")
+        return 0
+    if not args.scenario:
+        ap.error("need --scenario (or --list / --selftest / --replay)")
+    scenario = _resolve(args.scenario)
+    if args.replay:
+        result = sched.replay(scenario, args.replay)
+    else:
+        result = sched.explore(
+            scenario,
+            schedules=args.schedules,
+            seed=args.seed,
+            max_preemptions=args.max_preemptions,
+        )
+    return _report(args.scenario, result)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -17,11 +17,14 @@ from tools.shuffle_lint.rules import (  # noqa: F401  (registry import)
     met01,
     ord01,
     thr01,
+    thr02,
     trc01,
     wire01,
 )
 
 #: every active rule, in rule-id order
-ALL_RULES = (cfg01, cw01, exc01, imp01, lk01, met01, ord01, thr01, trc01, wire01)
+ALL_RULES = (
+    cfg01, cw01, exc01, imp01, lk01, met01, ord01, thr01, thr02, trc01, wire01,
+)
 
 __all__ = ["ALL_RULES"]

@@ -1,0 +1,449 @@
+"""THR02 — interprocedural shared-state lock discipline.
+
+The race witness (``utils/racewitness.py``) and the schedule explorer
+(``utils/sched.py``) catch unsynchronized shared mutation *dynamically* —
+on the accesses a test actually executes. This rule is the static half of
+the concurrency verification plane: an **instance attribute mutated from
+two or more thread-entry-reachable methods with no common package lock
+held on all mutation paths** is flagged at lint time, whether or not any
+test drives the interleaving.
+
+Mechanics (whole-scan, alongside the PR-11 :class:`ProjectGraph`):
+
+- **thread entries**: functions named as a ``Thread(target=...)``, passed
+  to an executor ``.submit(...)`` (both ``submit(fn)`` and the
+  GrowReapExecutor's ``submit(width, fn)`` shape), or RPC-handler methods
+  (``handle`` / ``_dispatch*`` — the socketserver convention the metadata
+  plane uses). Everything transitively callable from an entry is
+  *thread-entry-reachable* — but unlike LK01's terminal-name edges, call
+  resolution here is **scoped**: ``self.m()`` resolves within the class,
+  a bare ``f()`` to same-file module functions, and a cross-file edge
+  only when the name has exactly ONE definition in the scanned set (the
+  bare-name graph would make every method named ``write`` "reachable"
+  because *some* ``write`` runs on a thread, flooding single-threaded
+  stream classes with false findings);
+- **mutations**: ``self.X = ...`` / ``self.X += ...`` / ``self.X[k] = ...``
+  / ``del self.X[k]`` and mutating container calls (``self.X.append`` …)
+  inside a method body, excluding ``__init__``/``__post_init__`` (pre-
+  publication) and the class's own lock fields;
+- **lock discipline**: a mutation is protected by the lock names of every
+  enclosing ``with self.<lock>:`` (lock fields = attrs assigned a
+  ``threading.{Lock,RLock,Condition}()`` anywhere in the class, plus
+  lock-ish names). A method named ``*_locked`` is caller-holds-the-lock by
+  package convention and counts as protected by any lock;
+- **verdict**: ≥2 distinct thread-entry-reachable mutating methods whose
+  held-lock sets share no common member → one violation per (class, attr),
+  anchored at the first unprotected mutation.
+
+Resolution is still approximate in both directions: cross-object handoffs
+(``other._aggregator.seal()`` from a worker thread) are under-approximated
+— the dynamic race witness owns those — and benign sites survive (a
+single-threaded phase before workers start, futures-ordering guarantees,
+GIL-atomic flag writes): those carry an inline suppression explaining why,
+so the budget stays auditable via SUP00.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from tools.shuffle_lint.core import (
+    STDLIB_SHADOW_METHODS,
+    FileContext,
+    ProjectGraph,
+    Violation,
+    walk_function_body,
+)
+from tools.shuffle_lint.rules.common import LOCKISH_NAME_RE, terminal_name
+
+RULE_ID = "THR02"
+DESCRIPTION = (
+    "instance attribute mutated from >=2 thread-entry-reachable methods "
+    "with no common lock held"
+)
+
+#: container methods that mutate their receiver
+_MUTATOR_METHODS = frozenset(
+    {
+        "append", "extend", "insert", "pop", "popleft", "popitem", "remove",
+        "discard", "add", "clear", "update", "setdefault", "appendleft",
+        "sort", "reverse",
+    }
+)
+
+#: the raw _thread.allocate_lock forms cover infrastructure that must not
+#: route through the patchable threading factories (the witnesses' own
+#: bookkeeping locks — interposed locks there would recurse)
+_LOCK_CTORS = frozenset({"Lock", "RLock", "Condition", "allocate_lock", "_allocate_lock"})
+
+#: methods that are construction/teardown — mutations there are
+#: pre-publication (or post-quiescence), not concurrent
+_NON_CONCURRENT_METHODS = frozenset({"__init__", "__post_init__", "__del__"})
+
+#: caller-holds-lock sentinel (``*_locked`` naming convention)
+_WILDCARD = "<caller-held>"
+
+POSITIVE = '''
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+class Buffer:
+    def __init__(self):
+        self._items = []
+        self._pool = ThreadPoolExecutor(max_workers=2)
+        t = threading.Thread(target=self._fill_loop, daemon=True)
+        t.start()
+        self._pool.submit(self._drain)
+
+    def _fill_loop(self):
+        self._items.append(1)      # BUG: no lock, racing _drain
+
+    def _drain(self):
+        self._items = []           # BUG: no lock, racing _fill_loop
+'''
+
+NEGATIVE = '''
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+class Buffer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+        self._epoch = 0
+        self._pool = ThreadPoolExecutor(max_workers=2)
+        t = threading.Thread(target=self._fill_loop, daemon=True)
+        t.start()
+        self._pool.submit(self._drain)
+
+    def _fill_loop(self):
+        with self._lock:
+            self._append_locked(1)
+
+    def _append_locked(self, item):
+        self._items.append(item)   # caller holds self._lock by convention
+
+    def _drain(self):
+        with self._lock:
+            self._items = []
+
+    def bump_epoch(self):
+        # mutated only from this method (not a second entry): no pair
+        self._epoch += 1
+'''
+
+
+# ---------------------------------------------------------------------------
+# Scoped definition index, entry detection, reachability
+# ---------------------------------------------------------------------------
+
+#: definition key: (path, class name or None, function name)
+_Key = Tuple[str, Optional[str], str]
+
+
+class _Index:
+    """Scope-aware definition index over every scanned tree."""
+
+    def __init__(self, project: ProjectGraph):
+        #: (path, ClassDef) in scan order
+        self.classes: List[Tuple[str, ast.ClassDef]] = []
+        #: key -> definition node
+        self.defs: Dict[_Key, ast.AST] = {}
+        #: per-file module-level function names
+        self.module_funcs: Dict[str, Set[str]] = {}
+        #: name -> every key defining it (unique-name cross-file fallback)
+        self.by_name: Dict[str, List[_Key]] = {}
+        for path, tree in project.trees.items():
+            self.module_funcs[path] = set()
+            for node in tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._add((path, None, node.name), node)
+                    self.module_funcs[path].add(node.name)
+            for node in ast.walk(tree):
+                if isinstance(node, ast.ClassDef):
+                    self.classes.append((path, node))
+                    for stmt in node.body:
+                        if isinstance(
+                            stmt, (ast.FunctionDef, ast.AsyncFunctionDef)
+                        ):
+                            self._add((path, node.name, stmt.name), stmt)
+
+    def _add(self, key: _Key, node: ast.AST) -> None:
+        self.defs[key] = node
+        self.by_name.setdefault(key[2], []).append(key)
+
+    def resolve(
+        self, expr: ast.expr, path: str, cls: Optional[str]
+    ) -> Optional[_Key]:
+        """A callable reference to a definition key, scope-aware:
+        ``self._x`` -> method of the enclosing class; bare ``f`` -> a
+        module function of the same file; anything else only when the
+        terminal name has exactly one definition in the scanned set (and
+        does not shadow a ubiquitous stdlib method)."""
+        name = terminal_name(expr)
+        if name is None or name in ("self", "cls"):
+            return None
+        if cls is not None and _self_attr(expr) == name:
+            key = (path, cls, name)
+            if key in self.defs:
+                return key
+        if isinstance(expr, ast.Name) and name in self.module_funcs.get(path, ()):
+            return (path, None, name)
+        if name in STDLIB_SHADOW_METHODS or name.startswith("__"):
+            return None
+        candidates = self.by_name.get(name, [])
+        if len(candidates) == 1:
+            return candidates[0]
+        return None
+
+    def callees(self, key: _Key) -> Set[_Key]:
+        path, cls, _ = key
+        out: Set[_Key] = set()
+        for sub in walk_function_body(self.defs[key]):
+            if isinstance(sub, ast.Call):
+                target = self.resolve(sub.func, path, cls)
+                if target is not None:
+                    out.add(target)
+        return out
+
+
+def _entry_keys(index: _Index, project: ProjectGraph) -> Set[_Key]:
+    entries: Set[_Key] = set()
+    for key, node in index.defs.items():
+        path, cls, name = key
+        # RPC-handler convention (socketserver): handle() / _dispatch*()
+        if cls is not None and (name == "handle" or name.startswith("_dispatch")):
+            entries.add(key)
+        for sub in walk_function_body(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            if terminal_name(sub.func) == "Thread":
+                for kw in sub.keywords:
+                    if kw.arg == "target":
+                        target = index.resolve(kw.value, path, cls)
+                        if target is not None:
+                            entries.add(target)
+            elif (
+                isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "submit"
+            ):
+                # submit(fn, ...) and submit(width, fn, ...): the first two
+                # positionals cover both executor shapes
+                for arg in sub.args[:2]:
+                    if isinstance(arg, ast.Constant):
+                        continue
+                    target = index.resolve(arg, path, cls)
+                    if target is not None:
+                        entries.add(target)
+    # module-level spawns (outside any def: daemons wired at import time)
+    for path, tree in project.trees.items():
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and terminal_name(sub.func) == "Thread":
+                    for kw in sub.keywords:
+                        if kw.arg == "target":
+                            target = index.resolve(kw.value, path, None)
+                            if target is not None:
+                                entries.add(target)
+    return entries
+
+
+def _reachable_keys(index: _Index, entries: Set[_Key]) -> Set[_Key]:
+    reachable: Set[_Key] = set()
+    frontier = [k for k in entries if k in index.defs]
+    while frontier:
+        key = frontier.pop()
+        if key in reachable:
+            continue
+        reachable.add(key)
+        frontier.extend(
+            c for c in index.callees(key) if c not in reachable
+        )
+    return reachable
+
+
+# ---------------------------------------------------------------------------
+# Per-class mutation analysis
+# ---------------------------------------------------------------------------
+
+
+def _self_attr(node: ast.expr) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _lock_fields(cls: ast.ClassDef) -> Set[str]:
+    """Attrs assigned a threading sync ctor anywhere in the class, plus
+    lock-ish-named attrs (``self._mu`` built by a helper still counts)."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        value = None
+        targets: List[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        if value is None:
+            continue
+        ctor = terminal_name(value) if isinstance(value, ast.Call) else None
+        if ctor not in _LOCK_CTORS:
+            continue
+        for target in targets:
+            attr = _self_attr(target)
+            if attr is not None:
+                out.add(attr)
+    return out
+
+
+class _Mutation:
+    __slots__ = ("method", "attr", "line", "col", "held")
+
+    def __init__(self, method: str, attr: str, line: int, col: int,
+                 held: FrozenSet[str]):
+        self.method = method
+        self.attr = attr
+        self.line = line
+        self.col = col
+        self.held = held
+
+
+def _mutations_in(
+    method: ast.FunctionDef, lock_fields: Set[str]
+) -> List[_Mutation]:
+    """Every ``self.<attr>`` mutation in one method body with the lock
+    names held at that point. Nested defs are skipped (separate graph
+    nodes; their bodies run under their own entry analysis)."""
+    out: List[_Mutation] = []
+    base_held: Set[str] = set()
+    if method.name.endswith("_locked"):
+        base_held.add(_WILDCARD)
+
+    def locks_of(with_node: ast.With) -> Set[str]:
+        held: Set[str] = set()
+        for item in with_node.items:
+            name = terminal_name(item.context_expr)
+            if name is None:
+                continue
+            if name in lock_fields or LOCKISH_NAME_RE.search(name):
+                held.add(name)
+        return held
+
+    def record(attr: Optional[str], node: ast.AST, held: Set[str]) -> None:
+        if attr is None or attr in lock_fields:
+            return
+        out.append(
+            _Mutation(
+                method.name, attr, node.lineno, node.col_offset,
+                frozenset(held),
+            )
+        )
+
+    def visit(node: ast.AST, held: Set[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return
+        if isinstance(node, ast.With):
+            inner = held | locks_of(node)
+            for item in node.items:
+                visit(item, held)
+            for stmt in node.body:
+                visit(stmt, inner)
+            return
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                record(_target_attr(target), node, held)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            record(_target_attr(node.target), node, held)
+        elif isinstance(node, ast.Delete):
+            for target in node.targets:
+                record(_target_attr(target), node, held)
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in _MUTATOR_METHODS
+            ):
+                record(_self_attr(func.value), node, held)
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in method.body:
+        visit(stmt, set(base_held))
+    return out
+
+
+def _target_attr(target: ast.expr) -> Optional[str]:
+    """``self.X`` / ``self.X[k]`` assignment-target -> ``X``."""
+    if isinstance(target, ast.Subscript):
+        return _self_attr(target.value)
+    return _self_attr(target)
+
+
+# ---------------------------------------------------------------------------
+# Rule hooks
+# ---------------------------------------------------------------------------
+
+
+def check(ctx: FileContext) -> List[Violation]:
+    # whole-scan rule: all findings come from check_project (lint_source
+    # builds a single-file graph, so fixtures exercise the same path)
+    return []
+
+
+def check_project(project: ProjectGraph) -> List[Violation]:
+    index = _Index(project)
+    entries = _entry_keys(index, project)
+    reachable = _reachable_keys(index, entries)
+    out: List[Violation] = []
+    for path, cls in index.classes:
+        out.extend(_check_class(path, cls, reachable))
+    return out
+
+
+def _check_class(
+    path: str, cls: ast.ClassDef, reachable: Set[_Key]
+) -> List[Violation]:
+    lock_fields = _lock_fields(cls)
+    by_attr: Dict[str, List[_Mutation]] = {}
+    for stmt in cls.body:
+        if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if stmt.name in _NON_CONCURRENT_METHODS:
+            continue
+        if (path, cls.name, stmt.name) not in reachable:
+            continue
+        for mut in _mutations_in(stmt, lock_fields):
+            by_attr.setdefault(mut.attr, []).append(mut)
+    out: List[Violation] = []
+    for attr, muts in sorted(by_attr.items()):
+        methods = sorted({m.method for m in muts})
+        if len(methods) < 2:
+            continue
+        common: Optional[FrozenSet[str]] = None
+        for m in muts:
+            if _WILDCARD in m.held:
+                continue  # caller-holds-lock: compatible with any lock
+            common = m.held if common is None else (common & m.held)
+        if common is None or common:
+            continue  # every path shares a lock (or all are *_locked)
+        anchor = next((m for m in muts if not m.held), muts[0])
+        out.append(
+            Violation(
+                RULE_ID, path, anchor.line, anchor.col,
+                f"self.{attr} of {cls.name} is mutated from "
+                f"{len(methods)} thread-entry-reachable methods "
+                f"({', '.join(methods)}) with no common lock held on all "
+                "mutation paths — concurrent mutation without a shared "
+                "lock is a data race",
+            )
+        )
+    return out

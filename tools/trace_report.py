@@ -425,6 +425,24 @@ def _fleet_line(snapshot: dict) -> Optional[str]:
     return "Fleet: " + "; ".join(parts)
 
 
+def _concurrency_line(snapshot: dict) -> Optional[str]:
+    """One-line concurrency-verification digest: happens-before access
+    checks the race witness performed (and access pairs it reported —
+    a nonzero report count is a FINDING, not noise) plus deterministic
+    schedules the explorer drove through the cooperative scheduler."""
+    checks = _counter_total(snapshot, "race_witness_checks_total")
+    reports = _counter_total(snapshot, "race_witness_reports_total")
+    explored = _counter_total(snapshot, "sched_schedules_explored_total")
+    if checks <= 0 and reports <= 0 and explored <= 0:
+        return None
+    parts = []
+    if checks > 0 or reports > 0:
+        parts.append(f"{checks:g} HB checks, {reports:g} racy pair(s) flagged")
+    if explored > 0:
+        parts.append(f"{explored:g} schedules explored")
+    return "Concurrency: " + "; ".join(parts)
+
+
 def _tuning_line(snapshot: dict) -> Optional[str]:
     """One-line autotuner digest: controller decisions by outcome, the live
     rung of every tuned knob, and the closed loop's own overhead."""
@@ -523,6 +541,7 @@ def render_metrics_snapshot(
         _codec_read_line(snapshot),
         _tuning_line(snapshot),
         _fleet_line(snapshot),
+        _concurrency_line(snapshot),
         _control_plane_line(snapshot, reduce_tasks=reduce_tasks),
     ):
         if line:
@@ -797,6 +816,13 @@ def _selftest() -> int:
         "14 fallback rows (50.00% vectorized)",
     ):
         assert needle in text, f"record-plane line missing {needle!r}:\n{text}"
+    # the concurrency-verification digest renders from the synthetic
+    # witness/explorer counters (7 checks / 7 reports / 7 schedules)
+    for needle in (
+        "Concurrency: 7 HB checks, 7 racy pair(s) flagged",
+        "7 schedules explored",
+    ):
+        assert needle in text, f"concurrency line missing {needle!r}:\n{text}"
     # the scan-planner digest renders from the synthetic planner counters
     # (7 segments + 7 saved GETs, 1 MiB waste over 2 MiB read = 50%)
     for needle in ("Scan planner:", "7 GETs saved", "(14 → 7)", "50.00% of bytes read"):
