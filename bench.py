@@ -3215,7 +3215,8 @@ def _tracker_probe_worker(addr, worker_idx, n_maps, n_parts, lookups, barrier):
         client.close()
 
 
-def tracker_scaling(workers=(1, 4, 8), n_maps=64, n_parts=16, lookups=1500):
+def tracker_scaling(workers=(1, 4, 8), n_maps=64, n_parts=16, lookups=1500,
+                    reps=1):
     """Control-plane scaling probe (the PR-6 acceptance gate): aggregate
     tracker-op throughput at 1/4/8 workers against ONE sharded coordinator.
     Each worker process batch-registers ``n_maps`` outputs (one RPC per
@@ -3223,58 +3224,76 @@ def tracker_scaling(workers=(1, 4, 8), n_maps=64, n_parts=16, lookups=1500):
     enumerations locally — the steady-state reduce shape where the
     coordinator is a background publisher, not a per-lookup dependency.
     ``tracker_scaling_4w`` is the number to compare against the BENCH_r05
-    ``aggregate_scaling`` 1.21 coordinator-bound baseline."""
+    ``aggregate_scaling`` 1.21 coordinator-bound baseline.
+
+    ``reps > 1`` interleaves the worker counts rep by rep (1w, 4w, 8w, 1w,
+    4w, 8w, ...) and reports the PAIRED-median ratio — each rep's multi-
+    worker wall is divided by the single-worker wall measured moments
+    earlier, so slow host-load drift cancels out of the direction numbers
+    (the autotune_matrix deflake pattern). Throughputs come from the
+    median wall per worker count."""
     import multiprocessing as mp
+    import statistics
 
     from s3shuffle_tpu.config import ShuffleConfig
     from s3shuffle_tpu.metadata.service import MetadataServer
 
     cfg = ShuffleConfig()
     ops_per_worker = n_maps + lookups
-    results = {}
-    try:
-        for w in workers:
-            server = MetadataServer(
-                shards=cfg.metadata_shards,
-                shard_endpoints=cfg.metadata_shard_endpoints,
-            ).start()
-            ctx = mp.get_context("spawn")
-            barrier = ctx.Barrier(w + 1)
-            procs = [
-                ctx.Process(
-                    target=_tracker_probe_worker,
-                    args=(list(server.address), i, n_maps, n_parts, lookups, barrier),
-                    daemon=True,
+    reps = max(1, int(reps))
+
+    def _measure(w: int) -> float:
+        server = MetadataServer(
+            shards=cfg.metadata_shards,
+            shard_endpoints=cfg.metadata_shard_endpoints,
+        ).start()
+        ctx = mp.get_context("spawn")
+        barrier = ctx.Barrier(w + 1)
+        procs = [
+            ctx.Process(
+                target=_tracker_probe_worker,
+                args=(list(server.address), i, n_maps, n_parts, lookups, barrier),
+                daemon=True,
+            )
+            for i in range(w)
+        ]
+        try:
+            for p in procs:
+                p.start()
+            barrier.wait(timeout=120)  # spawn/connect cost stays outside
+            t0 = time.perf_counter()
+            for p in procs:
+                p.join(timeout=300)
+            wall = time.perf_counter() - t0
+            if any(p.is_alive() for p in procs) or any(p.exitcode for p in procs):
+                raise RuntimeError(
+                    f"tracker probe worker failed at {w} workers "
+                    f"(exitcodes {[p.exitcode for p in procs]})"
                 )
-                for i in range(w)
-            ]
-            try:
-                for p in procs:
-                    p.start()
-                barrier.wait(timeout=120)  # spawn/connect cost stays outside
-                t0 = time.perf_counter()
-                for p in procs:
-                    p.join(timeout=300)
-                wall = time.perf_counter() - t0
-                if any(p.is_alive() for p in procs) or any(p.exitcode for p in procs):
-                    raise RuntimeError(
-                        f"tracker probe worker failed at {w} workers "
-                        f"(exitcodes {[p.exitcode for p in procs]})"
-                    )
-            finally:
-                for p in procs:
-                    if p.is_alive():
-                        p.terminate()
-                    p.join(timeout=10)
-                server.stop()
-            results[w] = (w * ops_per_worker) / max(wall, 1e-9)
+        finally:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+                p.join(timeout=10)
+            server.stop()
+        return max(wall, 1e-9)
+
+    walls = {w: [] for w in workers}
+    try:
+        for _rep in range(reps):
+            for w in workers:
+                walls[w].append(_measure(w))
     except Exception as e:
         return {"tracker_scaling_error": str(e)[:120]}
-    base = results[workers[0]]
+    results = {
+        w: (w * ops_per_worker) / statistics.median(walls[w]) for w in workers
+    }
+    base_w = workers[0]
     out = {
         "tracker_scaling": {
             "workers": list(workers),
             "ops_per_worker": ops_per_worker,
+            "reps": reps,
             "aggregate_ops_per_s": {str(w): round(v) for w, v in results.items()},
             "knobs": {
                 "metadata_shards": cfg.metadata_shards,
@@ -3285,9 +3304,17 @@ def tracker_scaling(workers=(1, 4, 8), n_maps=64, n_parts=16, lookups=1500):
             "baseline_aggregate_scaling_r05": 1.21,
         },
     }
-    for w, v in results.items():
-        if w != workers[0]:
-            out[f"tracker_scaling_{w}w"] = round(v / base, 2)
+    for w in workers:
+        if w == base_w:
+            continue
+        # paired per-rep ratios: multi-worker aggregate over the single-
+        # worker aggregate from the SAME rep
+        ratios = [
+            (w * ops_per_worker / walls[w][i])
+            / (base_w * ops_per_worker / walls[base_w][i])
+            for i in range(reps)
+        ]
+        out[f"tracker_scaling_{w}w"] = round(statistics.median(ratios), 2)
     return out
 
 

@@ -99,6 +99,20 @@ _device_lock = threading.Lock()
 _device_broken = False
 
 
+def _mesh_dispatcher():
+    """The armed multi-chip dispatcher (parallel/dispatch.py), or None for
+    single-device placement. Import kept lazy and failure-proof: the host
+    parity path must never pull accelerator plumbing in."""
+    try:
+        from s3shuffle_tpu.parallel import dispatch
+
+        return dispatch.get_dispatcher()
+    except Exception:  # noqa: BLE001 — any import/arming failure = off
+        logger.debug("mesh dispatcher unavailable; striping disabled",
+                     exc_info=True)
+        return None
+
+
 def _encode_host(chunks: np.ndarray, coefs: np.ndarray) -> np.ndarray:
     """``[G, k, L] x [m, k] -> [G, m, L]`` on the host: one vectorized
     table-lookup multiply + XOR accumulate per (i, j) coefficient."""
@@ -147,12 +161,70 @@ def _device_kernel(m: int, k: int):
     return jax.jit(kernel)
 
 
+def _encode_striped(
+    chunks: np.ndarray, coefs: np.ndarray, disp
+) -> np.ndarray:
+    """Cross-chip parity placement: split the group axis into one slice per
+    dispatcher lane and encode each slice on the least-loaded device, so
+    every chip encodes parity for its neighbors' stripe groups (the Coded
+    MapReduce placement) instead of device 0 encoding everything. A
+    single-group batch still rides the dispatcher — concurrent degraded /
+    hot-fanout reconstructions then spread across all chips. Byte-identical
+    to the unstriped kernel (pure per-group math)."""
+    import jax
+
+    from s3shuffle_tpu.coding import gf_pallas
+
+    m, k = coefs.shape
+    use_pallas = gf_pallas.supported(m, k)
+    interpret = jax.default_backend() != "tpu"
+    groups = chunks.shape[0]
+    n_lanes = max(1, min(disp.n_devices, groups))
+    bounds = np.linspace(0, groups, n_lanes + 1).astype(np.int64)
+    outs = []
+    slots = []
+    try:
+        for i in range(n_lanes):
+            lo, hi = int(bounds[i]), int(bounds[i + 1])
+            if lo == hi:
+                continue
+            slot = disp.acquire("gf_encode")
+            slots.append(slot)
+            dev = disp.device(slot)
+            if use_pallas:
+                # the constant-select Pallas kernel (no table gathers);
+                # interpret mode keeps it byte-exact off-chip
+                with jax.default_device(dev):
+                    outs.append(
+                        gf_pallas.encode_groups_pallas(
+                            chunks[lo:hi], coefs, interpret
+                        )
+                    )
+            else:
+                outs.append(
+                    _device_kernel(m, k)(
+                        jax.device_put(chunks[lo:hi], dev),
+                        jax.device_put(coefs, dev),
+                    )
+                )
+        # materialize AFTER every lane launched: the table-kernel slices run
+        # concurrently across their devices and drain in order
+        parts = [np.asarray(o) for o in outs]
+    finally:
+        for slot in slots:
+            disp.release(slot)
+    return np.concatenate(parts, axis=0)
+
+
 def _encode_device(chunks: np.ndarray, coefs: np.ndarray) -> Optional[np.ndarray]:
     global _device_broken
     if _device_broken:
         return None
     try:
         m, k = coefs.shape
+        disp = _mesh_dispatcher()
+        if disp is not None:
+            return _encode_striped(chunks, coefs, disp)
         from s3shuffle_tpu.coding import gf_pallas
 
         if gf_pallas.supported(m, k):
@@ -248,14 +320,27 @@ def recover_group(
     need = len(unknown)
     if need > len(parity_present):
         return None
+    present_pos = sorted(data_present)
+    stacked = (
+        np.stack([data_present[j] for j in present_pos])
+        if present_pos
+        else None
+    )
     for combo in combinations(sorted(parity_present), need):
         a = [[int(coefs[i][j]) for j in unknown] for i in combo]
-        b = []
-        for i in combo:
-            acc = parity_present[i].copy()
-            for j, chunk in data_present.items():
-                acc ^= gf_mul_bytes(int(coefs[i][j]), chunk)
-            b.append(acc)
+        if stacked is None:
+            b = [parity_present[i].copy() for i in combo]
+        else:
+            # the survivors' contribution to each combo parity is itself a
+            # batched GF encode over the present chunks — routed through
+            # encode_groups so big degraded reads ride the same rate-gated,
+            # dispatcher-striped kernel as the write-side parity plane
+            sub = np.array(
+                [[int(coefs[i][j]) for j in present_pos] for i in combo],
+                dtype=np.uint8,
+            )
+            contrib = encode_groups(stacked[None, :, :], sub)[0]
+            b = [parity_present[i] ^ contrib[r] for r, i in enumerate(combo)]
         sol = _gauss_solve(a, b)
         if sol is not None:
             solved = dict(zip(unknown, sol))

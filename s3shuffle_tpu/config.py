@@ -303,6 +303,15 @@ class ShuffleConfig:
     # usually comes back; the old permanent pin parked long-running workers
     # on the host forever). 0 = the legacy permanent pin.
     codec_repin_probe_s: float = 300.0
+    # --- mesh plane (TPU-first addition; the reference's only data plane is
+    # the object store) --- local devices the multi-chip execution plane may
+    # schedule across: the codec batch executors and the GF parity kernel
+    # spread fixed-shape launches over this many chips
+    # (parallel/dispatch.py, least-outstanding-work placement), and
+    # mesh-routed shuffles build their ICI mesh this wide. 0 or 1 keeps
+    # today's single-device behavior op-for-op (the coalesce_gap_bytes=0
+    # contract); widths beyond the attached device count clamp.
+    mesh_devices: int = 0
     # --- observability / trace plane (TPU-first addition; the reference's
     # quantitative story is the external jvm-profiler → InfluxDB → Grafana
     # stack, examples/README.md:54-101) ---
@@ -384,6 +393,8 @@ class ShuffleConfig:
             raise ValueError("decode_inflight_batches must be >= 0")
         if self.codec_repin_probe_s < 0:
             raise ValueError("codec_repin_probe_s must be >= 0")
+        if self.mesh_devices < 0:
+            raise ValueError("mesh_devices must be >= 0")
         if self.autotune_interval_s < 0:
             raise ValueError("autotune_interval_s must be >= 0")
         if self.columnar not in (0, 1):

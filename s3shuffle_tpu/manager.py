@@ -102,6 +102,12 @@ class ShuffleManager:
             decode_inflight_batches=cfg.decode_inflight_batches,
             repin_probe_s=cfg.codec_repin_probe_s,
         )
+        # Multi-chip execution plane (parallel/dispatch.py): arm the batch
+        # dispatcher at the configured width. 0/1 (the default) keeps every
+        # executor on today's single-device op pattern.
+        from s3shuffle_tpu.parallel import dispatch as _mesh_dispatch
+
+        _mesh_dispatch.configure(cfg.mesh_devices)
         # Autotune: hand the codec to both tuners so its live windows are
         # retuned online — the write-side CommitTuner owns
         # encode_inflight_batches (CodecOutputStream reads it at every batch

@@ -145,4 +145,10 @@ KNOWN_METRICS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "race_witness_checks_total": ("counter", ()),
     "race_witness_reports_total": ("counter", ()),
     "sched_schedules_explored_total": ("counter", ()),
+    # --- mesh plane: multi-chip dispatcher + ICI routing
+    # (parallel/dispatch.py, parallel/ici_shuffle.py) ---
+    "mesh_batches_dispatched_total": ("counter", ("device",)),
+    "mesh_dispatch_wait_seconds": ("histogram", ()),
+    "mesh_route_rows_total": ("counter", ()),
+    "mesh_device_outstanding": ("gauge", ("device",)),
 }

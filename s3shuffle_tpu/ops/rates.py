@@ -98,10 +98,20 @@ OP_METRICS: Dict[str, Tuple[Tuple[str, ...], str]] = {
     ),
 }
 
+#: nested key of the rate cache carrying per-device-class tables for a
+#: heterogeneous fleet: ``{"device_classes": {"TPU v4": {...}, ...}}`` where
+#: each subtable holds the same metric fields as the top level and OVERRIDES
+#: it for devices of that class (tools/chip_gate.py gates each class
+#: independently; parallel/dispatch.py excludes classes whose measured
+#: rates lose to the host).
+DEVICE_CLASSES_KEY = "device_classes"
+
 _lock = threading.Lock()
 _cached: Optional[Dict[str, float]] = None
+_cached_classes: Optional[Dict[str, Dict[str, float]]] = None
 _cached_key: Optional[Tuple[str, float, int]] = None  # (path, mtime, size)
 _injected: Optional[Dict[str, float]] = None
+_injected_classes: Optional[Dict[str, Dict[str, float]]] = None
 
 
 def cache_path() -> str:
@@ -117,12 +127,40 @@ def cache_path() -> str:
 
 def set_rates_for_testing(table: Optional[Dict[str, float]]) -> None:
     """Inject a rate table (None restores file-backed lookup). Tests use
-    this to prove all three dispatch regimes without touching disk."""
-    global _injected, _cached, _cached_key
+    this to prove all three dispatch regimes without touching disk. A
+    ``device_classes`` entry (nested per-class tables) is split out and
+    served by :func:`class_table`."""
+    global _injected, _injected_classes, _cached, _cached_classes, _cached_key
     with _lock:
-        _injected = dict(table) if table is not None else None
+        if table is None:
+            _injected, _injected_classes = None, None
+        else:
+            _injected = {
+                k: v for k, v in table.items() if k != DEVICE_CLASSES_KEY
+            }
+            _injected_classes = _parse_classes(table)
         _cached = None
+        _cached_classes = None
         _cached_key = None
+
+
+def _parse_classes(raw: Dict) -> Dict[str, Dict[str, float]]:
+    """The validated ``device_classes`` nesting of one raw cache dict:
+    class name -> numeric metric fields (non-numeric members dropped, like
+    the top level)."""
+    nested = raw.get(DEVICE_CLASSES_KEY)
+    if not isinstance(nested, dict):
+        return {}
+    out: Dict[str, Dict[str, float]] = {}
+    for kind, sub in nested.items():
+        if not isinstance(sub, dict):
+            continue
+        out[str(kind)] = {
+            k: float(v)
+            for k, v in sub.items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
+        }
+    return out
 
 
 def invalidate() -> None:
@@ -134,7 +172,7 @@ def invalidate() -> None:
 def snapshot() -> Dict[str, float]:
     """Numeric fields of the rate cache (injected table, else the JSON file;
     missing/corrupt file = empty). Cached per (path, mtime, size)."""
-    global _cached, _cached_key
+    global _cached, _cached_classes, _cached_key
     with _lock:
         if _injected is not None:
             return dict(_injected)
@@ -143,7 +181,7 @@ def snapshot() -> Dict[str, float]:
             st = os.stat(path)
             key = (path, st.st_mtime, st.st_size)
         except OSError:
-            _cached, _cached_key = {}, None
+            _cached, _cached_classes, _cached_key = {}, {}, None
             return {}
         if _cached is not None and _cached_key == key:
             return dict(_cached)
@@ -159,15 +197,71 @@ def snapshot() -> Dict[str, float]:
             for k, v in raw.items()
             if isinstance(v, (int, float)) and not isinstance(v, bool)
         }
+        classes = _parse_classes(raw)
     except (OSError, ValueError) as exc:
         logger.warning("unreadable rate cache %s: %s — device paths "
                        "stay host-gated", path, exc)
-        table = {}
+        table, classes = {}, {}
     with _lock:
         if _injected is not None:  # a test swapped tables mid-read
             return dict(_injected)
-        _cached, _cached_key = table, key
+        _cached, _cached_classes, _cached_key = table, classes, key
     return dict(table)
+
+
+def class_table() -> Dict[str, Dict[str, float]]:
+    """Per-device-class rate tables (``device_classes`` in the cache /
+    injected table): class name -> metric fields that OVERRIDE the
+    top-level table for devices of that class. Empty on homogeneous rigs
+    whose probes never recorded class data."""
+    with _lock:
+        if _injected is not None:
+            return {k: dict(v) for k, v in (_injected_classes or {}).items()}
+        cached = _cached_classes
+    if cached is None:
+        snapshot()  # populate the per-file cache (classes ride along)
+        with _lock:
+            cached = _cached_classes
+    return {k: dict(v) for k, v in (cached or {}).items()}
+
+
+def class_armed(op: str, device_class: str, *, forced: bool = False) -> bool:
+    """Should devices of ``device_class`` take part in ``op`` placement?
+
+    The dispatcher-side half of the heterogeneous-fleet gate
+    (parallel/dispatch.py): a class with NO class-specific probe data for
+    the op stays armed — the caller's top-level :func:`select` already
+    chose the device side, and absence of evidence must not strand a
+    homogeneous fleet. A class WITH data is armed only when its merged
+    table (top-level fields overridden by the class subtable) still beats
+    the competing host rate, so a probe that measured one slow device
+    class can never arm it just because a faster class carried the
+    top-level verdict."""
+    mode = gate_mode()
+    if mode in ("device", "off"):
+        return True
+    if mode == "host":
+        return False
+    if forced:
+        return True
+    sub = class_table().get(device_class)
+    if not sub:
+        return True
+    device_metrics, host_metric = OP_METRICS[op]
+    if not any(m in sub for m in device_metrics):
+        return True  # no class-specific evidence for this op
+    merged = {**snapshot(), **sub}
+    dev_vals = [
+        float(merged[m])
+        for m in device_metrics
+        if isinstance(merged.get(m), (int, float)) and merged[m] > 0
+    ]
+    if not dev_vals:
+        return False  # class data exists but is unusable — stay honest
+    host = merged.get(host_metric)
+    if not (isinstance(host, (int, float)) and host > 0):
+        host = DEFAULT_HOST_RATES.get(host_metric, float("inf"))
+    return max(dev_vals) > float(host)
 
 
 def rate(metric: str) -> Optional[float]:

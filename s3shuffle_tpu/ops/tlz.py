@@ -516,15 +516,29 @@ class _EncodeStaging(threading.local):
     def __init__(self) -> None:
         self.buffers: dict = {}
 
-    def get(self, rows: int, block_size: int) -> np.ndarray:
-        buf = self.buffers.get((rows, block_size))
+    def get(self, rows: int, block_size: int, slot: int = 0) -> np.ndarray:
+        """``slot`` keys one buffer per in-flight dispatch lane: with the
+        mesh dispatcher armed, up to n_devices launches of the same shape
+        are outstanding at once, and each needs its own staging buffer
+        (single-device callers always pass slot 0 — one buffer per shape,
+        exactly the old behavior)."""
+        buf = self.buffers.get((rows, block_size, slot))
         if buf is None:
             buf = np.zeros((rows, block_size), dtype=np.uint8)
-            self.buffers[(rows, block_size)] = buf
+            self.buffers[(rows, block_size, slot)] = buf
         return buf
 
 
 _staging = _EncodeStaging()
+
+
+def _mesh_dispatcher():
+    """The armed multi-chip dispatcher (parallel/dispatch.py), or None for
+    the single-device op pattern. Lazy import: the parallel package loads
+    only when a device batch actually runs."""
+    from s3shuffle_tpu.parallel import dispatch
+
+    return dispatch.get_dispatcher()
 
 
 def _assemble_from_device(bitmap, cont, split, offs, ks, lits, n_new, n_split,
@@ -629,44 +643,82 @@ def encode_batch_device(
     crc_parts: Optional[list] = [] if poly is not None else None
     import time as _time
 
-    for s in range(0, n_blocks, cap):
-        e = min(n_blocks, s + cap)
-        rows = _bucket_rows(e - s, cap)
-        if rows == e - s:
-            staged = np.frombuffer(
-                mv[s * block_size : e * block_size], dtype=np.uint8
-            ).reshape(rows, block_size)
-        else:
-            staged = _staging.get(rows, block_size)
-            flat = staged.reshape(-1)
-            used = (e - s) * block_size
-            flat[:used] = np.frombuffer(
-                mv[s * block_size : e * block_size], dtype=np.uint8
-            )
-            flat[used:] = 0  # deterministic pad rows (outputs discarded)
-        with warnings.catch_warnings():
-            # the donated staging buffer may not be aliasable on every
-            # backend (XLA:CPU uint8 staging) — jax warns per compilation;
-            # an expected no-op for OUR launch, suppressed only around it so
-            # the host application's own donation warnings stay visible
-            warnings.filterwarnings(
-                "ignore", message="Some donated buffers were not usable"
-            )
-            outs = _batch_kernel(rows, n_groups, poly, _encode_impl())(
-                jax.device_put(staged)
-            )
-        arrs = tuple(np.asarray(x) for x in outs)
+    # Multi-chip placement (parallel/dispatch.py): with the dispatcher armed
+    # each batch launches on the least-loaded device and up to n_devices
+    # launches stay in flight (per-lane staging buffers keep their host
+    # sources alive); disarmed, the window is 0 and every batch launches,
+    # drains, and assembles synchronously on the default device — the exact
+    # single-device op pattern this function always had.
+    disp = _mesh_dispatcher()
+    window = disp.max_inflight() if disp is not None else 0
+    pending: List[tuple] = []  # (launch outputs, real rows, lane)
+
+    def _drain_oldest(backpressure: bool) -> None:
+        outs, n_real, slot = pending.pop(0)
         t0 = _time.perf_counter()
-        payloads.extend(_assemble_batch(arrs[:9], e - s, n_groups))
+        arrs = tuple(np.asarray(x) for x in outs)
+        if disp is not None:
+            disp.release(slot)
+            if backpressure:
+                disp.observe_wait(_time.perf_counter() - t0)
+        t1 = _time.perf_counter()
+        payloads.extend(_assemble_batch(arrs[:9], n_real, n_groups))
         if timings is not None:
             timings["assembly_s"] = (
-                timings.get("assembly_s", 0.0) + _time.perf_counter() - t0
+                timings.get("assembly_s", 0.0) + _time.perf_counter() - t1
             )
         if crc_parts is not None:
             crc_parts.append(
-                (arrs[9][: e - s], arrs[10][: e - s],
-                 arrs[8][: e - s], arrs[7][: e - s])
+                (arrs[9][:n_real], arrs[10][:n_real],
+                 arrs[8][:n_real], arrs[7][:n_real])
             )
+
+    try:
+        for s in range(0, n_blocks, cap):
+            e = min(n_blocks, s + cap)
+            rows = _bucket_rows(e - s, cap)
+            slot = disp.acquire("encode") if disp is not None else 0
+            while any(p[2] == slot for p in pending):
+                # the lane's previous launch may still be reading its
+                # device_put-aliased staging plane — drain until the lane is
+                # free before restaging on it
+                _drain_oldest(True)
+            if rows == e - s:
+                staged = np.frombuffer(
+                    mv[s * block_size : e * block_size], dtype=np.uint8
+                ).reshape(rows, block_size)
+            else:
+                staged = _staging.get(rows, block_size, slot)
+                flat = staged.reshape(-1)
+                used = (e - s) * block_size
+                flat[:used] = np.frombuffer(
+                    mv[s * block_size : e * block_size], dtype=np.uint8
+                )
+                flat[used:] = 0  # deterministic pad rows (outputs discarded)
+            with warnings.catch_warnings():
+                # the donated staging buffer may not be aliasable on every
+                # backend (XLA:CPU uint8 staging) — jax warns per
+                # compilation; an expected no-op for OUR launch, suppressed
+                # only around it so the host application's own donation
+                # warnings stay visible
+                warnings.filterwarnings(
+                    "ignore", message="Some donated buffers were not usable"
+                )
+                outs = _batch_kernel(rows, n_groups, poly, _encode_impl())(
+                    jax.device_put(staged, disp.device(slot))
+                    if disp is not None
+                    else jax.device_put(staged)
+                )
+            pending.append((outs, e - s, slot))
+            while len(pending) > window:
+                _drain_oldest(True)
+        while pending:
+            _drain_oldest(False)
+    except BaseException:
+        if disp is not None:
+            for _outs, _n, slot in pending:
+                disp.release(slot)
+        raise
     if crc_parts is None:
         return payloads, None
     from s3shuffle_tpu.ops.checksum import zero_run_crcs
@@ -1410,8 +1462,11 @@ class _DecodeStaging(threading.local):
     def __init__(self) -> None:
         self.buffers: dict = {}
 
-    def get(self, rows: int, n_groups: int) -> tuple:
-        arrs = self.buffers.get((rows, n_groups))
+    def get(self, rows: int, n_groups: int, slot: int = 0) -> tuple:
+        """``slot`` keys one plane set per in-flight dispatch lane (see
+        :meth:`_EncodeStaging.get`); single-device callers pass slot 0 and
+        keep exactly one set per shape."""
+        arrs = self.buffers.get((rows, n_groups, slot))
         if arrs is None:
             arrs = (
                 np.zeros((rows, n_groups), dtype=bool),
@@ -1422,7 +1477,7 @@ class _DecodeStaging(threading.local):
                 np.zeros((rows, n_groups, GROUP), dtype=np.uint8),
                 np.zeros(rows, dtype=np.int32),  # n_lits per row
             )
-            self.buffers[(rows, n_groups)] = arrs
+            self.buffers[(rows, n_groups, slot)] = arrs
         return arrs
 
 
@@ -1603,79 +1658,120 @@ def decode_batch_device(
 
         zero = zero_run_crcs(poly, n_groups * GROUP)
     jax = _jax()[0]
-    for s in range(0, b, cap):
-        e = min(b, s + cap)
+    # Multi-chip placement mirror of encode_batch_device: armed, each parsed
+    # chunk launches on the least-loaded device with per-lane staging planes
+    # and up to n_devices launches in flight; disarmed, window 0 keeps the
+    # launch→drain→emit sequence synchronous on the default device.
+    disp = _mesh_dispatcher()
+    window = disp.max_inflight() if disp is not None else 0
+    pending: List[tuple] = []  # (launch outputs, parsed rows, start, lane)
+
+    def _drain_oldest(backpressure: bool) -> None:
+        outs, prows, s0, slot = pending.pop(0)
         t0 = _time.perf_counter()
-        rows, fallback = _parse_batch_v2(payloads[s:e], ulens[s:e], n_groups)
-        if timings is not None:
-            timings["parse_s"] = (
-                timings.get("parse_s", 0.0) + _time.perf_counter() - t0
-            )
-        for j in sorted(fallback):
-            out[s + j] = decode_payload_numpy(payloads[s + j], ulens[s + j])
-        if len(fallback) == e - s:  # nothing device-shaped (e.g. a reader
-            # whose block_size differs from the writer's) — skip the kernel
-            continue
-        launch_rows = _bucket_rows(e - s, cap)
-        staging = _decode_staging.get(launch_rows, n_groups)
-        is_match, is_cont, is_split, offs, ks, lits, nlits = staging
-        for arr in staging:
-            arr[...] = 0  # deterministic pad + fallback rows
-        for j in range(e - s):
-            row = rows[j]
+        if poly is None:
+            decoded = np.asarray(outs)
+            raw_crcs = None
+        else:
+            decoded = np.asarray(outs[0])
+            raw_crcs = np.asarray(outs[1])
+        if disp is not None:
+            disp.release(slot)
+            if backpressure:
+                disp.observe_wait(_time.perf_counter() - t0)
+        for j, row in enumerate(prows):
             if row is None:
                 continue
-            m, c, sp, dist_vals, kv, l, nl, _lit_off = row
-            is_match[j] = m
-            is_cont[j] = c
-            is_split[j] = sp
-            offs[j, : len(dist_vals)] = dist_vals
-            ks[j, : len(kv)] = kv
-            lits[j, :nl] = l.reshape(nl, GROUP)
-            nlits[j] = nl
-        with warnings.catch_warnings():
-            # donated staging may not be aliasable on every backend
-            # (XLA:CPU bool/uint8 staging) — an expected no-op for OUR
-            # launch; suppressed only around it (see encode_batch_device)
-            warnings.filterwarnings(
-                "ignore", message="Some donated buffers were not usable"
-            )
-            if poly is None:
-                decoded = np.asarray(
-                    _decode_batch_kernel(launch_rows, n_groups, None)(
-                        jax.device_put(is_match), jax.device_put(is_cont),
-                        jax.device_put(is_split), jax.device_put(offs),
-                        jax.device_put(ks), jax.device_put(lits),
-                    )
-                )
-                raw_crcs = None
-            else:
-                dec, raw = _decode_batch_kernel(
-                    launch_rows, n_groups, poly, _decode_fused_impl()
-                )(
-                    jax.device_put(is_match), jax.device_put(is_cont),
-                    jax.device_put(is_split), jax.device_put(offs),
-                    jax.device_put(ks), jax.device_put(lits),
-                    jax.device_put(nlits),
-                )
-                decoded = np.asarray(dec)
-                raw_crcs = np.asarray(raw)
-        for j in range(e - s):
-            row = rows[j]
-            if row is None:
-                continue
-            out[s + j] = decoded[j, : ulens[s + j]].tobytes()
+            out[s0 + j] = decoded[j, : ulens[s0 + j]].tobytes()
             if raw_crcs is not None:
                 nl, lit_off = row[6], row[7]
                 lit_len = nl * GROUP
-                payload = payloads[s + j]
+                payload = payloads[s0 + j]
                 # stored payload = prefix (host-hashed, small) + literal
                 # plane (CRC'd in the launch, fixed up for length)
                 lit_crc = int(raw_crcs[j]) ^ int(zero[lit_len])
-                crcs[s + j] = crc_combine(
+                crcs[s0 + j] = crc_combine(
                     host_crc(payload[: len(payload) - lit_len], poly),
                     lit_crc, lit_len, poly,
                 )
+
+    try:
+        for s in range(0, b, cap):
+            e = min(b, s + cap)
+            t0 = _time.perf_counter()
+            rows, fallback = _parse_batch_v2(
+                payloads[s:e], ulens[s:e], n_groups
+            )
+            if timings is not None:
+                timings["parse_s"] = (
+                    timings.get("parse_s", 0.0) + _time.perf_counter() - t0
+                )
+            for j in sorted(fallback):
+                out[s + j] = decode_payload_numpy(payloads[s + j], ulens[s + j])
+            if len(fallback) == e - s:  # nothing device-shaped (e.g. a reader
+                # whose block_size differs from the writer's) — skip the kernel
+                continue
+            launch_rows = _bucket_rows(e - s, cap)
+            slot = disp.acquire("decode") if disp is not None else 0
+            while any(p[3] == slot for p in pending):
+                # the lane's previous launch may still be reading its
+                # device_put-aliased staging planes — drain until the lane
+                # is free before zeroing/refilling them
+                _drain_oldest(True)
+            staging = _decode_staging.get(launch_rows, n_groups, slot)
+            is_match, is_cont, is_split, offs, ks, lits, nlits = staging
+            for arr in staging:
+                arr[...] = 0  # deterministic pad + fallback rows
+            for j in range(e - s):
+                row = rows[j]
+                if row is None:
+                    continue
+                m, c, sp, dist_vals, kv, l, nl, _lit_off = row
+                is_match[j] = m
+                is_cont[j] = c
+                is_split[j] = sp
+                offs[j, : len(dist_vals)] = dist_vals
+                ks[j, : len(kv)] = kv
+                lits[j, :nl] = l.reshape(nl, GROUP)
+                nlits[j] = nl
+            dev = disp.device(slot) if disp is not None else None
+
+            def _put(arr, dev=dev):
+                return (
+                    jax.device_put(arr, dev)
+                    if dev is not None
+                    else jax.device_put(arr)
+                )
+
+            with warnings.catch_warnings():
+                # donated staging may not be aliasable on every backend
+                # (XLA:CPU bool/uint8 staging) — an expected no-op for OUR
+                # launch; suppressed only around it (see encode_batch_device)
+                warnings.filterwarnings(
+                    "ignore", message="Some donated buffers were not usable"
+                )
+                if poly is None:
+                    outs = _decode_batch_kernel(launch_rows, n_groups, None)(
+                        _put(is_match), _put(is_cont), _put(is_split),
+                        _put(offs), _put(ks), _put(lits),
+                    )
+                else:
+                    outs = _decode_batch_kernel(
+                        launch_rows, n_groups, poly, _decode_fused_impl()
+                    )(
+                        _put(is_match), _put(is_cont), _put(is_split),
+                        _put(offs), _put(ks), _put(lits), _put(nlits),
+                    )
+            pending.append((outs, rows[: e - s], s, slot))
+            while len(pending) > window:
+                _drain_oldest(True)
+        while pending:
+            _drain_oldest(False)
+    except BaseException:
+        if disp is not None:
+            for _outs, _r, _s, slot in pending:
+                disp.release(slot)
+        raise
     return out, crcs
 
 

@@ -1,0 +1,243 @@
+"""Chip-aware codec dispatcher: spread fixed-shape device batches across
+every local chip instead of pinning them to device 0.
+
+Until this module existed the batch executors (``ops/tlz.py``
+``encode_batch_device`` / ``decode_batch_device``, ``coding/gf.py``
+``encode_groups``) placed every launch with a bare ``jax.device_put`` — the
+default device — so "bytes/sec/chip" was a single-device number no matter
+how many chips the host had. The dispatcher is the placement layer under
+those executors:
+
+- **least-outstanding-work placement**: :meth:`DeviceDispatcher.acquire`
+  picks the eligible device with the fewest launches in flight (ties go to
+  the lowest index, so a single-stream caller still walks devices
+  round-robin);
+- **per-device-class rate gate**: a heterogeneous fleet may carry probe data
+  per device class (``device_classes`` in the rate cache — ops/rates.py);
+  classes whose measured rates lose to the host for an op are excluded from
+  placement, so one slow device class can never arm itself into the batch
+  path;
+- **per-device accounting**: ``mesh_batches_dispatched_total{device}``,
+  the ``mesh_device_outstanding{device}`` gauge, and
+  ``mesh_dispatch_wait_seconds`` (time a full in-flight window spent
+  draining its oldest launch) tell an operator from metrics alone how work
+  spread across the chips.
+
+Arming follows the ``coalesce_gap_bytes=0`` contract: ``mesh_devices`` 0 or
+1 (the default) means :func:`get_dispatcher` returns None and every caller
+keeps today's single-device op pattern byte-for-byte. The knob arrives via
+``ShuffleConfig.mesh_devices`` (plumbed through :func:`configure` by the
+codec construction) or the ``S3SHUFFLE_MESH_DEVICES`` env override (the
+bench/probe path). The dispatcher never *initiates* accelerator runtime
+init: when jax has not been imported by the process yet, no device batch
+can be in flight either, so :func:`get_dispatcher` answers None without
+importing anything (the tunnel-hang policy of codec/tpu.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from s3shuffle_tpu.metrics import registry as _metrics
+
+logger = logging.getLogger("s3shuffle_tpu.parallel")
+
+_C_DISPATCHED = _metrics.REGISTRY.counter(
+    "mesh_batches_dispatched_total",
+    "Device batches placed by the mesh dispatcher, by target device "
+    "(encode/decode/GF-parity launches riding the multi-chip plane)",
+    labelnames=("device",),
+)
+_H_WAIT = _metrics.REGISTRY.histogram(
+    "mesh_dispatch_wait_seconds",
+    "Seconds a full dispatch window spent draining its oldest in-flight "
+    "launch before the next batch could be placed",
+)
+_G_OUTSTANDING = _metrics.REGISTRY.gauge(
+    "mesh_device_outstanding",
+    "Launches currently in flight per device under the mesh dispatcher",
+    labelnames=("device",),
+)
+
+#: operator/bench override for the configured width (takes precedence over
+#: :func:`configure` so a probe subprocess can arm the plane without config
+#: plumbing); unset/empty defers to the configured value.
+_MESH_ENV = "S3SHUFFLE_MESH_DEVICES"
+
+_lock = threading.Lock()
+_configured = 0
+_dispatcher: Optional["DeviceDispatcher"] = None
+_built_for: Optional[int] = None
+
+
+class DeviceDispatcher:
+    """Least-outstanding-work placement over a fixed device tuple.
+
+    Thread-safe: the per-device outstanding counters and the per-op
+    eligibility cache are only touched under ``_lock`` (the race-witness
+    dispatcher units watch both fields).
+    """
+
+    def __init__(self, devices):
+        if not devices:
+            raise ValueError("dispatcher needs at least one device")
+        self.devices = tuple(devices)
+        self._labels = tuple(
+            f"{getattr(d, 'platform', 'dev')}:{getattr(d, 'id', i)}"
+            for i, d in enumerate(self.devices)
+        )
+        self._kinds = tuple(
+            str(getattr(d, "device_kind", None)
+                or getattr(d, "platform", "unknown"))
+            for d in self.devices
+        )
+        self._lock = threading.Lock()
+        self._outstanding: List[int] = [0] * len(self.devices)
+        self._eligible: Dict[str, Tuple[int, ...]] = {}
+
+    # ------------------------------------------------------------------
+    @property
+    def n_devices(self) -> int:
+        return len(self.devices)
+
+    def device(self, idx: int):
+        return self.devices[idx]
+
+    def label(self, idx: int) -> str:
+        return self._labels[idx]
+
+    def max_inflight(self) -> int:
+        """Launches a caller should keep in flight before draining — one
+        per device keeps every chip busy without unbounded staging memory."""
+        return len(self.devices)
+
+    # ------------------------------------------------------------------
+    def _eligible_for(self, op: str) -> Tuple[int, ...]:
+        """Device indices whose device CLASS the measured-rate table arms
+        for ``op`` (computed once per op; callers hold ``_lock``). A class
+        with no class-specific probe data stays eligible — the caller's
+        top-level rate gate already chose the device side. If every class
+        is gated out, all devices stay eligible rather than stranding the
+        launch (the top-level verdict wins)."""
+        cached = self._eligible.get(op)
+        if cached is not None:
+            return cached
+        from s3shuffle_tpu.ops import rates
+
+        armed = {kind: rates.class_armed(op, kind) for kind in set(self._kinds)}
+        eligible = tuple(
+            i for i, kind in enumerate(self._kinds) if armed[kind]
+        ) or tuple(range(len(self.devices)))
+        if len(eligible) < len(self.devices):
+            gated = sorted(k for k, ok in armed.items() if not ok)
+            logger.info(
+                "mesh dispatcher: device class(es) %s rate-gated out of %s "
+                "placement", ", ".join(gated), op,
+            )
+        self._eligible[op] = eligible
+        return eligible
+
+    def acquire(self, op: str = "encode") -> int:
+        """Pick the eligible device with the fewest launches in flight and
+        claim one slot on it. Returns the device index (pair every acquire
+        with a :meth:`release`)."""
+        with self._lock:
+            eligible = self._eligible_for(op)
+            idx = min(eligible, key=lambda i: (self._outstanding[i], i))
+            self._outstanding[idx] += 1
+            now = self._outstanding[idx]
+        if _metrics.enabled():
+            _C_DISPATCHED.labels(device=self._labels[idx]).inc()
+            _G_OUTSTANDING.labels(device=self._labels[idx]).set(now)
+        return idx
+
+    def release(self, idx: int) -> None:
+        with self._lock:
+            self._outstanding[idx] -= 1
+            now = self._outstanding[idx]
+        if _metrics.enabled():
+            _G_OUTSTANDING.labels(device=self._labels[idx]).set(now)
+
+    def observe_wait(self, seconds: float) -> None:
+        """Record one full-window drain wait (the dispatcher's only source
+        of backpressure latency)."""
+        if _metrics.enabled():
+            _H_WAIT.observe(seconds)
+
+    def outstanding_snapshot(self) -> List[int]:
+        with self._lock:
+            return list(self._outstanding)
+
+
+# ---------------------------------------------------------------------------
+# Module-level arming (config plumbing + env override)
+# ---------------------------------------------------------------------------
+
+
+def configure(mesh_devices: int) -> None:
+    """Record the configured plane width (``ShuffleConfig.mesh_devices``).
+    0/1 disarms: :func:`get_dispatcher` answers None and every executor
+    keeps the single-device path op-for-op."""
+    global _configured, _dispatcher, _built_for
+    with _lock:
+        width = max(0, int(mesh_devices))
+        if width != _configured:
+            _configured = width
+            _dispatcher, _built_for = None, None
+
+
+def requested_devices() -> int:
+    """Effective requested width: the env override when set, else the
+    configured value."""
+    raw = os.environ.get(_MESH_ENV, "").strip()
+    if raw:
+        try:
+            return max(0, int(raw))
+        except ValueError:
+            logger.warning("ignoring malformed %s=%r", _MESH_ENV, raw)
+    return _configured
+
+
+def reset_for_testing() -> None:
+    """Drop the armed width and any built dispatcher."""
+    global _configured, _dispatcher, _built_for
+    with _lock:
+        _configured = 0
+        _dispatcher, _built_for = None, None
+
+
+def get_dispatcher() -> Optional[DeviceDispatcher]:
+    """The armed dispatcher, or None when the plane is off.
+
+    None when the effective width is <= 1 (the op-for-op contract), when
+    jax was never imported by this process (no device batch can exist, and
+    the dispatcher must not be the thing that triggers a hanging backend
+    init), or when the host exposes fewer than two local devices."""
+    n = requested_devices()
+    if n <= 1:
+        return None
+    global _dispatcher, _built_for
+    with _lock:
+        if _dispatcher is not None and _built_for == n:
+            return _dispatcher
+    if "jax" not in sys.modules:
+        return None
+    try:
+        import jax
+
+        devices = list(jax.local_devices())
+    except Exception:  # noqa: BLE001 — backend init failure = plane off
+        logger.warning("mesh dispatcher: device enumeration failed, "
+                       "staying single-device", exc_info=True)
+        return None
+    if len(devices) < 2:
+        return None
+    built = DeviceDispatcher(devices[:n] if n < len(devices) else devices)
+    with _lock:
+        if _dispatcher is None or _built_for != n:
+            _dispatcher, _built_for = built, n
+        return _dispatcher

@@ -26,11 +26,21 @@ host/store path (the default `ShuffleContext.run_shuffle`).
 
 from __future__ import annotations
 
+import logging
 from typing import List, Sequence, Tuple
 
 import numpy as np
 
+from s3shuffle_tpu.metrics import registry as _metrics
 from s3shuffle_tpu.parallel.repartition import device_repartition, plan_capacity
+
+logger = logging.getLogger("s3shuffle_tpu.parallel")
+
+_C_ROUTED = _metrics.REGISTRY.counter(
+    "mesh_route_rows_total",
+    "Real rows routed to their owner devices over the ICI mesh (padding "
+    "rows excluded)",
+)
 
 #: leading row byte: 1 = real row, 0 = padding (dropped by receivers)
 _FLAG_BYTES = 1
@@ -155,4 +165,83 @@ def mesh_shuffle_to_store(
         except BaseException:
             writer.stop(success=False)
             raise
+    if _metrics.enabled():
+        _C_ROUTED.inc(sum(rows_per_device))
     return handle, rows_per_device
+
+
+def mesh_shuffle_or_fallback(
+    mesh,
+    batches: Sequence,
+    manager,
+    partitioner,
+    key_bytes: int,
+    value_bytes: int,
+    shuffle_id: int | None = None,
+    axis: str = "data",
+    capacity: int | None = None,
+) -> Tuple[object, List[int], bool]:
+    """`mesh_shuffle_to_store` with the fixed-shape contract made explicit:
+    ragged inputs (the ValueError raised by `batch_to_rows`) fall back to
+    the ordinary host/store path — one writer per input batch, no mesh
+    leg — instead of failing the job. Skew beyond `plan_capacity`'s slack
+    (the repartition-overflow ValueError) retries ONCE at the guaranteed
+    per-peer bound — a sender's whole padded lane, the most any single peer
+    can receive from it — before the job would fail; a caller-pinned
+    ``capacity`` opts out of the retry and sees the overflow raw.
+
+    Returns ``(handle, rows_per_device, used_mesh)``; on fallback
+    ``rows_per_device`` holds per-map-output row counts from the host path.
+    """
+    attempts = [capacity]
+    if capacity is None:
+        attempts.append(max((int(b.n) for b in batches), default=1) or 1)
+    for i, cap in enumerate(attempts):
+        try:
+            handle, per_dev = mesh_shuffle_to_store(
+                mesh,
+                batches,
+                manager,
+                partitioner,
+                key_bytes,
+                value_bytes,
+                shuffle_id=shuffle_id,
+                axis=axis,
+                capacity=cap,
+            )
+            return handle, per_dev, True
+        except ValueError as exc:
+            msg = str(exc)
+            if "repartition overflow" in msg and i + 1 < len(attempts):
+                logger.warning(
+                    "mesh route skewed past planned capacity (%s); retrying "
+                    "at the guaranteed per-peer bound %d rows",
+                    exc,
+                    attempts[i + 1],
+                )
+                continue
+            if "uniform key/value widths" not in msg:
+                raise
+            logger.warning(
+                "mesh route declined (%s); falling back to host path", exc
+            )
+            break
+
+    from s3shuffle_tpu.dependency import ShuffleDependency
+
+    dep = ShuffleDependency(
+        shuffle_id=shuffle_id if shuffle_id is not None else 0,
+        partitioner=partitioner,
+    )
+    handle = manager.register_shuffle(dep.shuffle_id, dep)
+    rows_per_map: List[int] = []
+    for d, batch in enumerate(batches):
+        writer = manager.get_writer(handle, map_id=d)
+        try:
+            writer.write(batch)
+            writer.stop(success=True)
+        except BaseException:
+            writer.stop(success=False)
+            raise
+        rows_per_map.append(int(batch.n))
+    return handle, rows_per_map, False

@@ -94,8 +94,11 @@ def device_repartition(mesh, rows, part_ids, axis: str = "data", capacity: int |
     """
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from s3shuffle_tpu.parallel.mesh import get_shard_map
+
+    shard_map = get_shard_map()
 
     n_dev = mesh.shape[axis]
     n, row_bytes = rows.shape

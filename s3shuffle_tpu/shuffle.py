@@ -203,6 +203,109 @@ class ShuffleContext:
         )
 
     # ------------------------------------------------------------------
+    def mesh_shuffle(
+        self,
+        input_batches: Sequence[Any],
+        num_output_partitions: Optional[int] = None,
+        partitioner: Optional[Partitioner] = None,
+        cleanup: bool = True,
+    ) -> Tuple[List[List[Tuple[bytes, bytes]]], bool]:
+        """Columnar shuffle that rides the multi-chip plane when it is armed.
+
+        ``input_batches`` is one RecordBatch per map task. With
+        ``mesh_devices >= 2`` (and that many local devices) and uniform
+        key/value widths, rows route to their owner devices over ICI
+        (``parallel/ici_shuffle.py``) and each device commits its partitions
+        through the write plane. Ragged widths, skewed shapes, or a disarmed
+        plane (``mesh_devices`` 0/1 — the default) take the ordinary
+        host/store path: one writer per input batch, op-for-op what
+        `run_shuffle`'s map tasks issue today.
+
+        Returns ``(partitions, used_mesh)`` — materialized output partitions
+        as lists of ``(key, value)`` tuples plus which path committed them.
+        """
+        from s3shuffle_tpu.batch import RecordBatch
+        from s3shuffle_tpu.parallel import dispatch as _mesh_dispatch
+
+        if partitioner is None:
+            if num_output_partitions is None:
+                raise ValueError("need num_output_partitions or partitioner")
+            partitioner = HashPartitioner(num_output_partitions)
+        shuffle_id = next(self._next_shuffle_id)
+
+        width = 0
+        requested = _mesh_dispatch.requested_devices()
+        if requested >= 2:
+            try:
+                import jax
+
+                width = min(requested, len(jax.local_devices()))
+            except Exception:  # noqa: BLE001 — backend init failure = host path
+                logger.warning(
+                    "mesh plane requested but device enumeration failed; "
+                    "using the host path", exc_info=True,
+                )
+                width = 0
+
+        handle = None
+        used_mesh = False
+        if width >= 2:
+            widths = _uniform_widths(input_batches)
+            if widths is None:
+                logger.warning(
+                    "mesh route declined (ragged key/value widths); "
+                    "falling back to host path"
+                )
+            else:
+                import jax
+
+                from s3shuffle_tpu.parallel.ici_shuffle import (
+                    mesh_shuffle_or_fallback,
+                )
+                from s3shuffle_tpu.parallel.mesh import make_mesh
+
+                mesh = make_mesh(
+                    {"data": width}, devices=jax.local_devices()[:width]
+                )
+                # one lane per device: round-robin the map batches onto lanes
+                lanes = [
+                    RecordBatch.concat(
+                        [b for i, b in enumerate(input_batches) if i % width == d]
+                        or [RecordBatch.empty()]
+                    )
+                    for d in range(width)
+                ]
+                handle, _per_dev, used_mesh = mesh_shuffle_or_fallback(
+                    mesh,
+                    lanes,
+                    self.manager,
+                    partitioner,
+                    widths[0],
+                    widths[1],
+                    shuffle_id=shuffle_id,
+                )
+
+        if handle is None:
+            dep = ShuffleDependency(shuffle_id=shuffle_id, partitioner=partitioner)
+            handle = self.manager.register_shuffle(shuffle_id, dep)
+            for map_id, batch in enumerate(input_batches):
+                writer = self.manager.get_writer(handle, map_id)
+                try:
+                    writer.write(batch)
+                    writer.stop(success=True)
+                except BaseException:
+                    writer.stop(success=False)
+                    raise
+
+        outputs: List[List[Tuple[bytes, bytes]]] = []
+        for p in range(partitioner.num_partitions):
+            reader = self.manager.get_reader(handle, p, p + 1)
+            outputs.append(list(reader.read()))
+        if cleanup:
+            self.manager.unregister_shuffle(shuffle_id)
+        return outputs, used_mesh
+
+    # ------------------------------------------------------------------
     def stop(self) -> None:
         self.manager.stop()
 
@@ -211,3 +314,22 @@ class ShuffleContext:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+
+def _uniform_widths(batches: Sequence[Any]) -> Optional[Tuple[int, int]]:
+    """(key_bytes, value_bytes) when every record across ``batches`` shares
+    one fixed key width and one fixed value width — the mesh route's
+    static-shape contract — else None."""
+    kw = vw = None
+    for b in batches:
+        if b.n == 0:
+            continue
+        if not (b.klens == b.klens[0]).all() or not (b.vlens == b.vlens[0]).all():
+            return None
+        if kw is None:
+            kw, vw = int(b.klens[0]), int(b.vlens[0])
+        elif (int(b.klens[0]), int(b.vlens[0])) != (kw, vw):
+            return None
+    if kw is None:
+        return None
+    return kw, vw

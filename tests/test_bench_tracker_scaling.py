@@ -3,21 +3,31 @@ against a real sharded coordinator, record aggregate throughput per worker
 count plus the knob fields that make BENCH rounds comparable, and show the
 multi-worker aggregate above the single-worker one (the full-size bench run
 compares `tracker_scaling_4w` against the BENCH_r05 coordinator-bound
-`aggregate_scaling` 1.21 baseline)."""
+`aggregate_scaling` 1.21 baseline).
+
+The direction check is deflaked for real (PR-20): it asserts the PAIRED-
+median ratio over interleaved reps — each rep measures 1w then 2w back to
+back, so slow host-load drift divides out — and it only runs where the
+claim can physically hold (two workers cannot beat one on a single-core
+host, where the steady-state lookup serving is CPU-bound).
+"""
+
+import os
+
+import pytest
 
 import bench
 
 
-def test_tracker_scaling_probe_records_and_scales():
-    # enough per-worker work that the measured wall dominates barrier/join
-    # scheduling noise (a few-ms wall made the direction check flaky);
-    # best-of-two attempts for the scaling direction on loaded CI hosts
-    out = bench.tracker_scaling(workers=(1, 2), n_maps=32, n_parts=8, lookups=12000)
+def test_tracker_scaling_probe_records_fields():
+    out = bench.tracker_scaling(workers=(1, 2), n_maps=32, n_parts=8, lookups=2000)
     assert "tracker_scaling_error" not in out, out
     probe = out["tracker_scaling"]
     assert probe["workers"] == [1, 2]
+    assert probe["reps"] == 1
     assert set(probe["aggregate_ops_per_s"]) == {"1", "2"}
     assert all(v > 0 for v in probe["aggregate_ops_per_s"].values())
+    assert out["tracker_scaling_2w"] > 0
     from s3shuffle_tpu.config import ShuffleConfig
 
     cfg = ShuffleConfig()
@@ -28,13 +38,22 @@ def test_tracker_scaling_probe_records_and_scales():
         "metadata_snapshots": cfg.metadata_snapshots,
     }
     assert probe["baseline_aggregate_scaling_r05"] == 1.21
-    # direction check only at smoke size (the snapshot-served steady state
-    # is per-worker-local, so 2 workers must beat 1; the >= 1.21-at-4-workers
-    # gate is asserted on the full bench artifact)
-    scaling = out["tracker_scaling_2w"]
-    if scaling <= 1.0:  # one retry: a loaded host can starve one attempt
-        retry = bench.tracker_scaling(
-            workers=(1, 2), n_maps=32, n_parts=8, lookups=12000
-        )
-        scaling = max(scaling, retry.get("tracker_scaling_2w", 0.0))
-    assert scaling > 1.0, (scaling, out)
+
+
+@pytest.mark.skipif(
+    (os.cpu_count() or 1) < 2,
+    reason="2 workers cannot out-aggregate 1 on a single-core host",
+)
+def test_tracker_scaling_direction_paired_median():
+    # interleaved reps + paired-median ratio: each rep's 2-worker wall is
+    # paired with the 1-worker wall measured moments earlier, so load drift
+    # on a busy CI host cancels instead of flipping the direction check
+    out = bench.tracker_scaling(
+        workers=(1, 2), n_maps=32, n_parts=8, lookups=8000, reps=3
+    )
+    assert "tracker_scaling_error" not in out, out
+    assert out["tracker_scaling"]["reps"] == 3
+    # the snapshot-served steady state is per-worker-local, so 2 workers
+    # must beat 1; the >= 1.21-at-4-workers gate is asserted on the full
+    # bench artifact
+    assert out["tracker_scaling_2w"] > 1.0, out

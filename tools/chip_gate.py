@@ -97,34 +97,67 @@ def _default_host_rates() -> Dict[str, float]:
 
 def evaluate(table: Dict) -> Tuple[list, int, int]:
     """Gate one rate table. Returns (rows, n_failures, n_nodata) with each
-    row ``(metric, measured, target, verdict)`` already formatted."""
+    row ``(metric, measured, target, verdict)`` already formatted.
+
+    A cache carrying ``device_classes`` (per-device-class subtables keyed by
+    device kind — the shape ``ops/rates.py class_armed`` consults on a
+    heterogeneous fleet) gets every class's OWN measurements judged against
+    the same floors, labeled ``<kind>:<metric>``: one slow device class must
+    MISS even when the fleet's fast class carries the top-level numbers."""
+    rows, failures, nodata = _evaluate_flat(table, label="")
+    classes = table.get("device_classes")
+    if isinstance(classes, dict):
+        base = {k: v for k, v in table.items() if k != "device_classes"}
+        for kind in sorted(classes):
+            sub = classes[kind]
+            if not isinstance(sub, dict):
+                continue
+            # class fields override the top level (class_armed's merge);
+            # judge only what the class itself measured — inherited numbers
+            # were already judged above
+            crows, cf, cn = _evaluate_flat(
+                {**base, **sub}, label=f"{kind}:", only=set(sub)
+            )
+            rows.extend(crows)
+            failures += cf
+            nodata += cn
+    return rows, failures, nodata
+
+
+def _evaluate_flat(
+    table: Dict, label: str = "", only: Optional[set] = None
+) -> Tuple[list, int, int]:
     defaults = _default_host_rates()
     rows = []
     failures = 0
     nodata = 0
     for metric, host_metric, desc in FLOOR_CHECKS:
+        if only is not None and metric not in only:
+            continue
         floor = _num(table, host_metric) or defaults.get(
             host_metric, float("inf")
         )
         target = f">= {floor:.1f} ({desc})"
         dev = _num(table, metric)
         if dev is None:
-            rows.append((metric, "no data", target, "SKIP"))
+            rows.append((label + metric, "no data", target, "SKIP"))
             nodata += 1
             continue
         delta = (dev - floor) / floor * 100.0
         ok = dev >= floor
         rows.append((
-            metric, f"{dev:.1f}", target,
+            label + metric, f"{dev:.1f}", target,
             f"{'PASS' if ok else 'MISS'} ({delta:+.1f}%)",
         ))
         failures += 0 if ok else 1
     for fused_m, unfused_m, tol in FUSION_CHECKS:
+        if only is not None and fused_m not in only:
+            continue
         fused = _num(table, fused_m)
         unfused = _num(table, unfused_m)
         if fused is None or unfused is None:
             rows.append((
-                fused_m,
+                label + fused_m,
                 "no data" if fused is None else f"{fused:.1f}",
                 f"within {tol:.0%} of {unfused_m}",
                 "SKIP",
@@ -134,7 +167,7 @@ def evaluate(table: Dict) -> Tuple[list, int, int]:
         drift = fused / unfused - 1.0
         ok = abs(drift) <= tol
         rows.append((
-            fused_m, f"{fused:.1f}",
+            label + fused_m, f"{fused:.1f}",
             f"within {tol:.0%} of {unfused_m} ({unfused:.1f})",
             f"{'PASS' if ok else 'MISS'} ({drift * 100.0:+.1f}%)",
         ))
@@ -215,6 +248,32 @@ def _selftest() -> int:
     slow_host = dict(losing, host_tlz_encode_mb_s=3.0)
     _rows, failures, _n = evaluate(slow_host)
     assert failures == 2, failures  # encode floor now met
+
+    # 6) heterogeneous fleet: per-device-class subtables are judged against
+    #    the same floors — a slow class MISSes on its own measurements even
+    #    when the fast class's top-level numbers all pass, and class rows
+    #    carry the kind label so the verdict names the offender
+    hetero = dict(
+        winning,
+        device_classes={
+            "TPU v5e": {"tpu_tlz_encode_pallas_mb_s": 700.0},
+            "TPU v4": {
+                "tpu_tlz_encode_pallas_mb_s": 3.6,   # below host C floor
+                "tpu_tlz_decode_fused_mb_s": 51.2,   # 20x under unfused
+            },
+        },
+    )
+    rows, failures, nodata = evaluate(hetero)
+    assert failures == 2, (failures, rows)
+    table = render(rows)
+    assert "TPU v4:tpu_tlz_encode_pallas_mb_s" in table, table
+    assert "TPU v4:tpu_tlz_decode_fused_mb_s" in table, table
+    v5e_rows = [r for r in rows if r[0].startswith("TPU v5e:")]
+    assert len(v5e_rows) == 1 and "PASS" in v5e_rows[0][3], v5e_rows
+    # a class measuring nothing contributes no rows (inherited top-level
+    # numbers were already judged once)
+    rows2, f2, n2 = evaluate(dict(winning, device_classes={"TPU v5e": {}}))
+    assert f2 == 0 and len(rows2) == len(evaluate(winning)[0]), rows2
 
     print("chip_gate selftest: OK")
     return 0
